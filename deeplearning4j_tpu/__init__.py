@@ -1,0 +1,23 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capabilities of deeplearning4j (reference: yangkf1985/deeplearning4j).
+
+Not a port: the reference's per-op JNI dispatch into CUDA kernels
+(SURVEY.md §3.3) is replaced by whole-step XLA compilation — layers are
+pure-function emitters, training steps are jitted with donated device-resident
+parameters, and distributed sync is in-step XLA collectives over an ICI mesh
+instead of the Aeron parameter server (SURVEY.md §2.6).
+
+Capability map (reference layer -> this package):
+  ND4J INDArray / Nd4j factory       -> deeplearning4j_tpu.ndarray
+  SameDiff graph autodiff            -> deeplearning4j_tpu.autodiff
+  NeuralNetConfiguration / networks  -> deeplearning4j_tpu.nn
+  DataVec ETL                        -> deeplearning4j_tpu.datasets
+  ParallelWrapper / Spark scale-out  -> deeplearning4j_tpu.parallel
+  Model zoo                          -> deeplearning4j_tpu.models
+  Evaluation                         -> deeplearning4j_tpu.evaluation
+  ModelSerializer / listeners / etc. -> deeplearning4j_tpu.utils
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ndarray import Nd4j, INDArray  # noqa: F401

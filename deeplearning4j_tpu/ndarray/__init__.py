@@ -1,0 +1,16 @@
+"""ND4J-capability tensor layer (reference: nd4j/nd4j-api-parent/nd4j-api,
+org.nd4j.linalg.api.ndarray.INDArray + org.nd4j.linalg.factory.Nd4j —
+SURVEY.md §2.3).
+
+TPU-first design: an :class:`INDArray` is a thin stateful handle over an
+immutable ``jax.Array`` resident on device. "In-place" ND4J ops (``addi`` …)
+rebind the handle (views write back through ``.at[]`` functional updates);
+everything lowers to XLA, so chained ops fuse instead of dispatching one
+kernel per call the way libnd4j did.
+"""
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray
+from deeplearning4j_tpu.ndarray.factory import Nd4j
+from deeplearning4j_tpu.ndarray.transforms import Transforms
+
+__all__ = ["INDArray", "Nd4j", "Transforms"]

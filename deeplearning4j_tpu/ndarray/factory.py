@@ -1,0 +1,219 @@
+"""Nd4j: static factory + exec surface (reference:
+org.nd4j.linalg.factory.Nd4j, SURVEY.md §2.3).
+
+Stateful RNG streams mirror org.nd4j.linalg.api.rng (SURVEY.md §2.3 "Random")
+but are built on jax's counter-based threefry: the stream holds a key and
+splits per draw, so draws are reproducible under setSeed yet safe to use from
+jitted code via explicit key passing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+
+
+class _RandomStream:
+    """Stateful RNG facade over jax.random (threefry counter RNG)."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def setSeed(self, seed: int):
+        self._key = jax.random.key(seed)
+
+    def nextKey(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def nextDouble(self) -> float:
+        return float(jax.random.uniform(self.nextKey(), ()))
+
+    def nextGaussian(self) -> float:
+        return float(jax.random.normal(self.nextKey(), ()))
+
+    def nextInt(self, bound: int) -> int:
+        return int(jax.random.randint(self.nextKey(), (), 0, bound))
+
+
+class Nd4j:
+    """Array factory; the capability analogue of org.nd4j.linalg.factory.Nd4j."""
+
+    _rng = _RandomStream(123)
+    default_dtype = jnp.float32
+
+    # -- rng ----------------------------------------------------------------
+    @classmethod
+    def getRandom(cls) -> _RandomStream:
+        return cls._rng
+
+    @classmethod
+    def setSeed(cls, seed: int):
+        cls._rng.setSeed(seed)
+
+    # -- creation -----------------------------------------------------------
+    @classmethod
+    def create(cls, *args, dtype=None) -> INDArray:
+        """create(data), create(data, shape), or create(*shape).
+
+        A tuple of ints (or int args) is a shape -> zeros, like ND4J
+        create(int[]); lists / ndarrays / INDArrays are data.
+        """
+        dtype = dtype or cls.default_dtype
+        first = args[0]
+        is_shape_tuple = isinstance(first, tuple) and all(
+            isinstance(x, (int, np.integer)) for x in first
+        )
+        if (
+            isinstance(first, (list, tuple, np.ndarray, INDArray, jax.Array))
+            and not is_shape_tuple
+        ):
+            data = jnp.asarray(_unwrap(first), dtype=dtype)
+            if len(args) == 2 and isinstance(args[1], (list, tuple)):
+                return INDArray(data.reshape(tuple(args[1])))
+            return INDArray(data)
+        shape = tuple(first) if is_shape_tuple and len(args) == 1 else args
+        return INDArray(jnp.zeros(tuple(int(s) for s in shape), dtype=dtype))
+
+    @classmethod
+    def zeros(cls, *shape, dtype=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.zeros(shape, dtype=dtype or cls.default_dtype))
+
+    @classmethod
+    def ones(cls, *shape, dtype=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.ones(shape, dtype=dtype or cls.default_dtype))
+
+    @classmethod
+    def zerosLike(cls, arr) -> INDArray:
+        return INDArray(jnp.zeros_like(_unwrap(arr)))
+
+    @classmethod
+    def onesLike(cls, arr) -> INDArray:
+        return INDArray(jnp.ones_like(_unwrap(arr)))
+
+    @classmethod
+    def valueArrayOf(cls, shape, value, dtype=None) -> INDArray:
+        if isinstance(shape, int):
+            shape = (shape,)
+        return INDArray(
+            jnp.full(tuple(shape), value, dtype=dtype or cls.default_dtype)
+        )
+
+    @classmethod
+    def scalar(cls, value, dtype=None) -> INDArray:
+        return INDArray(jnp.asarray(value, dtype=dtype or cls.default_dtype))
+
+    @classmethod
+    def eye(cls, n: int, dtype=None) -> INDArray:
+        return INDArray(jnp.eye(n, dtype=dtype or cls.default_dtype))
+
+    @classmethod
+    def arange(cls, *args, dtype=None) -> INDArray:
+        return INDArray(jnp.arange(*args, dtype=dtype or cls.default_dtype))
+
+    @classmethod
+    def linspace(cls, start, stop, num, dtype=None) -> INDArray:
+        return INDArray(
+            jnp.linspace(start, stop, int(num), dtype=dtype or cls.default_dtype)
+        )
+
+    @classmethod
+    def rand(cls, *shape, seed=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        key = jax.random.key(seed) if seed is not None else cls._rng.nextKey()
+        return INDArray(jax.random.uniform(key, shape, dtype=cls.default_dtype))
+
+    @classmethod
+    def randn(cls, *shape, seed=None) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        key = jax.random.key(seed) if seed is not None else cls._rng.nextKey()
+        return INDArray(jax.random.normal(key, shape, dtype=cls.default_dtype))
+
+    @classmethod
+    def randomBernoulli(cls, p: float, *shape) -> INDArray:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return INDArray(
+            jax.random.bernoulli(cls._rng.nextKey(), p, shape).astype(
+                cls.default_dtype
+            )
+        )
+
+    # -- combination --------------------------------------------------------
+    @classmethod
+    def concat(cls, dim: int, *arrs) -> INDArray:
+        return INDArray(jnp.concatenate([_unwrap(a) for a in arrs], axis=dim))
+
+    @classmethod
+    def vstack(cls, *arrs) -> INDArray:
+        return INDArray(jnp.vstack([_unwrap(a) for a in arrs]))
+
+    @classmethod
+    def hstack(cls, *arrs) -> INDArray:
+        return INDArray(jnp.hstack([_unwrap(a) for a in arrs]))
+
+    @classmethod
+    def stack(cls, dim: int, *arrs) -> INDArray:
+        return INDArray(jnp.stack([_unwrap(a) for a in arrs], axis=dim))
+
+    @classmethod
+    def pile(cls, *arrs) -> INDArray:
+        return cls.stack(0, *arrs)
+
+    @classmethod
+    def expandDims(cls, arr, dim: int) -> INDArray:
+        return INDArray(jnp.expand_dims(_unwrap(arr), dim))
+
+    @classmethod
+    def squeeze(cls, arr, dim: int) -> INDArray:
+        return INDArray(jnp.squeeze(_unwrap(arr), axis=dim))
+
+    @classmethod
+    def where(cls, cond, x, y) -> INDArray:
+        return INDArray(jnp.where(_unwrap(cond).astype(bool), _unwrap(x), _unwrap(y)))
+
+    @classmethod
+    def gemm(cls, a, b, transposeA=False, transposeB=False, alpha=1.0) -> INDArray:
+        A, B = _unwrap(a), _unwrap(b)
+        if transposeA:
+            A = A.T
+        if transposeB:
+            B = B.T
+        return INDArray(alpha * (A @ B))
+
+    @classmethod
+    def matmul(cls, a, b) -> INDArray:
+        return INDArray(_unwrap(a) @ _unwrap(b))
+
+    @classmethod
+    def diag(cls, arr) -> INDArray:
+        return INDArray(jnp.diag(_unwrap(arr)))
+
+    @classmethod
+    def sort(cls, arr, dim: int = -1, ascending: bool = True) -> INDArray:
+        s = jnp.sort(_unwrap(arr), axis=dim)
+        if not ascending:
+            s = jnp.flip(s, axis=dim)
+        return INDArray(s)
+
+    @classmethod
+    def fromNumpy(cls, arr: np.ndarray) -> INDArray:
+        return INDArray(jnp.asarray(arr))
+
+    # -- npy serde (reference: Nd4j.writeNpy / nd4j-serde, SURVEY.md §2.3) --
+    @classmethod
+    def writeNpy(cls, arr, path: str):
+        np.save(path, np.asarray(_unwrap(arr)), allow_pickle=False)
+
+    @classmethod
+    def readNpy(cls, path: str) -> INDArray:
+        return INDArray(jnp.asarray(np.load(path, allow_pickle=False)))

@@ -1,0 +1,446 @@
+"""INDArray: the ND4J tensor API re-expressed over jax.numpy.
+
+Reference capability surface: org.nd4j.linalg.api.ndarray.INDArray /
+BaseNDArray (SURVEY.md §2.3 "INDArray"). Semantics preserved: dtypes, views
+with write-back, broadcasting, dup/assign, i-suffixed in-place ops, dimension
+reductions. Execution model NOT preserved: ops build jax expressions that XLA
+fuses, instead of one JNI->kernel dispatch per op (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _unwrap(x):
+    if isinstance(x, INDArray):
+        return x.jax()
+    return x
+
+
+def _coerce(x):
+    """Like _unwrap but always yields a jax array (accepts python lists)."""
+    return jnp.asarray(_unwrap(x))
+
+
+class INDArray:
+    """Stateful handle over an immutable jax.Array.
+
+    Views: an INDArray produced by ``get``/``getRow``/``slice_`` holds only a
+    reference to its parent plus the index expression — reads slice the
+    parent's current buffer lazily (XLA fuses the slice), and in-place
+    mutation writes back via functional ``.at[]`` updates, so aliasing is
+    two-way like libnd4j's strided views.
+    """
+
+    __slots__ = ("_data", "_parent", "_index")
+    __array_priority__ = 100  # beat numpy operator dispatch
+
+    def __init__(self, data, parent: "INDArray | None" = None, index=None):
+        self._parent = parent
+        self._index = index
+        if parent is not None:
+            self._data = None  # views read through the parent
+            return
+        if isinstance(data, INDArray):
+            data = data.jax()
+        elif isinstance(data, (list, tuple, np.ndarray, int, float, bool)):
+            data = jnp.asarray(data)
+        self._data = data
+
+    @property
+    def _arr(self) -> jax.Array:
+        if self._parent is not None:
+            return self._parent._arr[self._index]
+        return self._data
+
+    # -- raw access ---------------------------------------------------------
+    def jax(self) -> jax.Array:
+        return self._arr
+
+    def toNumpy(self) -> np.ndarray:
+        return np.asarray(self._arr)
+
+    def _set(self, new_arr) -> "INDArray":
+        """Rebind this handle; views write back through the parent chain."""
+        cur = self._arr
+        new_arr = jnp.asarray(new_arr, dtype=cur.dtype)
+        if new_arr.shape != cur.shape:
+            new_arr = jnp.broadcast_to(new_arr, cur.shape)
+        if self._parent is not None:
+            self._parent._set(self._parent._arr.at[self._index].set(new_arr))
+        else:
+            self._data = new_arr
+        return self
+
+    # -- shape / dtype ------------------------------------------------------
+    def shape(self):
+        return tuple(self._arr.shape)
+
+    def rank(self) -> int:
+        return self._arr.ndim
+
+    def length(self) -> int:
+        return int(self._arr.size)
+
+    def size(self, dim: int) -> int:
+        return int(self._arr.shape[dim])
+
+    def isVector(self) -> bool:
+        return self._arr.ndim == 1 or (
+            self._arr.ndim == 2 and 1 in self._arr.shape
+        )
+
+    def isMatrix(self) -> bool:
+        return self._arr.ndim == 2
+
+    def isScalar(self) -> bool:
+        return self._arr.ndim == 0 or self._arr.size == 1
+
+    def rows(self) -> int:
+        return int(self._arr.shape[0])
+
+    def columns(self) -> int:
+        return int(self._arr.shape[1])
+
+    def dataType(self):
+        return self._arr.dtype
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def castTo(self, dtype) -> "INDArray":
+        return INDArray(self._arr.astype(dtype))
+
+    # -- copy / assign ------------------------------------------------------
+    def dup(self) -> "INDArray":
+        return INDArray(self._arr)  # jax arrays are immutable: zero-copy dup
+
+    def assign(self, other) -> "INDArray":
+        return self._set(_unwrap(other))
+
+    def ravel(self) -> "INDArray":
+        return INDArray(self._arr.ravel())
+
+    def flatten(self) -> "INDArray":
+        return self.ravel()
+
+    def reshape(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return INDArray(self._arr.reshape(shape))
+
+    def transpose(self) -> "INDArray":
+        return INDArray(self._arr.T)
+
+    def permute(self, *axes) -> "INDArray":
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return INDArray(jnp.transpose(self._arr, axes))
+
+    def broadcast(self, *shape) -> "INDArray":
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return INDArray(jnp.broadcast_to(self._arr, shape))
+
+    def repeat(self, dim: int, times: int) -> "INDArray":
+        return INDArray(jnp.repeat(self._arr, times, axis=dim))
+
+    def tile(self, *reps) -> "INDArray":
+        return INDArray(jnp.tile(self._arr, reps))
+
+    # -- elementwise arithmetic (functional + i-suffixed in-place) ----------
+    def add(self, other) -> "INDArray":
+        return INDArray(self._arr + _unwrap(other))
+
+    def addi(self, other) -> "INDArray":
+        return self._set(self._arr + _unwrap(other))
+
+    def sub(self, other) -> "INDArray":
+        return INDArray(self._arr - _unwrap(other))
+
+    def subi(self, other) -> "INDArray":
+        return self._set(self._arr - _unwrap(other))
+
+    def rsub(self, other) -> "INDArray":
+        return INDArray(_unwrap(other) - self._arr)
+
+    def rsubi(self, other) -> "INDArray":
+        return self._set(_unwrap(other) - self._arr)
+
+    def mul(self, other) -> "INDArray":
+        return INDArray(self._arr * _unwrap(other))
+
+    def muli(self, other) -> "INDArray":
+        return self._set(self._arr * _unwrap(other))
+
+    def div(self, other) -> "INDArray":
+        return INDArray(self._arr / _unwrap(other))
+
+    def divi(self, other) -> "INDArray":
+        return self._set(self._arr / _unwrap(other))
+
+    def rdiv(self, other) -> "INDArray":
+        return INDArray(_unwrap(other) / self._arr)
+
+    def rdivi(self, other) -> "INDArray":
+        return self._set(_unwrap(other) / self._arr)
+
+    def neg(self) -> "INDArray":
+        return INDArray(-self._arr)
+
+    def negi(self) -> "INDArray":
+        return self._set(-self._arr)
+
+    def fmod(self, other) -> "INDArray":
+        return INDArray(jnp.fmod(self._arr, _unwrap(other)))
+
+    # python operators
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __neg__ = neg
+
+    def __pow__(self, p):
+        return INDArray(self._arr ** _unwrap(p))
+
+    def __matmul__(self, other):
+        return self.mmul(other)
+
+    def __eq__(self, other):  # elementwise, like ND4J eq()
+        if isinstance(other, (INDArray, np.ndarray, jax.Array, int, float, bool)):
+            return self.eq(other)
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (INDArray, np.ndarray, jax.Array, int, float, bool)):
+            return self.neq(other)
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
+    # -- broadcast-along-dimension ops (ND4J addRowVector etc.) -------------
+    def addRowVector(self, row) -> "INDArray":
+        return INDArray(self._arr + _coerce(row).reshape(1, -1))
+
+    def addiRowVector(self, row) -> "INDArray":
+        return self._set(self._arr + _coerce(row).reshape(1, -1))
+
+    def addColumnVector(self, col) -> "INDArray":
+        return INDArray(self._arr + _coerce(col).reshape(-1, 1))
+
+    def addiColumnVector(self, col) -> "INDArray":
+        return self._set(self._arr + _coerce(col).reshape(-1, 1))
+
+    def mulRowVector(self, row) -> "INDArray":
+        return INDArray(self._arr * _coerce(row).reshape(1, -1))
+
+    def mulColumnVector(self, col) -> "INDArray":
+        return INDArray(self._arr * _coerce(col).reshape(-1, 1))
+
+    def subRowVector(self, row) -> "INDArray":
+        return INDArray(self._arr - _coerce(row).reshape(1, -1))
+
+    def divRowVector(self, row) -> "INDArray":
+        return INDArray(self._arr / _coerce(row).reshape(1, -1))
+
+    # -- linalg -------------------------------------------------------------
+    def mmul(self, other) -> "INDArray":
+        # GEMM -> stablehlo.dot_general -> MXU (replaces libnd4j MmulHelper /
+        # cuBLAS routing, SURVEY.md §2.1)
+        return INDArray(self._arr @ _unwrap(other))
+
+    def mmuli(self, other) -> "INDArray":
+        return self._set(self._arr @ _unwrap(other))
+
+    def tensorMmul(self, other, axes) -> "INDArray":
+        return INDArray(jnp.tensordot(self._arr, _unwrap(other), axes=axes))
+
+    # -- reductions ---------------------------------------------------------
+    def _reduce(self, fn, dims, keep=False):
+        if not dims:
+            return INDArray(fn(self._arr))
+        axis = tuple(d if d >= 0 else d + self._arr.ndim for d in dims)
+        return INDArray(fn(self._arr, axis=axis, keepdims=keep))
+
+    def sum(self, *dims, keepDims=False) -> "INDArray":
+        return self._reduce(jnp.sum, dims, keepDims)
+
+    def mean(self, *dims, keepDims=False) -> "INDArray":
+        return self._reduce(jnp.mean, dims, keepDims)
+
+    def max(self, *dims, keepDims=False) -> "INDArray":
+        return self._reduce(jnp.max, dims, keepDims)
+
+    def min(self, *dims, keepDims=False) -> "INDArray":
+        return self._reduce(jnp.min, dims, keepDims)
+
+    def prod(self, *dims, keepDims=False) -> "INDArray":
+        return self._reduce(jnp.prod, dims, keepDims)
+
+    def std(self, *dims, keepDims=False) -> "INDArray":
+        # ND4J std is the sample (Bessel-corrected) std
+        if not dims:
+            return INDArray(jnp.std(self._arr, ddof=1))
+        axis = tuple(dims)
+        return INDArray(jnp.std(self._arr, axis=axis, ddof=1, keepdims=keepDims))
+
+    def var(self, *dims, keepDims=False) -> "INDArray":
+        if not dims:
+            return INDArray(jnp.var(self._arr, ddof=1))
+        axis = tuple(dims)
+        return INDArray(jnp.var(self._arr, axis=axis, ddof=1, keepdims=keepDims))
+
+    def norm1(self, *dims) -> "INDArray":
+        return self._reduce(lambda a, **k: jnp.sum(jnp.abs(a), **k), dims)
+
+    def norm2(self, *dims) -> "INDArray":
+        return self._reduce(
+            lambda a, **k: jnp.sqrt(jnp.sum(a * a, **k)), dims
+        )
+
+    def normmax(self, *dims) -> "INDArray":
+        return self._reduce(lambda a, **k: jnp.max(jnp.abs(a), **k), dims)
+
+    def _arg_reduce(self, fn, dims):
+        a = self._arr
+        if not dims:
+            return INDArray(fn(a))
+        if len(dims) == 1:
+            return INDArray(fn(a, axis=dims[0]))
+        # multi-dim: move reduced axes last, flatten them, index within them
+        dims = tuple(d % a.ndim for d in dims)
+        keep = tuple(i for i in range(a.ndim) if i not in dims)
+        moved = jnp.transpose(a, keep + dims)
+        flat = moved.reshape(moved.shape[: len(keep)] + (-1,))
+        return INDArray(fn(flat, axis=-1))
+
+    def argMax(self, *dims) -> "INDArray":
+        return self._arg_reduce(jnp.argmax, dims)
+
+    def argMin(self, *dims) -> "INDArray":
+        return self._arg_reduce(jnp.argmin, dims)
+
+    def cumsum(self, dim: int = 0) -> "INDArray":
+        return INDArray(jnp.cumsum(self._arr, axis=dim))
+
+    def entropy(self) -> "INDArray":
+        a = self._arr
+        return INDArray(-jnp.sum(a * jnp.log(a)))
+
+    # -- comparisons --------------------------------------------------------
+    def gt(self, other) -> "INDArray":
+        return INDArray(self._arr > _unwrap(other))
+
+    def gte(self, other) -> "INDArray":
+        return INDArray(self._arr >= _unwrap(other))
+
+    def lt(self, other) -> "INDArray":
+        return INDArray(self._arr < _unwrap(other))
+
+    def lte(self, other) -> "INDArray":
+        return INDArray(self._arr <= _unwrap(other))
+
+    def eq(self, other) -> "INDArray":
+        return INDArray(self._arr == _unwrap(other))
+
+    def neq(self, other) -> "INDArray":
+        return INDArray(self._arr != _unwrap(other))
+
+    def equalsWithEps(self, other, eps: float = 1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(jnp.shape(o)) != self.shape():
+            return False
+        return bool(jnp.all(jnp.abs(self._arr - o) < eps))
+
+    def equals(self, other) -> bool:
+        return self.equalsWithEps(other, 1e-5)
+
+    # -- indexing -----------------------------------------------------------
+    def get(self, *index) -> "INDArray":
+        """Strided view with write-back (NDArrayIndex capability)."""
+        idx = index[0] if len(index) == 1 else tuple(index)
+        return INDArray(self._arr[idx], parent=self, index=idx)
+
+    def __getitem__(self, idx):
+        return INDArray(self._arr[idx], parent=self, index=idx)
+
+    def __setitem__(self, idx, value):
+        self._set(self._arr.at[idx].set(_unwrap(value)))
+
+    def put(self, idx, value) -> "INDArray":
+        return self._set(self._arr.at[idx].set(_unwrap(value)))
+
+    def putScalar(self, idx, value) -> "INDArray":
+        # single int index is LINEAR (raveled) like ND4J putScalar(long, v),
+        # matching getDouble's read side
+        if isinstance(idx, (list, tuple)):
+            idx = tuple(idx)
+        elif self._arr.ndim > 1:
+            idx = tuple(int(i) for i in np.unravel_index(int(idx), self._arr.shape))
+        return self._set(self._arr.at[idx].set(value))
+
+    def getRow(self, i: int) -> "INDArray":
+        return INDArray(self._arr[i], parent=self, index=i)
+
+    def getColumn(self, i: int) -> "INDArray":
+        return INDArray(self._arr[:, i], parent=self, index=(slice(None), i))
+
+    def getRows(self, *rows) -> "INDArray":
+        return INDArray(self._arr[jnp.asarray(rows)])
+
+    def getColumns(self, *cols) -> "INDArray":
+        return INDArray(self._arr[:, jnp.asarray(cols)])
+
+    def putRow(self, i: int, row) -> "INDArray":
+        return self._set(self._arr.at[i].set(_unwrap(row)))
+
+    def putColumn(self, i: int, col) -> "INDArray":
+        return self._set(self._arr.at[:, i].set(_coerce(col).ravel()))
+
+    def slice_(self, i: int, dim: int = 0) -> "INDArray":
+        idx = tuple([slice(None)] * dim + [i])
+        return INDArray(self._arr[idx], parent=self, index=idx)
+
+    def getScalar(self, *idx) -> "INDArray":
+        return INDArray(self._arr[tuple(idx)])
+
+    def getDouble(self, *idx) -> float:
+        if len(idx) == 1 and self._arr.ndim > 1:
+            return float(self._arr.ravel()[idx[0]])
+        return float(self._arr[tuple(idx)] if idx else self._arr)
+
+    def getFloat(self, *idx) -> float:
+        return self.getDouble(*idx)
+
+    def getInt(self, *idx) -> int:
+        return int(self.getDouble(*idx))
+
+    # -- misc ---------------------------------------------------------------
+    def isNaN(self) -> "INDArray":
+        return INDArray(jnp.isnan(self._arr))
+
+    def isInfinite(self) -> "INDArray":
+        return INDArray(jnp.isinf(self._arr))
+
+    def replaceWhere(self, replacement, mask) -> "INDArray":
+        return self._set(
+            jnp.where(_unwrap(mask).astype(bool), _unwrap(replacement), self._arr)
+        )
+
+    def __len__(self) -> int:
+        return int(self._arr.shape[0])
+
+    def __repr__(self) -> str:
+        return f"INDArray{self.shape()}:{self._arr.dtype}\n{np.asarray(self._arr)}"
+
+    def __str__(self) -> str:
+        return str(np.asarray(self._arr))

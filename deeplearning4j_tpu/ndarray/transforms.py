@@ -1,0 +1,109 @@
+"""Transforms: elementwise math (reference: org.nd4j.linalg.ops.transforms.
+Transforms + libnd4j legacy transform loops, SURVEY.md §2.1 "Legacy op loops").
+
+Each call is a jnp expression XLA fuses into neighbors — the whole category of
+hand-enumerated transform kernels collapses into the compiler.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
+
+
+def _t(fn):
+    def wrapper(x, *args):
+        return INDArray(fn(_unwrap(x), *[_unwrap(a) for a in args]))
+
+    return wrapper
+
+
+class Transforms:
+    sigmoid = staticmethod(_t(jax.nn.sigmoid))
+    tanh = staticmethod(_t(jnp.tanh))
+    relu = staticmethod(_t(jax.nn.relu))
+    relu6 = staticmethod(_t(jax.nn.relu6))
+    leakyRelu = staticmethod(_t(lambda x, a=0.01: jax.nn.leaky_relu(x, a)))
+    elu = staticmethod(_t(jax.nn.elu))
+    selu = staticmethod(_t(jax.nn.selu))
+    gelu = staticmethod(_t(jax.nn.gelu))
+    softPlus = staticmethod(_t(jax.nn.softplus))
+    softsign = staticmethod(_t(jax.nn.soft_sign))
+    swish = staticmethod(_t(jax.nn.silu))
+    mish = staticmethod(_t(lambda x: x * jnp.tanh(jax.nn.softplus(x))))
+    hardSigmoid = staticmethod(_t(jax.nn.hard_sigmoid))
+    hardTanh = staticmethod(_t(lambda x: jnp.clip(x, -1.0, 1.0)))
+    exp = staticmethod(_t(jnp.exp))
+    log = staticmethod(_t(jnp.log))
+    log1p = staticmethod(_t(jnp.log1p))
+    sqrt = staticmethod(_t(jnp.sqrt))
+    abs = staticmethod(_t(jnp.abs))
+    sign = staticmethod(_t(jnp.sign))
+    floor = staticmethod(_t(jnp.floor))
+    ceil = staticmethod(_t(jnp.ceil))
+    round = staticmethod(_t(jnp.round))
+    sin = staticmethod(_t(jnp.sin))
+    cos = staticmethod(_t(jnp.cos))
+    tan = staticmethod(_t(jnp.tan))
+    asin = staticmethod(_t(jnp.arcsin))
+    acos = staticmethod(_t(jnp.arccos))
+    atan = staticmethod(_t(jnp.arctan))
+    sinh = staticmethod(_t(jnp.sinh))
+    cosh = staticmethod(_t(jnp.cosh))
+    pow = staticmethod(_t(jnp.power))
+    reciprocal = staticmethod(_t(lambda x: 1.0 / x))
+    square = staticmethod(_t(jnp.square))
+    cube = staticmethod(_t(lambda x: x * x * x))
+    neg = staticmethod(_t(jnp.negative))
+    max = staticmethod(_t(jnp.maximum))
+    min = staticmethod(_t(jnp.minimum))
+    clip = staticmethod(_t(jnp.clip))
+    step = staticmethod(_t(lambda x: (x > 0).astype(x.dtype)))
+    erf = staticmethod(_t(jax.scipy.special.erf))
+
+    @staticmethod
+    def softmax(x, dim: int = -1) -> INDArray:
+        return INDArray(jax.nn.softmax(_unwrap(x), axis=dim))
+
+    @staticmethod
+    def logSoftmax(x, dim: int = -1) -> INDArray:
+        return INDArray(jax.nn.log_softmax(_unwrap(x), axis=dim))
+
+    @staticmethod
+    def unitVec(x) -> INDArray:
+        a = _unwrap(x)
+        return INDArray(a / jnp.linalg.norm(a))
+
+    @staticmethod
+    def cosineSim(a, b) -> float:
+        x, y = _unwrap(a).ravel(), _unwrap(b).ravel()
+        return float(
+            jnp.dot(x, y) / (jnp.linalg.norm(x) * jnp.linalg.norm(y))
+        )
+
+    @staticmethod
+    def euclideanDistance(a, b) -> float:
+        return float(jnp.linalg.norm(_unwrap(a).ravel() - _unwrap(b).ravel()))
+
+    @staticmethod
+    def manhattanDistance(a, b) -> float:
+        return float(jnp.sum(jnp.abs(_unwrap(a).ravel() - _unwrap(b).ravel())))
+
+    @staticmethod
+    def allEuclideanDistances(a, b) -> INDArray:
+        x, y = _unwrap(a), _unwrap(b)
+        d2 = (
+            jnp.sum(x * x, 1, keepdims=True)
+            - 2.0 * x @ y.T
+            + jnp.sum(y * y, 1)[None, :]
+        )
+        return INDArray(jnp.sqrt(jnp.maximum(d2, 0.0)))
+
+    @staticmethod
+    def allCosineSimilarities(a, b) -> INDArray:
+        x, y = _unwrap(a), _unwrap(b)
+        xn = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+        yn = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+        return INDArray(xn @ yn.T)

@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-virtual-device CPU platform so mesh /
+sharding tests run without TPU hardware (SURVEY.md §4 "distributed without a
+cluster": the reference simulates multi-node in-process over Aeron loopback;
+our equivalent is XLA's forced host platform device count)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,239 @@
+"""INDArray / Nd4j / Transforms unit tests (modeled on the reference's
+libnd4j NDArrayTest*.cpp small-fixed-tensor exact/epsilon asserts,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray import Nd4j, INDArray, Transforms
+
+
+def test_create_and_shape():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.shape() == (2, 2)
+    assert a.rank() == 2
+    assert a.length() == 4
+    assert a.rows() == 2 and a.columns() == 2
+    assert a.isMatrix() and not a.isVector()
+
+
+def test_zeros_ones_eye_arange():
+    assert Nd4j.zeros(2, 3).toNumpy().sum() == 0
+    assert Nd4j.ones(4).toNumpy().sum() == 4
+    np.testing.assert_allclose(Nd4j.eye(3).toNumpy(), np.eye(3))
+    np.testing.assert_allclose(Nd4j.arange(5).toNumpy(), np.arange(5.0))
+    np.testing.assert_allclose(
+        Nd4j.linspace(0, 1, 5).toNumpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+
+
+def test_arithmetic_functional():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    b = Nd4j.create([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose(a.add(b).toNumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose(a.sub(1.0).toNumpy(), [[0, 1], [2, 3]])
+    np.testing.assert_allclose(a.mul(2.0).toNumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose(a.rsub(5.0).toNumpy(), [[4, 3], [2, 1]])
+    np.testing.assert_allclose(a.rdiv(12.0).toNumpy(), [[12, 6], [4, 3]])
+    np.testing.assert_allclose((a + b).toNumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((-a).toNumpy(), [[-1, -2], [-3, -4]])
+    # original untouched
+    np.testing.assert_allclose(a.toNumpy(), [[1, 2], [3, 4]])
+
+
+def test_inplace_ops():
+    a = Nd4j.create([1.0, 2.0, 3.0])
+    r = a.addi(1.0)
+    assert r is a
+    np.testing.assert_allclose(a.toNumpy(), [2, 3, 4])
+    a.muli(2.0).subi(1.0)
+    np.testing.assert_allclose(a.toNumpy(), [3, 5, 7])
+
+
+def test_view_writeback():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    row = a.getRow(0)
+    row.addi(10.0)
+    np.testing.assert_allclose(a.toNumpy(), [[11, 12], [3, 4]])
+    col = a.getColumn(1)
+    col.muli(0.0)
+    np.testing.assert_allclose(a.toNumpy(), [[11, 0], [3, 0]])
+
+
+def test_assign_dup():
+    a = Nd4j.create([1.0, 2.0])
+    b = a.dup()
+    b.addi(5.0)
+    np.testing.assert_allclose(a.toNumpy(), [1, 2])
+    a.assign(Nd4j.create([9.0, 9.0]))
+    np.testing.assert_allclose(a.toNumpy(), [9, 9])
+
+
+def test_mmul():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    b = Nd4j.create([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose(a.mmul(b).toNumpy(), [[19, 22], [43, 50]])
+    np.testing.assert_allclose(
+        Nd4j.gemm(a, b, transposeA=True).toNumpy(),
+        a.toNumpy().T @ b.toNumpy(),
+    )
+
+
+def test_reductions():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().getDouble() == 10.0
+    assert a.mean().getDouble() == 2.5
+    np.testing.assert_allclose(a.sum(0).toNumpy(), [4, 6])
+    np.testing.assert_allclose(a.sum(1).toNumpy(), [3, 7])
+    np.testing.assert_allclose(a.max(0).toNumpy(), [3, 4])
+    assert a.argMax(1).toNumpy().tolist() == [1, 1]
+    np.testing.assert_allclose(a.norm2().getDouble(), np.sqrt(30.0), rtol=1e-6)
+    # sample std (Bessel), matches ND4J
+    np.testing.assert_allclose(
+        a.std().getDouble(), np.std(a.toNumpy(), ddof=1), rtol=1e-6
+    )
+
+
+def test_reshape_transpose_permute():
+    a = Nd4j.arange(6).reshape(2, 3)
+    assert a.shape() == (2, 3)
+    assert a.transpose().shape() == (3, 2)
+    b = Nd4j.arange(24).reshape(2, 3, 4)
+    assert b.permute(2, 0, 1).shape() == (4, 2, 3)
+
+
+def test_row_column_broadcast():
+    a = Nd4j.zeros(2, 3)
+    r = a.addRowVector(Nd4j.create([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(r.toNumpy(), [[1, 2, 3], [1, 2, 3]])
+    c = a.addColumnVector(Nd4j.create([1.0, 2.0]))
+    np.testing.assert_allclose(c.toNumpy(), [[1, 1, 1], [2, 2, 2]])
+
+
+def test_concat_stack():
+    a, b = Nd4j.ones(2, 2), Nd4j.zeros(2, 2)
+    assert Nd4j.concat(0, a, b).shape() == (4, 2)
+    assert Nd4j.concat(1, a, b).shape() == (2, 4)
+    assert Nd4j.stack(0, a, b).shape() == (2, 2, 2)
+
+
+def test_transforms():
+    x = Nd4j.create([-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(Transforms.relu(x).toNumpy(), [0, 0, 1])
+    np.testing.assert_allclose(
+        Transforms.sigmoid(Nd4j.zeros(3)).toNumpy(), [0.5, 0.5, 0.5]
+    )
+    s = Transforms.softmax(Nd4j.create([[1.0, 1.0, 1.0]]))
+    np.testing.assert_allclose(s.toNumpy(), [[1 / 3] * 3], rtol=1e-6)
+    np.testing.assert_allclose(
+        Transforms.exp(Nd4j.zeros(2)).toNumpy(), [1, 1]
+    )
+
+
+def test_cosine_and_distance():
+    a = Nd4j.create([1.0, 0.0])
+    b = Nd4j.create([0.0, 1.0])
+    assert abs(Transforms.cosineSim(a, b)) < 1e-6
+    assert abs(Transforms.euclideanDistance(a, b) - np.sqrt(2)) < 1e-6
+
+
+def test_indexing_put():
+    a = Nd4j.zeros(3, 3)
+    a.putScalar((1, 1), 5.0)
+    assert a.getDouble(1, 1) == 5.0
+    a.putRow(0, Nd4j.create([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(a.toNumpy()[0], [1, 2, 3])
+    sub = a[0:2, 0:2]
+    assert sub.shape() == (2, 2)
+
+
+def test_comparisons_where():
+    a = Nd4j.create([1.0, 5.0, 3.0])
+    np.testing.assert_allclose(
+        a.gt(2.0).toNumpy().astype(np.float32), [0, 1, 1]
+    )
+    w = Nd4j.where(a.gt(2.0), Nd4j.zeros(3), a)
+    np.testing.assert_allclose(w.toNumpy(), [1, 0, 0])
+
+
+def test_rand_reproducible():
+    Nd4j.setSeed(42)
+    a = Nd4j.rand(3, 3)
+    Nd4j.setSeed(42)
+    b = Nd4j.rand(3, 3)
+    np.testing.assert_allclose(a.toNumpy(), b.toNumpy())
+    assert a.toNumpy().min() >= 0 and a.toNumpy().max() < 1
+
+
+def test_npy_roundtrip(tmp_path):
+    a = Nd4j.randn(4, 5)
+    p = str(tmp_path / "a.npy")
+    Nd4j.writeNpy(a, p)
+    b = Nd4j.readNpy(p)
+    np.testing.assert_allclose(a.toNumpy(), b.toNumpy())
+
+
+def test_castTo():
+    a = Nd4j.create([1.5, 2.5])
+    b = a.castTo(np.int32)
+    assert b.toNumpy().dtype == np.int32
+
+
+def test_equals():
+    a = Nd4j.create([1.0, 2.0])
+    assert a.equals(Nd4j.create([1.0, 2.0]))
+    assert not a.equals(Nd4j.create([1.0, 2.1]))
+    assert not a.equals(Nd4j.create([1.0, 2.0, 3.0]))
+
+
+# -- regression tests for review findings --------------------------------
+
+def test_view_reads_through_parent():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    row = a.getRow(0)
+    a.addi(1.0)  # parent mutates after view creation
+    np.testing.assert_allclose(row.toNumpy(), [2, 3])  # view sees it
+    row.addi(1.0)
+    np.testing.assert_allclose(a.toNumpy(), [[3, 4], [4, 5]])
+
+
+def test_putScalar_linear_index_roundtrip():
+    m = Nd4j.create([[0.0, 0.0], [0.0, 0.0]])
+    m.putScalar(3, 5.0)
+    assert m.getDouble(3) == 5.0
+    assert m.toNumpy()[1, 1] == 5.0
+
+
+def test_argmax_multi_dims():
+    a = Nd4j.arange(24).reshape(2, 3, 4)
+    r = a.argMax(1, 2)
+    assert r.shape() == (2,)
+    assert r.toNumpy().tolist() == [11, 11]  # last element of each 3x4 block
+
+
+def test_create_dispatch_variants():
+    r = Nd4j.create(Nd4j.ones(4), [2, 2])
+    assert r.shape() == (2, 2)
+    r2 = Nd4j.create((1.0, 2.0, 3.0, 4.0), [2, 2])
+    assert r2.shape() == (2, 2)
+    assert Nd4j.create((2, 3)).shape() == (2, 3)  # int tuple = shape
+    assert Nd4j.create(2, 3).shape() == (2, 3)
+
+
+def test_rowvector_accepts_list():
+    a = Nd4j.zeros(2, 3)
+    r = a.addRowVector([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(r.toNumpy(), [[1, 2, 3], [1, 2, 3]])
+    a.putColumn(0, [9.0, 9.0])
+    assert a.toNumpy()[:, 0].tolist() == [9, 9]
+
+
+def test_eq_operator_elementwise():
+    a = Nd4j.create([1.0, 2.0, 3.0])
+    b = Nd4j.create([1.0, 0.0, 3.0])
+    np.testing.assert_allclose(
+        (a == b).toNumpy().astype(np.float32), [1, 0, 1]
+    )
+    np.testing.assert_allclose(
+        (a != b).toNumpy().astype(np.float32), [0, 1, 0]
+    )
