@@ -1,0 +1,19 @@
+"""SameDiff-capability graph autodiff (reference:
+org.nd4j.autodiff.samediff.* — SURVEY.md §2.3 "SameDiff", §3.4).
+
+TPU-first inversion: the reference interprets the graph op-by-op in Java with
+per-op JNI dispatch (and per-op doDiff rules for the backward graph). Here the
+graph lowers ONCE to a pure jax function; autodiff is jax.grad of the lowered
+function (no per-op doDiff needed) and the whole train step (forward+backward+
+updater) compiles to a single XLA executable with donated parameters —
+SURVEY.md §7's "center of gravity".
+"""
+
+from deeplearning4j_tpu.autodiff.samediff import (
+    SameDiff,
+    SDVariable,
+    TrainingConfig,
+    VariableType,
+)
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "VariableType"]

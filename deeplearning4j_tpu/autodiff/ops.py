@@ -1,0 +1,887 @@
+"""Op registry: pure jnp/lax emitter functions for every SameDiff op.
+
+This is the TPU-native collapse of libnd4j's declarable-op layer
+(SURVEY.md §2.1 "Declarable (custom) ops", ~500-700 CUDA/C++ kernels in
+libnd4j/include/ops/declarable/): each entry is a pure function XLA fuses
+and differentiates, replacing {generic impl + cuda helper + cudnn platform
+helper + hand-written doDiff} per op.
+
+Conventions:
+  - fn(*inputs, **attrs) -> jnp array or tuple of arrays
+  - ops in RANDOM_OPS receive a `key=` jax PRNG key kwarg at execution
+  - ops in TRAINING_AWARE_OPS receive `training=` bool kwarg
+  - conv/pool use NCHW activations and [out, in, kH, kW] weights, matching
+    DL4J's layout (libnd4j conv2d); lowered to lax.conv_general_dilated which
+    XLA maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# elementwise / transforms
+# ---------------------------------------------------------------------------
+
+def _identity(x):
+    return x
+
+
+def _axis(dims, ndim):
+    if dims is None or dims == () or dims == []:
+        return None
+    if isinstance(dims, int):
+        dims = (dims,)
+    return tuple(d % ndim for d in dims)
+
+
+OPS = {}
+
+
+def op(name=None, random=False, training_aware=False):
+    def deco(fn):
+        OPS[name or fn.__name__] = fn
+        if random:
+            RANDOM_OPS.add(name or fn.__name__)
+        if training_aware:
+            TRAINING_AWARE_OPS.add(name or fn.__name__)
+        return fn
+
+    return deco
+
+
+RANDOM_OPS: set = set()
+TRAINING_AWARE_OPS: set = set()
+
+# binary
+OPS["add"] = lambda a, b: a + b
+OPS["sub"] = lambda a, b: a - b
+OPS["mul"] = lambda a, b: a * b
+OPS["div"] = lambda a, b: a / b
+OPS["rsub"] = lambda a, b: b - a
+OPS["rdiv"] = lambda a, b: b / a
+OPS["pow"] = lambda a, b: a**b
+OPS["floordiv"] = lambda a, b: jnp.floor_divide(a, b)
+OPS["mod"] = lambda a, b: jnp.mod(a, b)
+OPS["squaredDifference"] = lambda a, b: (a - b) ** 2
+OPS["maximum"] = jnp.maximum
+OPS["minimum"] = jnp.minimum
+
+# unary
+OPS["identity"] = _identity
+OPS["neg"] = jnp.negative
+OPS["abs"] = jnp.abs
+OPS["exp"] = jnp.exp
+OPS["log"] = jnp.log
+OPS["log1p"] = jnp.log1p
+OPS["sqrt"] = jnp.sqrt
+OPS["rsqrt"] = lax.rsqrt
+OPS["square"] = jnp.square
+OPS["reciprocal"] = jnp.reciprocal
+OPS["sign"] = jnp.sign
+OPS["floor"] = jnp.floor
+OPS["ceil"] = jnp.ceil
+OPS["round"] = jnp.round
+OPS["sin"] = jnp.sin
+OPS["cos"] = jnp.cos
+OPS["tan"] = jnp.tan
+OPS["asin"] = jnp.arcsin
+OPS["acos"] = jnp.arccos
+OPS["atan"] = jnp.arctan
+OPS["sinh"] = jnp.sinh
+OPS["cosh"] = jnp.cosh
+OPS["tanh"] = jnp.tanh
+OPS["erf"] = jax.scipy.special.erf
+OPS["isnan"] = jnp.isnan
+OPS["isinf"] = jnp.isinf
+
+# activations
+OPS["sigmoid"] = jax.nn.sigmoid
+OPS["relu"] = jax.nn.relu
+OPS["relu6"] = jax.nn.relu6
+OPS["elu"] = jax.nn.elu
+OPS["selu"] = jax.nn.selu
+OPS["gelu"] = jax.nn.gelu
+OPS["softplus"] = jax.nn.softplus
+OPS["softsign"] = jax.nn.soft_sign
+OPS["swish"] = jax.nn.silu
+OPS["mish"] = lambda x: x * jnp.tanh(jax.nn.softplus(x))
+OPS["hardSigmoid"] = jax.nn.hard_sigmoid
+OPS["hardTanh"] = lambda x: jnp.clip(x, -1.0, 1.0)
+OPS["leakyRelu"] = lambda x, alpha=0.01: jax.nn.leaky_relu(x, alpha)
+OPS["prelu"] = lambda x, a: jnp.where(x >= 0, x, a * x)
+OPS["rationalTanh"] = lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0)
+OPS["rectifiedTanh"] = lambda x: jnp.maximum(jnp.tanh(x), 0.0)
+OPS["thresholdRelu"] = lambda x, cutoff=0.0: jnp.where(x > cutoff, x, 0.0)
+OPS["clipByValue"] = lambda x, clipValueMin=-1.0, clipValueMax=1.0: jnp.clip(
+    x, clipValueMin, clipValueMax
+)
+
+
+@op("clipByNorm")
+def _clip_by_norm(x, clipValue=1.0, dims=None):
+    n = jnp.sqrt(jnp.sum(x * x, axis=_axis(dims, x.ndim), keepdims=True))
+    return jnp.where(n > clipValue, x * (clipValue / jnp.maximum(n, 1e-12)), x)
+
+
+@op("softmax")
+def _softmax(x, dimension=-1):
+    return jax.nn.softmax(x, axis=dimension)
+
+
+@op("logSoftmax")
+def _log_softmax(x, dimension=-1):
+    return jax.nn.log_softmax(x, axis=dimension)
+
+
+@op("softmaxDerivative")
+def _softmax_deriv(x, wrt, dimension=-1):
+    s = jax.nn.softmax(x, axis=dimension)
+    return s * (wrt - jnp.sum(wrt * s, axis=dimension, keepdims=True))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _red(fn):
+    def f(x, dimensions=None, keepDims=False):
+        return fn(x, axis=_axis(dimensions, x.ndim), keepdims=keepDims)
+
+    return f
+
+
+OPS["sum"] = _red(jnp.sum)
+OPS["mean"] = _red(jnp.mean)
+OPS["max"] = _red(jnp.max)
+OPS["min"] = _red(jnp.min)
+OPS["prod"] = _red(jnp.prod)
+OPS["any"] = _red(jnp.any)
+OPS["all"] = _red(jnp.all)
+OPS["norm1"] = _red(lambda x, **k: jnp.sum(jnp.abs(x), **k))
+OPS["norm2"] = _red(lambda x, **k: jnp.sqrt(jnp.sum(x * x, **k)))
+OPS["normMax"] = _red(lambda x, **k: jnp.max(jnp.abs(x), **k))
+OPS["logSumExp"] = _red(jax.scipy.special.logsumexp)
+OPS["countNonZero"] = _red(lambda x, **k: jnp.sum((x != 0), **k))
+OPS["zeroFraction"] = lambda x: jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("variance")
+def _variance(x, dimensions=None, biasCorrected=True, keepDims=False):
+    return jnp.var(
+        x, axis=_axis(dimensions, x.ndim), ddof=1 if biasCorrected else 0,
+        keepdims=keepDims,
+    )
+
+
+@op("standardDeviation")
+def _std(x, dimensions=None, biasCorrected=True, keepDims=False):
+    return jnp.std(
+        x, axis=_axis(dimensions, x.ndim), ddof=1 if biasCorrected else 0,
+        keepdims=keepDims,
+    )
+
+
+@op("argmax")
+def _argmax(x, dimension=None, keepDims=False):
+    r = jnp.argmax(x, axis=dimension, keepdims=keepDims)
+    return r
+
+
+@op("argmin")
+def _argmin(x, dimension=None, keepDims=False):
+    return jnp.argmin(x, axis=dimension, keepdims=keepDims)
+
+
+@op("cumsum")
+def _cumsum(x, axis=0, exclusive=False, reverse=False):
+    a = x
+    if reverse:
+        a = jnp.flip(a, axis)
+    r = jnp.cumsum(a, axis=axis)
+    if exclusive:
+        r = r - a
+    if reverse:
+        r = jnp.flip(r, axis)
+    return r
+
+
+@op("cumprod")
+def _cumprod(x, axis=0):
+    return jnp.cumprod(x, axis=axis)
+
+
+@op("moments")
+def _moments(x, dimensions=None, keepDims=False):
+    ax = _axis(dimensions, x.ndim)
+    return jnp.mean(x, ax, keepdims=keepDims), jnp.var(x, ax, keepdims=keepDims)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+@op("matmul")
+def _matmul(a, b, transposeA=False, transposeB=False):
+    if transposeA:
+        a = jnp.swapaxes(a, -1, -2)
+    if transposeB:
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+@op("tensorMmul")
+def _tensor_mmul(a, b, axesA=None, axesB=None):
+    return jnp.tensordot(a, b, axes=(tuple(axesA), tuple(axesB)))
+
+
+@op("batchMmul")
+def _batch_mmul(a, b):
+    return a @ b
+
+
+@op("dot")
+def _dot(a, b, dimensions=None):
+    if dimensions is None:
+        return jnp.sum(a * b)
+    return jnp.sum(a * b, axis=_axis(dimensions, a.ndim))
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+@op("reshape")
+def _reshape(x, shape=None):
+    return x.reshape(tuple(shape))
+
+
+@op("permute")
+def _permute(x, dimensions=None):
+    return jnp.transpose(x, tuple(dimensions))
+
+
+@op("transpose")
+def _transpose(x):
+    return x.T
+
+
+@op("expandDims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@op("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@op("concat")
+def _concat(*xs, dimension=0):
+    return jnp.concatenate(xs, axis=dimension)
+
+
+@op("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@op("unstack")
+def _unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis))
+
+
+@op("split")
+def _split(x, numSplit=2, dimension=0):
+    return tuple(jnp.split(x, numSplit, axis=dimension))
+
+
+@op("slice")
+def _slice(x, begin=None, size=None):
+    begin = tuple(begin)
+    size = tuple(
+        s if s >= 0 else x.shape[i] - begin[i] for i, s in enumerate(size)
+    )
+    return lax.dynamic_slice(x, begin, size)
+
+
+@op("stridedSlice")
+def _strided_slice(x, begin=None, end=None, strides=None):
+    idx = tuple(
+        slice(b, e, s) for b, e, s in zip(begin, end, strides or [1] * len(begin))
+    )
+    return x[idx]
+
+
+@op("tile")
+def _tile(x, reps=None):
+    return jnp.tile(x, tuple(reps))
+
+
+@op("pad")
+def _pad(x, paddings=None, constant=0.0, mode="CONSTANT"):
+    pads = tuple(tuple(p) for p in paddings)
+    if mode.upper() == "CONSTANT":
+        return jnp.pad(x, pads, constant_values=constant)
+    return jnp.pad(x, pads, mode=mode.lower())
+
+
+@op("reverse")
+def _reverse(x, dimensions=None):
+    return jnp.flip(x, axis=_axis(dimensions, x.ndim))
+
+
+@op("gather")
+def _gather(x, indices, axis=0):
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis)
+
+
+@op("gatherNd")
+def _gather_nd(x, indices):
+    idx = tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+@op("scatterUpdate")
+def _scatter_update(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].set(updates)
+
+
+@op("scatterAdd")
+def _scatter_add(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].add(updates)
+
+
+@op("oneHot")
+def _one_hot(x, depth=None, on=1.0, off=0.0, axis=-1):
+    return jax.nn.one_hot(x.astype(jnp.int32), depth, axis=axis) * (on - off) + off
+
+
+@op("linspace")
+def _linspace(start=0.0, stop=1.0, num=10):
+    return jnp.linspace(start, stop, num)
+
+
+@op("range")
+def _range(start=0, limit=None, delta=1):
+    return jnp.arange(start, limit, delta)
+
+
+@op("shape_of")
+def _shape_of(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+@op("cast")
+def _cast(x, dtype=None):
+    return x.astype(dtype)
+
+
+@op("assign_op")
+def _assign_op(a, b):
+    return jnp.broadcast_to(b, a.shape).astype(a.dtype)
+
+
+@op("invertPermutation")
+def _invert_permutation(x):
+    return jnp.argsort(x)
+
+
+@op("sequenceMask")
+def _sequence_mask(lengths, maxLen=None):
+    return (jnp.arange(maxLen)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+
+@op("diag")
+def _diag(x):
+    return jnp.diag(x)
+
+
+@op("eye_op")
+def _eye(n=1, m=None):
+    return jnp.eye(n, m)
+
+
+@op("meshgrid")
+def _meshgrid(*xs, indexing="xy"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+# comparisons / selection
+OPS["eq"] = lambda a, b: a == b
+OPS["neq"] = lambda a, b: a != b
+OPS["gt"] = lambda a, b: a > b
+OPS["gte"] = lambda a, b: a >= b
+OPS["lt"] = lambda a, b: a < b
+OPS["lte"] = lambda a, b: a <= b
+OPS["and_op"] = jnp.logical_and
+OPS["or_op"] = jnp.logical_or
+OPS["not_op"] = jnp.logical_not
+OPS["xor_op"] = jnp.logical_xor
+
+
+@op("where_op")
+def _where(cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@op("layerNorm")
+def _layer_norm(x, gain, bias=None, channelwise_axis=-1, epsilon=1e-5):
+    mean = jnp.mean(x, axis=channelwise_axis, keepdims=True)
+    var = jnp.var(x, axis=channelwise_axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon) * gain
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@op("batchNorm")
+def _batch_norm(x, mean, variance, gamma=None, beta=None, epsilon=1e-5,
+                axis=1):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    rs = lambda a: a.reshape(shape)
+    y = (x - rs(mean)) * lax.rsqrt(rs(variance) + epsilon)
+    if gamma is not None:
+        y = y * rs(gamma)
+    if beta is not None:
+        y = y + rs(beta)
+    return y
+
+
+@op("standardize")
+def _standardize(x, dimensions=(-1,)):
+    ax = _axis(dimensions, x.ndim)
+    m = jnp.mean(x, axis=ax, keepdims=True)
+    s = jnp.std(x, axis=ax, keepdims=True)
+    return (x - m) / jnp.maximum(s, 1e-12)
+
+
+@op("dropout", random=True, training_aware=True)
+def _dropout(x, p=0.5, key=None, training=False):
+    """p is the RETAIN probability, matching DL4J dropout semantics
+    (org.deeplearning4j.nn.conf.dropout.Dropout: activations scaled by 1/p)."""
+    if not training or p >= 1.0:
+        return x
+    mask = jax.random.bernoulli(key, p, x.shape)
+    return jnp.where(mask, x / p, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+
+@op("randomNormal", random=True)
+def _random_normal(shape=None, mean=0.0, stddev=1.0, key=None):
+    return mean + stddev * jax.random.normal(key, tuple(shape))
+
+
+@op("randomUniform", random=True)
+def _random_uniform(shape=None, min=0.0, max=1.0, key=None):
+    return jax.random.uniform(key, tuple(shape), minval=min, maxval=max)
+
+
+@op("randomBernoulli", random=True)
+def _random_bernoulli(shape=None, p=0.5, key=None):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool (NCHW, weights [out, in, kH, kW] like libnd4j conv2d)
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+def _conv_pad(padding, kernel, strides, dilation=(1, 1)):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding)
+    return [(p[0], p[0]), (p[1], p[1])]
+
+
+@op("conv2d")
+def _conv2d(x, w, b=None, kernel=None, strides=(1, 1), padding=(0, 0),
+            dilation=(1, 1), sameMode=False):
+    """x: [N,C,H,W]; w: [outC, inC, kH, kW] (DL4J layout)."""
+    strides = _pair(strides)
+    dilation = _pair(dilation)
+    pad = "SAME" if sameMode else _conv_pad(padding, kernel, strides, dilation)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+@op("depthwiseConv2d")
+def _depthwise_conv2d(x, w, b=None, strides=(1, 1), padding=(0, 0),
+                      dilation=(1, 1), sameMode=False):
+    """w: [depthMult, inC, kH, kW] -> grouped conv with C groups."""
+    strides = _pair(strides)
+    dilation = _pair(dilation)
+    c = x.shape[1]
+    mult = w.shape[0]
+    # reshape to [C*mult, 1, kH, kW] for feature_group_count=C
+    w2 = jnp.transpose(w, (1, 0, 2, 3)).reshape(c * mult, 1, *w.shape[2:])
+    pad = "SAME" if sameMode else _conv_pad(padding, None, strides, dilation)
+    y = lax.conv_general_dilated(
+        x, w2, window_strides=strides, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=c,
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+@op("conv1d")
+def _conv1d(x, w, b=None, stride=1, padding=0, sameMode=False):
+    """x: [N,C,W]; w: [outC, inC, k]."""
+    pad = "SAME" if sameMode else [(padding, padding)]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=pad,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1)
+    return y
+
+
+@op("deconv2d")
+def _deconv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), sameMode=False):
+    """Transposed conv; w: [outC, inC, kH, kW] wrt the FORWARD direction of
+    the deconv (i.e. produces outC channels)."""
+    strides = _pair(strides)
+    p = _pair(padding)
+    pad = "SAME" if sameMode else [(p[0], p[0]), (p[1], p[1])]
+    y = lax.conv_transpose(
+        x, jnp.transpose(w, (2, 3, 1, 0)), strides=strides, padding=pad,
+        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def _pool(x, kernel, strides, padding, sameMode, init, fn, norm=False):
+    kernel = _pair(kernel)
+    strides = _pair(strides)
+    p = _pair(padding)
+    if sameMode:
+        pad = "SAME"
+    else:
+        pad = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    window = (1, 1) + kernel
+    strides_full = (1, 1) + strides
+    y = lax.reduce_window(x, init, fn, window, strides_full, pad)
+    if norm:
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, pad)
+        y = y / cnt
+    return y
+
+
+@op("maxPooling2d")
+def _max_pool2d(x, kernel=(2, 2), strides=(2, 2), padding=(0, 0),
+                sameMode=False):
+    return _pool(x, kernel, strides, padding, sameMode, -jnp.inf, lax.max)
+
+
+@op("avgPooling2d")
+def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding=(0, 0),
+                sameMode=False, includePadInAvg=False):
+    if includePadInAvg:
+        k = _pair(kernel)
+        s = _pool(x, kernel, strides, padding, sameMode, 0.0, lax.add)
+        return s / (k[0] * k[1])
+    return _pool(x, kernel, strides, padding, sameMode, 0.0, lax.add, norm=True)
+
+
+@op("globalAvgPooling")
+def _global_avg_pool(x, dimensions=(2, 3)):
+    return jnp.mean(x, axis=_axis(dimensions, x.ndim))
+
+
+@op("upsampling2d")
+def _upsampling2d(x, size=(2, 2)):
+    s = _pair(size)
+    return jnp.repeat(jnp.repeat(x, s[0], axis=2), s[1], axis=3)
+
+
+@op("im2col")
+def _im2col(x, kernel=(2, 2), strides=(1, 1), padding=(0, 0)):
+    """Kept for parity with libnd4j helpers/im2col — on TPU conv doesn't go
+    through im2col (XLA handles tiling), but the op is part of the surface."""
+    k = _pair(kernel)
+    s = _pair(strides)
+    p = _pair(padding)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    n, c, h, w = xp.shape
+    oh = (h - k[0]) // s[0] + 1
+    ow = (w - k[1]) // s[1] + 1
+    idx_h = (jnp.arange(oh) * s[0])[:, None] + jnp.arange(k[0])[None, :]
+    idx_w = (jnp.arange(ow) * s[1])[:, None] + jnp.arange(k[1])[None, :]
+    cols = xp[:, :, idx_h[:, :, None, None], idx_w[None, None, :, :]]
+    # [n, c, oh, kh, ow, kw] -> [n, c, kh, kw, oh, ow]
+    return jnp.transpose(cols, (0, 1, 3, 5, 2, 4))
+
+
+# ---------------------------------------------------------------------------
+# recurrent (lstmLayer replaces libnd4j helpers/lstm + cudnn LSTM,
+# SURVEY.md §2.1; scan keeps the weights resident and lets XLA pipeline steps)
+# ---------------------------------------------------------------------------
+
+@op("lstmCell")
+def _lstm_cell(x, h_prev, c_prev, w, r, b=None, forgetBias=0.0):
+    """One LSTM step. x:[N,I], h_prev/c_prev:[N,H], w:[I,4H], r:[H,4H],
+    b:[4H]. Gate order i,f,g(cell),o — matches DL4J lstmLayer gate packing."""
+    z = x @ w + h_prev @ r
+    if b is not None:
+        z = z + b
+    hsz = h_prev.shape[-1]
+    i, f, g, o = (z[..., k * hsz:(k + 1) * hsz] for k in range(4))
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forgetBias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return h, c
+
+
+@op("lstmLayer")
+def _lstm_layer(x, w, r, b=None, h0=None, c0=None, forgetBias=0.0,
+                returnFullSequence=True):
+    """x: [N, I, T] (DL4J NCW time-series layout). Returns ([N,H,T], hT, cT).
+    lax.scan over time -> one compiled while loop on device."""
+    n, _, t = x.shape
+    hsz = r.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((n, hsz), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((n, hsz), x.dtype)
+
+    xs = jnp.moveaxis(x, 2, 0)  # [T, N, I]
+
+    def step(carry, xt):
+        h, c = carry
+        h2, c2 = _lstm_cell(xt, h, c, w, r, b, forgetBias)
+        return (h2, c2), h2
+
+    (hT, cT), hs = lax.scan(step, (h0, c0), xs)
+    out = jnp.moveaxis(hs, 0, 2)  # [N, H, T]
+    if not returnFullSequence:
+        return hT, hT, cT
+    return out, hT, cT
+
+
+@op("gruCell")
+def _gru_cell(x, h_prev, w, r, b=None):
+    """x:[N,I], h_prev:[N,H], w:[I,3H], r:[H,3H], b:[6H] (ru then c, input
+    and recurrent biases separate, like libnd4j gruCell)."""
+    hsz = h_prev.shape[-1]
+    wz = x @ w
+    rz = h_prev @ r
+    if b is not None:
+        wz = wz + b[: 3 * hsz]
+        rz = rz + b[3 * hsz:]
+    ru_w, c_w = wz[..., : 2 * hsz], wz[..., 2 * hsz:]
+    ru_r, c_r = rz[..., : 2 * hsz], rz[..., 2 * hsz:]
+    ru = jax.nn.sigmoid(ru_w + ru_r)
+    rgate, ugate = ru[..., :hsz], ru[..., hsz:]
+    cand = jnp.tanh(c_w + rgate * c_r)
+    return ugate * h_prev + (1 - ugate) * cand
+
+
+@op("gruLayer")
+def _gru_layer(x, w, r, b=None, h0=None):
+    n, _, t = x.shape
+    hsz = r.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((n, hsz), x.dtype)
+    xs = jnp.moveaxis(x, 2, 0)
+
+    def step(h, xt):
+        h2 = _gru_cell(xt, h, w, r, b)
+        return h2, h2
+
+    hT, hs = lax.scan(step, h0, xs)
+    return jnp.moveaxis(hs, 0, 2), hT
+
+
+@op("simpleRnnLayer")
+def _simple_rnn_layer(x, w, r, b=None, h0=None, activation="tanh"):
+    n, _, t = x.shape
+    hsz = r.shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((n, hsz), x.dtype)
+    act = OPS[activation]
+    xs = jnp.moveaxis(x, 2, 0)
+
+    def step(h, xt):
+        z = xt @ w + h @ r
+        if b is not None:
+            z = z + b
+        h2 = act(z)
+        return h2, h2
+
+    hT, hs = lax.scan(step, h0, xs)
+    return jnp.moveaxis(hs, 0, 2), hT
+
+
+# ---------------------------------------------------------------------------
+# attention (the reference's multiHeadDotProductAttention declarable op;
+# here the soft path — the Pallas flash kernel plugs in via ops/attention)
+# ---------------------------------------------------------------------------
+
+@op("dotProductAttention")
+def _dot_product_attention(q, k, v, mask=None, scaled=True):
+    """q:[..., T_q, D], k:[..., T_k, D], v:[..., T_k, Dv]."""
+    scale = 1.0 / _math.sqrt(q.shape[-1]) if scaled else 1.0
+    logits = (q * scale) @ jnp.swapaxes(k, -1, -2)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return w @ v
+
+
+@op("multiHeadDotProductAttention")
+def _mhdpa(q, k, v, wq, wk, wv, wo, mask=None, numHeads=1, scaled=True):
+    """Batched multi-head attention: q/k/v [N, T, E]; wq/wk/wv [E, H*Dh],
+    wo [H*Dh, E]."""
+    n, tq, e = q.shape
+    h = numHeads
+
+    def heads(x, wm):
+        y = x @ wm
+        return y.reshape(n, x.shape[1], h, -1).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = heads(q, wq), heads(k, wk), heads(v, wv)
+    if mask is not None and mask.ndim == 2:
+        mask = mask[:, None, None, :]
+    o = _dot_product_attention(qh, kh, vh, mask, scaled)
+    o = o.transpose(0, 2, 1, 3).reshape(n, tq, -1)
+    return o @ wo
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: SDLoss / org.nd4j.linalg.lossfunctions)
+# ---------------------------------------------------------------------------
+
+def _reduce_loss(per_ex, weights, reduction):
+    if weights is not None:
+        per_ex = per_ex * weights
+    if reduction in ("MEAN_BY_NONZERO_WEIGHT_COUNT", "MEAN_BY_WEIGHT"):
+        if weights is not None:
+            denom = jnp.maximum(jnp.sum(weights != 0), 1)
+            return jnp.sum(per_ex) / denom
+        return jnp.mean(per_ex)
+    if reduction == "SUM":
+        return jnp.sum(per_ex)
+    if reduction == "NONE":
+        return per_ex
+    return jnp.mean(per_ex)
+
+
+@op("softmaxCrossEntropy")
+def _softmax_ce(logits, labels, weights=None, labelSmoothing=0.0,
+                reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    nc = logits.shape[-1]
+    if labelSmoothing > 0:
+        labels = labels * (1 - labelSmoothing) + labelSmoothing / nc
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    per_ex = -jnp.sum(labels * lp, axis=-1)
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("sparseSoftmaxCrossEntropy")
+def _sparse_softmax_ce(logits, labels, reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    per_ex = -jnp.take_along_axis(
+        lp, labels.astype(jnp.int32)[..., None], axis=-1
+    )[..., 0]
+    return _reduce_loss(per_ex, None, reduction)
+
+
+@op("sigmoidCrossEntropy")
+def _sigmoid_ce(logits, labels, weights=None,
+                reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("meanSquaredError")
+def _mse(predictions, labels, weights=None,
+         reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    per = (predictions - labels) ** 2
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("absoluteDifference")
+def _mae(predictions, labels, weights=None,
+         reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    per = jnp.abs(predictions - labels)
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("huberLoss")
+def _huber(predictions, labels, weights=None, delta=1.0,
+           reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    err = jnp.abs(predictions - labels)
+    per = jnp.where(err <= delta, 0.5 * err**2, delta * err - 0.5 * delta**2)
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("logLoss")
+def _log_loss(predictions, labels, weights=None, epsilon=1e-7,
+              reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    p = jnp.clip(predictions, epsilon, 1 - epsilon)
+    per = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("hingeLoss")
+def _hinge(predictions, labels, weights=None,
+           reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    # labels in {0,1} -> {-1,1} like SDLoss.hingeLoss
+    y = 2.0 * labels - 1.0
+    per = jnp.maximum(0.0, 1.0 - y * predictions)
+    per_ex = jnp.mean(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("cosineDistance")
+def _cosine_distance(predictions, labels, weights=None, dimension=-1,
+                     reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    per_ex = 1.0 - jnp.sum(predictions * labels, axis=dimension)
+    return _reduce_loss(per_ex, weights, reduction)
+
+
+@op("klDivergence")
+def _kld(predictions, labels, reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
+    per = labels * (jnp.log(jnp.maximum(labels, 1e-12)) -
+                    jnp.log(jnp.maximum(predictions, 1e-12)))
+    per_ex = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    return _reduce_loss(per_ex, None, reduction)

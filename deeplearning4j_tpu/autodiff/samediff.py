@@ -1,0 +1,965 @@
+"""SameDiff: define-then-run graph with whole-graph XLA compilation.
+
+Reference capability: org.nd4j.autodiff.samediff.SameDiff / SDVariable /
+internal.{InferenceSession, TrainingSession} (SURVEY.md §2.3, §3.4). The
+reference interprets the graph op-by-op in the JVM with per-op JNI dispatch
+and builds an explicit backward graph from per-op doDiff rules. Here:
+
+  - the op graph lowers once to a pure jax function (topological execution
+    over the pruned ancestor set);
+  - gradients are jax.grad of the lowered function — correct for every op
+    in the registry without any doDiff rules;
+  - fit() compiles forward+backward+updater into ONE XLA executable with
+    donated parameter/updater-state buffers (device-resident params);
+  - executables are cached per (outputs, training) and re-specialized by
+    jax on shape changes (the executable-cache role of libnd4j's
+    GraphExecutioner, SURVEY.md §2.1 item 7).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zipfile
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.ops import OPS, RANDOM_OPS, TRAINING_AWARE_OPS
+from deeplearning4j_tpu.ndarray import INDArray
+from deeplearning4j_tpu.optimize.updaters import IUpdater, Sgd, updater_from_config
+
+
+class VariableType(Enum):
+    VARIABLE = "VARIABLE"        # trainable
+    CONSTANT = "CONSTANT"
+    PLACEHOLDER = "PLACEHOLDER"
+    ARRAY = "ARRAY"              # op output
+
+
+@dataclass
+class Op:
+    fn_name: str
+    inputs: list          # input var names
+    outputs: list         # output var names
+    attrs: dict
+
+
+def _unwrap_value(v):
+    if isinstance(v, INDArray):
+        return v.jax()
+    return jnp.asarray(v)
+
+
+class SDVariable:
+    def __init__(self, sd: "SameDiff", name: str, vtype: VariableType,
+                 shape=None, dtype=jnp.float32):
+        self.sd = sd
+        self._name = name
+        self.variableType = vtype
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    def name(self) -> str:
+        return self._name
+
+    def getShape(self):
+        return self._shape
+
+    # -- graph-building arithmetic -----------------------------------------
+    def _bin(self, opname, other, rev=False):
+        other = self.sd._as_var(other)
+        a, b = (other, self) if rev else (self, other)
+        return self.sd._op(opname, [a, b])
+
+    def add(self, o):
+        return self._bin("add", o)
+
+    def sub(self, o):
+        return self._bin("sub", o)
+
+    def mul(self, o):
+        return self._bin("mul", o)
+
+    def div(self, o):
+        return self._bin("div", o)
+
+    def rsub(self, o):
+        return self._bin("sub", o, rev=True)
+
+    def rdiv(self, o):
+        return self._bin("div", o, rev=True)
+
+    def pow(self, o):
+        return self._bin("pow", o)
+
+    def squaredDifference(self, o):
+        return self._bin("squaredDifference", o)
+
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    __rsub__ = rsub
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    __rtruediv__ = rdiv
+    __pow__ = pow
+
+    def __neg__(self):
+        return self.sd._op("neg", [self])
+
+    def __matmul__(self, o):
+        return self.mmul(o)
+
+    def neg(self):
+        return self.sd._op("neg", [self])
+
+    def mmul(self, o, transposeA=False, transposeB=False):
+        return self.sd._op(
+            "matmul", [self, self.sd._as_var(o)],
+            {"transposeA": transposeA, "transposeB": transposeB},
+        )
+
+    def dot(self, o, *dims):
+        return self.sd._op(
+            "dot", [self, self.sd._as_var(o)],
+            {"dimensions": list(dims) or None},
+        )
+
+    # shape ops
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", [self], {"shape": list(shape)})
+
+    def transpose(self):
+        return self.sd._op("transpose", [self])
+
+    def permute(self, *dims):
+        return self.sd._op("permute", [self], {"dimensions": list(dims)})
+
+    def castTo(self, dtype):
+        return self.sd._op("cast", [self], {"dtype": dtype})
+
+    # reductions
+    def _red(self, opname, dims, keepDims=False):
+        return self.sd._op(
+            opname, [self], {"dimensions": list(dims) or None, "keepDims": keepDims}
+        )
+
+    def sum(self, *dims, keepDims=False):
+        return self._red("sum", dims, keepDims)
+
+    def mean(self, *dims, keepDims=False):
+        return self._red("mean", dims, keepDims)
+
+    def max(self, *dims, keepDims=False):
+        return self._red("max", dims, keepDims)
+
+    def min(self, *dims, keepDims=False):
+        return self._red("min", dims, keepDims)
+
+    def prod(self, *dims, keepDims=False):
+        return self._red("prod", dims, keepDims)
+
+    def norm1(self, *dims):
+        return self._red("norm1", dims)
+
+    def norm2(self, *dims):
+        return self._red("norm2", dims)
+
+    def std(self, biasCorrected=True, *dims):
+        return self.sd._op(
+            "standardDeviation", [self],
+            {"dimensions": list(dims) or None, "biasCorrected": biasCorrected},
+        )
+
+    def argmax(self, dim=None):
+        return self.sd._op("argmax", [self], {"dimension": dim})
+
+    def argmin(self, dim=None):
+        return self.sd._op("argmin", [self], {"dimension": dim})
+
+    # misc
+    def get(self, idx):
+        raise NotImplementedError("use sd.stridedSlice / sd.gather")
+
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd._rename(self._name, new_name)
+        return self
+
+    def markAsLoss(self):
+        self.sd._loss_vars.append(self._name)
+        return self
+
+    def isPlaceHolder(self):
+        return self.variableType == VariableType.PLACEHOLDER
+
+    # -- execution ----------------------------------------------------------
+    def eval(self, feeds: dict | None = None) -> INDArray:
+        return self.sd.output(feeds or {}, self._name)[self._name]
+
+    def getArr(self) -> INDArray:
+        if self.variableType in (VariableType.VARIABLE, VariableType.CONSTANT):
+            return INDArray(self.sd._values[self._name])
+        return self.eval()
+
+    def setArr(self, value):
+        self.sd._values[self._name] = _unwrap_value(value)
+        return self
+
+    def __repr__(self):
+        return (f"SDVariable(name={self._name!r}, "
+                f"type={self.variableType.value}, shape={self._shape})")
+
+
+# ---------------------------------------------------------------------------
+# op namespaces (reference: SDOps families SDMath/SDNN/SDCNN/SDRNN/SDLoss/
+# SDRandom on the SameDiff object, SURVEY.md §2.3)
+# ---------------------------------------------------------------------------
+
+class _Namespace:
+    _passthrough: tuple = ()
+
+    def __init__(self, sd: "SameDiff"):
+        self.sd = sd
+
+    def __getattr__(self, item):
+        if item in type(self)._passthrough:
+            def f(*inputs, name=None, **attrs):
+                vars_ = [self.sd._as_var(v) for v in inputs]
+                return self.sd._op(item, vars_, attrs, name=name)
+
+            return f
+        raise AttributeError(item)
+
+
+class SDMath(_Namespace):
+    _passthrough = (
+        "add", "sub", "mul", "div", "rsub", "rdiv", "pow", "neg", "abs",
+        "exp", "log", "log1p", "sqrt", "square", "reciprocal", "sign",
+        "floor", "ceil", "round", "sin", "cos", "tan", "asin", "acos",
+        "atan", "sinh", "cosh", "tanh", "erf", "isnan", "isinf", "matmul",
+        "tensorMmul", "dot", "cumsum", "cumprod", "sum", "mean", "max",
+        "min", "prod", "norm1", "norm2", "normMax", "logSumExp", "moments",
+        "variance", "standardDeviation", "countNonZero", "eq", "neq", "gt",
+        "gte", "lt", "lte", "and_op", "or_op", "not_op", "xor_op",
+        "maximum", "minimum", "clipByValue", "clipByNorm", "standardize",
+        "squaredDifference", "floordiv", "mod", "diag", "invertPermutation",
+        "reverse", "argmax", "argmin",
+    )
+
+
+class SDNN(_Namespace):
+    _passthrough = (
+        "sigmoid", "relu", "relu6", "elu", "selu", "gelu", "softplus",
+        "softsign", "swish", "mish", "hardSigmoid", "hardTanh", "leakyRelu",
+        "prelu", "softmax", "logSoftmax", "layerNorm", "batchNorm",
+        "dropout", "dotProductAttention", "multiHeadDotProductAttention",
+        "pad", "rationalTanh", "rectifiedTanh",
+    )
+
+    def linear(self, x, w, b=None, name=None):
+        y = self.sd._op("matmul", [x, w])
+        if b is not None:
+            y = self.sd._op("add", [y, b], name=name)
+        return y
+
+    def reluLayer(self, x, w, b, name=None):
+        return self.sd._op("relu", [self.linear(x, w, b)], name=name)
+
+
+class SDCNN(_Namespace):
+    _passthrough = (
+        "conv2d", "conv1d", "depthwiseConv2d", "deconv2d", "maxPooling2d",
+        "avgPooling2d", "globalAvgPooling", "upsampling2d", "im2col",
+    )
+
+
+class SDRNN(_Namespace):
+    _passthrough = ("lstmCell", "gruCell", "lstmLayer", "gruLayer",
+                    "simpleRnnLayer")
+
+
+class SDLoss(_Namespace):
+    _passthrough = (
+        "softmaxCrossEntropy", "sparseSoftmaxCrossEntropy",
+        "sigmoidCrossEntropy", "meanSquaredError", "absoluteDifference",
+        "huberLoss", "logLoss", "hingeLoss", "cosineDistance",
+        "klDivergence",
+    )
+
+    def __getattr__(self, item):
+        f = super().__getattr__(item)
+
+        def g(*inputs, name=None, **attrs):
+            v = f(*inputs, name=name, **attrs)
+            v.markAsLoss()
+            return v
+
+        return g
+
+
+class SDRandom(_Namespace):
+    def normal(self, mean, stddev, *shape, name=None):
+        return self.sd._op(
+            "randomNormal", [], {"shape": list(shape), "mean": mean,
+                                 "stddev": stddev}, name=name)
+
+    def uniform(self, low, high, *shape, name=None):
+        return self.sd._op(
+            "randomUniform", [], {"shape": list(shape), "min": low,
+                                  "max": high}, name=name)
+
+    def bernoulli(self, p, *shape, name=None):
+        return self.sd._op(
+            "randomBernoulli", [], {"shape": list(shape), "p": p}, name=name)
+
+
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TrainingConfig:
+    """Reference: org.nd4j.autodiff.samediff.TrainingConfig (SURVEY.md §2.3)."""
+
+    updater: IUpdater = field(default_factory=lambda: Sgd(1e-2))
+    dataSetFeatureMapping: Sequence[str] = ()
+    dataSetLabelMapping: Sequence[str] = ()
+    lossVariables: Sequence[str] = ()
+    l1: float = 0.0
+    l2: float = 0.0
+    weightDecay: float = 0.0
+    minimize: bool = True
+
+    def to_json(self):
+        return {
+            "updater": self.updater.to_json(),
+            "dataSetFeatureMapping": list(self.dataSetFeatureMapping),
+            "dataSetLabelMapping": list(self.dataSetLabelMapping),
+            "lossVariables": list(self.lossVariables),
+            "l1": self.l1, "l2": self.l2, "weightDecay": self.weightDecay,
+            "minimize": self.minimize,
+        }
+
+    @staticmethod
+    def from_json(d):
+        d = dict(d)
+        d["updater"] = updater_from_config(d["updater"])
+        return TrainingConfig(**d)
+
+
+class History:
+    """fit() result (reference: org.nd4j.autodiff.listeners.records.History)."""
+
+    def __init__(self):
+        self.lossCurve = []      # per-epoch mean loss
+        self.iterLosses = []
+
+    def finalTrainingLoss(self):
+        return self.lossCurve[-1] if self.lossCurve else None
+
+
+class SameDiff:
+    MULTI_OUTPUT_OPS = {"moments": 2, "lstmCell": 2, "lstmLayer": 3,
+                        "gruLayer": 2, "simpleRnnLayer": 2}
+
+    def __init__(self):
+        self._ops: list[Op] = []
+        self._vars: dict[str, SDVariable] = {}
+        self._values: dict[str, jax.Array] = {}   # VARIABLE + CONSTANT values
+        self._producer: dict[str, int] = {}       # var name -> op index
+        self._loss_vars: list[str] = []
+        self._name_counter = 0
+        self.trainingConfig: TrainingConfig | None = None
+        self._train_step_fn = None
+        self._updater_state = None
+        self._step = 0
+        self._fn_cache: dict = {}
+        self._seed = 0
+        # namespaces
+        self.math = SDMath(self)
+        self.nn = SDNN(self)
+        self.cnn = SDCNN(self)
+        self.rnn = SDRNN(self)
+        self.loss = SDLoss(self)
+        self.random = SDRandom(self)
+
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    # -- variable creation --------------------------------------------------
+    def _unique(self, base: str) -> str:
+        if base not in self._vars:
+            return base
+        while True:
+            self._name_counter += 1
+            cand = f"{base}_{self._name_counter}"
+            if cand not in self._vars:
+                return cand
+
+    def placeHolder(self, name: str, dtype=jnp.float32, *shape) -> SDVariable:
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        v = SDVariable(self, name, VariableType.PLACEHOLDER, shape or None, dtype)
+        self._vars[name] = v
+        return v
+
+    def var(self, name: str, *args, dtype=jnp.float32) -> SDVariable:
+        """var(name, array) | var(name, *shape) (zeros) |
+        var(name, init_fn, *shape) where init_fn(key, shape)->array."""
+        name = self._unique(name)
+        if len(args) == 1 and isinstance(
+            args[0], (list, np.ndarray, jnp.ndarray, INDArray)
+        ):
+            val = _unwrap_value(args[0])
+        elif args and callable(args[0]):
+            shape = tuple(
+                args[1]) if len(args) == 2 and isinstance(
+                args[1], (list, tuple)) else tuple(args[1:])
+            # stable per-name key: crc32, not hash() (which is salted per
+            # interpreter and would make initialization nondeterministic)
+            import zlib
+
+            key = jax.random.key(
+                zlib.crc32(name.encode()) % (2**31) + self._seed)
+            val = jnp.asarray(args[0](key, shape), dtype=dtype)
+        else:
+            shape = tuple(
+                args[0]) if len(args) == 1 and isinstance(
+                args[0], (list, tuple)) else tuple(args)
+            val = jnp.zeros(shape, dtype)
+        v = SDVariable(self, name, VariableType.VARIABLE,
+                       tuple(val.shape), val.dtype)
+        self._vars[name] = v
+        self._values[name] = val
+        return v
+
+    def constant(self, name_or_value, value=None) -> SDVariable:
+        if value is None:
+            name, value = self._unique("const"), name_or_value
+        else:
+            name = self._unique(name_or_value)
+        val = _unwrap_value(value)
+        v = SDVariable(self, name, VariableType.CONSTANT,
+                       tuple(val.shape), val.dtype)
+        self._vars[name] = v
+        self._values[name] = val
+        return v
+
+    def _as_var(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(x)
+
+    def convertToConstant(self, var: SDVariable):
+        var.variableType = VariableType.CONSTANT
+        return var
+
+    def convertToVariable(self, var: SDVariable):
+        var.variableType = VariableType.VARIABLE
+        return var
+
+    def _rename(self, old: str, new: str):
+        if new in self._vars:
+            raise ValueError(f"variable {new!r} already exists")
+        v = self._vars.pop(old)
+        v._name = new
+        self._vars[new] = v
+        if old in self._values:
+            self._values[new] = self._values.pop(old)
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        for op_ in self._ops:
+            op_.inputs = [new if n == old else n for n in op_.inputs]
+            op_.outputs = [new if n == old else n for n in op_.outputs]
+        self._loss_vars = [new if n == old else n for n in self._loss_vars]
+        self._invalidate()
+
+    # -- op construction ----------------------------------------------------
+    def _op(self, fn_name: str, inputs: list, attrs: dict | None = None,
+            name: str | None = None, n_out: int | None = None):
+        if fn_name not in OPS:
+            raise ValueError(f"unknown op {fn_name!r}")
+        attrs = {k: v for k, v in (attrs or {}).items() if v is not None}
+        n_out = n_out or self.MULTI_OUTPUT_OPS.get(fn_name, 1)
+        base = name or fn_name
+        out_names = [
+            self._unique(base if i == 0 else f"{base}:{i}")
+            for i in range(n_out)
+        ]
+        op_idx = len(self._ops)
+        self._ops.append(Op(fn_name, [v.name() for v in inputs],
+                            out_names, attrs))
+        outs = []
+        for on in out_names:
+            v = SDVariable(self, on, VariableType.ARRAY)
+            self._vars[on] = v
+            self._producer[on] = op_idx
+            outs.append(v)
+        self._invalidate()
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    def _invalidate(self):
+        """Drop every compiled executable after a graph mutation."""
+        self._fn_cache.clear()
+        self._train_step_fn = None
+
+    # convenience graph ops on sd itself
+    def one_hot(self, x, depth, name=None):
+        return self._op("oneHot", [self._as_var(x)], {"depth": depth}, name)
+
+    def gather(self, x, indices, axis=0, name=None):
+        return self._op("gather", [self._as_var(x), self._as_var(indices)],
+                        {"axis": axis}, name)
+
+    def concat(self, dim, *vars_, name=None):
+        return self._op("concat", [self._as_var(v) for v in vars_],
+                        {"dimension": dim}, name)
+
+    def stack(self, axis, *vars_, name=None):
+        return self._op("stack", [self._as_var(v) for v in vars_],
+                        {"axis": axis}, name)
+
+    def unstack(self, x, axis, num, name=None):
+        return self._op("unstack", [self._as_var(x)],
+                        {"axis": axis, "num": num}, name, n_out=num)
+
+    def split(self, x, numSplit, dimension, name=None):
+        return self._op("split", [self._as_var(x)],
+                        {"numSplit": numSplit, "dimension": dimension},
+                        name, n_out=numSplit)
+
+    def stridedSlice(self, x, begin, end, strides=None, name=None):
+        return self._op("stridedSlice", [self._as_var(x)],
+                        {"begin": list(begin), "end": list(end),
+                         "strides": list(strides) if strides else None}, name)
+
+    def expandDims(self, x, axis, name=None):
+        return self._op("expandDims", [self._as_var(x)], {"axis": axis}, name)
+
+    def squeeze(self, x, axis, name=None):
+        return self._op("squeeze", [self._as_var(x)], {"axis": axis}, name)
+
+    def where(self, cond, x, y, name=None):
+        return self._op("where_op",
+                        [self._as_var(cond), self._as_var(x), self._as_var(y)],
+                        {}, name)
+
+    def identity(self, x, name=None):
+        return self._op("identity", [self._as_var(x)], {}, name)
+
+    def getVariable(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+    def hasVariable(self, name: str) -> bool:
+        return name in self._vars
+
+    def variables(self):
+        return [v for v in self._vars.values()
+                if v.variableType == VariableType.VARIABLE]
+
+    def variableNames(self):
+        return [v.name() for v in self.variables()]
+
+    def setLossVariables(self, *names):
+        self._loss_vars = [n.name() if isinstance(n, SDVariable) else n
+                           for n in names]
+        self._train_step_fn = None
+
+    def getLossVariables(self):
+        return list(self._loss_vars)
+
+    # -- execution core -----------------------------------------------------
+    def _needed_ops(self, wanted: Sequence[str]) -> list[int]:
+        needed: set[int] = set()
+        stack = [n for n in wanted if n in self._producer]
+        while stack:
+            n = stack.pop()
+            idx = self._producer.get(n)
+            if idx is None or idx in needed:
+                continue
+            needed.add(idx)
+            for inp in self._ops[idx].inputs:
+                if inp in self._producer:
+                    stack.append(inp)
+        return sorted(needed)
+
+    def _make_fn(self, outputs: tuple, training: bool):
+        op_indices = self._needed_ops(outputs)
+
+        def fn(placeholders: dict, params: dict, consts: dict, rng):
+            env = dict(consts)
+            env.update(params)
+            env.update(placeholders)
+            for idx in op_indices:
+                o = self._ops[idx]
+                kwargs = dict(o.attrs)
+                fn_name = o.fn_name
+                if fn_name in RANDOM_OPS:
+                    kwargs["key"] = jax.random.fold_in(rng, idx)
+                if fn_name in TRAINING_AWARE_OPS:
+                    kwargs["training"] = training
+                args = [env[i] for i in o.inputs]
+                res = OPS[fn_name](*args, **kwargs)
+                if len(o.outputs) == 1:
+                    env[o.outputs[0]] = res
+                else:
+                    for on, r in zip(o.outputs, res):
+                        env[on] = r
+            return {n: env[n] for n in outputs}
+
+        return fn
+
+    def _split_values(self):
+        params, consts = {}, {}
+        for n, v in self._values.items():
+            if self._vars[n].variableType == VariableType.VARIABLE:
+                params[n] = v
+            else:
+                consts[n] = v
+        return params, consts
+
+    def _jitted(self, outputs: tuple, training: bool):
+        key = (outputs, training)
+        if key not in self._fn_cache:
+            fn = self._make_fn(outputs, training)
+            self._fn_cache[key] = jax.jit(fn)
+        return self._fn_cache[key]
+
+    def output(self, feeds: dict, *outputs) -> dict:
+        """Execute the graph for the requested outputs (InferenceSession
+        capability; one compiled XLA executable per (outputs, shapes))."""
+        names = tuple(
+            o.name() if isinstance(o, SDVariable) else o for o in outputs
+        )
+        feeds = {k: _unwrap_value(v) for k, v in feeds.items()}
+        params, consts = self._split_values()
+        rng = jax.random.key(self._seed)
+        res = self._jitted(names, False)(feeds, params, consts, rng)
+        return {k: INDArray(v) for k, v in res.items()}
+
+    def batchOutput(self):
+        return _BatchOutputBuilder(self)
+
+    def outputSingle(self, feeds: dict, output) -> INDArray:
+        name = output.name() if isinstance(output, SDVariable) else output
+        return self.output(feeds, name)[name]
+
+    def exec_all(self, feeds: dict) -> dict:
+        names = tuple(self._vars)
+        return self.output(feeds, *names)
+
+    # -- gradients -----------------------------------------------------------
+    def _loss_value(self, outs: dict):
+        total = 0.0
+        for lv in (self._loss_vars or list(outs)):
+            total = total + jnp.sum(outs[lv])
+        return total
+
+    def calculateGradients(self, feeds: dict, *wrt) -> dict:
+        """Analytic gradients of the summed loss variables w.r.t. the given
+        variable names (replaces the reference's backward-graph construction,
+        SURVEY.md §3.4)."""
+        if not self._loss_vars:
+            raise ValueError("no loss variables; call setLossVariables/markAsLoss")
+        wrt_names = [w.name() if isinstance(w, SDVariable) else w for w in wrt]
+        feeds = {k: _unwrap_value(v) for k, v in feeds.items()}
+        params, consts = self._split_values()
+        rng = jax.random.key(self._seed)
+        fwd = self._make_fn(tuple(self._loss_vars), False)
+
+        diff_feeds = {n: feeds[n] for n in wrt_names if n in feeds}
+        diff_params = {n: params[n] for n in wrt_names if n in params}
+        missing = [n for n in wrt_names
+                   if n not in diff_feeds and n not in diff_params]
+        if missing:
+            raise ValueError(
+                f"cannot differentiate w.r.t. {missing}: each name must be a "
+                f"fed placeholder or a VARIABLE (constants/ARRAY outputs are "
+                f"not differentiable targets)")
+
+        def loss_fn(dfeeds, dparams):
+            f = dict(feeds)
+            f.update(dfeeds)
+            p = dict(params)
+            p.update(dparams)
+            return self._loss_value(fwd(f, p, consts, rng))
+
+        gf, gp = jax.grad(loss_fn, argnums=(0, 1))(diff_feeds, diff_params)
+        out = {}
+        out.update({k: INDArray(v) for k, v in gf.items()})
+        out.update({k: INDArray(v) for k, v in gp.items()})
+        return out
+
+    # -- training ------------------------------------------------------------
+    def setTrainingConfig(self, cfg: TrainingConfig):
+        self.trainingConfig = cfg
+        if cfg.lossVariables:
+            self._loss_vars = list(cfg.lossVariables)
+        self._updater_state = None
+        self._train_step_fn = None
+
+    def _build_train_step(self):
+        cfg = self.trainingConfig
+        loss_names = tuple(self._loss_vars)
+        fwd = self._make_fn(loss_names, True)
+        updater = cfg.updater
+
+        def step_fn(params, opt_state, consts, feeds, rng, step):
+            def loss_fn(p):
+                outs = fwd(feeds, p, consts, rng)
+                loss = self._loss_value(outs)
+                if cfg.l2 > 0:
+                    loss = loss + cfg.l2 * sum(
+                        jnp.sum(w * w) for w in jax.tree_util.tree_leaves(p)
+                    )
+                if cfg.l1 > 0:
+                    loss = loss + cfg.l1 * sum(
+                        jnp.sum(jnp.abs(w)) for w in jax.tree_util.tree_leaves(p)
+                    )
+                return loss if cfg.minimize else -loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if cfg.weightDecay > 0:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g + cfg.weightDecay * p, grads, params
+                )
+            updates, opt_state = updater.apply(grads, opt_state, params, step)
+            params = jax.tree_util.tree_map(lambda p, u: p - u, params, updates)
+            return loss, params, opt_state
+
+        # params+opt state live on device and are donated every step —
+        # the PJRT buffer-donation equivalent of the flat-param update in
+        # MultiLayerNetwork.fit (SURVEY.md §3.1)
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def fit(self, data=None, epochs: int = 1, listeners=()) -> History:
+        """data: iterable of DataSet-like ((features, labels) tuples or
+        objects with .getFeatures()/.getLabels()), or a single such batch."""
+        if self.trainingConfig is None:
+            raise ValueError("call setTrainingConfig first")
+        cfg = self.trainingConfig
+        if not self._loss_vars:
+            raise ValueError("no loss variables set")
+        if getattr(self, "_train_step_fn", None) is None:
+            self._train_step_fn = self._build_train_step()
+
+        history = History()
+        params, consts = self._split_values()
+        if self._updater_state is None:
+            self._updater_state = cfg.updater.init_state(params)
+        opt_state = self._updater_state
+
+        base_key = jax.random.key(self._seed + 7)
+
+        for epoch in range(epochs):
+            batches = _as_batches(data)
+            if epoch == 0 and not hasattr(data, "reset") and not isinstance(
+                batches, (list, tuple)
+            ):
+                # one-shot iterable (generator): materialize so later epochs
+                # see the data instead of silently training on nothing
+                batches = list(batches)
+                data = batches
+            epoch_losses = []
+            for ds in batches:
+                feats, labels = _split_dataset(ds)
+                feeds = {}
+                fmap = list(cfg.dataSetFeatureMapping)
+                lmap = list(cfg.dataSetLabelMapping)
+                for name, arr in zip(fmap, feats):
+                    feeds[name] = _unwrap_value(arr)
+                for name, arr in zip(lmap, labels):
+                    feeds[name] = _unwrap_value(arr)
+                rng = jax.random.fold_in(base_key, self._step)
+                loss, params, opt_state = self._train_step_fn(
+                    params, opt_state, consts, feeds, rng, self._step
+                )
+                # rebind immediately: the step donated the previous buffers,
+                # so self._values must never be left pointing at them (a
+                # listener or a mid-fit exception would otherwise observe
+                # deleted device arrays)
+                for n, v in params.items():
+                    self._values[n] = v
+                self._updater_state = opt_state
+                self._step += 1
+                epoch_losses.append(loss)  # device array; no host sync here
+                if listeners:
+                    lv = float(loss)
+                    for listener in listeners:
+                        if hasattr(listener, "iterationDone"):
+                            listener.iterationDone(self, self._step, epoch, lv)
+            if not epoch_losses:
+                raise ValueError(
+                    f"epoch {epoch}: data yielded no batches (exhausted "
+                    f"iterator or empty dataset)")
+            epoch_losses = [float(l) for l in jax.device_get(epoch_losses)]
+            history.iterLosses.extend(epoch_losses)
+            history.lossCurve.append(float(np.mean(epoch_losses)))
+        return history
+
+    # -- serde (reference: SameDiff.save/load flatbuffers .fb; here a zip of
+    # graph JSON + npz values, same round-trip capability, SURVEY.md §5) ----
+    def save(self, path: str, saveUpdaterState: bool = False):
+        graph = {
+            "variables": [
+                {
+                    "name": v.name(),
+                    "type": v.variableType.value,
+                    "shape": list(v._shape) if v._shape else None,
+                    "dtype": str(np.dtype(v.dtype)) if v.dtype else "float32",
+                }
+                for v in self._vars.values()
+            ],
+            "ops": [
+                {"fn": o.fn_name, "inputs": o.inputs, "outputs": o.outputs,
+                 "attrs": _json_attrs(o.attrs)}
+                for o in self._ops
+            ],
+            "lossVariables": self._loss_vars,
+            "trainingConfig": (self.trainingConfig.to_json()
+                               if self.trainingConfig else None),
+            "step": self._step,
+        }
+        import io
+
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("graph.json", json.dumps(graph, indent=1))
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v) for k, v in self._values.items()})
+            zf.writestr("values.npz", buf.getvalue())
+            if saveUpdaterState and self._updater_state is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(self._updater_state)
+                sbuf = io.BytesIO()
+                np.savez(sbuf, **{str(i): np.asarray(l)
+                                  for i, l in enumerate(leaves)})
+                zf.writestr("updater_state.npz", sbuf.getvalue())
+
+    @staticmethod
+    def load(path: str, loadUpdaterState: bool = False) -> "SameDiff":
+        import io
+
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as zf:
+            graph = json.loads(zf.read("graph.json"))
+            values = np.load(io.BytesIO(zf.read("values.npz")))
+            for vd in graph["variables"]:
+                v = SDVariable(
+                    sd, vd["name"], VariableType(vd["type"]),
+                    tuple(vd["shape"]) if vd["shape"] else None,
+                    np.dtype(vd["dtype"]),
+                )
+                sd._vars[vd["name"]] = v
+            for i, od in enumerate(graph["ops"]):
+                sd._ops.append(Op(od["fn"], od["inputs"], od["outputs"],
+                                  od["attrs"]))
+                for on in od["outputs"]:
+                    sd._producer[on] = i
+            for k in values.files:
+                sd._values[k] = jnp.asarray(values[k])
+            sd._loss_vars = graph["lossVariables"]
+            sd._step = graph.get("step", 0)
+            if graph.get("trainingConfig"):
+                sd.trainingConfig = TrainingConfig.from_json(
+                    graph["trainingConfig"])
+            if loadUpdaterState and "updater_state.npz" in zf.namelist():
+                params, _ = sd._split_values()
+                proto = sd.trainingConfig.updater.init_state(params)
+                leaves, treedef = jax.tree_util.tree_flatten(proto)
+                data = np.load(io.BytesIO(zf.read("updater_state.npz")))
+                new_leaves = [jnp.asarray(data[str(i)])
+                              for i in range(len(leaves))]
+                sd._updater_state = jax.tree_util.tree_unflatten(
+                    treedef, new_leaves)
+        return sd
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} variables, {len(self._ops)} ops"]
+        for v in self._vars.values():
+            if v.variableType != VariableType.ARRAY:
+                lines.append(
+                    f"  {v.variableType.value:<12} {v.name():<24} {v._shape}"
+                )
+        for o in self._ops:
+            lines.append(
+                f"  op {o.fn_name:<20} {','.join(o.inputs)} -> "
+                f"{','.join(o.outputs)}"
+            )
+        return "\n".join(lines)
+
+
+class _BatchOutputBuilder:
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self._feeds = {}
+        self._outputs = []
+
+    def input(self, name, value):
+        self._feeds[name.name() if isinstance(name, SDVariable) else name] = value
+        return self
+
+    def output(self, *names):
+        self._outputs.extend(
+            n.name() if isinstance(n, SDVariable) else n for n in names
+        )
+        return self
+
+    def execute(self) -> dict:
+        return self.sd.output(self._feeds, *self._outputs)
+
+    def exec(self) -> dict:
+        return self.execute()
+
+
+def _json_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        elif hasattr(v, "dtype") and hasattr(v, "tolist"):
+            v = v.tolist()
+        elif isinstance(v, (np.integer, np.floating)):
+            v = v.item()
+        else:
+            try:
+                json.dumps(v)
+            except TypeError:
+                v = str(np.dtype(v))  # dtypes and dtype-like objects
+        out[k] = v
+    return out
+
+
+def _as_batches(data):
+    if data is None:
+        raise ValueError("fit() requires data")
+    if isinstance(data, (tuple,)) and len(data) == 2 and not isinstance(
+        data[0], (tuple, list)
+    ):
+        return [data]
+    if hasattr(data, "getFeatures") or hasattr(data, "features"):
+        return [data]
+    if hasattr(data, "reset"):
+        data.reset()
+    return data
+
+
+def _split_dataset(ds):
+    """Accept (features, labels) tuples, DataSet-like objects, or
+    MultiDataSet-like (lists of arrays)."""
+    if isinstance(ds, tuple) and len(ds) == 2:
+        f, l = ds
+    elif hasattr(ds, "getFeatures"):
+        f, l = ds.getFeatures(), ds.getLabels()
+    else:
+        f, l = ds.features, ds.labels
+    if not isinstance(f, (list, tuple)):
+        f = [f]
+    if not isinstance(l, (list, tuple)):
+        l = [l]
+    return f, l

@@ -1,0 +1,43 @@
+"""Optimizers/updaters (reference: org.nd4j.linalg.learning.* updaters +
+org.nd4j.linalg.learning.config.* and org.nd4j.linalg.schedule —
+SURVEY.md §2.3 "Updaters/optimizers").
+
+TPU-first: each updater is a pure pytree transform `(grads, state, params,
+step) -> (updates, state)` so the whole update fuses into the jitted train
+step (reference applied updaters as separate vectorized ops over the flat
+param view; here XLA fuses them into the backward pass).
+"""
+
+from deeplearning4j_tpu.optimize.updaters import (
+    Sgd,
+    Adam,
+    AdamW,
+    AdaMax,
+    Nadam,
+    AMSGrad,
+    Nesterovs,
+    AdaGrad,
+    AdaDelta,
+    RmsProp,
+    NoOp,
+    updater_from_config,
+)
+from deeplearning4j_tpu.optimize.schedules import (
+    FixedSchedule,
+    ExponentialSchedule,
+    InverseSchedule,
+    PolySchedule,
+    SigmoidSchedule,
+    StepSchedule,
+    MapSchedule,
+    RampSchedule,
+    CycleSchedule,
+)
+
+__all__ = [
+    "Sgd", "Adam", "AdamW", "AdaMax", "Nadam", "AMSGrad", "Nesterovs",
+    "AdaGrad", "AdaDelta", "RmsProp", "NoOp", "updater_from_config",
+    "FixedSchedule", "ExponentialSchedule", "InverseSchedule", "PolySchedule",
+    "SigmoidSchedule", "StepSchedule", "MapSchedule", "RampSchedule",
+    "CycleSchedule",
+]

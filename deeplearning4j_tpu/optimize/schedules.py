@@ -1,0 +1,157 @@
+"""Learning-rate schedules (reference: org.nd4j.linalg.schedule.ISchedule
+implementations — SURVEY.md §2.3). Pure functions of the integer step so they
+trace cleanly inside a jitted train step."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ISchedule:
+    def valueAt(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.valueAt(step)
+
+    def to_json(self):
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.to_json() if isinstance(v, ISchedule) else v
+        return d
+
+
+class FixedSchedule(ISchedule):
+    def __init__(self, value: float):
+        self.value = value
+
+    def valueAt(self, iteration, epoch=0):
+        return self.value
+
+
+class ExponentialSchedule(ISchedule):
+    def __init__(self, initialValue: float, gamma: float):
+        self.initialValue = initialValue
+        self.gamma = gamma
+
+    def valueAt(self, iteration, epoch=0):
+        return self.initialValue * jnp.power(self.gamma, iteration)
+
+
+class InverseSchedule(ISchedule):
+    def __init__(self, initialValue: float, gamma: float, power: float):
+        self.initialValue = initialValue
+        self.gamma = gamma
+        self.power = power
+
+    def valueAt(self, iteration, epoch=0):
+        return self.initialValue / jnp.power(1.0 + self.gamma * iteration, self.power)
+
+
+class PolySchedule(ISchedule):
+    def __init__(self, initialValue: float, power: float, maxIter: int):
+        self.initialValue = initialValue
+        self.power = power
+        self.maxIter = maxIter
+
+    def valueAt(self, iteration, epoch=0):
+        frac = jnp.minimum(iteration / self.maxIter, 1.0)
+        return self.initialValue * jnp.power(1.0 - frac, self.power)
+
+
+class SigmoidSchedule(ISchedule):
+    def __init__(self, initialValue: float, gamma: float, stepSize: int):
+        self.initialValue = initialValue
+        self.gamma = gamma
+        self.stepSize = stepSize
+
+    def valueAt(self, iteration, epoch=0):
+        return self.initialValue / (
+            1.0 + jnp.exp(self.gamma * (iteration - self.stepSize))
+        )
+
+
+class StepSchedule(ISchedule):
+    def __init__(self, initialValue: float, decayRate: float, step: float):
+        self.initialValue = initialValue
+        self.decayRate = decayRate
+        self.step = step
+
+    def valueAt(self, iteration, epoch=0):
+        return self.initialValue * jnp.power(
+            self.decayRate, jnp.floor(iteration / self.step)
+        )
+
+
+class MapSchedule(ISchedule):
+    """Piecewise-constant: {iteration: value}. First key must be 0."""
+
+    def __init__(self, values: dict):
+        self.values = dict(sorted((int(k), float(v)) for k, v in values.items()))
+
+    def valueAt(self, iteration, epoch=0):
+        keys = jnp.asarray(list(self.values.keys()))
+        vals = jnp.asarray(list(self.values.values()))
+        idx = jnp.sum(keys <= iteration) - 1
+        return vals[idx]
+
+
+class RampSchedule(ISchedule):
+    """Linear warmup from 0 to the wrapped schedule over numIter steps."""
+
+    def __init__(self, baseSchedule: ISchedule, numIter: int):
+        self.baseSchedule = baseSchedule
+        self.numIter = numIter
+
+    def valueAt(self, iteration, epoch=0):
+        ramp = jnp.minimum((iteration + 1.0) / self.numIter, 1.0)
+        return ramp * self.baseSchedule.valueAt(iteration, epoch)
+
+
+class CycleSchedule(ISchedule):
+    """1cycle-style: ramp up then down, with a final annihilation phase."""
+
+    def __init__(self, initialLearningRate, maxLearningRate, cycleLength,
+                 annealingLength=None, annealingDecay=0.1):
+        self.initialLearningRate = initialLearningRate
+        self.maxLearningRate = maxLearningRate
+        self.cycleLength = cycleLength
+        self.annealingLength = annealingLength or max(cycleLength // 10, 1)
+        self.annealingDecay = annealingDecay
+
+    def valueAt(self, iteration, epoch=0):
+        half = (self.cycleLength - self.annealingLength) / 2.0
+        it = jnp.asarray(iteration, dtype=jnp.float32)
+        up = self.initialLearningRate + (
+            self.maxLearningRate - self.initialLearningRate
+        ) * (it / half)
+        down = self.maxLearningRate - (
+            self.maxLearningRate - self.initialLearningRate
+        ) * ((it - half) / half)
+        anneal_start = 2 * half
+        anneal = self.initialLearningRate * jnp.power(
+            self.annealingDecay,
+            (it - anneal_start) / jnp.maximum(self.annealingLength, 1),
+        )
+        return jnp.where(it < half, up, jnp.where(it < anneal_start, down, anneal))
+
+
+def schedule_from_json(d) -> ISchedule:
+    import sys
+
+    d = dict(d)
+    cls = getattr(sys.modules[__name__], d.pop("@class"))
+    kwargs = {
+        k: schedule_from_json(v) if isinstance(v, dict) and "@class" in v else v
+        for k, v in d.items()
+    }
+    return cls(**kwargs)
+
+
+def resolve_lr(lr, step):
+    """lr may be a float, an ISchedule, or a callable(step)."""
+    if isinstance(lr, ISchedule):
+        return lr.valueAt(step)
+    if callable(lr):
+        return lr(step)
+    return lr

@@ -1,0 +1,285 @@
+"""Gradient updaters with DL4J semantics (reference:
+org.nd4j.linalg.learning.{SgdUpdater, AdamUpdater, NesterovsUpdater, ...} and
+config classes org.nd4j.linalg.learning.config.* — SURVEY.md §2.3).
+
+Each updater is a config object with:
+  init_state(params)                      -> state pytree
+  apply(grads, state, params, step)       -> (updates, new_state)
+where `updates` is what gets SUBTRACTED from params. All math is jnp
+tree_maps, so the whole optimizer fuses into the compiled train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.optimize.schedules import resolve_lr
+
+_tm = jax.tree_util.tree_map
+
+
+class IUpdater:
+    """Base: holds learningRate (float / schedule / callable)."""
+
+    def __init__(self, learningRate=0.1):
+        self.learningRate = learningRate
+
+    def lr(self, step):
+        return resolve_lr(self.learningRate, step)
+
+    def init_state(self, params):
+        return ()
+
+    def apply(self, grads, state, params, step):
+        raise NotImplementedError
+
+    def to_json(self):
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if hasattr(v, "to_json"):
+                v = v.to_json()
+            d[k] = v
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return updater_from_config(d)
+
+
+class NoOp(IUpdater):
+    def __init__(self):
+        super().__init__(0.0)
+
+    def apply(self, grads, state, params, step):
+        return _tm(jnp.zeros_like, grads), state
+
+
+class Sgd(IUpdater):
+    DEFAULT_SGD_LR = 1e-3
+
+    def __init__(self, learningRate=DEFAULT_SGD_LR):
+        super().__init__(learningRate)
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        return _tm(lambda g: lr * g, grads), state
+
+
+class Nesterovs(IUpdater):
+    """Nesterov momentum, DL4J formulation (NesterovsUpdater):
+    v' = mu*v - lr*g;  update = -(mu*v' - lr*g) i.e. params += mu*v' - lr*g."""
+
+    DEFAULT_NESTEROV_MOMENTUM = 0.9
+
+    def __init__(self, learningRate=0.1, momentum=DEFAULT_NESTEROV_MOMENTUM):
+        super().__init__(learningRate)
+        self.momentum = momentum
+
+    def init_state(self, params):
+        return {"v": _tm(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        mu = self.momentum
+        v_new = _tm(lambda v, g: mu * v - lr * g, state["v"], grads)
+        updates = _tm(lambda vn, g: -(mu * vn - lr * g), v_new, grads)
+        return updates, {"v": v_new}
+
+
+class AdaGrad(IUpdater):
+    DEFAULT_ADAGRAD_EPSILON = 1e-6
+
+    def __init__(self, learningRate=0.1, epsilon=DEFAULT_ADAGRAD_EPSILON):
+        super().__init__(learningRate)
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        return {"h": _tm(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        h = _tm(lambda h, g: h + g * g, state["h"], grads)
+        updates = _tm(
+            lambda g, h: lr * g / (jnp.sqrt(h) + self.epsilon), grads, h
+        )
+        return updates, {"h": h}
+
+
+class RmsProp(IUpdater):
+    DEFAULT_RMSPROP_RMSDECAY = 0.95
+    DEFAULT_RMSPROP_EPSILON = 1e-8
+
+    def __init__(self, learningRate=0.1, rmsDecay=DEFAULT_RMSPROP_RMSDECAY,
+                 epsilon=DEFAULT_RMSPROP_EPSILON):
+        super().__init__(learningRate)
+        self.rmsDecay = rmsDecay
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        return {"g2": _tm(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        d = self.rmsDecay
+        g2 = _tm(lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
+        updates = _tm(
+            lambda g, a: lr * g / (jnp.sqrt(a + self.epsilon)), grads, g2
+        )
+        return updates, {"g2": g2}
+
+
+class AdaDelta(IUpdater):
+    DEFAULT_ADADELTA_RHO = 0.95
+    DEFAULT_ADADELTA_EPSILON = 1e-6
+
+    def __init__(self, rho=DEFAULT_ADADELTA_RHO, epsilon=DEFAULT_ADADELTA_EPSILON):
+        super().__init__(1.0)  # AdaDelta has no lr
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        z = _tm(jnp.zeros_like, params)
+        return {"msg": z, "msdx": _tm(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, step):
+        rho, eps = self.rho, self.epsilon
+        msg = _tm(lambda a, g: rho * a + (1 - rho) * g * g, state["msg"], grads)
+        updates = _tm(
+            lambda g, a, dx: g * jnp.sqrt(dx + eps) / jnp.sqrt(a + eps),
+            grads, msg, state["msdx"],
+        )
+        msdx = _tm(
+            lambda a, u: rho * a + (1 - rho) * u * u, state["msdx"], updates
+        )
+        return updates, {"msg": msg, "msdx": msdx}
+
+
+class Adam(IUpdater):
+    DEFAULT_ADAM_LEARNING_RATE = 1e-3
+    DEFAULT_ADAM_BETA1 = 0.9
+    DEFAULT_ADAM_BETA2 = 0.999
+    DEFAULT_ADAM_EPSILON = 1e-8
+
+    def __init__(self, learningRate=DEFAULT_ADAM_LEARNING_RATE,
+                 beta1=DEFAULT_ADAM_BETA1, beta2=DEFAULT_ADAM_BETA2,
+                 epsilon=DEFAULT_ADAM_EPSILON):
+        super().__init__(learningRate)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        return {
+            "m": _tm(jnp.zeros_like, params),
+            "v": _tm(jnp.zeros_like, params),
+        }
+
+    def _moments(self, grads, state):
+        b1, b2 = self.beta1, self.beta2
+        m = _tm(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = _tm(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        return m, v
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        t = step + 1
+        m, v = self._moments(grads, state)
+        bc = jnp.sqrt(1.0 - self.beta2**t) / (1.0 - self.beta1**t)
+        updates = _tm(
+            lambda m_, v_: lr * bc * m_ / (jnp.sqrt(v_) + self.epsilon), m, v
+        )
+        return updates, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (capability beyond the reference's
+    updater set; standard for BERT-class training)."""
+
+    def __init__(self, learningRate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weightDecay=0.01):
+        super().__init__(learningRate, beta1, beta2, epsilon)
+        self.weightDecay = weightDecay
+
+    def apply(self, grads, state, params, step):
+        updates, new_state = super().apply(grads, state, params, step)
+        lr = self.lr(step)
+        wd = self.weightDecay
+        updates = _tm(lambda u, p: u + lr * wd * p, updates, params)
+        return updates, new_state
+
+
+class AMSGrad(Adam):
+    def init_state(self, params):
+        s = super().init_state(params)
+        s["vhat"] = _tm(jnp.zeros_like, params)
+        return s
+
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        t = step + 1
+        m, v = self._moments(grads, state)
+        vhat = _tm(jnp.maximum, state["vhat"], v)
+        bc = jnp.sqrt(1.0 - self.beta2**t) / (1.0 - self.beta1**t)
+        updates = _tm(
+            lambda m_, vh: lr * bc * m_ / (jnp.sqrt(vh) + self.epsilon), m, vhat
+        )
+        return updates, {"m": m, "v": v, "vhat": vhat}
+
+
+class AdaMax(Adam):
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        t = step + 1
+        b1 = self.beta1
+        m = _tm(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = _tm(
+            lambda v, g: jnp.maximum(self.beta2 * v, jnp.abs(g)),
+            state["v"], grads,
+        )
+        updates = _tm(
+            lambda m_, u_: lr / (1 - b1**t) * m_ / (u_ + self.epsilon), m, u
+        )
+        return updates, {"m": m, "v": u}
+
+
+class Nadam(Adam):
+    def apply(self, grads, state, params, step):
+        lr = self.lr(step)
+        t = step + 1
+        b1, b2 = self.beta1, self.beta2
+        m, v = self._moments(grads, state)
+        mhat = _tm(
+            lambda m_, g: b1 * m_ / (1 - b1**t) + (1 - b1) * g / (1 - b1**t),
+            m, grads,
+        )
+        vhat = _tm(lambda v_: v_ / (1 - b2**t), v)
+        updates = _tm(
+            lambda mh, vh: lr * mh / (jnp.sqrt(vh) + self.epsilon), mhat, vhat
+        )
+        return updates, {"m": m, "v": v}
+
+
+_REGISTRY = {
+    c.__name__: c
+    for c in [NoOp, Sgd, Nesterovs, AdaGrad, RmsProp, AdaDelta, Adam, AdamW,
+              AMSGrad, AdaMax, Nadam]
+}
+
+
+def updater_from_config(d):
+    """Inverse of IUpdater.to_json."""
+    if isinstance(d, IUpdater):
+        return d
+    d = dict(d)
+    cls = _REGISTRY[d.pop("@class")]
+    lr = d.pop("learningRate", None)
+    if isinstance(lr, dict):  # serialized schedule (possibly nested)
+        from deeplearning4j_tpu.optimize.schedules import schedule_from_json
+
+        lr = schedule_from_json(lr)
+    obj = cls.__new__(cls)
+    IUpdater.__init__(obj, lr if lr is not None else 0.1)
+    for k, v in d.items():
+        setattr(obj, k, v)
+    return obj

@@ -1,19 +1,31 @@
 """Test configuration: force an 8-virtual-device CPU platform so mesh /
 sharding tests run without TPU hardware (SURVEY.md §4 "distributed without a
 cluster": the reference simulates multi-node in-process over Aeron loopback;
-our equivalent is XLA's forced host platform device count)."""
+our equivalent is XLA's forced host platform device count).
+
+NOTE: in this environment jax is partially pre-imported at interpreter
+startup (a .pth hook), so config env vars are already latched — we must use
+jax.config.update, not os.environ, for jax settings. XLA_FLAGS is still read
+lazily at first backend init, so setting it here works as long as no test
+touched a device yet.
+"""
 
 import os
+import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
-import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Run the suite on the virtual CPU mesh, not the real-TPU axon tunnel.
+jax.config.update("jax_platforms", "cpu")
+# This jax build's default matmul precision truncates operands to bfloat16
+# (fine for the MXU perf path; fatal for numeric gradient checks) — force
+# full fp32 matmuls in tests (SURVEY.md §7 "Numerics").
+jax.config.update("jax_default_matmul_precision", "highest")

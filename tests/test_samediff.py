@@ -1,0 +1,335 @@
+"""SameDiff graph tests: numeric-vs-analytic gradient checks (the backbone
+of DL4J correctness testing — reference GradientCheckUtil, SURVEY.md §4),
+training convergence, and serde round-trips."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig, VariableType
+from deeplearning4j_tpu.ndarray import Nd4j
+from deeplearning4j_tpu.optimize import Adam, Sgd, Nesterovs
+
+
+def numeric_grad(f, x, eps=1e-4):
+    """Central-difference gradient of scalar f wrt numpy array x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_basic_graph_eval():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, 2, 2)
+    w = sd.var("w", [[1.0, 0.0], [0.0, 1.0]])
+    y = x.mmul(w).add(1.0).rename("y")
+    out = sd.output({"x": [[1.0, 2.0], [3.0, 4.0]]}, "y")
+    np.testing.assert_allclose(out["y"].toNumpy(), [[2, 3], [4, 5]])
+
+
+def test_namespaces():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, 3)
+    a = sd.nn.relu(x).rename("a")
+    b = sd.math.exp(x).rename("b")
+    s = sd.nn.softmax(sd.math.mul(x, 2.0)).rename("s")
+    out = sd.output({"x": [-1.0, 0.0, 1.0]}, "a", "b", "s")
+    np.testing.assert_allclose(out["a"].toNumpy(), [0, 0, 1])
+    np.testing.assert_allclose(out["b"].toNumpy(), np.exp([-1, 0, 1]), rtol=1e-5)
+    np.testing.assert_allclose(out["s"].toNumpy().sum(), 1.0, rtol=1e-6)
+
+
+def test_gradient_check_mlp():
+    """Analytic grads from the lowered graph vs central differences."""
+    rng = np.random.RandomState(0)
+    xval = rng.randn(4, 3).astype(np.float32)
+    wval = rng.randn(3, 2).astype(np.float32)
+    bval = rng.randn(2).astype(np.float32)
+    lval = np.eye(2)[rng.randint(0, 2, 4)].astype(np.float32)
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 4, 3)
+        lab = sd.placeHolder("label", jnp.float32, 4, 2)
+        w = sd.var("w", wval)
+        b = sd.var("b", bval)
+        z = sd.nn.linear(x, w, b)
+        h = sd.math.tanh(z)
+        loss = sd.loss.softmaxCrossEntropy(h, lab).rename("loss")
+        return sd
+
+    sd = build()
+    grads = sd.calculateGradients({"x": xval, "label": lval}, "w", "b")
+
+    def loss_with_w(w_):
+        sd2 = SameDiff.create()
+        x = sd2.placeHolder("x", jnp.float32, 4, 3)
+        lab = sd2.placeHolder("label", jnp.float32, 4, 2)
+        w = sd2.var("w", w_.astype(np.float32))
+        b = sd2.var("b", bval)
+        h = sd2.math.tanh(sd2.nn.linear(x, w, b))
+        sd2.loss.softmaxCrossEntropy(h, lab).rename("loss")
+        return float(sd2.output({"x": xval, "label": lval}, "loss")["loss"].getDouble())
+
+    ng = numeric_grad(loss_with_w, wval.astype(np.float64), eps=1e-3)
+    np.testing.assert_allclose(grads["w"].toNumpy(), ng, rtol=1e-2, atol=1e-3)
+
+
+def test_training_linear_regression():
+    rng = np.random.RandomState(1)
+    X = rng.randn(128, 4).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [3.0], [0.5]], dtype=np.float32)
+    Y = X @ true_w + 0.01 * rng.randn(128, 1).astype(np.float32)
+
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 4)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((4, 1), np.float32))
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred = sd.nn.linear(x, w, b)
+    sd.loss.meanSquaredError(pred, y).rename("loss")
+
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Adam(0.05),
+        dataSetFeatureMapping=["x"],
+        dataSetLabelMapping=["y"],
+        lossVariables=["loss"],
+    ))
+    hist = sd.fit([(X, Y)], epochs=150)
+    assert hist.lossCurve[-1] < 0.01
+    learned = sd.getVariable("w").getArr().toNumpy()
+    np.testing.assert_allclose(learned, true_w, atol=0.1)
+
+
+def test_training_loss_decreases_with_each_updater():
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 3).astype(np.float32)
+    Y = (X.sum(1, keepdims=True) > 0).astype(np.float32)
+    for upd in [Sgd(0.1), Adam(0.05), Nesterovs(0.1, 0.9)]:
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, -1, 3)
+        y = sd.placeHolder("y", jnp.float32, -1, 1)
+        w = sd.var("w", np.zeros((3, 1), np.float32))
+        b = sd.var("b", np.zeros((1,), np.float32))
+        logits = sd.nn.linear(x, w, b)
+        sd.loss.sigmoidCrossEntropy(logits, y).rename("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=upd, dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["y"], lossVariables=["loss"]))
+        hist = sd.fit([(X, Y)], epochs=30)
+        assert hist.lossCurve[-1] < hist.lossCurve[0], type(upd).__name__
+
+
+def test_lstm_layer_shapes_and_grad():
+    sd = SameDiff.create()
+    N, I, T, H = 2, 3, 5, 4
+    x = sd.placeHolder("x", jnp.float32, N, I, T)
+    rng = np.random.RandomState(3)
+    w = sd.var("w", (0.1 * rng.randn(I, 4 * H)).astype(np.float32))
+    r = sd.var("r", (0.1 * rng.randn(H, 4 * H)).astype(np.float32))
+    b = sd.var("b", np.zeros(4 * H, np.float32))
+    out, hT, cT = sd.rnn.lstmLayer(x, w, r, b, name="lstm")
+    loss = out.sum().markAsLoss().rename("loss")
+    xv = rng.randn(N, I, T).astype(np.float32)
+    res = sd.output({"x": xv}, out.name(), hT.name())
+    assert res[out.name()].shape() == (N, H, T)
+    assert res[hT.name()].shape() == (N, H)
+    g = sd.calculateGradients({"x": xv}, "w", "r")
+    assert g["w"].shape() == (I, 4 * H)
+    assert np.abs(g["w"].toNumpy()).sum() > 0
+
+
+def test_conv_pool_graph():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, 1, 1, 6, 6)
+    w = sd.var("w", np.ones((2, 1, 3, 3), np.float32))
+    b = sd.var("b", np.zeros(2, np.float32))
+    c = sd.cnn.conv2d(x, w, b, kernel=(3, 3), strides=(1, 1), padding=(0, 0))
+    p = sd.cnn.maxPooling2d(c, kernel=(2, 2), strides=(2, 2)).rename("p")
+    out = sd.output({"x": np.ones((1, 1, 6, 6), np.float32)}, "p")
+    assert out["p"].shape() == (1, 2, 2, 2)
+    np.testing.assert_allclose(out["p"].toNumpy(), 9.0)
+
+
+def test_dropout_training_vs_inference():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, 10, 10)
+    d = sd.nn.dropout(x, p=0.5).rename("d")
+    out = sd.output({"x": np.ones((10, 10), np.float32)}, "d")
+    # inference: identity
+    np.testing.assert_allclose(out["d"].toNumpy(), 1.0)
+
+
+def test_serde_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 3)
+    w = sd.var("w", np.arange(6, dtype=np.float32).reshape(3, 2))
+    y = x.mmul(w).rename("y")
+    path = str(tmp_path / "model.sdz")
+    sd.save(path)
+
+    sd2 = SameDiff.load(path)
+    xv = np.ones((2, 3), np.float32)
+    o1 = sd.output({"x": xv}, "y")["y"].toNumpy()
+    o2 = sd2.output({"x": xv}, "y")["y"].toNumpy()
+    np.testing.assert_allclose(o1, o2)
+    assert sd2.getVariable("w").variableType == VariableType.VARIABLE
+
+
+def test_serde_with_training_state(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.randn(32, 2).astype(np.float32)
+    Y = X @ np.array([[1.0], [2.0]], np.float32)
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 2)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    pred = x.mmul(w)
+    sd.loss.meanSquaredError(pred, y).rename("loss")
+    cfg = TrainingConfig(updater=Adam(0.1), dataSetFeatureMapping=["x"],
+                         dataSetLabelMapping=["y"], lossVariables=["loss"])
+    sd.setTrainingConfig(cfg)
+    sd.fit([(X, Y)], epochs=5)
+    path = str(tmp_path / "m.sdz")
+    sd.save(path, saveUpdaterState=True)
+
+    sd2 = SameDiff.load(path, loadUpdaterState=True)
+    h2 = sd2.fit([(X, Y)], epochs=5)
+    assert h2.lossCurve[-1] < h2.lossCurve[0]
+
+
+def test_multihead_attention():
+    sd = SameDiff.create()
+    N, T, E, H = 2, 4, 8, 2
+    rng = np.random.RandomState(6)
+    x = sd.placeHolder("x", jnp.float32, N, T, E)
+    mk = lambda n: sd.var(n, (0.1 * rng.randn(E, E)).astype(np.float32))
+    out = sd.nn.multiHeadDotProductAttention(
+        x, x, x, mk("wq"), mk("wk"), mk("wv"), mk("wo"), numHeads=H
+    ).rename("att")
+    res = sd.output({"x": rng.randn(N, T, E).astype(np.float32)}, "att")
+    assert res["att"].shape() == (N, T, E)
+
+
+def test_one_hot_gather():
+    sd = SameDiff.create()
+    idx = sd.placeHolder("idx", jnp.int32, 3)
+    oh = sd.one_hot(idx, 4).rename("oh")
+    table = sd.var("table", np.arange(8, dtype=np.float32).reshape(4, 2))
+    emb = sd.gather(table, idx).rename("emb")
+    out = sd.output({"idx": np.array([0, 2, 3], np.int32)}, "oh", "emb")
+    assert out["oh"].shape() == (3, 4)
+    np.testing.assert_allclose(out["emb"].toNumpy()[1], [4, 5])
+
+
+# -- regression tests for review findings --------------------------------
+
+def test_refit_after_loss_change():
+    X = np.ones((4, 2), np.float32)
+    Y = np.ones((4, 1), np.float32)
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 2)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    pred = x.mmul(w)
+    sd.loss.meanSquaredError(pred, y).rename("loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Sgd(0.1), dataSetFeatureMapping=["x"],
+        dataSetLabelMapping=["y"], lossVariables=["loss"]))
+    sd.fit([(X, Y)], epochs=2)
+    # add a second loss and retarget — the cached train step must rebuild
+    sd.loss.absoluteDifference(pred, y).rename("loss2")
+    sd.setLossVariables("loss2")
+    h = sd.fit([(X, Y)], epochs=2)
+    assert len(h.lossCurve) == 2
+
+
+def test_var_initializer_deterministic():
+    import jax
+    sd1 = SameDiff.create()
+    v1 = sd1.var("w", jax.random.normal, 3, 3).getArr().toNumpy()
+    sd2 = SameDiff.create()
+    v2 = sd2.var("w", jax.random.normal, 3, 3).getArr().toNumpy()
+    np.testing.assert_allclose(v1, v2)
+
+
+def test_nested_schedule_serde(tmp_path):
+    from deeplearning4j_tpu.optimize import RampSchedule, FixedSchedule
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 2)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    sd.loss.meanSquaredError(x.mmul(w), y).rename("loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Sgd(RampSchedule(FixedSchedule(0.1), 10)),
+        dataSetFeatureMapping=["x"], dataSetLabelMapping=["y"],
+        lossVariables=["loss"]))
+    p = str(tmp_path / "m.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    lr = sd2.trainingConfig.updater.learningRate
+    assert isinstance(lr, RampSchedule)
+    assert isinstance(lr.baseSchedule, FixedSchedule)
+    h = sd2.fit([(np.ones((2, 2), np.float32), np.ones((2, 1), np.float32))],
+                epochs=2)
+    assert len(h.lossCurve) == 2
+
+
+def test_fit_with_generator_data():
+    X = np.ones((8, 2), np.float32)
+    Y = np.ones((8, 1), np.float32)
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 2)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    sd.loss.meanSquaredError(x.mmul(w), y).rename("loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Sgd(0.05), dataSetFeatureMapping=["x"],
+        dataSetLabelMapping=["y"], lossVariables=["loss"]))
+    gen = ((X[i:i + 4], Y[i:i + 4]) for i in range(0, 8, 4))
+    h = sd.fit(gen, epochs=3)
+    assert len(h.lossCurve) == 3
+    assert not np.isnan(h.lossCurve).any()
+
+
+def test_calculate_gradients_strict_wrt():
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, 2)
+    c = sd.constant("c", np.ones(2, np.float32))
+    (x.mul(c)).sum().markAsLoss().rename("loss")
+    with pytest.raises(ValueError, match="differentiate"):
+        sd.calculateGradients({"x": np.ones(2, np.float32)}, "x", "typo")
+    with pytest.raises(ValueError, match="differentiate"):
+        sd.calculateGradients({"x": np.ones(2, np.float32)}, "c")
+    g = sd.calculateGradients({"x": np.ones(2, np.float32)}, "x")
+    np.testing.assert_allclose(g["x"].toNumpy(), [1, 1])
+
+
+def test_params_accessible_during_fit():
+    """Listener reads variables mid-fit: must not observe donated buffers."""
+    X = np.ones((4, 2), np.float32)
+    Y = np.ones((4, 1), np.float32)
+    sd = SameDiff.create()
+    x = sd.placeHolder("x", jnp.float32, -1, 2)
+    y = sd.placeHolder("y", jnp.float32, -1, 1)
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    sd.loss.meanSquaredError(x.mmul(w), y).rename("loss")
+    sd.setTrainingConfig(TrainingConfig(
+        updater=Sgd(0.1), dataSetFeatureMapping=["x"],
+        dataSetLabelMapping=["y"], lossVariables=["loss"]))
+
+    seen = []
+
+    class L:
+        def iterationDone(self, sd_, it, epoch, loss):
+            seen.append(sd_.getVariable("w").getArr().toNumpy().copy())
+
+    sd.fit([(X, Y)], epochs=3, listeners=[L()])
+    assert len(seen) == 3
+    assert np.abs(seen[-1]).sum() > 0
