@@ -565,13 +565,25 @@ def _conv1d(x, w, b=None, stride=1, padding=0, sameMode=False):
 @op("deconv2d")
 def _deconv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), sameMode=False):
     """Transposed conv; w: [outC, inC, kH, kW] wrt the FORWARD direction of
-    the deconv (i.e. produces outC channels)."""
+    the deconv (i.e. produces outC channels). Implemented as the
+    lhs-dilated conv with per-side padding k-1-p and a spatially flipped
+    kernel, which yields DL4J's deconv output size s*(i-1) + k - 2p
+    (SAME mode: i*s)."""
     strides = _pair(strides)
     p = _pair(padding)
-    pad = "SAME" if sameMode else [(p[0], p[0]), (p[1], p[1])]
-    y = lax.conv_transpose(
-        x, jnp.transpose(w, (2, 3, 1, 0)), strides=strides, padding=pad,
-        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+    k = (w.shape[2], w.shape[3])
+    if sameMode:
+        # total pad k+s-2 per dim -> output i*s
+        tot = (k[0] + strides[0] - 2, k[1] + strides[1] - 2)
+        pad = [(tot[0] // 2, tot[0] - tot[0] // 2),
+               (tot[1] // 2, tot[1] - tot[1] // 2)]
+    else:
+        pad = [(k[0] - 1 - p[0], k[0] - 1 - p[0]),
+               (k[1] - 1 - p[1], k[1] - 1 - p[1])]
+    y = lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)), window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
     if b is not None:
         y = y + b.reshape(1, -1, 1, 1)

@@ -19,7 +19,6 @@ and builds an explicit backward graph from per-op doDiff rules. Here:
 from __future__ import annotations
 
 import json
-import time
 import zipfile
 from dataclasses import dataclass, field
 from enum import Enum
@@ -194,7 +193,8 @@ class SDVariable:
         return self
 
     def markAsLoss(self):
-        self.sd._loss_vars.append(self._name)
+        if self._name not in self.sd._loss_vars:
+            self.sd._loss_vars.append(self._name)
         return self
 
     def isPlaceHolder(self):
@@ -672,7 +672,6 @@ class SameDiff:
         feeds = {k: _unwrap_value(v) for k, v in feeds.items()}
         params, consts = self._split_values()
         rng = jax.random.key(self._seed)
-        fwd = self._make_fn(tuple(self._loss_vars), False)
 
         diff_feeds = {n: feeds[n] for n in wrt_names if n in feeds}
         diff_params = {n: params[n] for n in wrt_names if n in params}
@@ -684,14 +683,25 @@ class SameDiff:
                 f"fed placeholder or a VARIABLE (constants/ARRAY outputs are "
                 f"not differentiable targets)")
 
-        def loss_fn(dfeeds, dparams):
-            f = dict(feeds)
-            f.update(dfeeds)
-            p = dict(params)
-            p.update(dparams)
-            return self._loss_value(fwd(f, p, consts, rng))
+        cache_key = ("grad", tuple(self._loss_vars), tuple(wrt_names),
+                     tuple(sorted(feeds)))
+        if cache_key not in self._fn_cache:
+            fwd = self._make_fn(tuple(self._loss_vars), False)
 
-        gf, gp = jax.grad(loss_fn, argnums=(0, 1))(diff_feeds, diff_params)
+            def grad_fn(feeds, params, consts, rng, dfeeds, dparams):
+                def loss_fn(dfeeds, dparams):
+                    f = dict(feeds)
+                    f.update(dfeeds)
+                    p = dict(params)
+                    p.update(dparams)
+                    return self._loss_value(fwd(f, p, consts, rng))
+
+                return jax.grad(loss_fn, argnums=(0, 1))(dfeeds, dparams)
+
+            self._fn_cache[cache_key] = jax.jit(grad_fn)
+
+        gf, gp = self._fn_cache[cache_key](
+            feeds, params, consts, rng, diff_feeds, diff_params)
         out = {}
         out.update({k: INDArray(v) for k, v in gf.items()})
         out.update({k: INDArray(v) for k, v in gp.items()})

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.evaluation.classification import (  # noqa: F401
+    Evaluation, EvaluationBinary, ROC, ROCMultiClass)
+from deeplearning4j_tpu.evaluation.regression import (  # noqa: F401
+    RegressionEvaluation)

@@ -1,0 +1,248 @@
+"""Classification evaluation.
+
+Reference capability: org.nd4j.evaluation.classification.{Evaluation,
+EvaluationBinary, ROC, ROCMultiClass} (SURVEY.md §2.3 "Evaluation").
+Accumulation is a confusion-matrix merge per eval(labels, predictions)
+call — device math is a couple of argmax/scatter ops; the stats() report
+is host-side formatting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to_np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def _class_indices(arr):
+    a = _to_np(arr)
+    if a.ndim >= 2 and a.shape[-1] > 1:
+        return np.argmax(a, axis=-1).reshape(-1)
+    return a.reshape(-1).astype(np.int64)
+
+
+class Evaluation:
+    """Multiclass accuracy/precision/recall/F1 + confusion matrix."""
+
+    def __init__(self, numClasses=None, labelsList=None):
+        self.numClasses = numClasses
+        self.labelsList = labelsList
+        self._conf = None if numClasses is None else np.zeros(
+            (numClasses, numClasses), np.int64)
+
+    # -- accumulation --------------------------------------------------------
+    def eval(self, labels, predictions, mask=None):
+        if hasattr(labels, "ndim") and _to_np(labels).ndim == 3:
+            # [N, C, T] time series -> fold time into batch
+            labels = np.moveaxis(_to_np(labels), 2, 1).reshape(
+                -1, _to_np(labels).shape[1])
+            predictions = np.moveaxis(_to_np(predictions), 2, 1).reshape(
+                -1, _to_np(predictions).shape[1])
+        t = _class_indices(labels)
+        p = _class_indices(predictions)
+        if mask is not None:
+            m = _to_np(mask).reshape(-1).astype(bool)
+            t, p = t[m], p[m]
+        n = self.numClasses or int(max(t.max(initial=0),
+                                       p.max(initial=0))) + 1
+        if self._conf is None or n > self._conf.shape[0]:
+            conf = np.zeros((n, n), np.int64)
+            if self._conf is not None:
+                conf[: self._conf.shape[0], : self._conf.shape[1]] = self._conf
+            self._conf = conf
+            self.numClasses = n
+        np.add.at(self._conf, (t, p), 1)
+        return self
+
+    # -- metrics -------------------------------------------------------------
+    def _require(self):
+        if self._conf is None:
+            raise ValueError("no data accumulated; call eval() first")
+        return self._conf
+
+    def accuracy(self):
+        c = self._require()
+        tot = c.sum()
+        return float(np.trace(c) / tot) if tot else 0.0
+
+    def _tp(self):
+        return np.diag(self._require()).astype(np.float64)
+
+    def precision(self, cls=None):
+        c = self._require()
+        col = c.sum(axis=0).astype(np.float64)
+        per = np.divide(self._tp(), col, out=np.zeros_like(col),
+                        where=col > 0)
+        return float(per[cls]) if cls is not None else float(
+            per[col > 0].mean() if (col > 0).any() else 0.0)
+
+    def recall(self, cls=None):
+        c = self._require()
+        row = c.sum(axis=1).astype(np.float64)
+        per = np.divide(self._tp(), row, out=np.zeros_like(row),
+                        where=row > 0)
+        return float(per[cls]) if cls is not None else float(
+            per[row > 0].mean() if (row > 0).any() else 0.0)
+
+    def f1(self, cls=None):
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def falsePositiveRate(self, cls):
+        c = self._require()
+        fp = c[:, cls].sum() - c[cls, cls]
+        tn = c.sum() - c[cls, :].sum() - c[:, cls].sum() + c[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def confusionMatrix(self):
+        return self._require().copy()
+
+    def getNumRowCounter(self):
+        return int(self._require().sum())
+
+    def stats(self) -> str:
+        c = self._require()
+        n = c.shape[0]
+        names = self.labelsList or [str(i) for i in range(n)]
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {n}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+        ]
+        width = max(len(nm) for nm in names) + 2
+        header = " " * width + " ".join(f"{i:>6d}" for i in range(n))
+        lines.append(header)
+        for i in range(n):
+            row = " ".join(f"{c[i, j]:>6d}" for j in range(n))
+            lines.append(f"{names[i]:<{width}}{row}")
+        return "\n".join(lines)
+
+    def __str__(self):
+        return self.stats()
+
+
+class EvaluationBinary:
+    """Per-output independent binary evaluation (sigmoid outputs)."""
+
+    def __init__(self, nOutputs=None, threshold=0.5):
+        self.threshold = threshold
+        self._tp = self._fp = self._tn = self._fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        t = _to_np(labels)
+        p = (_to_np(predictions) >= self.threshold).astype(np.int64)
+        t = (t >= 0.5).astype(np.int64)
+        if self._tp is None:
+            k = t.shape[-1]
+            self._tp = np.zeros(k, np.int64)
+            self._fp = np.zeros(k, np.int64)
+            self._tn = np.zeros(k, np.int64)
+            self._fn = np.zeros(k, np.int64)
+        self._tp += ((p == 1) & (t == 1)).sum(axis=0)
+        self._fp += ((p == 1) & (t == 0)).sum(axis=0)
+        self._tn += ((p == 0) & (t == 0)).sum(axis=0)
+        self._fn += ((p == 0) & (t == 1)).sum(axis=0)
+        return self
+
+    def accuracy(self, i):
+        tot = self._tp[i] + self._fp[i] + self._tn[i] + self._fn[i]
+        return float((self._tp[i] + self._tn[i]) / tot) if tot else 0.0
+
+    def precision(self, i):
+        d = self._tp[i] + self._fp[i]
+        return float(self._tp[i] / d) if d else 0.0
+
+    def recall(self, i):
+        d = self._tp[i] + self._fn[i]
+        return float(self._tp[i] / d) if d else 0.0
+
+    def f1(self, i):
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def stats(self):
+        k = len(self._tp)
+        lines = ["Label  Acc     Precision  Recall   F1"]
+        for i in range(k):
+            lines.append(f"{i:<6d} {self.accuracy(i):<7.4f} "
+                         f"{self.precision(i):<10.4f} {self.recall(i):<8.4f} "
+                         f"{self.f1(i):.4f}")
+        return "\n".join(lines)
+
+
+class ROC:
+    """Binary ROC / AUC / AUPRC with exact thresholding (thresholdSteps=0
+    semantics of the reference: every distinct score is a threshold)."""
+
+    def __init__(self, thresholdSteps=0):
+        self.thresholdSteps = thresholdSteps
+        self._scores = []
+        self._labels = []
+
+    def eval(self, labels, predictions, mask=None):
+        lab = _to_np(labels)
+        pred = _to_np(predictions)
+        if lab.ndim >= 2 and lab.shape[-1] == 2:
+            lab = lab[..., 1]
+            pred = pred[..., 1]
+        self._labels.append(lab.reshape(-1))
+        self._scores.append(pred.reshape(-1))
+        return self
+
+    def _collect(self):
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        return y, s
+
+    def calculateAUC(self):
+        y, s = self._collect()
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        P, N = tps[-1], fps[-1]
+        if P == 0 or N == 0:
+            return 0.0
+        tpr = np.concatenate([[0], tps / P])
+        fpr = np.concatenate([[0], fps / N])
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculateAUCPR(self):
+        y, s = self._collect()
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        tps = np.cumsum(y)
+        P = tps[-1]
+        if P == 0:
+            return 0.0
+        prec = tps / np.arange(1, len(y) + 1)
+        rec = tps / P
+        return float(np.trapezoid(prec, rec))
+
+
+class ROCMultiClass:
+    def __init__(self, thresholdSteps=0):
+        self._rocs: dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        lab = _to_np(labels)
+        pred = _to_np(predictions)
+        for c in range(lab.shape[-1]):
+            self._rocs.setdefault(c, ROC()).eval(lab[..., c], pred[..., c])
+        return self
+
+    def calculateAUC(self, cls):
+        return self._rocs[cls].calculateAUC()
+
+    def calculateAverageAUC(self):
+        return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))

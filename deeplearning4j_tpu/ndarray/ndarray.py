@@ -63,6 +63,8 @@ class INDArray:
     def toNumpy(self) -> np.ndarray:
         return np.asarray(self._arr)
 
+    numpy = toNumpy  # pythonic alias
+
     def _set(self, new_arr) -> "INDArray":
         """Rebind this handle; views write back through the parent chain."""
         cur = self._arr

@@ -1,0 +1,24 @@
+"""Neural-net API layer (reference L4: deeplearning4j-nn, SURVEY.md §2.5)."""
+
+from deeplearning4j_tpu.nn.activations import Activation  # noqa: F401
+from deeplearning4j_tpu.nn.weights import WeightInit  # noqa: F401
+from deeplearning4j_tpu.nn.losses import LossFunction  # noqa: F401
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
+    MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.graph_conf import (  # noqa: F401
+    ComputationGraphConfiguration, ElementWiseVertex, GraphVertex,
+    L2NormalizeVertex, MergeVertex, ReshapeVertex, ScaleVertex, ShiftVertex,
+    StackVertex, SubsetVertex)
+from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
+from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
+    ActivationLayer, BatchNormalization, Bidirectional, Convolution1DLayer,
+    ConvolutionLayer, ConvolutionMode, Deconvolution2D, DenseLayer,
+    DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    GravesLSTM, LastTimeStep, LocalResponseNormalization, LossLayer, LSTM,
+    OutputLayer, PoolingType, RnnOutputLayer, SeparableConvolution2D,
+    SimpleRnn, Subsampling1DLayer, SubsamplingLayer, Upsampling2D,
+    ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
+    GradientNormalization, MultiLayerNetwork)
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

@@ -1,0 +1,76 @@
+"""Activation functions for layer configs.
+
+Reference capability: org.nd4j.linalg.activations.Activation enum +
+IActivation impls (SURVEY.md §2.5 layer impls call these as nd4j transform
+ops). Here each activation is a pure jnp function that XLA fuses into the
+surrounding matmul/conv — there is no separate kernel to dispatch, which is
+the TPU-native replacement for the reference's per-op JNI transform calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_CUBE = lambda x: x ** 3  # noqa: E731
+
+ACTIVATIONS = {
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "rectifiedtanh": lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    "cube": _CUBE,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+class Activation:
+    """Enum-style accessors: Activation.RELU == "relu" (string names keep the
+    config JSON-serializable exactly like the reference's enum names)."""
+
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    TANH = "tanh"
+    SIGMOID = "sigmoid"
+    SOFTMAX = "softmax"
+    LOGSOFTMAX = "logsoftmax"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SWISH = "swish"
+    MISH = "mish"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    HARDTANH = "hardtanh"
+    HARDSIGMOID = "hardsigmoid"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+
+def resolve_activation(name):
+    """Accept a name string, an Activation constant, or a callable."""
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    if key not in ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}")
+    return ACTIVATIONS[key]

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
+    MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401

@@ -1,0 +1,228 @@
+"""NeuralNetConfiguration builder DSL and MultiLayerConfiguration.
+
+Reference capability: org.deeplearning4j.nn.conf.NeuralNetConfiguration
+(+.Builder and .ListBuilder) and MultiLayerConfiguration (SURVEY.md §2.5
+"Config DSL"): global defaults (seed/updater/weightInit/activation/l1/l2)
+cloned into per-layer configs, automatic nIn inference + preprocessor
+insertion driven by setInputType, and canonical-JSON round-trip
+(MultiLayerConfiguration.fromJson) so checkpoints are portable.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    ConvolutionalFlatType, ConvolutionalType, InputType, RecurrentType,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, ConvolutionLayer, OUTPUT_LAYER_TYPES, SubsamplingLayer,
+)
+from deeplearning4j_tpu.optimize.updaters import (
+    IUpdater, Sgd, updater_from_config)
+
+# preprocessor kinds recorded per layer index (the reference's
+# InputPreProcessor impls: CnnToFeedForwardPreProcessor etc. — here pure
+# reshapes that XLA folds away)
+_PP_FLATTEN = "cnn_to_ff"
+_PP_TO_CNN = "ff_to_cnn"
+
+
+def _apply_preprocessor(pp, x):
+    if pp is None:
+        return x
+    kind, shape = pp
+    if kind == _PP_FLATTEN:
+        return x.reshape(x.shape[0], -1)
+    if kind == _PP_TO_CNN:
+        return x.reshape((x.shape[0],) + tuple(shape))
+    raise ValueError(f"unknown preprocessor {kind}")
+
+
+class MultiLayerConfiguration:
+    def __init__(self, layers, defaults=None, inputType=None, seed=12345,
+                 dataType="float32"):
+        self.layers: list[BaseLayer] = layers
+        self.defaults = defaults or {}
+        self.inputType = inputType
+        self.seed = seed
+        self.dataType = dataType
+        self.preprocessors: list = [None] * len(layers)
+        self.layer_input_types: list = [None] * len(layers)
+        self._finalize()
+
+    def _finalize(self):
+        """Clone defaults into layers and run shape inference front-to-back
+        (the reference does this in MultiLayerConfiguration.Builder.build)."""
+        for lr in self.layers:
+            lr.apply_defaults(self.defaults)
+        it = self.inputType
+        if it is None:
+            return
+        for i, lr in enumerate(self.layers):
+            if isinstance(it, ConvolutionalFlatType) and isinstance(
+                    lr, (ConvolutionLayer, SubsamplingLayer)):
+                self.preprocessors[i] = (
+                    _PP_TO_CNN, (it.channels, it.height, it.width))
+                it = InputType.convolutional(it.height, it.width, it.channels)
+            elif isinstance(it, ConvolutionalType) and not isinstance(
+                    it, ConvolutionalFlatType) and not isinstance(
+                    lr, (ConvolutionLayer, SubsamplingLayer)) \
+                    and not _wants_conv(lr):
+                self.preprocessors[i] = (_PP_FLATTEN, None)
+                it = InputType.feedForward(it.arrayElementsPerExample())
+            self.layer_input_types[i] = it
+            it = lr.infer(it)
+
+    # -- serde ---------------------------------------------------------------
+    def to_json(self):
+        return json.dumps({
+            "layers": [lr.to_json() for lr in self.layers],
+            "defaults": _json_defaults(self.defaults),
+            "inputType": self.inputType.to_json() if self.inputType else None,
+            "seed": self.seed,
+            "dataType": self.dataType,
+        }, indent=1)
+
+    toJson = to_json
+
+    @staticmethod
+    def from_json(s):
+        d = json.loads(s) if isinstance(s, str) else s
+        defaults = dict(d.get("defaults") or {})
+        if isinstance(defaults.get("updater"), dict):
+            defaults["updater"] = updater_from_config(defaults["updater"])
+        layers = [BaseLayer.from_json(ld) for ld in d["layers"]]
+        it = InputType.from_json(d["inputType"]) if d.get("inputType") else None
+        return MultiLayerConfiguration(layers, defaults, it,
+                                       d.get("seed", 12345),
+                                       d.get("dataType", "float32"))
+
+    fromJson = from_json
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dataType)
+
+
+def _wants_conv(layer):
+    from deeplearning4j_tpu.nn.conf.layers import (
+        BatchNormalization, LocalResponseNormalization, Upsampling2D,
+        ZeroPaddingLayer, Deconvolution2D)
+
+    return isinstance(layer, (BatchNormalization, LocalResponseNormalization,
+                              Upsampling2D, ZeroPaddingLayer,
+                              Deconvolution2D))
+
+
+def _json_defaults(defaults):
+    out = {}
+    for k, v in defaults.items():
+        out[k] = v.to_json() if hasattr(v, "to_json") else v
+    return out
+
+
+class ListBuilder:
+    def __init__(self, defaults, seed, dataType):
+        self._defaults = defaults
+        self._seed = seed
+        self._dataType = dataType
+        self._layers: list = []
+        self._input_type = None
+
+    def layer(self, idx_or_layer, layer=None):
+        if layer is None:
+            self._layers.append(idx_or_layer)
+        else:
+            idx = int(idx_or_layer)
+            while len(self._layers) <= idx:
+                self._layers.append(None)
+            self._layers[idx] = layer
+        return self
+
+    def setInputType(self, input_type):
+        self._input_type = input_type
+        return self
+
+    def inputType(self, input_type):
+        return self.setInputType(input_type)
+
+    def build(self) -> MultiLayerConfiguration:
+        if any(lr is None for lr in self._layers):
+            raise ValueError("layer list has gaps")
+        return MultiLayerConfiguration(self._layers, dict(self._defaults),
+                                       self._input_type, self._seed,
+                                       self._dataType)
+
+
+class NeuralNetConfiguration:
+    """Entry point: NeuralNetConfiguration.Builder()...list()...build()."""
+
+    class Builder:
+        def __init__(self):
+            self._defaults = {"updater": Sgd(1e-2)}
+            self._seed = 12345
+            self._dataType = "float32"
+
+        def seed(self, s):
+            self._seed = int(s)
+            return self
+
+        def updater(self, u: IUpdater):
+            self._defaults["updater"] = u
+            return self
+
+        def weightInit(self, wi):
+            self._defaults["weightInit"] = wi
+            return self
+
+        def activation(self, a):
+            self._defaults["activation"] = a
+            return self
+
+        def l1(self, v):
+            self._defaults["l1"] = float(v)
+            return self
+
+        def l2(self, v):
+            self._defaults["l2"] = float(v)
+            return self
+
+        def dropOut(self, p):
+            self._defaults["dropOut"] = float(p)
+            return self
+
+        def biasInit(self, v):
+            self._defaults["biasInit"] = float(v)
+            return self
+
+        def dataType(self, dt):
+            self._dataType = str(jnp.dtype(dt))
+            return self
+
+        def gradientNormalization(self, gn, threshold=1.0):
+            self._defaults["gradientNormalization"] = gn
+            self._defaults["gradientNormalizationThreshold"] = threshold
+            return self
+
+        def miniBatch(self, flag=True):
+            return self  # minibatch scaling is implicit in mean losses
+
+        def trainingWorkspaceMode(self, *_):
+            return self  # workspaces are an XLA concern here (no-op facade)
+
+        def inferenceWorkspaceMode(self, *_):
+            return self
+
+        def cudnnAlgoMode(self, *_):
+            return self  # no cuDNN on the TPU path
+
+        def list(self):
+            return ListBuilder(self._defaults, self._seed, self._dataType)
+
+        def graphBuilder(self):
+            from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+
+            return GraphBuilder(self._defaults, self._seed, self._dataType)

@@ -1,0 +1,292 @@
+"""ComputationGraphConfiguration: DAG of layers and vertices.
+
+Reference capability: org.deeplearning4j.nn.conf.ComputationGraphConfiguration
+(+.GraphBuilder) and graph vertices (MergeVertex, ElementWiseVertex, ...)
+(SURVEY.md §2.5, call stack §3.2). The reference precomputes a topological
+order and walks GraphVertex.doForward/doBackward objects at runtime; here
+the whole DAG lowers to one pure function executed inside a single jitted
+step, so vertices are just emitter functions.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    ConvolutionalType, FeedForwardType, InputType, RecurrentType)
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer
+
+VERTEX_REGISTRY: dict = {}
+
+
+def _register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class GraphVertex:
+    """Parameter-less combination vertex."""
+
+    def infer(self, *input_types):
+        return input_types[0]
+
+    def apply(self, *xs):
+        raise NotImplementedError
+
+    def to_json(self):
+        d = {"@class": type(self).__name__}
+        d.update({k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_json(d):
+        d = dict(d)
+        return VERTEX_REGISTRY[d.pop("@class")](**d)
+
+
+@_register_vertex
+class MergeVertex(GraphVertex):
+    """Concat along the feature/channel axis (axis 1 for >=2D, matching the
+    reference's MergeVertex default)."""
+
+    def infer(self, *input_types):
+        t0 = input_types[0]
+        total = sum(getattr(t, "channels", getattr(t, "size", 0))
+                    for t in input_types)
+        if isinstance(t0, ConvolutionalType):
+            return InputType.convolutional(t0.height, t0.width, total)
+        if isinstance(t0, RecurrentType):
+            return InputType.recurrent(total, t0.timeSeriesLength)
+        return InputType.feedForward(total)
+
+    def apply(self, *xs):
+        return jnp.concatenate(xs, axis=1)
+
+
+@_register_vertex
+class ElementWiseVertex(GraphVertex):
+    Add, Subtract, Product, Average, Max = ("Add", "Subtract", "Product",
+                                            "Average", "Max")
+
+    def __init__(self, op="Add"):
+        self.op = op
+
+    def apply(self, *xs):
+        if self.op == "Add":
+            y = xs[0]
+            for x in xs[1:]:
+                y = y + x
+            return y
+        if self.op == "Subtract":
+            return xs[0] - xs[1]
+        if self.op == "Product":
+            y = xs[0]
+            for x in xs[1:]:
+                y = y * x
+            return y
+        if self.op == "Average":
+            return sum(xs) / len(xs)
+        if self.op == "Max":
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+            return y
+        raise ValueError(self.op)
+
+
+@_register_vertex
+class ScaleVertex(GraphVertex):
+    def __init__(self, scaleFactor=1.0):
+        self.scaleFactor = scaleFactor
+
+    def apply(self, x):
+        return x * self.scaleFactor
+
+
+@_register_vertex
+class ShiftVertex(GraphVertex):
+    def __init__(self, shiftFactor=0.0):
+        self.shiftFactor = shiftFactor
+
+    def apply(self, x):
+        return x + self.shiftFactor
+
+
+@_register_vertex
+class StackVertex(GraphVertex):
+    """Stack along the batch axis (reference: StackVertex)."""
+
+    def apply(self, *xs):
+        return jnp.concatenate(xs, axis=0)
+
+
+@_register_vertex
+class SubsetVertex(GraphVertex):
+    def __init__(self, fromIdx=0, toIdx=0):
+        self.fromIdx = int(fromIdx)
+        self.toIdx = int(toIdx)
+
+    def infer(self, *input_types):
+        n = self.toIdx - self.fromIdx + 1
+        return InputType.feedForward(n)
+
+    def apply(self, x):
+        return x[:, self.fromIdx: self.toIdx + 1]
+
+
+@_register_vertex
+class L2NormalizeVertex(GraphVertex):
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+
+    def apply(self, x):
+        n = jnp.sqrt(jnp.sum(x * x, axis=tuple(range(1, x.ndim)),
+                             keepdims=True))
+        return x / (n + self.eps)
+
+
+@_register_vertex
+class ReshapeVertex(GraphVertex):
+    def __init__(self, newShape=None):
+        self.newShape = tuple(newShape)
+
+    def apply(self, x):
+        return x.reshape((x.shape[0],) + tuple(self.newShape))
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, inputs, nodes, outputs, defaults=None, seed=12345,
+                 dataType="float32", input_types=None):
+        self.inputs = list(inputs)            # input names
+        self.nodes = nodes                    # name -> (layer|vertex, [input names])
+        self.outputs = list(outputs)          # output layer names
+        self.defaults = defaults or {}
+        self.seed = seed
+        self.dataType = dataType
+        self.input_types = input_types or {}
+        self.topo_order: list[str] = []
+        self._finalize()
+
+    def _finalize(self):
+        # defaults
+        for name, (node, _) in self.nodes.items():
+            if isinstance(node, BaseLayer):
+                node.apply_defaults(self.defaults)
+        # topological order (Kahn)
+        indeg = {n: 0 for n in self.nodes}
+        dependents: dict[str, list[str]] = {n: [] for n in self.nodes}
+        for name, (_, ins) in self.nodes.items():
+            for i in ins:
+                if i in self.nodes:
+                    indeg[name] += 1
+                    dependents[i].append(name)
+                elif i not in self.inputs:
+                    raise ValueError(f"node {name!r} input {i!r} undefined")
+        ready = sorted(n for n, d in indeg.items() if d == 0)
+        order = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for m in dependents[n]:
+                indeg[m] -= 1
+                if indeg[m] == 0:
+                    ready.append(m)
+        if len(order) != len(self.nodes):
+            raise ValueError("cycle in computation graph")
+        self.topo_order = order
+        # shape inference when input types are declared
+        if self.input_types:
+            types = dict(self.input_types)
+            for name in order:
+                node, ins = self.nodes[name]
+                in_types = [types[i] for i in ins if i in types]
+                if len(in_types) != len(ins):
+                    continue
+                types[name] = node.infer(*in_types) if isinstance(
+                    node, GraphVertex) else node.infer(in_types[0])
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dataType)
+
+    def to_json(self):
+        nodes = {}
+        for name, (node, ins) in self.nodes.items():
+            kind = "layer" if isinstance(node, BaseLayer) else "vertex"
+            nodes[name] = {"kind": kind, "conf": node.to_json(),
+                           "inputs": list(ins)}
+        from deeplearning4j_tpu.nn.conf.configuration import _json_defaults
+
+        return json.dumps({
+            "inputs": self.inputs,
+            "nodes": nodes,
+            "outputs": self.outputs,
+            "defaults": _json_defaults(self.defaults),
+            "seed": self.seed,
+            "dataType": self.dataType,
+            "inputTypes": {k: v.to_json()
+                           for k, v in self.input_types.items()},
+        }, indent=1)
+
+    toJson = to_json
+
+    @staticmethod
+    def from_json(s):
+        from deeplearning4j_tpu.optimize.updaters import updater_from_config
+
+        d = json.loads(s) if isinstance(s, str) else s
+        nodes = {}
+        for name, nd in d["nodes"].items():
+            conf = (BaseLayer.from_json(nd["conf"]) if nd["kind"] == "layer"
+                    else GraphVertex.from_json(nd["conf"]))
+            nodes[name] = (conf, nd["inputs"])
+        defaults = dict(d.get("defaults") or {})
+        if isinstance(defaults.get("updater"), dict):
+            defaults["updater"] = updater_from_config(defaults["updater"])
+        input_types = {k: InputType.from_json(v)
+                       for k, v in (d.get("inputTypes") or {}).items()}
+        return ComputationGraphConfiguration(
+            d["inputs"], nodes, d["outputs"], defaults, d.get("seed", 12345),
+            d.get("dataType", "float32"), input_types)
+
+    fromJson = from_json
+
+
+class GraphBuilder:
+    def __init__(self, defaults, seed, dataType):
+        self._defaults = defaults
+        self._seed = seed
+        self._dataType = dataType
+        self._inputs: list[str] = []
+        self._nodes: dict = {}
+        self._outputs: list[str] = []
+        self._input_types: dict = {}
+
+    def addInputs(self, *names):
+        self._inputs.extend(names)
+        return self
+
+    def setInputTypes(self, *types):
+        for name, t in zip(self._inputs, types):
+            self._input_types[name] = t
+        return self
+
+    def addLayer(self, name, layer, *inputs):
+        self._nodes[name] = (layer, list(inputs))
+        return self
+
+    def addVertex(self, name, vertex, *inputs):
+        self._nodes[name] = (vertex, list(inputs))
+        return self
+
+    def setOutputs(self, *names):
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        return ComputationGraphConfiguration(
+            self._inputs, self._nodes, self._outputs, dict(self._defaults),
+            self._seed, self._dataType, self._input_types)

@@ -1,0 +1,103 @@
+"""Input type declarations for automatic shape inference.
+
+Reference capability: org.deeplearning4j.nn.conf.inputs.InputType
+(SURVEY.md §2.5 "Config DSL") — setInputType on the config builder drives
+nIn inference and automatic preprocessor insertion between layer kinds
+(conv <-> dense <-> recurrent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class InputType:
+    @staticmethod
+    def feedForward(size):
+        return FeedForwardType(int(size))
+
+    @staticmethod
+    def recurrent(size, timeSeriesLength=None):
+        return RecurrentType(int(size), timeSeriesLength)
+
+    @staticmethod
+    def convolutional(height, width, channels):
+        return ConvolutionalType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def convolutionalFlat(height, width, channels):
+        return ConvolutionalFlatType(int(height), int(width), int(channels))
+
+    @staticmethod
+    def from_json(d):
+        kinds = {
+            "feedforward": lambda: FeedForwardType(d["size"]),
+            "recurrent": lambda: RecurrentType(
+                d["size"], d.get("timeSeriesLength")),
+            "convolutional": lambda: ConvolutionalType(
+                d["height"], d["width"], d["channels"]),
+            "convolutionalflat": lambda: ConvolutionalFlatType(
+                d["height"], d["width"], d["channels"]),
+        }
+        return kinds[d["kind"]]()
+
+
+@dataclass
+class FeedForwardType:
+    size: int
+    kind: str = "feedforward"
+
+    def arrayElementsPerExample(self):
+        return self.size
+
+    def batch_shape(self, n=1):
+        return (n, self.size)
+
+    def to_json(self):
+        return {"kind": self.kind, "size": self.size}
+
+
+@dataclass
+class RecurrentType:
+    size: int
+    timeSeriesLength: int | None = None
+    kind: str = "recurrent"
+
+    def arrayElementsPerExample(self):
+        return self.size * (self.timeSeriesLength or 1)
+
+    def batch_shape(self, n=1):
+        # DL4J time-series layout: [N, C, T]
+        return (n, self.size, self.timeSeriesLength or 1)
+
+    def to_json(self):
+        return {"kind": self.kind, "size": self.size,
+                "timeSeriesLength": self.timeSeriesLength}
+
+
+@dataclass
+class ConvolutionalType:
+    height: int
+    width: int
+    channels: int
+    kind: str = "convolutional"
+
+    def arrayElementsPerExample(self):
+        return self.height * self.width * self.channels
+
+    def batch_shape(self, n=1):
+        return (n, self.channels, self.height, self.width)
+
+    def to_json(self):
+        return {"kind": self.kind, "height": self.height,
+                "width": self.width, "channels": self.channels}
+
+
+@dataclass
+class ConvolutionalFlatType(ConvolutionalType):
+    """MNIST-style flat input that the first conv layer reshapes to NCHW."""
+
+    kind: str = "convolutionalflat"
+
+    def batch_shape(self, n=1):
+        return (n, self.height * self.width * self.channels)

@@ -1,0 +1,260 @@
+"""ComputationGraph: the DAG-network runtime (multi-input / multi-output).
+
+Reference capability: org.deeplearning4j.nn.graph.ComputationGraph
+(SURVEY.md §2.5, call stack §3.2). As with MultiLayerNetwork, the DAG is
+lowered to one pure function over the precomputed topological order and
+trained with a single donated-buffer XLA step per minibatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.samediff import _as_batches, _split_dataset
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.ndarray import INDArray
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration, GraphVertex)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, OUTPUT_LAYER_TYPES)
+from deeplearning4j_tpu.nn.multilayer import _normalize_grads, _unwrap
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        for out in conf.outputs:
+            node, _ = conf.nodes[out]
+            if not isinstance(node, OUTPUT_LAYER_TYPES):
+                raise ValueError(f"output node {out!r} must be an "
+                                 f"OutputLayer/LossLayer")
+        self._params: dict[str, dict] = {}
+        self._states: dict[str, dict] = {}
+        self._opt_states: dict = {}
+        self._listeners: list = []
+        self._train_step = None
+        self._infer_fn_cache = {}
+        self._iteration = 0
+        self._epoch = 0
+        self._score = None
+        self._initialized = False
+
+    def init(self):
+        dtype = self.conf.dtype
+        key = jax.random.key(self.conf.seed)
+        for i, name in enumerate(self.conf.topo_order):
+            node, _ = self.conf.nodes[name]
+            if isinstance(node, BaseLayer):
+                self._params[name] = node.init_params(
+                    jax.random.fold_in(key, i), dtype)
+                self._states[name] = node.init_state(dtype)
+            else:
+                self._params[name] = {}
+                self._states[name] = {}
+        self._opt_states = {
+            name: (self._updater(name).init_state(p) if p else ())
+            for name, p in self._params.items()
+        }
+        self._initialized = True
+        return self
+
+    def _updater(self, name):
+        node, _ = self.conf.nodes[name]
+        u = getattr(node, "updater", None)
+        return u if u is not None else self.conf.defaults["updater"]
+
+    def _check_init(self):
+        if not self._initialized:
+            raise RuntimeError("call init() first")
+
+    # -- pure forward over the DAG ------------------------------------------
+    def _forward(self, params, states, inputs: dict, training, rng,
+                 stop_before_output=False):
+        env = dict(inputs)
+        new_states = {}
+        for i, name in enumerate(self.conf.topo_order):
+            node, ins = self.conf.nodes[name]
+            xs = [env[n] for n in ins]
+            if isinstance(node, GraphVertex):
+                env[name] = node.apply(*xs)
+                new_states[name] = {}
+            elif stop_before_output and name in self.conf.outputs:
+                # leave the pre-output input available for the loss
+                env[name] = xs[0]
+                new_states[name] = states[name]
+            else:
+                lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                y, st = node.apply(params[name], states[name], xs[0],
+                                   training, lrng)
+                env[name] = y
+                new_states[name] = st
+        return env, new_states
+
+    def _loss_from(self, params, states, inputs, labels: dict, training, rng):
+        env, new_states = self._forward(params, states, inputs, training, rng,
+                                        stop_before_output=True)
+        loss = 0.0
+        for out in self.conf.outputs:
+            node, _ = self.conf.nodes[out]
+            loss = loss + node.compute_loss(params[out], env[out],
+                                            labels[out])
+        # regularization
+        for name, (node, _) in self.conf.nodes.items():
+            p = params.get(name)
+            if not p:
+                continue
+            l2 = getattr(node, "l2", None) or 0.0
+            l1 = getattr(node, "l1", None) or 0.0
+            if l2:
+                loss = loss + 0.5 * l2 * sum(
+                    jnp.sum(w * w) for w in jax.tree_util.tree_leaves(p))
+            if l1:
+                loss = loss + l1 * sum(
+                    jnp.sum(jnp.abs(w)) for w in jax.tree_util.tree_leaves(p))
+        return loss, new_states
+
+    # -- training ------------------------------------------------------------
+    def _build_train_step(self):
+        def step(params, states, opt_states, inputs, labels, rng, it):
+            def loss_fn(p):
+                return self._loss_from(p, states, inputs, labels, True, rng)
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opts = {}, {}
+            for name, (node, _) in self.conf.nodes.items():
+                g = grads.get(name)
+                if not g:
+                    new_params[name] = params[name]
+                    new_opts[name] = opt_states[name]
+                    continue
+                g = _normalize_grads(
+                    g, getattr(node, "gradientNormalization", None),
+                    getattr(node, "gradientNormalizationThreshold", None)
+                    or 1.0)
+                upd, new_opt = self._updater(name).apply(
+                    g, opt_states[name], params[name], it)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[name], upd)
+                new_opts[name] = new_opt
+            return loss, new_params, new_states, new_opts
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _feeds(self, ds):
+        feats, labels = _split_dataset(ds)
+        inputs = {n: _unwrap(f) for n, f in zip(self.conf.inputs, feats)}
+        lab = {n: _unwrap(l) for n, l in zip(self.conf.outputs, labels)}
+        return inputs, lab
+
+    def fit(self, data, epochs: int = 1):
+        self._check_init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        params, states, opts = self._params, self._states, self._opt_states
+        base_key = jax.random.key(self.conf.seed + 1)
+        last = None
+        for _ in range(epochs):
+            for ds in _as_batches(data):
+                inputs, labels = self._feeds(ds)
+                rng = jax.random.fold_in(base_key, self._iteration)
+                loss, params, states, opts = self._train_step(
+                    params, states, opts, inputs, labels, rng,
+                    self._iteration)
+                self._params, self._states, self._opt_states = (
+                    params, states, opts)
+                self._iteration += 1
+                last = loss
+                if self._listeners:
+                    self._score = float(loss)
+                    for listener in self._listeners:
+                        listener.iterationDone(self, self._iteration,
+                                               self._epoch)
+            self._epoch += 1
+        if last is not None:
+            self._score = float(last)
+        return self
+
+    # -- inference -----------------------------------------------------------
+    def output(self, *xs, train=False):
+        """output(x1, x2, ...) -> list of output arrays (one per configured
+        output)."""
+        self._check_init()
+        inputs = {n: _unwrap(x) for n, x in zip(self.conf.inputs, xs)}
+        key = ("out", train)
+        if key not in self._infer_fn_cache:
+            def fn(params, states, inputs):
+                env, _ = self._forward(params, states, inputs, train, None)
+                return [env[o] for o in self.conf.outputs]
+
+            self._infer_fn_cache[key] = jax.jit(fn)
+        ys = self._infer_fn_cache[key](self._params, self._states, inputs)
+        return [INDArray(y) for y in ys]
+
+    def outputSingle(self, *xs, train=False) -> INDArray:
+        return self.output(*xs, train=train)[0]
+
+    def score(self, dataset=None) -> float:
+        self._check_init()
+        if dataset is None:
+            if self._score is None:
+                raise ValueError("no score yet")
+            return self._score
+        inputs, labels = self._feeds(dataset)
+        loss, _ = self._loss_from(self._params, self._states, inputs, labels,
+                                  False, None)
+        return float(loss)
+
+    def evaluate(self, iterator, numClasses=None) -> Evaluation:
+        self._check_init()
+        ev = Evaluation(numClasses)
+        for ds in _as_batches(iterator):
+            feats, labels = _split_dataset(ds)
+            out = self.output(*feats)[0]
+            ev.eval(labels[0], out)
+        return ev
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(v.shape)) for p in self._params.values()
+                   for v in p.values())
+
+    def params(self) -> INDArray:
+        leaves = []
+        for name in self.conf.topo_order:
+            p = self._params[name]
+            for k in sorted(p):
+                leaves.append(jnp.ravel(p[k]))
+        if not leaves:
+            return INDArray(jnp.zeros((0,)))
+        return INDArray(jnp.concatenate(leaves))
+
+    def getParam(self, node: str, name: str) -> INDArray:
+        return INDArray(self._params[node][name])
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def gradients(self, inputs_and_labels) -> dict:
+        """Per-node analytic gradients for the gradient-check harness."""
+        self._check_init()
+        inputs, labels = self._feeds(inputs_and_labels)
+
+        def loss_fn(p):
+            loss, _ = self._loss_from(p, self._states, inputs, labels, False,
+                                      None)
+            return loss
+
+        return jax.grad(loss_fn)(self._params)
+
+    def summary(self) -> str:
+        lines = [f"{'name':<24}{'type':<26}{'nParams':<10}{'inputs'}"]
+        for name in self.conf.topo_order:
+            node, ins = self.conf.nodes[name]
+            n = sum(int(np.prod(v.shape))
+                    for v in self._params.get(name, {}).values())
+            lines.append(f"{name:<24}{type(node).__name__:<26}{n:<10}{ins}")
+        lines.append(f"Total params: {self.numParams()}")
+        return "\n".join(lines)

@@ -1,0 +1,162 @@
+"""Loss functions for output layers.
+
+Reference capability: org.nd4j.linalg.lossfunctions.LossFunctions.LossFunction
+enum + ILossFunction impls (used by BaseOutputLayer.computeScore, SURVEY.md
+§2.5). Each loss maps (labels, pre_output, activation_name, mask) -> scalar
+mean-per-example score. Softmax+MCXENT and sigmoid+XENT fuse into
+numerically-stable logit formulations (log_softmax / logaddexp) instead of
+activating first — the fused form is also what XLA wants to see.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import resolve_activation
+
+
+class LossFunction:
+    MCXENT = "mcxent"
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    MSE = "mse"
+    L2 = "l2"
+    XENT = "xent"
+    MAE = "mae"
+    L1 = "l1"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    POISSON = "poisson"
+    COSINE_PROXIMITY = "cosine_proximity"
+    SPARSE_MCXENT = "sparse_mcxent"
+
+
+# DL4J alias: LossFunctions.LossFunction.NEGATIVELOGLIKELIHOOD is MCXENT
+# with softmax clamping; both reduce to CE-with-logits here.
+_XENT_FAMILY = {LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD}
+
+
+def _flatten_time(labels, pre):
+    """RNN outputs arrive as [N, C, T] (DL4J NCW). Fold time into batch so
+    every loss sees [N*, C]."""
+    if pre.ndim == 3:
+        pre = jnp.reshape(jnp.moveaxis(pre, 2, 1), (-1, pre.shape[1]))
+        labels = jnp.reshape(jnp.moveaxis(labels, 2, 1), (-1, labels.shape[1]))
+    return labels, pre
+
+
+def _per_example(loss_fn):
+    def wrapped(labels, pre_output, activation, mask=None):
+        labels, pre_output = _flatten_time(labels, pre_output)
+        per_ex = loss_fn(labels, pre_output, activation)  # [N]
+        if mask is not None:
+            m = jnp.reshape(mask, (-1,)).astype(per_ex.dtype)
+            return jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(per_ex)
+
+    return wrapped
+
+
+def _mcxent(labels, pre, activation):
+    if activation == "softmax":
+        logp = jax.nn.log_softmax(pre, axis=-1)
+    elif activation in ("identity", "logsoftmax"):
+        logp = pre if activation == "logsoftmax" else jnp.log(
+            jnp.clip(pre, 1e-10, 1.0))
+    else:
+        out = resolve_activation(activation)(pre)
+        logp = jnp.log(jnp.clip(out, 1e-10, 1.0))
+    return -jnp.sum(labels * logp, axis=-1)
+
+
+def _sparse_mcxent(labels, pre, activation):
+    logp = jax.nn.log_softmax(pre, axis=-1)
+    idx = labels.astype(jnp.int32)
+    if idx.ndim == logp.ndim:  # [N,1] -> [N]
+        idx = idx[..., 0]
+    return -jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+
+
+def _xent(labels, pre, activation):
+    if activation == "sigmoid":
+        # stable binary CE from logits: max(x,0) - x*z + log1p(exp(-|x|))
+        per = (jnp.maximum(pre, 0) - pre * labels
+               + jnp.log1p(jnp.exp(-jnp.abs(pre))))
+    else:
+        out = jnp.clip(resolve_activation(activation)(pre), 1e-10, 1 - 1e-10)
+        per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
+    return jnp.sum(per, axis=-1)
+
+
+def _mse(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.mean((labels - out) ** 2, axis=-1)
+
+
+def _l2(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.sum((labels - out) ** 2, axis=-1)
+
+
+def _mae(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.mean(jnp.abs(labels - out), axis=-1)
+
+
+def _l1(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.sum(jnp.abs(labels - out), axis=-1)
+
+
+def _hinge(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.sum(jnp.maximum(0.0, 1.0 - labels * out), axis=-1)
+
+
+def _squared_hinge(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.sum(jnp.maximum(0.0, 1.0 - labels * out) ** 2, axis=-1)
+
+
+def _kld(labels, pre, activation):
+    out = jnp.clip(resolve_activation(activation)(pre), 1e-10, 1.0)
+    lab = jnp.clip(labels, 1e-10, 1.0)
+    return jnp.sum(labels * (jnp.log(lab) - jnp.log(out)), axis=-1)
+
+
+def _poisson(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    return jnp.sum(out - labels * jnp.log(jnp.clip(out, 1e-10, None)), axis=-1)
+
+
+def _cosine(labels, pre, activation):
+    out = resolve_activation(activation)(pre)
+    dot = jnp.sum(labels * out, axis=-1)
+    norms = (jnp.linalg.norm(labels, axis=-1)
+             * jnp.linalg.norm(out, axis=-1))
+    return -dot / jnp.maximum(norms, 1e-10)
+
+
+_LOSSES = {
+    LossFunction.MCXENT: _mcxent,
+    LossFunction.NEGATIVELOGLIKELIHOOD: _mcxent,
+    LossFunction.SPARSE_MCXENT: _sparse_mcxent,
+    LossFunction.MSE: _mse,
+    LossFunction.L2: _l2,
+    LossFunction.XENT: _xent,
+    LossFunction.MAE: _mae,
+    LossFunction.L1: _l1,
+    LossFunction.HINGE: _hinge,
+    LossFunction.SQUARED_HINGE: _squared_hinge,
+    LossFunction.KL_DIVERGENCE: _kld,
+    LossFunction.POISSON: _poisson,
+    LossFunction.COSINE_PROXIMITY: _cosine,
+}
+
+
+def resolve_loss(name):
+    key = str(name).lower()
+    if key not in _LOSSES:
+        raise ValueError(f"unknown loss function {name!r}")
+    return _per_example(_LOSSES[key])

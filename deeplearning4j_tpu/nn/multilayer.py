@@ -1,0 +1,369 @@
+"""MultiLayerNetwork: the sequential-network runtime.
+
+Reference capability: org.deeplearning4j.nn.multilayer.MultiLayerNetwork
+(SURVEY.md §2.5, call stack §3.1). The reference's fit() walks layers
+calling activate/backpropGradient with a JNI dispatch per op and assembles
+a flat gradient for the Solver. Here the whole network lowers to ONE pure
+function and fit() runs ONE compiled XLA step per minibatch:
+forward + backward (jax.grad) + every per-layer updater fused, with
+parameter/updater-state buffers donated (device-resident params — the
+PJRT equivalent of the reference's flat-param views, SURVEY.md §7 hard
+part 2). No Solver, no per-layer workspaces: XLA owns scheduling.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.samediff import _as_batches, _split_dataset
+from deeplearning4j_tpu.evaluation import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.ndarray import INDArray
+from deeplearning4j_tpu.nn.conf.configuration import (
+    MultiLayerConfiguration, _apply_preprocessor)
+from deeplearning4j_tpu.nn.conf.layers import OUTPUT_LAYER_TYPES
+
+
+def _unwrap(x):
+    if isinstance(x, INDArray):
+        return x.jax()
+    return jnp.asarray(x)
+
+
+class GradientNormalization:
+    ClipL2PerLayer = "clip_l2_per_layer"
+    ClipL2PerParamType = "clip_l2_per_param"
+    ClipElementWiseAbsoluteValue = "clip_elementwise"
+    RenormalizeL2PerLayer = "renorm_l2_per_layer"
+
+
+def _normalize_grads(grads, mode, threshold):
+    if mode is None:
+        return grads
+    leaves = jax.tree_util.tree_leaves(grads)
+    if mode == GradientNormalization.ClipElementWiseAbsoluteValue:
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves) + 1e-12)
+    if mode == GradientNormalization.RenormalizeL2PerLayer:
+        return jax.tree_util.tree_map(lambda g: g / norm, grads)
+    scale = jnp.minimum(1.0, threshold / norm)
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        out = self.layers[-1]
+        if not isinstance(out, OUTPUT_LAYER_TYPES):
+            raise ValueError("last layer must be an OutputLayer/LossLayer")
+        self._params: list[dict] = []
+        self._states: list[dict] = []
+        self._opt_states: list = []
+        self._listeners: list = []
+        self._train_step = None
+        self._infer_fns: dict = {}
+        self._iteration = 0
+        self._epoch = 0
+        self._score = None
+        self._initialized = False
+
+    # -- init ----------------------------------------------------------------
+    def init(self):
+        dtype = self.conf.dtype
+        key = jax.random.key(self.conf.seed)
+        self._params, self._states = [], []
+        for i, lr in enumerate(self.layers):
+            self._params.append(lr.init_params(jax.random.fold_in(key, i),
+                                               dtype))
+            self._states.append(lr.init_state(dtype))
+        self._opt_states = [
+            self._layer_updater(i).init_state(p) if p else ()
+            for i, p in enumerate(self._params)
+        ]
+        self._initialized = True
+        return self
+
+    def _layer_updater(self, i):
+        u = self.layers[i].updater
+        return u if u is not None else self.conf.defaults["updater"]
+
+    def _check_init(self):
+        if not self._initialized:
+            raise RuntimeError("call init() first")
+
+    # -- pure forward --------------------------------------------------------
+    def _forward(self, params, states, x, training, rng, upto=None):
+        new_states = []
+        n = len(self.layers) if upto is None else upto
+        for i in range(n):
+            lr = self.layers[i]
+            x = _apply_preprocessor(self.conf.preprocessors[i], x)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, st = lr.apply(params[i], states[i], x, training, lrng)
+            new_states.append(st)
+        new_states.extend(states[n:])
+        return x, new_states
+
+    def _loss_from(self, params, states, f, l, training, rng, mask=None):
+        """Forward to the last hidden activation, then the output layer's
+        fused pre-activation loss (stable logits path)."""
+        out_idx = len(self.layers) - 1
+        h, new_states = self._forward(params, states, f, training, rng,
+                                      upto=out_idx)
+        h = _apply_preprocessor(self.conf.preprocessors[out_idx], h)
+        out_layer = self.layers[out_idx]
+        loss = out_layer.compute_loss(params[out_idx], h, l, mask)
+        # L1/L2 regularization per layer (reference: BaseLayer.calcRegularizationScore)
+        reg = 0.0
+        for i, lr in enumerate(self.layers):
+            if not params[i]:
+                continue
+            l2 = lr.l2 or 0.0
+            l1 = lr.l1 or 0.0
+            if l2:
+                reg = reg + l2 * sum(jnp.sum(w * w)
+                                     for w in jax.tree_util.tree_leaves(
+                                         params[i])) * 0.5
+            if l1:
+                reg = reg + l1 * sum(jnp.sum(jnp.abs(w))
+                                     for w in jax.tree_util.tree_leaves(
+                                         params[i]))
+        return loss + reg, new_states
+
+    # -- compiled train step -------------------------------------------------
+    def _build_train_step(self):
+        updaters = [self._layer_updater(i) for i in range(len(self.layers))]
+
+        def step(params, states, opt_states, f, l, rng, it):
+            def loss_fn(p):
+                loss, ns = self._loss_from(p, states, f, l, True, rng)
+                return loss, ns
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opts = [], []
+            for i, lr in enumerate(self.layers):
+                g = grads[i]
+                if not g:
+                    new_params.append(params[i])
+                    new_opts.append(opt_states[i])
+                    continue
+                g = _normalize_grads(g, lr.gradientNormalization,
+                                     lr.gradientNormalizationThreshold or 1.0)
+                upd, new_opt = updaters[i].apply(g, opt_states[i], params[i],
+                                                 it)
+                new_params.append(jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[i], upd))
+                new_opts.append(new_opt)
+            return loss, new_params, new_states, new_opts
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int | None = None):
+        """fit(iterator) / fit(iterator, nEpochs) / fit(features, labels) /
+        fit(DataSet)."""
+        self._check_init()
+        if epochs is not None and not isinstance(epochs, int):
+            # fit(features, labels)
+            data, epochs = (data, epochs), 1
+        epochs = epochs or 1
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        params, states, opts = self._params, self._states, self._opt_states
+        base_key = jax.random.key(self.conf.seed + 1)
+        last_loss = None
+        for _ in range(epochs):
+            for ds in _as_batches(data):
+                feats, labels = _split_dataset(ds)
+                f = _unwrap(feats[0])
+                l = _unwrap(labels[0])
+                rng = jax.random.fold_in(base_key, self._iteration)
+                loss, params, states, opts = self._train_step(
+                    params, states, opts, f, l, rng, self._iteration)
+                # rebind before anything can observe donated buffers
+                self._params, self._states, self._opt_states = (
+                    params, states, opts)
+                self._iteration += 1
+                last_loss = loss
+                if self._listeners:
+                    lv = float(loss)
+                    self._score = lv
+                    for listener in self._listeners:
+                        listener.iterationDone(self, self._iteration,
+                                               self._epoch)
+            self._epoch += 1
+        if last_loss is not None:
+            self._score = float(last_loss)
+        return self
+
+    # -- inference -----------------------------------------------------------
+    def _infer_fn(self, training=False):
+        key = ("out", training)
+        if key not in self._infer_fns:
+            def fn(params, states, x):
+                y, _ = self._forward(params, states, x, training, None)
+                return y
+
+            self._infer_fns[key] = jax.jit(fn)
+        return self._infer_fns[key]
+
+    def output(self, x, train: bool = False) -> INDArray:
+        self._check_init()
+        y = self._infer_fn(train)(self._params, self._states, _unwrap(x))
+        return INDArray(y)
+
+    def feedForward(self, x, train: bool = False) -> list:
+        """All layer activations (reference returns input + each layer's
+        activation)."""
+        self._check_init()
+        x = _unwrap(x)
+        acts = [INDArray(x)]
+        states = self._states
+        for i, lr in enumerate(self.layers):
+            x = _apply_preprocessor(self.conf.preprocessors[i], x)
+            x, _ = lr.apply(self._params[i], states[i], x, train, None)
+            acts.append(INDArray(x))
+        return acts
+
+    def rnnTimeStep(self, x):
+        """Minimal streaming inference (TBPTT capability, SURVEY.md §2.5):
+        full-sequence output of the final step."""
+        return self.output(x)
+
+    # -- scoring / eval ------------------------------------------------------
+    def score(self, dataset=None) -> float:
+        self._check_init()
+        if dataset is None:
+            if self._score is None:
+                raise ValueError("no score yet: call fit() or score(dataset)")
+            return self._score
+        feats, labels = _split_dataset(dataset)
+        loss, _ = self._loss_from(self._params, self._states,
+                                  _unwrap(feats[0]), _unwrap(labels[0]),
+                                  False, None)
+        return float(loss)
+
+    def evaluate(self, iterator, numClasses=None) -> Evaluation:
+        self._check_init()
+        ev = Evaluation(numClasses)
+        for ds in _as_batches(iterator):
+            feats, labels = _split_dataset(ds)
+            out = self.output(feats[0])
+            ev.eval(labels[0], out)
+        return ev
+
+    def evaluateRegression(self, iterator) -> RegressionEvaluation:
+        ev = RegressionEvaluation()
+        for ds in _as_batches(iterator):
+            feats, labels = _split_dataset(ds)
+            out = self.output(feats[0])
+            ev.eval(labels[0], out)
+        return ev
+
+    # -- params --------------------------------------------------------------
+    def params(self) -> INDArray:
+        """Flat parameter vector in layer order (reference:
+        MultiLayerNetwork.params() flat view)."""
+        self._check_init()
+        leaves = []
+        for p in self._params:
+            for k in sorted(p):
+                leaves.append(jnp.ravel(p[k]))
+        if not leaves:
+            return INDArray(jnp.zeros((0,)))
+        return INDArray(jnp.concatenate(leaves))
+
+    def setParams(self, flat):
+        self._check_init()
+        flat = _unwrap(flat).reshape(-1)
+        off = 0
+        for p in self._params:
+            for k in sorted(p):
+                n = int(np.prod(p[k].shape)) if p[k].shape else 1
+                p[k] = flat[off: off + n].reshape(p[k].shape).astype(
+                    p[k].dtype)
+                off += n
+        self._train_step = None
+
+    def numParams(self) -> int:
+        return sum(int(np.prod(v.shape)) for p in self._params
+                   for v in p.values())
+
+    def getParam(self, layer_idx: int, name: str) -> INDArray:
+        return INDArray(self._params[layer_idx][name])
+
+    def setParam(self, layer_idx: int, name: str, value):
+        self._params[layer_idx][name] = _unwrap(value)
+
+    def paramTable(self) -> dict:
+        return {f"{i}_{k}": INDArray(v)
+                for i, p in enumerate(self._params) for k, v in p.items()}
+
+    def gradients(self, features, labels) -> list[dict]:
+        """Per-layer analytic gradients (for the gradient-check harness,
+        SURVEY.md §4)."""
+        self._check_init()
+        f, l = _unwrap(features), _unwrap(labels)
+
+        def loss_fn(p):
+            loss, _ = self._loss_from(p, self._states, f, l, False, None)
+            return loss
+
+        return jax.grad(loss_fn)(self._params)
+
+    def computeGradientAndScore(self, features, labels):
+        f, l = _unwrap(features), _unwrap(labels)
+
+        def loss_fn(p):
+            loss, _ = self._loss_from(p, self._states, f, l, False, None)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(self._params)
+        self._score = float(loss)
+        return grads, self._score
+
+    # -- listeners / misc ----------------------------------------------------
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners)
+        return self
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+        return self
+
+    def getListeners(self):
+        return list(self._listeners)
+
+    def getIterationCount(self):
+        return self._iteration
+
+    def getEpochCount(self):
+        return self._epoch
+
+    def clone(self) -> "MultiLayerNetwork":
+        other = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(self.conf.to_json()))
+        if self._initialized:
+            other.init()
+            # real copies, not aliases: the source's next fit() DONATES its
+            # buffers, which would invalidate shared references
+            copy = lambda x: jnp.array(x, copy=True)  # noqa: E731
+            other._params = jax.tree_util.tree_map(copy, self._params)
+            other._states = jax.tree_util.tree_map(copy, self._states)
+            other._opt_states = jax.tree_util.tree_map(copy, self._opt_states)
+        return other
+
+    def summary(self) -> str:
+        lines = [f"{'idx':<4}{'layer':<28}{'nParams':<10}{'shape'}"]
+        for i, (lr, p) in enumerate(zip(self.layers, self._params)):
+            n = sum(int(np.prod(v.shape)) for v in p.values())
+            shapes = {k: tuple(v.shape) for k, v in p.items()}
+            lines.append(f"{i:<4}{type(lr).__name__:<28}{n:<10}{shapes}")
+        lines.append(f"Total params: {self.numParams()}")
+        return "\n".join(lines)

@@ -1,0 +1,67 @@
+"""Weight initialization schemes.
+
+Reference capability: org.deeplearning4j.nn.weights.WeightInit +
+WeightInitUtil (SURVEY.md §2.5 "Param init & flat params"). Initializers are
+(key, shape, fan_in, fan_out) -> array; fan values follow DL4J's conventions
+(for conv: fanIn = inC*kH*kW, fanOut = outC*kH*kW).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _normal(key, shape, std):
+    return jax.random.normal(key, shape) * std
+
+
+def _uniform(key, shape, limit):
+    return jax.random.uniform(key, shape, minval=-limit, maxval=limit)
+
+
+_INITS = {
+    # DL4J XAVIER: gaussian with var 2/(fanIn+fanOut)
+    "xavier": lambda k, s, fi, fo: _normal(k, s, math.sqrt(2.0 / (fi + fo))),
+    "xavier_uniform": lambda k, s, fi, fo: _uniform(
+        k, s, math.sqrt(6.0 / (fi + fo))),
+    "xavier_fan_in": lambda k, s, fi, fo: _normal(k, s, math.sqrt(1.0 / fi)),
+    # He / RELU: gaussian with var 2/fanIn
+    "relu": lambda k, s, fi, fo: _normal(k, s, math.sqrt(2.0 / fi)),
+    "relu_uniform": lambda k, s, fi, fo: _uniform(k, s, math.sqrt(6.0 / fi)),
+    "lecun_normal": lambda k, s, fi, fo: _normal(k, s, math.sqrt(1.0 / fi)),
+    "lecun_uniform": lambda k, s, fi, fo: _uniform(k, s, math.sqrt(3.0 / fi)),
+    "normal": lambda k, s, fi, fo: _normal(k, s, 1.0 / math.sqrt(fi)),
+    "uniform": lambda k, s, fi, fo: _uniform(
+        k, s, 1.0 / math.sqrt(fi)),
+    "sigmoid_uniform": lambda k, s, fi, fo: _uniform(
+        k, s, 4.0 * math.sqrt(6.0 / (fi + fo))),
+    "zero": lambda k, s, fi, fo: jnp.zeros(s),
+    "ones": lambda k, s, fi, fo: jnp.ones(s),
+}
+
+
+class WeightInit:
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    ZERO = "zero"
+    ONES = "ones"
+
+
+def init_weight(name, key, shape, fan_in, fan_out, dtype=jnp.float32):
+    if callable(name):
+        return jnp.asarray(name(key, shape), dtype)
+    key_name = str(name).lower()
+    if key_name not in _INITS:
+        raise ValueError(f"unknown weight init {name!r}")
+    return _INITS[key_name](key, shape, fan_in, fan_out).astype(dtype)

@@ -1,0 +1,111 @@
+"""Evaluation suite tests (reference: org.nd4j.evaluation.* test style:
+known confusion matrices with hand-computed metrics)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.evaluation import (
+    Evaluation, EvaluationBinary, RegressionEvaluation, ROC, ROCMultiClass)
+
+
+class TestEvaluation:
+    def test_perfect_predictions(self):
+        ev = Evaluation(3)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1]]
+        ev.eval(y, y)
+        assert ev.accuracy() == 1.0
+        assert ev.f1() == 1.0
+
+    def test_known_confusion(self):
+        ev = Evaluation(2)
+        labels = np.eye(2)[[0, 0, 0, 1, 1, 1]]
+        preds = np.eye(2)[[0, 0, 1, 1, 1, 0]]
+        ev.eval(labels, preds)
+        conf = ev.confusionMatrix()
+        assert conf[0, 0] == 2 and conf[0, 1] == 1
+        assert conf[1, 1] == 2 and conf[1, 0] == 1
+        assert abs(ev.accuracy() - 4 / 6) < 1e-9
+        assert abs(ev.precision(1) - 2 / 3) < 1e-9
+        assert abs(ev.recall(1) - 2 / 3) < 1e-9
+
+    def test_accumulation_across_batches(self):
+        ev = Evaluation(2)
+        y1 = np.eye(2)[[0, 1]]
+        ev.eval(y1, y1)
+        ev.eval(np.eye(2)[[1]], np.eye(2)[[0]])
+        assert ev.getNumRowCounter() == 3
+        assert abs(ev.accuracy() - 2 / 3) < 1e-9
+
+    def test_class_index_input(self):
+        ev = Evaluation(3)
+        ev.eval(np.array([0, 1, 2]), np.eye(3)[[0, 1, 1]])
+        assert abs(ev.accuracy() - 2 / 3) < 1e-9
+
+    def test_stats_renders(self):
+        ev = Evaluation(2)
+        ev.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]])
+        s = ev.stats()
+        assert "Accuracy" in s and "Confusion" in s
+
+
+class TestROC:
+    def test_perfect_separation_auc(self):
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1], np.float32)
+        scores = np.array([0.1, 0.2, 0.8, 0.9], np.float32)
+        roc.eval(labels, scores)
+        assert abs(roc.calculateAUC() - 1.0) < 1e-9
+
+    def test_random_auc_half(self):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 2000).astype(np.float32)
+        scores = rng.uniform(size=2000).astype(np.float32)
+        roc = ROC().eval(labels, scores)
+        assert abs(roc.calculateAUC() - 0.5) < 0.05
+
+    def test_two_column_input(self):
+        roc = ROC()
+        labels = np.eye(2)[[0, 0, 1, 1]]
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.1, 0.9]])
+        roc.eval(labels, preds)
+        assert roc.calculateAUC() == 1.0
+
+    def test_multiclass(self):
+        rm = ROCMultiClass()
+        labels = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+        preds = np.eye(3)[[0, 1, 2, 0, 1, 2]] * 0.9 + 0.05
+        rm.eval(labels, preds)
+        assert rm.calculateAverageAUC() == 1.0
+
+
+class TestEvaluationBinary:
+    def test_per_label_metrics(self):
+        ev = EvaluationBinary()
+        labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.1], [0.3, 0.9]],
+                         np.float32)
+        ev.eval(labels, preds)
+        assert ev.accuracy(0) == 1.0
+        assert ev.recall(1) == 0.5
+
+
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        ev = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0]])
+        preds = np.array([[1.5], [2.0], [2.5]])
+        ev.eval(labels, preds)
+        assert abs(ev.meanSquaredError(0) - (0.25 + 0 + 0.25) / 3) < 1e-9
+        assert abs(ev.meanAbsoluteError(0) - (0.5 + 0 + 0.5) / 3) < 1e-9
+
+    def test_perfect_r2(self):
+        ev = RegressionEvaluation()
+        labels = np.array([[1.0, 5.0], [2.0, 6.0], [3.0, 7.0]])
+        ev.eval(labels, labels)
+        assert abs(ev.rSquared(0) - 1.0) < 1e-9
+        assert abs(ev.pearsonCorrelation(1) - 1.0) < 1e-9
+
+    def test_accumulates(self):
+        ev = RegressionEvaluation()
+        ev.eval(np.array([[1.0]]), np.array([[2.0]]))
+        ev.eval(np.array([[3.0]]), np.array([[3.0]]))
+        assert abs(ev.meanSquaredError(0) - 0.5) < 1e-9

@@ -1,0 +1,308 @@
+"""Tests for the nn layer (MultiLayerNetwork / ComputationGraph / layer
+configs), modeled on the reference's deeplearning4j-core test style
+(SURVEY.md §4 "Layer/net integration"): small nets, a few iterations on
+synthetic data, loss-decrease and shape asserts."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalization, Bidirectional, ComputationGraph,
+    ComputationGraphConfiguration, ConvolutionLayer, DenseLayer, DropoutLayer,
+    ElementWiseVertex, EmbeddingSequenceLayer, GlobalPoolingLayer, InputType,
+    LastTimeStep, LossFunction, LSTM, MergeVertex, MultiLayerConfiguration,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+    SubsamplingLayer, WeightInit)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _xy(n=32, fin=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, fin)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return X, y
+
+
+def _mlp(updater=None, fin=10, classes=3, seed=12345):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(1e-2))
+            .weightInit(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer.Builder().nIn(fin).nOut(16)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nIn(16).nOut(classes)
+                   .activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+
+
+class TestMultiLayerNetwork:
+    def test_mlp_loss_decreases(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        X, y = _xy()
+        s0 = net.score((X, y))
+        net.fit([(X, y)], 30)
+        assert net.score((X, y)) < s0 * 0.7
+
+    def test_output_shape_and_softmax(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        X, _ = _xy()
+        out = net.output(X).numpy()
+        assert out.shape == (32, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_feedforward_returns_all_activations(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        X, _ = _xy()
+        acts = net.feedForward(X)
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape() == (32, 16)
+
+    def test_params_roundtrip(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        flat = net.params().numpy()
+        assert flat.shape == (net.numParams(),)
+        net2 = MultiLayerNetwork(_mlp(seed=999)).init()
+        net2.setParams(flat)
+        np.testing.assert_allclose(net2.params().numpy(), flat, rtol=1e-6)
+        X, _ = _xy()
+        np.testing.assert_allclose(net.output(X).numpy(),
+                                   net2.output(X).numpy(), rtol=1e-5)
+
+    def test_json_roundtrip_same_init(self):
+        conf = _mlp()
+        net = MultiLayerNetwork(conf).init()
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        net2 = MultiLayerNetwork(conf2).init()
+        X, _ = _xy()
+        np.testing.assert_allclose(net.output(X).numpy(),
+                                   net2.output(X).numpy(), rtol=1e-5)
+
+    def test_evaluate(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        X, y = _xy(64)
+        net.fit([(X, y)], 100)
+        ev = net.evaluate([(X, y)])
+        assert ev.accuracy() > 0.8  # memorize small synthetic set
+
+    def test_clone_independent(self):
+        net = MultiLayerNetwork(_mlp()).init()
+        X, y = _xy()
+        c = net.clone()
+        net.fit([(X, y)], 5)
+        assert not np.allclose(net.params().numpy(), c.params().numpy())
+
+
+class TestConvNet:
+    def test_lenet_flat_input_trains(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+                .list()
+                .layer(ConvolutionLayer.Builder().nOut(4).kernelSize([5, 5])
+                       .activation("relu").build())
+                .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                       .stride([2, 2]).build())
+                .layer(DenseLayer.Builder().nOut(16).activation("relu")
+                       .build())
+                .layer(OutputLayer.Builder().nOut(10).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.convolutionalFlat(12, 12, 1))
+                .build())
+        # nIn inference through the conv stack
+        assert conf.layers[0].nIn == 1
+        assert conf.layers[2].nIn == 4 * 4 * 4
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 144)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+        s0 = net.score((X, y))
+        net.fit([(X, y)], 20)
+        assert net.score((X, y)) < s0
+
+    def test_batchnorm_running_stats_update(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(1e-2))
+                .list()
+                .layer(DenseLayer.Builder().nIn(8).nOut(8)
+                       .activation("identity").build())
+                .layer(BatchNormalization.Builder().build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.feedForward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X, y = _xy(fin=8)
+        mean_before = np.asarray(net._states[1]["mean"])
+        net.fit([(X, y)], 5)
+        mean_after = np.asarray(net._states[1]["mean"])
+        assert not np.allclose(mean_before, mean_after)
+
+    def test_global_pooling(self):
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(ConvolutionLayer.Builder().nOut(6).kernelSize([3, 3])
+                       .activation("relu").build())
+                .layer(GlobalPoolingLayer.Builder().build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(8, 8, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = np.random.default_rng(0).normal(size=(4, 3, 8, 8)).astype(
+            np.float32)
+        assert net.output(X).shape() == (4, 2)
+
+
+class TestRecurrent:
+    def test_lstm_char_rnn_shape_and_training(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(5e-3))
+                .list()
+                .layer(LSTM.Builder().nOut(12).build())
+                .layer(RnnOutputLayer.Builder().nOut(5).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(6, 10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4, 6, 10)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[
+            rng.integers(0, 5, (4, 10))].transpose(0, 2, 1)
+        assert net.output(X).shape() == (4, 5, 10)
+        s0 = net.score((X, y))
+        net.fit([(X, y)], 30)
+        assert net.score((X, y)) < s0
+
+    def test_embedding_sequence_lstm(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(EmbeddingSequenceLayer.Builder().nIn(20).nOut(8)
+                       .build())
+                .layer(LSTM.Builder().nOut(8).build())
+                .layer(RnnOutputLayer.Builder().nOut(20)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(20, 7))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 20, (3, 7))
+        y = np.eye(20, dtype=np.float32)[
+            rng.integers(0, 20, (3, 7))].transpose(0, 2, 1)
+        assert net.output(tokens).shape() == (3, 20, 7)
+        s0 = net.score((tokens, y))
+        net.fit([(tokens, y)], 20)
+        assert net.score((tokens, y)) < s0
+
+    def test_bidirectional_last_timestep(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(Bidirectional(rnn=LSTM(nOut=6), mode="concat"))
+                .layer(LastTimeStep(rnn=LSTM(nOut=4)))
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(5, 9))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = np.random.default_rng(0).normal(size=(4, 5, 9)).astype(np.float32)
+        assert net.output(X).shape() == (4, 2)
+
+
+class TestComputationGraph:
+    def _graph_conf(self):
+        return (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer.Builder().nIn(10).nOut(16)
+                          .activation("relu").build(), "in")
+                .addLayer("d2", DenseLayer.Builder().nIn(16).nOut(16)
+                          .activation("identity").build(), "d1")
+                .addVertex("res", ElementWiseVertex("Add"), "d1", "d2")
+                .addLayer("out", OutputLayer.Builder().nIn(16).nOut(3)
+                          .activation("softmax").lossFunction("mcxent")
+                          .build(), "res")
+                .setOutputs("out")
+                .build())
+
+    def test_residual_graph_trains(self):
+        g = ComputationGraph(self._graph_conf()).init()
+        X, y = _xy()
+        s0 = g.score((X, y))
+        g.fit([(X, y)], 30)
+        assert g.score((X, y)) < s0 * 0.7
+
+    def test_multi_input_merge(self):
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+                .graphBuilder()
+                .addInputs("a", "b")
+                .addLayer("da", DenseLayer.Builder().nIn(4).nOut(8)
+                          .activation("relu").build(), "a")
+                .addLayer("db", DenseLayer.Builder().nIn(6).nOut(8)
+                          .activation("relu").build(), "b")
+                .addVertex("m", MergeVertex(), "da", "db")
+                .addLayer("out", OutputLayer.Builder().nIn(16).nOut(2)
+                          .activation("softmax").lossFunction("mcxent")
+                          .build(), "m")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(8, 4)).astype(np.float32)
+        b = rng.normal(size=(8, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        out = g.output(a, b)[0]
+        assert out.shape() == (8, 2)
+        s0 = g.score(((a, b), (y,)))
+        g.fit([((a, b), (y,))], 20)
+        assert g.score(((a, b), (y,))) < s0
+
+    def test_json_roundtrip(self):
+        conf = self._graph_conf()
+        g = ComputationGraph(conf).init()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        g2 = ComputationGraph(conf2).init()
+        X, _ = _xy()
+        np.testing.assert_allclose(g.output(X)[0].numpy(),
+                                   g2.output(X)[0].numpy(), rtol=1e-5)
+
+    def test_topo_rejects_cycle(self):
+        b = (NeuralNetConfiguration.Builder().graphBuilder()
+             .addInputs("in")
+             .addLayer("a", DenseLayer(nIn=4, nOut=4), "b")
+             .addLayer("b", DenseLayer(nIn=4, nOut=4), "a")
+             .setOutputs("b"))
+        with pytest.raises(ValueError):
+            b.build()
+
+
+class TestLayerBits:
+    def test_dropout_only_in_training(self):
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Sgd(0.1))
+                .list()
+                .layer(DropoutLayer.Builder().dropOut(0.5).build())
+                .layer(OutputLayer.Builder().nIn(10).nOut(2)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _xy()
+        a = net.output(X, train=False).numpy()
+        b = net.output(X, train=False).numpy()
+        np.testing.assert_allclose(a, b)  # inference is deterministic
+
+    def test_activation_layer(self):
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Sgd(0.1))
+                .list()
+                .layer(ActivationLayer.Builder().activation("relu").build())
+                .layer(OutputLayer.Builder().nIn(10).nOut(2)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X = -np.ones((3, 10), np.float32)
+        acts = net.feedForward(X)
+        assert np.all(acts[1].numpy() == 0)
+
+    def test_weight_init_statistics(self):
+        from deeplearning4j_tpu.nn.weights import init_weight
+        import jax
+
+        key = jax.random.key(0)
+        w = np.asarray(init_weight("xavier", key, (200, 300), 200, 300))
+        assert abs(w.std() - np.sqrt(2.0 / 500)) < 0.01
+        w = np.asarray(init_weight("relu", key, (200, 300), 200, 300))
+        assert abs(w.std() - np.sqrt(2.0 / 200)) < 0.01
