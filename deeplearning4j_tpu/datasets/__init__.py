@@ -1,0 +1,16 @@
+"""Data/ETL layer (reference L3: DataVec + dataset iterators, SURVEY.md
+§2.4)."""
+
+from deeplearning4j_tpu.datasets.dataset import (  # noqa: F401
+    DataSet, MultiDataSet, SplitTestAndTrain)
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    AsyncDataSetIterator, DataSetIterator, ExistingDataSetIterator,
+    ListDataSetIterator)
+from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
+    MnistDataSetIterator, synthesize_mnist)
+from deeplearning4j_tpu.datasets.records import (  # noqa: F401
+    CSVRecordReader, FileSplit, InputSplit, LineRecordReader,
+    ListStringSplit, RecordReader, RecordReaderDataSetIterator)
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler, Normalizer, NormalizerMinMaxScaler,
+    NormalizerStandardize)

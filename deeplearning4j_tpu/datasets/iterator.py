@@ -1,0 +1,163 @@
+"""DataSetIterator abstraction + async prefetch.
+
+Reference capability: org.nd4j.linalg.dataset.api.iterator.DataSetIterator
+(SURVEY.md §2.4) and deeplearning4j-core's AsyncDataSetIterator. Iterators
+are python-iterable AND expose the reference's hasNext/next/reset protocol,
+so both `for ds in it` and the DL4J idiom work. AsyncDataSetIterator
+prefetches batches on a host thread — the host-side half of the
+double-buffered H2D pipeline (SURVEY.md §7 step 6); the device half is the
+compiled step's async dispatch."""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base: subclasses implement reset() and _next_batch() -> DataSet|None."""
+
+    def __init__(self, batch_size=32):
+        self._batch = batch_size
+        self.preProcessor = None
+
+    # -- reference protocol --------------------------------------------------
+    def batch(self):
+        return self._batch
+
+    def setPreProcessor(self, pp):
+        self.preProcessor = pp
+
+    def getPreProcessor(self):
+        return self.preProcessor
+
+    def hasNext(self) -> bool:
+        if getattr(self, "_peek", None) is None:
+            self._peek = self._next_batch()
+        return self._peek is not None
+
+    def next(self) -> DataSet:
+        if getattr(self, "_peek", None) is not None:
+            ds, self._peek = self._peek, None
+        else:
+            ds = self._next_batch()
+        if ds is None:
+            raise StopIteration
+        if self.preProcessor is not None:
+            self.preProcessor.preProcess(ds)
+        return ds
+
+    def reset(self):
+        raise NotImplementedError
+
+    def resetSupported(self):
+        return True
+
+    def asyncSupported(self):
+        return True
+
+    def _next_batch(self):
+        raise NotImplementedError
+
+    # -- python protocol -----------------------------------------------------
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        try:
+            return self.next()
+        except StopIteration:
+            raise
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over an in-memory list of DataSets or one big DataSet split
+    into minibatches (reference: ListDataSetIterator)."""
+
+    def __init__(self, data, batch_size=32):
+        super().__init__(batch_size)
+        if isinstance(data, DataSet):
+            self._list = data.batchBy(batch_size)
+        else:
+            self._list = list(data)
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+        self._peek = None
+
+    def _next_batch(self):
+        if self._pos >= len(self._list):
+            return None
+        ds = self._list[self._pos]
+        self._pos += 1
+        if not isinstance(ds, DataSet):
+            f, l = ds
+            ds = DataSet(f, l)
+        return ds
+
+    def totalExamples(self):
+        return sum(d.numExamples() if isinstance(d, DataSet) else len(d[0])
+                   for d in self._list)
+
+
+class ExistingDataSetIterator(ListDataSetIterator):
+    """Reference: ExistingDataSetIterator — wraps an existing collection."""
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Wraps any DataSetIterator with a background prefetch thread and a
+    bounded queue (reference: deeplearning4j AsyncDataSetIterator with
+    queue size N). Keeps the accelerator fed while the host parses the
+    next batch."""
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        super().__init__(base.batch())
+        self._base = base
+        self._qsize = queue_size
+        self._queue: queue.Queue = None
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._base.reset()
+        self._queue = queue.Queue(maxsize=self._qsize)
+        self._error = None
+
+        def produce():
+            try:
+                while True:
+                    if not self._base.hasNext():
+                        break
+                    self._queue.put(self._base.next())
+            except Exception as e:  # surface in consumer
+                self._error = e
+            finally:
+                self._queue.put(self._END)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        # drain current thread then restart
+        if self._thread is not None and self._thread.is_alive():
+            while self._queue.get() is not self._END:
+                pass
+        self._start()
+        self._peek = None
+
+    def _next_batch(self):
+        item = self._queue.get()
+        if item is self._END:
+            if self._error is not None:
+                raise self._error
+            return None
+        return item
+
+    def resetSupported(self):
+        return self._base.resetSupported()

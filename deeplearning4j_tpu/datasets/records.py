@@ -1,0 +1,175 @@
+"""Record readers and the record→DataSet bridge.
+
+Reference capability: DataVec's RecordReader/InputSplit API
+(org.datavec.api.records.reader.impl.csv.CSVRecordReader, FileSplit) and
+deeplearning4j-core's RecordReaderDataSetIterator (SURVEY.md §2.4). Host-side
+CPU parsing, exactly like the reference — ETL never touches the device."""
+
+from __future__ import annotations
+
+import csv
+import glob
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+
+class InputSplit:
+    def locations(self) -> list:
+        raise NotImplementedError
+
+
+class FileSplit(InputSplit):
+    def __init__(self, path, extensions=None, recursive=True):
+        self.path = path
+        self.extensions = extensions
+        self.recursive = recursive
+
+    def locations(self):
+        if os.path.isfile(self.path):
+            return [self.path]
+        pattern = "**/*" if self.recursive else "*"
+        files = sorted(glob.glob(os.path.join(self.path, pattern),
+                                 recursive=self.recursive))
+        files = [f for f in files if os.path.isfile(f)]
+        if self.extensions:
+            files = [f for f in files
+                     if any(f.endswith(e) for e in self.extensions)]
+        return files
+
+
+class ListStringSplit(InputSplit):
+    """In-memory lines (reference: ListStringSplit)."""
+
+    def __init__(self, data: list):
+        self.data = list(data)
+
+    def locations(self):
+        return self.data
+
+
+class RecordReader:
+    def initialize(self, split: InputSplit):
+        raise NotImplementedError
+
+    def hasNext(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> list:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class CSVRecordReader(RecordReader):
+    """Reference: CSVRecordReader(numLinesToSkip, delimiter)."""
+
+    def __init__(self, skipNumLines=0, delimiter=","):
+        self.skip = skipNumLines
+        self.delimiter = delimiter
+        self._records: list = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._records = []
+        if isinstance(split, ListStringSplit):
+            rows = csv.reader(split.data, delimiter=self.delimiter)
+            self._records = [r for r in rows][self.skip:]
+        else:
+            for path in split.locations():
+                with open(path, newline="") as f:
+                    rows = list(csv.reader(f, delimiter=self.delimiter))
+                self._records.extend(rows[self.skip:])
+        self._pos = 0
+        return self
+
+    def hasNext(self):
+        return self._pos < len(self._records)
+
+    def next(self):
+        r = self._records[self._pos]
+        self._pos += 1
+        return r
+
+    def reset(self):
+        self._pos = 0
+
+
+class LineRecordReader(RecordReader):
+    def __init__(self):
+        self._lines: list = []
+        self._pos = 0
+
+    def initialize(self, split: InputSplit):
+        self._lines = []
+        if isinstance(split, ListStringSplit):
+            self._lines = list(split.data)
+        else:
+            for path in split.locations():
+                with open(path) as f:
+                    self._lines.extend(line.rstrip("\n") for line in f)
+        self._pos = 0
+        return self
+
+    def hasNext(self):
+        return self._pos < len(self._lines)
+
+    def next(self):
+        line = self._lines[self._pos]
+        self._pos += 1
+        return [line]
+
+    def reset(self):
+        self._pos = 0
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """records -> DataSet minibatches (reference:
+    org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator).
+
+    Classification: labelIndex column holds an int class -> one-hot of
+    numPossibleLabels. Regression: regression=True, labelIndex..labelIndexTo
+    columns are float targets."""
+
+    def __init__(self, recordReader: RecordReader, batchSize=32,
+                 labelIndex=-1, numPossibleLabels=None, regression=False,
+                 labelIndexTo=None):
+        super().__init__(batchSize)
+        self.reader = recordReader
+        self.labelIndex = labelIndex
+        self.numPossibleLabels = numPossibleLabels
+        self.regression = regression
+        self.labelIndexTo = labelIndexTo if labelIndexTo is not None \
+            else labelIndex
+
+    def reset(self):
+        self.reader.reset()
+        self._peek = None
+
+    def _next_batch(self):
+        feats, labels = [], []
+        while len(feats) < self._batch and self.reader.hasNext():
+            rec = [float(v) for v in self.reader.next()]
+            li, lj = self.labelIndex, self.labelIndexTo
+            if li < 0:
+                li = lj = len(rec) + li
+            lab = rec[li:lj + 1]
+            feat = rec[:li] + rec[lj + 1:]
+            feats.append(feat)
+            labels.append(lab)
+        if not feats:
+            return None
+        f = np.asarray(feats, np.float32)
+        if self.regression:
+            l = np.asarray(labels, np.float32)
+        else:
+            idx = np.asarray(labels, np.int64).reshape(-1)
+            if self.numPossibleLabels is None:
+                # pin the inferred width so every batch one-hots identically
+                self.numPossibleLabels = int(idx.max()) + 1
+            l = np.eye(self.numPossibleLabels, dtype=np.float32)[idx]
+        return DataSet(f, l)

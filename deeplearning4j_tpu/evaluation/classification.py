@@ -36,12 +36,13 @@ class Evaluation:
 
     # -- accumulation --------------------------------------------------------
     def eval(self, labels, predictions, mask=None):
-        if hasattr(labels, "ndim") and _to_np(labels).ndim == 3:
+        labels = _to_np(labels)
+        predictions = _to_np(predictions)
+        if labels.ndim == 3:
             # [N, C, T] time series -> fold time into batch
-            labels = np.moveaxis(_to_np(labels), 2, 1).reshape(
-                -1, _to_np(labels).shape[1])
-            predictions = np.moveaxis(_to_np(predictions), 2, 1).reshape(
-                -1, _to_np(predictions).shape[1])
+            labels = np.moveaxis(labels, 2, 1).reshape(-1, labels.shape[1])
+            predictions = np.moveaxis(predictions, 2, 1).reshape(
+                -1, predictions.shape[1])
         t = _class_indices(labels)
         p = _class_indices(predictions)
         if mask is not None:

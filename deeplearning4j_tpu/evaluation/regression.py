@@ -26,8 +26,15 @@ class RegressionEvaluation:
         self._sum_lp = None
 
     def eval(self, labels, predictions, mask=None):
-        l = _to_np(labels).reshape(-1, _to_np(labels).shape[-1])
-        p = _to_np(predictions).reshape(-1, _to_np(predictions).shape[-1])
+        l = _to_np(labels)
+        p = _to_np(predictions)
+        if l.ndim == 3:
+            # [N, C, T] (NCW layout) -> fold time into batch, C columns
+            l = np.moveaxis(l, 2, 1).reshape(-1, l.shape[1])
+            p = np.moveaxis(p, 2, 1).reshape(-1, p.shape[1])
+        else:
+            l = l.reshape(-1, l.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
         if self._sum_err2 is None:
             k = l.shape[1]
             for name in ("_sum_err2", "_sum_abs", "_sum_l", "_sum_l2",

@@ -60,7 +60,25 @@ class MultiLayerConfiguration:
             lr.apply_defaults(self.defaults)
         it = self.inputType
         if it is None:
-            return
+            # no declared input type: if the first layer states its nIn,
+            # chain inference from there (common DL4J idiom: nIn on layer 0
+            # only, later layers inferred). Only dense-ish and recurrent
+            # first layers imply an input kind; conv needs explicit H/W.
+            from deeplearning4j_tpu.nn.conf.layers import (
+                Convolution1DLayer, DenseLayer, EmbeddingSequenceLayer,
+                LSTM, SimpleRnn)
+
+            first = self.layers[0]
+            n_in = getattr(first, "nIn", None)
+            if n_in is None:
+                return
+            if isinstance(first, (LSTM, SimpleRnn, Convolution1DLayer,
+                                  EmbeddingSequenceLayer)):
+                it = InputType.recurrent(n_in)
+            elif isinstance(first, DenseLayer):  # includes output layers
+                it = InputType.feedForward(n_in)
+            else:
+                return
         for i, lr in enumerate(self.layers):
             if isinstance(it, ConvolutionalFlatType) and isinstance(
                     lr, (ConvolutionLayer, SubsamplingLayer)):

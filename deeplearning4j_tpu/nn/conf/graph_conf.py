@@ -131,6 +131,12 @@ class SubsetVertex(GraphVertex):
 
     def infer(self, *input_types):
         n = self.toIdx - self.fromIdx + 1
+        t0 = input_types[0]
+        # subset is on the feature/channel axis; preserve the input kind
+        if isinstance(t0, ConvolutionalType):
+            return InputType.convolutional(t0.height, t0.width, n)
+        if isinstance(t0, RecurrentType):
+            return InputType.recurrent(n, t0.timeSeriesLength)
         return InputType.feedForward(n)
 
     def apply(self, x):

@@ -103,9 +103,13 @@ class BaseLayer:
     Builder = _BuilderFactory()
 
     def apply_defaults(self, defaults: dict):
+        import copy
+
         for f in self.INHERITED:
             if getattr(self, f, None) is None and f in defaults:
-                setattr(self, f, defaults[f])
+                # deep-copy so layers never share mutable config objects
+                # (the reference clones the conf per layer)
+                setattr(self, f, copy.deepcopy(defaults[f]))
         if self.activation is None:
             self.activation = "identity"
         if self.weightInit is None:
@@ -195,6 +199,10 @@ class DenseLayer(BaseLayer):
         return InputType.feedForward(self.nOut)
 
     def init_params(self, key, dtype=jnp.float32):
+        if self.nIn is None or self.nOut is None:
+            raise ValueError(
+                f"{type(self).__name__} has nIn={self.nIn}, nOut={self.nOut}:"
+                f" set nIn explicitly or declare setInputType on the config")
         kw, kb = jax.random.split(key)
         p = {"W": init_weight(self.weightInit, kw, (self.nIn, self.nOut),
                               self.nIn, self.nOut, dtype)}
@@ -551,8 +559,10 @@ class BatchNormalization(BaseLayer):
         self.lockGammaBeta = lockGammaBeta
 
     def infer(self, input_type):
-        if isinstance(input_type, ConvolutionalType):
-            self.nIn = self.nIn or input_type.channels
+        if isinstance(input_type, (ConvolutionalType, RecurrentType)):
+            # per-channel stats for conv [N,C,H,W] and recurrent [N,C,T]
+            self.nIn = self.nIn or getattr(input_type, "channels",
+                                           getattr(input_type, "size", None))
         else:
             self.nIn = self.nIn or input_type.arrayElementsPerExample()
         self.nOut = self.nIn

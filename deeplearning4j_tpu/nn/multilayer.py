@@ -13,8 +13,6 @@ part 2). No Solver, no per-layer workspaces: XLA owns scheduling.
 
 from __future__ import annotations
 
-import time
-
 import numpy as np
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,194 @@
+"""Datasets layer tests (reference test style: DataVec reader unit tests +
+iterator round-trips, SURVEY.md §2.4/§4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator, CSVRecordReader, DataSet, FileSplit,
+    ImagePreProcessingScaler, ListDataSetIterator, ListStringSplit,
+    MnistDataSetIterator, NormalizerMinMaxScaler, NormalizerStandardize,
+    RecordReaderDataSetIterator, synthesize_mnist)
+
+
+class TestDataSet:
+    def test_split_test_and_train(self):
+        ds = DataSet(np.arange(20).reshape(10, 2).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[[0, 1] * 5])
+        s = ds.splitTestAndTrain(0.8)
+        assert s.getTrain().numExamples() == 8
+        assert s.getTest().numExamples() == 2
+
+    def test_shuffle_keeps_pairs(self):
+        f = np.arange(10, dtype=np.float32).reshape(10, 1)
+        ds = DataSet(f, f * 2)
+        ds.shuffle(seed=0)
+        np.testing.assert_allclose(ds.labels, ds.features * 2)
+
+    def test_save_load(self, tmp_path):
+        ds = DataSet(np.ones((3, 2), np.float32), np.zeros((3, 1), np.float32))
+        p = str(tmp_path / "ds.npz")
+        ds.save(p)
+        ds2 = DataSet.load(p)
+        np.testing.assert_allclose(ds2.features, ds.features)
+
+    def test_batch_by_and_merge(self):
+        ds = DataSet(np.arange(10, dtype=np.float32).reshape(10, 1),
+                     np.ones((10, 1), np.float32))
+        batches = ds.batchBy(3)
+        assert [b.numExamples() for b in batches] == [3, 3, 3, 1]
+        merged = DataSet.merge(batches)
+        np.testing.assert_allclose(merged.features, ds.features)
+
+
+class TestIterators:
+    def test_list_iterator_protocol(self):
+        ds = DataSet(np.zeros((10, 4), np.float32), np.zeros((10, 2),
+                                                             np.float32))
+        it = ListDataSetIterator(ds, batch_size=4)
+        sizes = []
+        while it.hasNext():
+            sizes.append(it.next().numExamples())
+        assert sizes == [4, 4, 2]
+        it.reset()
+        assert it.hasNext()
+
+    def test_python_iteration(self):
+        ds = DataSet(np.zeros((6, 2), np.float32), np.zeros((6, 1),
+                                                            np.float32))
+        it = ListDataSetIterator(ds, batch_size=2)
+        assert len(list(it)) == 3
+        assert len(list(it)) == 3  # __iter__ resets
+
+    def test_async_wrapper_same_data(self):
+        ds = DataSet(np.arange(12, dtype=np.float32).reshape(12, 1),
+                     np.zeros((12, 1), np.float32))
+        base = ListDataSetIterator(ds, batch_size=4)
+        async_it = AsyncDataSetIterator(base, queue_size=2)
+        got = [b.features[0, 0] for b in async_it]
+        assert got == [0.0, 4.0, 8.0]
+        async_it.reset()
+        assert [b.features[0, 0] for b in async_it] == [0.0, 4.0, 8.0]
+
+
+class TestMnist:
+    def test_synthetic_deterministic(self):
+        x1, y1 = synthesize_mnist(50, seed=7)
+        x2, y2 = synthesize_mnist(50, seed=7)
+        np.testing.assert_allclose(x1, x2)
+        assert x1.shape == (50, 784)
+        assert 0 <= x1.min() and x1.max() <= 1.0
+
+    def test_iterator_shapes(self):
+        it = MnistDataSetIterator(batch_size=32, train=True, num_examples=100)
+        ds = it.next()
+        assert ds.features.shape == (32, 784)
+        assert ds.labels.shape == (32, 10)
+        assert it.totalOutcomes() == 10
+
+    def test_learnable_by_mlp(self):
+        """The synthetic digits must be actually learnable (else LeNet
+        benchmarks are meaningless)."""
+        from deeplearning4j_tpu.nn import (
+            NeuralNetConfiguration, DenseLayer, OutputLayer,
+            MultiLayerNetwork)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        train = MnistDataSetIterator(batch_size=64, train=True,
+                                     num_examples=512, seed=3)
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer.Builder().nIn(784).nOut(64)
+                       .activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(10).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(train, 15)
+        ev = net.evaluate(train)
+        assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+class TestRecords:
+    def test_csv_reader_to_dataset(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,0\n")
+        reader = CSVRecordReader().initialize(FileSplit(str(p)))
+        it = RecordReaderDataSetIterator(reader, batchSize=2, labelIndex=2,
+                                         numPossibleLabels=3)
+        b1 = it.next()
+        assert b1.features.shape == (2, 2)
+        assert b1.labels.shape == (2, 3)
+        np.testing.assert_allclose(b1.labels[1], [0, 1, 0])
+        b2 = it.next()
+        assert b2.features.shape == (2, 2)
+        assert not it.hasNext()
+
+    def test_csv_regression(self):
+        split = ListStringSplit(["1,2,10.5", "3,4,20.5"])
+        reader = CSVRecordReader().initialize(split)
+        it = RecordReaderDataSetIterator(reader, batchSize=10, labelIndex=2,
+                                         regression=True)
+        ds = it.next()
+        np.testing.assert_allclose(ds.labels.reshape(-1), [10.5, 20.5])
+
+    def test_skip_lines(self):
+        split = ListStringSplit(["header,x,y", "1,2,0"])
+        reader = CSVRecordReader(skipNumLines=1).initialize(split)
+        it = RecordReaderDataSetIterator(reader, batchSize=10, labelIndex=2,
+                                         numPossibleLabels=1)
+        assert it.next().features.shape == (1, 2)
+
+
+class TestNormalizers:
+    def test_standardize_fit_transform_revert(self):
+        rng = np.random.default_rng(0)
+        f = rng.normal(5.0, 3.0, size=(200, 4)).astype(np.float32)
+        ds = DataSet(f, np.zeros((200, 1), np.float32))
+        norm = NormalizerStandardize().fit(ds)
+        t = norm.transform(f)
+        assert abs(t.mean()) < 0.05 and abs(t.std() - 1.0) < 0.05
+        np.testing.assert_allclose(norm.revert(t), f, atol=1e-3)
+
+    def test_standardize_streaming_over_iterator(self):
+        f = np.random.default_rng(1).normal(size=(100, 3)).astype(np.float32)
+        it = ListDataSetIterator(DataSet(f, np.zeros((100, 1), np.float32)),
+                                 batch_size=16)
+        norm = NormalizerStandardize().fit(it)
+        direct = NormalizerStandardize().fit(
+            DataSet(f, np.zeros((100, 1), np.float32)))
+        np.testing.assert_allclose(norm.mean, direct.mean, rtol=1e-5)
+
+    def test_minmax(self):
+        f = np.array([[0.0, 10.0], [5.0, 20.0], [10.0, 30.0]], np.float32)
+        norm = NormalizerMinMaxScaler().fit(
+            DataSet(f, np.zeros((3, 1), np.float32)))
+        t = norm.transform(f)
+        assert t.min() == 0.0 and t.max() == 1.0
+        np.testing.assert_allclose(norm.revert(t), f, atol=1e-5)
+
+    def test_image_scaler(self):
+        f = np.array([[0.0, 127.5, 255.0]], np.float32)
+        s = ImagePreProcessingScaler()
+        np.testing.assert_allclose(s.transform(f), [[0.0, 0.5, 1.0]])
+
+    def test_preprocessor_on_iterator(self):
+        f = np.full((8, 2), 100.0, np.float32)
+        it = ListDataSetIterator(DataSet(f, np.zeros((8, 1), np.float32)),
+                                 batch_size=4)
+        it.setPreProcessor(ImagePreProcessingScaler(maxPixelVal=100.0))
+        ds = it.next()
+        np.testing.assert_allclose(ds.features, 1.0)
+
+    def test_save_load(self, tmp_path):
+        f = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+        norm = NormalizerStandardize().fit(
+            DataSet(f, np.zeros((50, 1), np.float32)))
+        p = str(tmp_path / "norm.npz")
+        norm.save(p)
+        from deeplearning4j_tpu.datasets import Normalizer
+
+        norm2 = Normalizer.load(p)
+        np.testing.assert_allclose(norm2.mean, norm.mean)
