@@ -98,6 +98,9 @@ class DataSet:
             if datasets[0].labels is not None else None)
 
     def save(self, path):
+        # np.savez silently appends .npz; normalize so load(path) matches
+        if not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
         np.savez(path, **{k: v for k, v in [
             ("features", self.features), ("labels", self.labels),
             ("featuresMask", self.featuresMask),
@@ -105,6 +108,8 @@ class DataSet:
 
     @staticmethod
     def load(path) -> "DataSet":
+        if not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
         z = np.load(path)
         return DataSet(z.get("features"), z.get("labels"),
                        z.get("featuresMask"), z.get("labelsMask"))

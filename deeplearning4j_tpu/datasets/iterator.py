@@ -128,6 +128,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._base.reset()
         self._queue = queue.Queue(maxsize=self._qsize)
         self._error = None
+        self._done = False
 
         def produce():
             try:
@@ -152,8 +153,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._peek = None
 
     def _next_batch(self):
+        if self._done:
+            return None  # exhausted: don't block on the dead producer
         item = self._queue.get()
         if item is self._END:
+            self._done = True
             if self._error is not None:
                 raise self._error
             return None

@@ -38,10 +38,15 @@ class Normalizer:
 
     # persistence
     def save(self, path):
+        # np.savez silently appends .npz; normalize so load(path) matches
+        if not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
         np.savez(path, __class__=type(self).__name__, **self._state())
 
     @staticmethod
     def load(path) -> "Normalizer":
+        if not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
         z = np.load(path, allow_pickle=True)
         cls = {c.__name__: c for c in (NormalizerStandardize,
                                        NormalizerMinMaxScaler,

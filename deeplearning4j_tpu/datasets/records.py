@@ -150,7 +150,24 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
         self._peek = None
 
+    def _infer_num_labels(self):
+        """Full pre-scan so every batch one-hots with the same width (a
+        first-batch-only guess breaks when a later batch holds a higher
+        class index)."""
+        li = self.labelIndex
+        max_idx = -1
+        while self.reader.hasNext():
+            rec = self.reader.next()
+            idx = li if li >= 0 else len(rec) + li
+            max_idx = max(max_idx, int(float(rec[idx])))
+        self.reader.reset()
+        if max_idx < 0:
+            raise ValueError("no records to infer numPossibleLabels from")
+        self.numPossibleLabels = max_idx + 1
+
     def _next_batch(self):
+        if not self.regression and self.numPossibleLabels is None:
+            self._infer_num_labels()
         feats, labels = [], []
         while len(feats) < self._batch and self.reader.hasNext():
             rec = [float(v) for v in self.reader.next()]
@@ -168,8 +185,5 @@ class RecordReaderDataSetIterator(DataSetIterator):
             l = np.asarray(labels, np.float32)
         else:
             idx = np.asarray(labels, np.int64).reshape(-1)
-            if self.numPossibleLabels is None:
-                # pin the inferred width so every batch one-hots identically
-                self.numPossibleLabels = int(idx.max()) + 1
             l = np.eye(self.numPossibleLabels, dtype=np.float32)[idx]
         return DataSet(f, l)

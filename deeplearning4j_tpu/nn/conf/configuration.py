@@ -56,6 +56,8 @@ class MultiLayerConfiguration:
     def _finalize(self):
         """Clone defaults into layers and run shape inference front-to-back
         (the reference does this in MultiLayerConfiguration.Builder.build)."""
+        if not self.layers:
+            return
         for lr in self.layers:
             lr.apply_defaults(self.defaults)
         it = self.inputType

@@ -56,6 +56,8 @@ class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = conf.layers
+        if not self.layers:
+            raise ValueError("configuration has no layers")
         out = self.layers[-1]
         if not isinstance(out, OUTPUT_LAYER_TYPES):
             raise ValueError("last layer must be an OutputLayer/LossLayer")

@@ -1,0 +1,17 @@
+"""Aux utilities (reference: ModelSerializer, listeners, early stopping,
+transfer learning — SURVEY.md §2.5/§5)."""
+
+from deeplearning4j_tpu.utils.serializer import ModelSerializer  # noqa: F401
+from deeplearning4j_tpu.utils.listeners import (  # noqa: F401
+    CheckpointListener, CollectScoresIterationListener, EvaluativeListener,
+    PerformanceListener, ScoreIterationListener, TimeIterationListener,
+    TrainingListener)
+from deeplearning4j_tpu.utils.early_stopping import (  # noqa: F401
+    ClassificationScoreCalculator, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingGraphTrainer,
+    EarlyStoppingResult, EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.utils.transfer import (  # noqa: F401
+    FineTuneConfiguration, TransferLearning)

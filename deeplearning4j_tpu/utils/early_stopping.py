@@ -1,0 +1,218 @@
+"""Early stopping.
+
+Reference capability: org.deeplearning4j.earlystopping.* (SURVEY.md §2.5):
+EarlyStoppingConfiguration with epoch/score/time termination conditions, a
+score calculator over a validation iterator, trainer that keeps the best
+model and returns an EarlyStoppingResult."""
+
+from __future__ import annotations
+
+import time
+
+
+# -- termination conditions --------------------------------------------------
+
+class MaxEpochsTerminationCondition:
+    def __init__(self, maxEpochs):
+        self.maxEpochs = maxEpochs
+
+    def terminate(self, epoch, score, best_epoch):
+        return epoch >= self.maxEpochs
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after N epochs without an improvement of at least
+    minImprovement. Tracks its own best (the trainer's best ignores the
+    threshold); direction is set by the trainer via `minimize`."""
+
+    def __init__(self, maxEpochsWithNoImprovement, minImprovement=0.0):
+        self.patience = maxEpochsWithNoImprovement
+        self.minImprovement = minImprovement
+        self.minimize = True
+        self._best = None
+        self._best_epoch = -1
+
+    def terminate(self, epoch, score, best_epoch):
+        if self._best is None:
+            improved = True
+        elif self.minimize:
+            improved = (self._best - score) > self.minImprovement
+        else:
+            improved = (score - self._best) > self.minImprovement
+        if improved:
+            self._best, self._best_epoch = score, epoch
+        return (epoch - self._best_epoch) > self.patience
+
+
+class MaxTimeIterationTerminationCondition:
+    def __init__(self, maxSeconds):
+        self.maxSeconds = maxSeconds
+        self._start = None
+
+    def terminate(self, epoch, score, best_epoch):
+        if self._start is None:
+            self._start = time.time()
+            return False
+        return (time.time() - self._start) > self.maxSeconds
+
+
+class MaxScoreIterationTerminationCondition:
+    """Abort if the score explodes above a bound (NaN guard included)."""
+
+    def __init__(self, maxScore):
+        self.maxScore = maxScore
+
+    def terminate(self, epoch, score, best_epoch):
+        return score != score or score > self.maxScore
+
+
+# -- score calculators -------------------------------------------------------
+
+class DataSetLossCalculator:
+    """Mean loss over a validation iterator (reference:
+    DataSetLossCalculator). Lower is better."""
+
+    def __init__(self, iterator, average=True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculateScore(self, model):
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            total += model.score(ds)
+            n += 1
+        return total / max(n, 1) if self.average else total
+
+    def minimizeScore(self):
+        return True
+
+
+class ClassificationScoreCalculator:
+    """Accuracy-based (higher better)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculateScore(self, model):
+        return model.evaluate(self.iterator).accuracy()
+
+    def minimizeScore(self):
+        return False
+
+
+# -- configuration + trainer -------------------------------------------------
+
+class EarlyStoppingConfiguration:
+    class Builder:
+        def __init__(self):
+            self._epoch_conds = []
+            self._iter_conds = []
+            self._calc = None
+            self._save_last = False
+            self._eval_every = 1
+
+        def epochTerminationConditions(self, *conds):
+            self._epoch_conds.extend(conds)
+            return self
+
+        def iterationTerminationConditions(self, *conds):
+            self._iter_conds.extend(conds)
+            return self
+
+        def scoreCalculator(self, calc):
+            self._calc = calc
+            return self
+
+        def evaluateEveryNEpochs(self, n):
+            self._eval_every = n
+            return self
+
+        def build(self):
+            cfg = EarlyStoppingConfiguration()
+            cfg.epochConditions = self._epoch_conds
+            cfg.iterationConditions = self._iter_conds
+            cfg.scoreCalculator = self._calc
+            cfg.evaluateEveryNEpochs = self._eval_every
+            return cfg
+
+
+class EarlyStoppingResult:
+    def __init__(self, terminationReason, terminationDetails, scoreVsEpoch,
+                 bestModelEpoch, bestModelScore, totalEpochs, bestModel):
+        self.terminationReason = terminationReason
+        self.terminationDetails = terminationDetails
+        self.scoreVsEpoch = scoreVsEpoch
+        self.bestModelEpoch = bestModelEpoch
+        self.bestModelScore = bestModelScore
+        self.totalEpochs = totalEpochs
+        self.bestModel = bestModel
+
+    def getBestModel(self):
+        return self.bestModel
+
+    def getBestModelEpoch(self):
+        return self.bestModelEpoch
+
+    def getBestModelScore(self):
+        return self.bestModelScore
+
+
+class EarlyStoppingTrainer:
+    """Reference: EarlyStoppingTrainer / EarlyStoppingGraphTrainer (works
+    for both net kinds here since both expose fit/score/clone)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 trainIterator):
+        self.config = config
+        self.model = model
+        self.trainIterator = trainIterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        minimize = cfg.scoreCalculator.minimizeScore()
+        best_score = float("inf") if minimize else float("-inf")
+        best_epoch = -1
+        best_model = None
+        score_vs_epoch = {}
+        for c in cfg.epochConditions + cfg.iterationConditions:
+            if hasattr(c, "minimize"):
+                c.minimize = minimize
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        last_score = None
+        while True:
+            self.model.fit(self.trainIterator, 1)
+            stop = False
+            if epoch % cfg.evaluateEveryNEpochs == 0:
+                score = cfg.scoreCalculator.calculateScore(self.model)
+                score_vs_epoch[epoch] = score
+                last_score = score
+                better = (score < best_score) if minimize \
+                    else (score > best_score)
+                if better:
+                    best_score, best_epoch = score, epoch
+                    best_model = self.model.clone()
+            # iteration conditions (time budget, NaN/exploding score) run
+            # EVERY epoch against the last known score, not only on
+            # evaluation epochs
+            for c in cfg.iterationConditions:
+                if c.terminate(epoch, last_score if last_score is not None
+                               else best_score, best_epoch):
+                    reason = "IterationTerminationCondition"
+                    details = type(c).__name__
+                    stop = True
+            for c in cfg.epochConditions:
+                if c.terminate(epoch, score_vs_epoch.get(epoch, best_score),
+                               best_epoch):
+                    reason = "EpochTerminationCondition"
+                    details = type(c).__name__
+                    stop = True
+            epoch += 1
+            if stop:
+                break
+        return EarlyStoppingResult(
+            reason, details, score_vs_epoch, best_epoch, best_score, epoch,
+            best_model or self.model)
+
+
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

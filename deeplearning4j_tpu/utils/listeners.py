@@ -1,0 +1,154 @@
+"""Training listeners.
+
+Reference capability: org.deeplearning4j.optimize.listeners.* (SURVEY.md
+§2.5 "Listeners", §5 observability): hooks called from the fit loop with
+(model, iteration, epoch). Score reads are host-side floats the fit loop
+already materialized — no extra device sync."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iterationDone(self, model, iteration, epoch):
+        pass
+
+    def onEpochStart(self, model):
+        pass
+
+    def onEpochEnd(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference: ScoreIterationListener)."""
+
+    def __init__(self, printIterations=10):
+        self.printIterations = printIterations
+        self.scores: list = []  # (iteration, score) history
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.printIterations == 0:
+            s = model.score()
+            self.scores.append((iteration, s))
+            log.info("Score at iteration %d is %s", iteration, s)
+
+
+class PerformanceListener(TrainingListener):
+    """Iterations/sec + examples/sec (reference: PerformanceListener)."""
+
+    def __init__(self, frequency=10, reportScore=False):
+        self.frequency = frequency
+        self.reportScore = reportScore
+        self._last_time = None
+        self._last_iter = None
+        self.samples: list = []  # (iteration, iters_per_sec)
+
+    def iterationDone(self, model, iteration, epoch):
+        now = time.time()
+        if self._last_time is not None and \
+                iteration % self.frequency == 0 and \
+                iteration != self._last_iter:
+            dt = now - self._last_time
+            its = (iteration - self._last_iter) / dt if dt > 0 else 0.0
+            self.samples.append((iteration, its))
+            msg = f"iteration {iteration}: {its:.2f} iters/sec"
+            if self.reportScore:
+                msg += f", score {model.score()}"
+            log.info(msg)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CheckpointListener(TrainingListener):
+    """Rotating checkpoints every N iterations/epochs (reference:
+    CheckpointListener.Builder keepLast/saveEveryNIterations)."""
+
+    def __init__(self, directory, saveEveryNIterations=None,
+                 saveEveryNEpochs=None, keepLast=3, saveUpdater=True):
+        self.directory = directory
+        self.saveEveryNIterations = saveEveryNIterations
+        self.saveEveryNEpochs = saveEveryNEpochs
+        self.keepLast = keepLast
+        self.saveUpdater = saveUpdater
+        self._saved: list = []
+        self._last_epoch = None
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag):
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+        path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        ModelSerializer.writeModel(model, path, self.saveUpdater)
+        self._saved.append(path)
+        while len(self._saved) > self.keepLast:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iterationDone(self, model, iteration, epoch):
+        if self.saveEveryNIterations and \
+                iteration % self.saveEveryNIterations == 0:
+            self._save(model, f"iter_{iteration}")
+        if self.saveEveryNEpochs and epoch != self._last_epoch and \
+                epoch % self.saveEveryNEpochs == 0:
+            self._last_epoch = epoch
+            self._save(model, f"epoch_{epoch}")
+
+    def lastCheckpoint(self):
+        return self._saved[-1] if self._saved else None
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (reference:
+    EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency=100):
+        self.iterator = iterator
+        self.frequency = frequency
+        self.evaluations: list = []  # (iteration, Evaluation)
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            ev = model.evaluate(self.iterator)
+            self.evaluations.append((iteration, ev))
+            log.info("Eval at iteration %d: accuracy %.4f", iteration,
+                     ev.accuracy())
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (reference: TimeIterationListener)."""
+
+    def __init__(self, totalIterations):
+        self.totalIterations = totalIterations
+        self._start = None
+
+    def iterationDone(self, model, iteration, epoch):
+        if self._start is None:
+            self._start = time.time()
+            return
+        elapsed = time.time() - self._start
+        rate = iteration / elapsed if elapsed > 0 else 0
+        remaining = (self.totalIterations - iteration) / rate if rate else 0
+        log.info("iteration %d/%d, ETA %.1fs", iteration,
+                 self.totalIterations, remaining)
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect every score (reference: CollectScoresIterationListener)."""
+
+    def __init__(self, frequency=1):
+        self.frequency = frequency
+        self.scores: list = []
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))

@@ -1,0 +1,154 @@
+"""Model persistence.
+
+Reference capability: org.deeplearning4j.util.ModelSerializer (SURVEY.md §5
+"Checkpoint / resume"): a ZIP holding configuration.json + coefficients
+(flat params) + updater state + optional normalizer — the same artifact
+shape, so checkpoints carry config + weights + optimizer state in one file.
+Coefficients are stored as a raw little-endian float32 flat vector
+('coefficients.bin') exactly in MultiLayerNetwork.params() order, plus an
+npz with per-layer named arrays for robust restore."""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class ModelSerializer:
+    @staticmethod
+    def writeModel(model, path, saveUpdater: bool = True, normalizer=None):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        is_graph = isinstance(model, ComputationGraph)
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("configuration.json", model.conf.to_json())
+            zf.writestr("modelType",
+                        "ComputationGraph" if is_graph
+                        else "MultiLayerNetwork")
+            flat = model.params().toNumpy().astype("<f4")
+            zf.writestr("coefficients.bin", flat.tobytes())
+            # named per-layer arrays (robust against ordering drift)
+            named = {}
+            if is_graph:
+                for name, p in model._params.items():
+                    for k, v in p.items():
+                        named[f"p|{name}|{k}"] = np.asarray(v)
+                for name, s in model._states.items():
+                    for k, v in s.items():
+                        named[f"s|{name}|{k}"] = np.asarray(v)
+            else:
+                for i, p in enumerate(model._params):
+                    for k, v in p.items():
+                        named[f"p|{i}|{k}"] = np.asarray(v)
+                for i, s in enumerate(model._states):
+                    for k, v in s.items():
+                        named[f"s|{i}|{k}"] = np.asarray(v)
+            buf = io.BytesIO()
+            np.savez(buf, **named)
+            zf.writestr("params.npz", buf.getvalue())
+            if saveUpdater:
+                import jax
+
+                leaves, _ = jax.tree_util.tree_flatten(model._opt_states)
+                ubuf = io.BytesIO()
+                np.savez(ubuf, **{str(i): np.asarray(l)
+                                  for i, l in enumerate(leaves)})
+                zf.writestr("updaterState.npz", ubuf.getvalue())
+                zf.writestr("trainingState.json", json.dumps({
+                    "iteration": model._iteration, "epoch": model._epoch}))
+            if normalizer is not None:
+                nbuf = io.BytesIO()
+                np.savez(nbuf, __class__=type(normalizer).__name__,
+                         **normalizer._state())
+                zf.writestr("normalizer.npz", nbuf.getvalue())
+
+    @staticmethod
+    def _restore(path, expect, loadUpdater):
+        import jax
+
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path) as zf:
+            mtype = zf.read("modelType").decode()
+            if expect and mtype != expect:
+                raise ValueError(f"model file holds a {mtype}, not {expect}")
+            conf_json = zf.read("configuration.json").decode()
+            if mtype == "ComputationGraph":
+                model = ComputationGraph(
+                    ComputationGraphConfiguration.from_json(conf_json))
+            else:
+                model = MultiLayerNetwork(
+                    MultiLayerConfiguration.from_json(conf_json))
+            model.init()
+            named = np.load(io.BytesIO(zf.read("params.npz")))
+            for key in named.files:
+                kind, idx, pname = key.split("|", 2)
+                arr = jnp.asarray(named[key])
+                if mtype == "ComputationGraph":
+                    target = model._params if kind == "p" else model._states
+                    target[idx][pname] = arr
+                else:
+                    target = model._params if kind == "p" else model._states
+                    target[int(idx)][pname] = arr
+            if loadUpdater and "updaterState.npz" in zf.namelist():
+                proto_leaves, treedef = jax.tree_util.tree_flatten(
+                    model._opt_states)
+                data = np.load(io.BytesIO(zf.read("updaterState.npz")))
+                leaves = [jnp.asarray(data[str(i)])
+                          for i in range(len(proto_leaves))]
+                model._opt_states = jax.tree_util.tree_unflatten(
+                    treedef, leaves)
+                ts = json.loads(zf.read("trainingState.json"))
+                model._iteration = ts["iteration"]
+                model._epoch = ts["epoch"]
+        return model
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path, loadUpdater: bool = True):
+        return ModelSerializer._restore(path, "MultiLayerNetwork",
+                                        loadUpdater)
+
+    @staticmethod
+    def restoreComputationGraph(path, loadUpdater: bool = True):
+        return ModelSerializer._restore(path, "ComputationGraph", loadUpdater)
+
+    @staticmethod
+    def restoreNormalizerFromFile(path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler, NormalizerMinMaxScaler,
+            NormalizerStandardize)
+
+        with zipfile.ZipFile(path) as zf:
+            if "normalizer.npz" not in zf.namelist():
+                return None
+            z = np.load(io.BytesIO(zf.read("normalizer.npz")),
+                        allow_pickle=True)
+            cls = {c.__name__: c for c in (
+                NormalizerStandardize, NormalizerMinMaxScaler,
+                ImagePreProcessingScaler)}[str(z["__class__"])]
+            obj = cls.__new__(cls)
+            obj._load_state(z)
+            return obj
+
+    @staticmethod
+    def addNormalizerToModel(path, normalizer):
+        # rewrite zip with the normalizer entry added
+        with zipfile.ZipFile(path) as zf:
+            entries = {n: zf.read(n) for n in zf.namelist()
+                       if n != "normalizer.npz"}
+        nbuf = io.BytesIO()
+        np.savez(nbuf, __class__=type(normalizer).__name__,
+                 **normalizer._state())
+        entries["normalizer.npz"] = nbuf.getvalue()
+        with zipfile.ZipFile(path, "w") as zf:
+            for n, data in entries.items():
+                zf.writestr(n, data)

@@ -1,0 +1,133 @@
+"""Transfer learning.
+
+Reference capability: org.deeplearning4j.nn.transferlearning.TransferLearning
+(.Builder) + FineTuneConfiguration (SURVEY.md §2.5): take a trained net,
+freeze feature-extractor layers, swap/replace output layers, fine-tune the
+rest. Freezing here = assigning the NoOp updater to the frozen layer configs
+(their gradients are still computed inside the fused step but produce zero
+updates — XLA dead-code-eliminates the unused updater math)."""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import NoOp
+
+
+class FineTuneConfiguration:
+    class Builder:
+        def __init__(self):
+            self._fields = {}
+
+        def updater(self, u):
+            self._fields["updater"] = u
+            return self
+
+        def seed(self, s):
+            self._fields["seed"] = s
+            return self
+
+        def l1(self, v):
+            self._fields["l1"] = v
+            return self
+
+        def l2(self, v):
+            self._fields["l2"] = v
+            return self
+
+        def build(self):
+            cfg = FineTuneConfiguration()
+            cfg.fields = self._fields
+            return cfg
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if not net._initialized:
+                raise ValueError("source network must be initialized")
+            self._src = net
+            self._fine_tune = None
+            self._freeze_until = None
+            self._replacements: dict = {}   # layer idx -> new layer conf
+            self._removed_from = None       # drop layers >= idx
+            self._appended: list = []
+
+        def fineTuneConfiguration(self, cfg: FineTuneConfiguration):
+            self._fine_tune = cfg
+            return self
+
+        def setFeatureExtractor(self, layerIdx):
+            """Freeze layers [0..layerIdx] inclusive."""
+            self._freeze_until = layerIdx
+            return self
+
+        def nOutReplace(self, layerIdx, nOut, weightInit=None):
+            old = self._src.layers[layerIdx]
+            new = copy.deepcopy(old)
+            new.nOut = nOut
+            if weightInit is not None:
+                new.weightInit = weightInit
+            self._replacements[layerIdx] = new
+            # the next layer's nIn must change too; clear for re-inference
+            if layerIdx + 1 < len(self._src.layers):
+                nxt = copy.deepcopy(self._src.layers[layerIdx + 1])
+                nxt.nIn = None
+                self._replacements.setdefault(layerIdx + 1, nxt)
+            return self
+
+        def removeLayersFromOutput(self, n):
+            self._removed_from = len(self._src.layers) - n
+            return self
+
+        def removeOutputLayer(self):
+            return self.removeLayersFromOutput(1)
+
+        def addLayer(self, layer):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            layers = [copy.deepcopy(lr) for lr in src.layers]
+            n_keep = self._removed_from if self._removed_from is not None \
+                else len(layers)
+            layers = layers[:n_keep]
+            for idx, new in self._replacements.items():
+                if idx < len(layers):
+                    layers[idx] = new
+            layers.extend(self._appended)
+            defaults = dict(src.conf.defaults)
+            if self._fine_tune is not None:
+                defaults.update(self._fine_tune.fields)
+                # clear the overridden fields on copied layers so
+                # apply_defaults refills them from the fine-tune values
+                # (copied layers arrive with the OLD defaults materialized)
+                for lr in layers:
+                    for fld in self._fine_tune.fields:
+                        if fld in lr.INHERITED:
+                            setattr(lr, fld, None)
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    layers[i].updater = NoOp()
+            conf = MultiLayerConfiguration(
+                layers, defaults, src.conf.inputType,
+                defaults.get("seed", src.conf.seed), src.conf.dataType)
+            net = MultiLayerNetwork(conf)
+            net.init()
+            # copy weights for all kept, unreplaced layers — REAL copies:
+            # the source net's next fit() donates its buffers
+            copy_arr = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: jnp.array(x, copy=True), t)
+            for i in range(min(n_keep, len(layers))):
+                if i in self._replacements:
+                    continue
+                if i < len(src._params):
+                    net._params[i] = copy_arr(src._params[i])
+                    net._states[i] = copy_arr(src._states[i])
+            return net

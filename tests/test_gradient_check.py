@@ -1,0 +1,120 @@
+"""Gradient checks per layer type — the reference's core correctness
+strategy (SURVEY.md §4: GradientCheckTests*, CNNGradientCheckTest,
+LSTMGradientCheckTests). Tiny nets, fp64, central differences vs jax.grad."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, InputType, LSTM, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, RnnOutputLayer, SimpleRnn,
+    SubsamplingLayer)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.utils.gradient_check import GradientCheckUtil
+
+
+def _check(conf, f_shape, classes, rnn=False, subset=25, seed=0):
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(seed)
+    f = rng.normal(size=f_shape).astype(np.float32)
+    n = f_shape[0]
+    if rnn:
+        t = f_shape[-1]
+        y = np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, (n, t))].transpose(0, 2, 1)
+    else:
+        y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    assert GradientCheckUtil.checkGradients(net, f, y, subset=subset,
+                                            print_results=True)
+
+
+def _base():
+    return (NeuralNetConfiguration.Builder().seed(42).updater(Sgd(0.1)))
+
+
+class TestGradientChecks:
+    def test_dense_softmax(self):
+        conf = (_base().list()
+                .layer(DenseLayer.Builder().nIn(4).nOut(5)
+                       .activation("tanh").build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .build())
+        _check(conf, (3, 4), 3, subset=None)
+
+    def test_dense_mse(self):
+        conf = (_base().list()
+                .layer(DenseLayer.Builder().nIn(4).nOut(6)
+                       .activation("sigmoid").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("identity")
+                       .lossFunction("mse").build())
+                .build())
+        _check(conf, (3, 4), 2, subset=None)
+
+    def test_cnn(self):
+        conf = (_base().list()
+                .layer(ConvolutionLayer.Builder().nOut(3).kernelSize([3, 3])
+                       .activation("tanh").build())
+                .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                       .stride([2, 2]).build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(6, 6, 2))
+                .build())
+        _check(conf, (2, 2, 6, 6), 2, subset=20)
+
+    def test_batchnorm(self):
+        conf = (_base().list()
+                .layer(DenseLayer.Builder().nIn(5).nOut(5)
+                       .activation("identity").build())
+                .layer(BatchNormalization.Builder().build())
+                .layer(ActivationLayer.Builder().activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.feedForward(5))
+                .build())
+        _check(conf, (4, 5), 3, subset=20)
+
+    def test_lstm(self):
+        conf = (_base().list()
+                .layer(LSTM.Builder().nOut(4).build())
+                .layer(RnnOutputLayer.Builder().nOut(3).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(3, 5))
+                .build())
+        _check(conf, (2, 3, 5), 3, rnn=True, subset=15)
+
+    def test_simple_rnn(self):
+        conf = (_base().list()
+                .layer(SimpleRnn.Builder().nOut(4).build())
+                .layer(RnnOutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(3, 4))
+                .build())
+        _check(conf, (2, 3, 4), 2, rnn=True, subset=15)
+
+    def test_global_pooling_cnn(self):
+        conf = (_base().list()
+                .layer(ConvolutionLayer.Builder().nOut(3).kernelSize([3, 3])
+                       .activation("tanh").build())
+                .layer(GlobalPoolingLayer.Builder().build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(5, 5, 1))
+                .build())
+        _check(conf, (2, 1, 5, 5), 2, subset=20)
+
+    def test_xent_sigmoid(self):
+        conf = (_base().list()
+                .layer(DenseLayer.Builder().nIn(4).nOut(4)
+                       .activation("tanh").build())
+                .layer(OutputLayer.Builder().nOut(3).activation("sigmoid")
+                       .lossFunction("xent").build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        f = rng.normal(size=(3, 4)).astype(np.float32)
+        y = rng.integers(0, 2, (3, 3)).astype(np.float32)
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=None,
+                                                print_results=True)

@@ -1,0 +1,216 @@
+"""Tests for serialization, listeners, early stopping, transfer learning
+(reference test style: regression/serialization round-trips + trainer
+behavior, SURVEY.md §4)."""
+
+import os
+import zipfile
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ComputationGraph, DenseLayer, ElementWiseVertex, InputType,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.utils import (
+    CheckpointListener, ClassificationScoreCalculator,
+    CollectScoresIterationListener, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, ModelSerializer,
+    ScoreImprovementEpochTerminationCondition, ScoreIterationListener,
+    TransferLearning)
+
+
+def _xy(n=32, fin=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, fin)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return X, y
+
+
+def _net(seed=1):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.Builder().nIn(10).nOut(16).activation("relu")
+                   .build())
+            .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                   .lossFunction("mcxent").build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestModelSerializer:
+    def test_write_restore_multilayer(self, tmp_path):
+        net = _net()
+        X, y = _xy()
+        net.fit([(X, y)], 10)
+        p = str(tmp_path / "model.zip")
+        ModelSerializer.writeModel(net, p, saveUpdater=True)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_allclose(net.output(X).numpy(),
+                                   net2.output(X).numpy(), rtol=1e-5)
+        # updater state restored: continued training matches
+        assert net2._iteration == net._iteration
+
+    def test_restore_continues_training(self, tmp_path):
+        net = _net()
+        X, y = _xy()
+        net.fit([(X, y)], 5)
+        p = str(tmp_path / "model.zip")
+        ModelSerializer.writeModel(net, p, saveUpdater=True)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p, loadUpdater=True)
+        net.fit([(X, y)], 5)
+        net2.fit([(X, y)], 5)
+        np.testing.assert_allclose(net.params().numpy(),
+                                   net2.params().numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_restore_graph(self, tmp_path):
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer.Builder().nIn(10).nOut(8)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(8).nOut(3)
+                          .activation("softmax").lossFunction("mcxent")
+                          .build(), "d")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf).init()
+        X, y = _xy()
+        g.fit([(X, y)], 3)
+        p = str(tmp_path / "graph.zip")
+        ModelSerializer.writeModel(g, p)
+        g2 = ModelSerializer.restoreComputationGraph(p)
+        np.testing.assert_allclose(g.output(X)[0].numpy(),
+                                   g2.output(X)[0].numpy(), rtol=1e-5)
+
+    def test_wrong_kind_rejected(self, tmp_path):
+        net = _net()
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p)
+        try:
+            ModelSerializer.restoreComputationGraph(p)
+            assert False, "should reject"
+        except ValueError:
+            pass
+
+    def test_normalizer_embedding(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (
+            DataSet, NormalizerStandardize)
+
+        net = _net()
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.writeModel(net, p)
+        X, y = _xy()
+        norm = NormalizerStandardize().fit(DataSet(X, y))
+        ModelSerializer.addNormalizerToModel(p, norm)
+        norm2 = ModelSerializer.restoreNormalizerFromFile(p)
+        np.testing.assert_allclose(norm2.mean, norm.mean)
+        # model still restorable after zip rewrite
+        ModelSerializer.restoreMultiLayerNetwork(p)
+
+
+class TestListeners:
+    def test_score_listener_collects(self):
+        net = _net()
+        listener = CollectScoresIterationListener(frequency=1)
+        net.setListeners(listener)
+        X, y = _xy()
+        net.fit([(X, y)], 5)
+        assert len(listener.scores) == 5
+        assert listener.scores[-1][1] < listener.scores[0][1] * 1.5
+
+    def test_checkpoint_listener_rotates(self, tmp_path):
+        net = _net()
+        listener = CheckpointListener(str(tmp_path), saveEveryNIterations=2,
+                                      keepLast=2)
+        net.setListeners(listener)
+        X, y = _xy()
+        net.fit([(X, y)], 10)
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+        assert len(files) == 2
+        restored = ModelSerializer.restoreMultiLayerNetwork(
+            listener.lastCheckpoint())
+        assert restored.numParams() == net.numParams()
+
+
+class TestEarlyStopping:
+    def test_stops_at_max_epochs(self):
+        net = _net()
+        X, y = _xy(64)
+        val_it = [(X, y)]
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(4))
+               .scoreCalculator(DataSetLossCalculator(val_it))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, [(X, y)]).fit()
+        assert result.totalEpochs == 5  # 0..4 inclusive
+        assert result.getBestModel() is not None
+        assert result.terminationReason == "EpochTerminationCondition"
+
+    def test_patience_stops_early(self):
+        net = _net()
+        X, y = _xy(32)
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(
+                   MaxEpochsTerminationCondition(200),
+                   ScoreImprovementEpochTerminationCondition(3))
+               .scoreCalculator(ClassificationScoreCalculator([(X, y)]))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, [(X, y)]).fit()
+        assert result.totalEpochs < 200
+
+    def test_best_model_is_best(self):
+        net = _net()
+        X, y = _xy(64)
+        cfg = (EarlyStoppingConfiguration.Builder()
+               .epochTerminationConditions(MaxEpochsTerminationCondition(5))
+               .scoreCalculator(DataSetLossCalculator([(X, y)]))
+               .build())
+        result = EarlyStoppingTrainer(cfg, net, [(X, y)]).fit()
+        best = result.getBestModel()
+        assert abs(best.score((X, y)) - result.getBestModelScore()) < 1e-4
+
+
+class TestTransferLearning:
+    def test_freeze_feature_extractor(self):
+        net = _net()
+        X, y = _xy()
+        net.fit([(X, y)], 5)
+        frozen_w = net.getParam(0, "W").numpy().copy()
+        new_net = (TransferLearning.Builder(net)
+                   .setFeatureExtractor(0)
+                   .build())
+        new_net.fit([(X, y)], 5)
+        np.testing.assert_allclose(new_net.getParam(0, "W").numpy(),
+                                   frozen_w, rtol=1e-6)
+        # unfrozen output layer did move
+        assert not np.allclose(new_net.getParam(1, "W").numpy(),
+                               net.getParam(1, "W").numpy())
+
+    def test_nout_replace(self):
+        net = _net()
+        X, y = _xy()
+        net.fit([(X, y)], 3)
+        new_net = (TransferLearning.Builder(net)
+                   .nOutReplace(1, 5)
+                   .build())
+        assert new_net.output(X).shape() == (32, 5)
+        # layer 0 weights carried over
+        np.testing.assert_allclose(new_net.getParam(0, "W").numpy(),
+                                   net.getParam(0, "W").numpy(), rtol=1e-6)
+
+    def test_replace_output_layer(self):
+        net = _net()
+        new_net = (TransferLearning.Builder(net)
+                   .removeOutputLayer()
+                   .addLayer(OutputLayer.Builder().nIn(16).nOut(7)
+                             .activation("softmax").lossFunction("mcxent")
+                             .build())
+                   .build())
+        X, _ = _xy()
+        assert new_net.output(X).shape() == (32, 7)
+        y7 = np.eye(7, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, 7, 32)]
+        s0 = new_net.score((X, y7))
+        new_net.fit([(X, y7)], 10)
+        assert new_net.score((X, y7)) < s0
