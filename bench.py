@@ -1,0 +1,72 @@
+"""Benchmark: flagship BERT-base MLM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note (BASELINE.md): the reference publishes no in-tree numbers
+(`published: {}`), so vs_baseline is reported against BASELINE.json's
+north-star target of 40% MFU — vs_baseline = measured_MFU / 0.40; >1.0
+beats the target. Peak bf16 throughput per TPU v5e chip: 197 TFLOP/s.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12
+MFU_TARGET = 0.40
+
+
+def train_flops_per_step(cfg, batch, seq):
+    """fwd+bwd ~= 3x fwd. Per token, each layer's matmuls cost
+    2*h*3h (QKV) + 2*h*h (attn out) + 2*2*h*f (FFN pair); attention
+    adds 2*2*T*h per token (QK^T and PV); the tied LM head adds 2*h*V."""
+    h, f, L, v = cfg.hidden, cfg.ffn, cfg.num_layers, cfg.vocab_size
+    tokens = batch * seq
+    fwd = tokens * L * (2 * h * 3 * h + 2 * h * h + 4 * h * f)
+    fwd += tokens * L * (4 * seq * h)
+    fwd += tokens * 2 * h * v
+    return 3 * fwd
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.models.bert import (
+        BertConfig, BertTrainer, synthetic_mlm_batch)
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+
+    cfg = BertConfig(vocab_size=30522, hidden=768, num_layers=12,
+                     num_heads=12, ffn=3072, max_len=512)
+    batch, seq = 16, 512
+    mesh = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+    trainer = BertTrainer(cfg, mesh, lr=1e-4)
+    tokens, labels = synthetic_mlm_batch(cfg, batch, seq, seed=0)
+
+    # warmup/compile; float() forces a device->host read because
+    # block_until_ready does not synchronize on the experimental axon
+    # platform
+    float(trainer.train_step(tokens, labels))
+    float(trainer.train_step(tokens, labels))
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.train_step(tokens, labels)
+    float(loss)  # sync
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_sec = batch * seq / dt
+    mfu = train_flops_per_step(cfg, batch, seq) / dt / V5E_PEAK_BF16
+    print(json.dumps({
+        "metric": "bert_base_mlm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / MFU_TARGET, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

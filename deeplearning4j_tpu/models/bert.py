@@ -1,0 +1,256 @@
+"""BERT-capability transformer encoder, TPU-first.
+
+Reference capability: the BERT-base SameDiff TF-import path (SURVEY.md
+§3.4, BASELINE.json configs[3]). The reference imports a frozen GraphDef
+and interprets it op-by-op; here the model is a native graph-level module:
+pure init/forward functions over an explicit param pytree, compiled to ONE
+XLA step with GSPMD shardings:
+
+  - data parallel: batch axis over 'data'
+  - tensor parallel: Megatron column/row pairs over 'model' (QKV + FFN-in
+    column-parallel, attn-out + FFN-out row-parallel)
+  - sequence parallel: ring attention over 'seq' (SURVEY.md §5
+    long-context: absent in the reference, additive here)
+
+bfloat16 activations with float32 params/optimizer state (MXU-friendly);
+the LM head ties the embedding matrix."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, SEQ_AXIS, spec_for)
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    compute_dtype: str = "bfloat16"   # activations; params stay f32
+    layer_norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+
+def init_params(cfg: BertConfig, key) -> dict:
+    h, f, v = cfg.hidden, cfg.ffn, cfg.vocab_size
+    std = 0.02
+    keys = jax.random.split(key, 6 + cfg.num_layers)
+
+    def norm(k, shape):
+        return jax.random.normal(k, shape, jnp.float32) * std
+
+    params = {
+        "tok_emb": norm(keys[0], (v, h)),
+        "pos_emb": norm(keys[1], (cfg.max_len, h)),
+        "type_emb": norm(keys[2], (cfg.type_vocab, h)),
+        "emb_ln": {"g": jnp.ones((h,)), "b": jnp.zeros((h,))},
+        "layers": [],
+        "mlm_bias": jnp.zeros((v,)),
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[6 + i], 6)
+        params["layers"].append({
+            "qkv_w": norm(k[0], (h, 3 * h)),
+            "qkv_b": jnp.zeros((3 * h,)),
+            "out_w": norm(k[1], (h, h)),
+            "out_b": jnp.zeros((h,)),
+            "ln1": {"g": jnp.ones((h,)), "b": jnp.zeros((h,))},
+            "ffn_in_w": norm(k[2], (h, f)),
+            "ffn_in_b": jnp.zeros((f,)),
+            "ffn_out_w": norm(k[3], (f, h)),
+            "ffn_out_b": jnp.zeros((h,)),
+            "ln2": {"g": jnp.ones((h,)), "b": jnp.zeros((h,))},
+        })
+    return params
+
+
+def param_specs(cfg: BertConfig) -> dict:
+    """Megatron-style PartitionSpecs matching init_params structure."""
+    layer = {
+        "qkv_w": P(None, MODEL_AXIS), "qkv_b": P(MODEL_AXIS),
+        "out_w": P(MODEL_AXIS, None), "out_b": P(),
+        "ln1": {"g": P(), "b": P()},
+        "ffn_in_w": P(None, MODEL_AXIS), "ffn_in_b": P(MODEL_AXIS),
+        "ffn_out_w": P(MODEL_AXIS, None), "ffn_out_b": P(),
+        "ln2": {"g": P(), "b": P()},
+    }
+    return {
+        "tok_emb": P(None, MODEL_AXIS),
+        "pos_emb": P(),
+        "type_emb": P(),
+        "emb_ln": {"g": P(), "b": P()},
+        "layers": [dict(layer) for _ in range(cfg.num_layers)],
+        "mlm_bias": P(),
+    }
+
+
+def _layer_norm(x, g, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def forward(params, cfg: BertConfig, tokens, type_ids=None, mesh=None,
+            deterministic=True, rng=None):
+    """tokens: [B, T] int32 -> hidden states [B, T, H]."""
+    dtype = jnp.dtype(cfg.compute_dtype)
+    b, t = tokens.shape
+    x = params["tok_emb"][tokens]                       # [B,T,H] f32 gather
+    x = x + params["pos_emb"][None, :t, :]
+    if type_ids is not None:
+        x = x + params["type_emb"][type_ids]
+    x = _layer_norm(x, params["emb_ln"]["g"], params["emb_ln"]["b"],
+                    cfg.layer_norm_eps)
+    x = x.astype(dtype)
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    for li, lp in enumerate(params["layers"]):
+        # attention (post-LN like original BERT)
+        qkv = x @ lp["qkv_w"].astype(dtype) + lp["qkv_b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda a: jnp.transpose(  # noqa: E731
+            a.reshape(b, t, nh, hd), (0, 2, 1, 3))
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if mesh is not None and SEQ_AXIS in mesh.axis_names:
+            att = ring_attention(q, k, v, mesh)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+            w = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, t, nh * hd)
+        att = att @ lp["out_w"].astype(dtype) + lp["out_b"].astype(dtype)
+        if not deterministic and cfg.dropout > 0 and rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, 2 * li), 1 - cfg.dropout, att.shape)
+            att = jnp.where(keep, att / (1 - cfg.dropout), 0)
+        x = _layer_norm((x + att).astype(jnp.float32), lp["ln1"]["g"],
+                        lp["ln1"]["b"], cfg.layer_norm_eps).astype(dtype)
+        # FFN
+        hdn = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dtype)
+                          + lp["ffn_in_b"].astype(dtype))
+        hdn = hdn @ lp["ffn_out_w"].astype(dtype) \
+            + lp["ffn_out_b"].astype(dtype)
+        if not deterministic and cfg.dropout > 0 and rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, 2 * li + 1), 1 - cfg.dropout,
+                hdn.shape)
+            hdn = jnp.where(keep, hdn / (1 - cfg.dropout), 0)
+        x = _layer_norm((x + hdn).astype(jnp.float32), lp["ln2"]["g"],
+                        lp["ln2"]["b"], cfg.layer_norm_eps).astype(dtype)
+    return x
+
+
+def mlm_loss(params, cfg: BertConfig, tokens, labels, mesh=None,
+             deterministic=False, rng=None):
+    """Masked-LM loss; labels = -100 for unmasked positions (ignored).
+    LM head ties tok_emb."""
+    hs = forward(params, cfg, tokens, mesh=mesh,
+                 deterministic=deterministic, rng=rng)
+    logits = (hs.astype(jnp.float32) @ params["tok_emb"].T
+              + params["mlm_bias"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    tok_lp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(jnp.sum(valid), 1)
+    return -jnp.sum(jnp.where(valid, tok_lp, 0.0)) / n
+
+
+class BertTrainer:
+    """One donated jitted step: fwd + bwd + Adam, with dp/tp/sp shardings."""
+
+    def __init__(self, cfg: BertConfig, mesh: Mesh, lr=1e-4, seed=0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.lr = lr
+        key = jax.random.key(seed)
+        specs = param_specs(cfg)
+        to_sharding = lambda s: NamedSharding(  # noqa: E731
+            mesh, P(*[a if a in mesh.axis_names else None
+                      for a in (s or P())]))
+        self.p_sh = jax.tree_util.tree_map(
+            to_sharding, specs, is_leaf=lambda x: isinstance(x, P))
+        params = init_params(cfg, key)
+        self.params = jax.device_put(params, self.p_sh)
+        zeros = lambda: jax.tree_util.tree_map(  # noqa: E731
+            jnp.zeros_like, self.params)
+        self.opt = {"m": zeros(), "v": zeros()}
+        self.o_sh = {"m": self.p_sh, "v": self.p_sh}
+        self.batch_sh = NamedSharding(mesh, spec_for(mesh, DATA_AXIS,
+                                                     SEQ_AXIS))
+        self._step_fn = None
+        self._step = 0
+
+    def _build(self):
+        cfg, mesh, lr = self.cfg, self.mesh, self.lr
+        repl = NamedSharding(mesh, P())
+
+        def step(params, opt, tokens, labels, rng, t):
+            loss, grads = jax.value_and_grad(mlm_loss)(
+                params, cfg, tokens, labels, mesh=mesh,
+                deterministic=False, rng=rng)
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree_util.tree_map(
+                lambda m_, g: b1 * m_ + (1 - b1) * g, opt["m"], grads)
+            v = jax.tree_util.tree_map(
+                lambda v_, g: b2 * v_ + (1 - b2) * g * g, opt["v"], grads)
+            tt = t + 1
+            mhat = jax.tree_util.tree_map(
+                lambda m_: m_ / (1 - b1 ** tt), m)
+            vhat = jax.tree_util.tree_map(
+                lambda v_: v_ / (1 - b2 ** tt), v)
+            params = jax.tree_util.tree_map(
+                lambda p, mh, vh: p - lr * mh / (jnp.sqrt(vh) + eps),
+                params, mhat, vhat)
+            return loss, params, {"m": m, "v": v}
+
+        return jax.jit(
+            step,
+            in_shardings=(self.p_sh, self.o_sh, self.batch_sh,
+                          self.batch_sh, repl, repl),
+            out_shardings=(repl, self.p_sh, self.o_sh),
+            donate_argnums=(0, 1),
+        )
+
+    def train_step(self, tokens, labels):
+        if self._step_fn is None:
+            self._step_fn = self._build()
+        rng = jax.random.key(self._step + 1)
+        # step counter as a traced scalar — a static arg would recompile
+        # the executable every step
+        loss, self.params, self.opt = self._step_fn(
+            self.params, self.opt, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(labels, jnp.int32), rng,
+            jnp.asarray(self._step, jnp.int32))
+        self._step += 1
+        return loss
+
+
+def synthetic_mlm_batch(cfg: BertConfig, batch, seq_len, seed=0,
+                        mask_frac=0.15):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(3, cfg.vocab_size, (batch, seq_len))
+    labels = np.full((batch, seq_len), -100, np.int64)
+    n_mask = max(1, int(mask_frac * seq_len))
+    for i in range(batch):
+        pos = rng.choice(seq_len, n_mask, replace=False)
+        labels[i, pos] = tokens[i, pos]
+        tokens[i, pos] = 1  # [MASK]
+    return tokens.astype(np.int32), labels.astype(np.int64)

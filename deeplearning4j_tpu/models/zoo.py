@@ -1,0 +1,357 @@
+"""Model zoo: canned architectures.
+
+Reference capability: deeplearning4j-zoo org.deeplearning4j.zoo.model.*
+(SURVEY.md §2.7): ZooModel.init() returns a ready network. Pretrained
+weight download is environment-gated (no egress here); initPretrained
+raises with a clear message instead.
+
+Configs follow the reference's published architectures (LeNet, SimpleCNN,
+AlexNet, VGG16, Darknet19, ResNet50); all lower to single jitted XLA steps
+like any other net."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalization, ComputationGraph, ConvolutionLayer,
+    ConvolutionMode, DenseLayer, DropoutLayer, ElementWiseVertex,
+    GlobalPoolingLayer, InputType, LocalResponseNormalization, LossLayer,
+    LSTM, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
+    PoolingType, RnnOutputLayer, SubsamplingLayer, WeightInit)
+from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs
+
+
+class ZooModel:
+    def init(self):
+        raise NotImplementedError
+
+    def initPretrained(self, *_):
+        raise NotImplementedError(
+            "pretrained weights need network access; load a checkpoint with "
+            "ModelSerializer.restoreMultiLayerNetwork instead")
+
+    def metaData(self):
+        return {"name": type(self).__name__}
+
+
+class LeNet(ZooModel):
+    """Reference: zoo.model.LeNet (the LeNet-MNIST baseline,
+    BASELINE.json configs[0])."""
+
+    def __init__(self, numClasses=10, seed=123, inputShape=(1, 28, 28),
+                 updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        return (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(self.updater).weightInit(WeightInit.XAVIER)
+                .list()
+                .layer(ConvolutionLayer.Builder().nOut(20).kernelSize([5, 5])
+                       .stride([1, 1]).activation("relu").build())
+                .layer(SubsamplingLayer.Builder(poolingType=PoolingType.MAX)
+                       .kernelSize([2, 2]).stride([2, 2]).build())
+                .layer(ConvolutionLayer.Builder().nOut(50).kernelSize([5, 5])
+                       .stride([1, 1]).activation("relu").build())
+                .layer(SubsamplingLayer.Builder(poolingType=PoolingType.MAX)
+                       .kernelSize([2, 2]).stride([2, 2]).build())
+                .layer(DenseLayer.Builder().nOut(500).activation("relu")
+                       .build())
+                .layer(OutputLayer.Builder().nOut(self.numClasses)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.convolutionalFlat(h, w, c))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class SimpleCNN(ZooModel):
+    """Reference: zoo.model.SimpleCNN."""
+
+    def __init__(self, numClasses=10, seed=123, inputShape=(3, 48, 48)):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+
+    def init(self) -> MultiLayerNetwork:
+        c, h, w = self.inputShape
+        conf = (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(Adam(1e-3)).weightInit(WeightInit.RELU)
+                .list()
+                .layer(ConvolutionLayer.Builder().nOut(16)
+                       .kernelSize([3, 3])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build())
+                .layer(BatchNormalization.Builder().build())
+                .layer(ConvolutionLayer.Builder().nOut(16)
+                       .kernelSize([3, 3])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build())
+                .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                       .stride([2, 2]).build())
+                .layer(ConvolutionLayer.Builder().nOut(32)
+                       .kernelSize([3, 3])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build())
+                .layer(BatchNormalization.Builder().build())
+                .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                       .stride([2, 2]).build())
+                .layer(GlobalPoolingLayer.Builder().build())
+                .layer(DropoutLayer.Builder().dropOut(0.5).build())
+                .layer(OutputLayer.Builder().nOut(self.numClasses)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class AlexNet(ZooModel):
+    """Reference: zoo.model.AlexNet (LRN + grouped-conv-free variant)."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224)):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+
+    def init(self) -> MultiLayerNetwork:
+        c, h, w = self.inputShape
+        conf = (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(Nesterovs(1e-2, 0.9)).weightInit(WeightInit.RELU)
+                .list()
+                .layer(ConvolutionLayer.Builder().nOut(96)
+                       .kernelSize([11, 11]).stride([4, 4])
+                       .activation("relu").build())
+                .layer(LocalResponseNormalization.Builder().build())
+                .layer(SubsamplingLayer.Builder().kernelSize([3, 3])
+                       .stride([2, 2]).build())
+                .layer(ConvolutionLayer.Builder().nOut(256)
+                       .kernelSize([5, 5]).padding([2, 2])
+                       .activation("relu").build())
+                .layer(LocalResponseNormalization.Builder().build())
+                .layer(SubsamplingLayer.Builder().kernelSize([3, 3])
+                       .stride([2, 2]).build())
+                .layer(ConvolutionLayer.Builder().nOut(384)
+                       .kernelSize([3, 3]).padding([1, 1])
+                       .activation("relu").build())
+                .layer(ConvolutionLayer.Builder().nOut(384)
+                       .kernelSize([3, 3]).padding([1, 1])
+                       .activation("relu").build())
+                .layer(ConvolutionLayer.Builder().nOut(256)
+                       .kernelSize([3, 3]).padding([1, 1])
+                       .activation("relu").build())
+                .layer(SubsamplingLayer.Builder().kernelSize([3, 3])
+                       .stride([2, 2]).build())
+                .layer(DenseLayer.Builder().nOut(4096).activation("relu")
+                       .dropOut(0.5).build())
+                .layer(DenseLayer.Builder().nOut(4096).activation("relu")
+                       .dropOut(0.5).build())
+                .layer(OutputLayer.Builder().nOut(self.numClasses)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class VGG16(ZooModel):
+    """Reference: zoo.model.VGG16."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224)):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+
+    def init(self) -> MultiLayerNetwork:
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9)).weightInit(WeightInit.RELU)
+             .list())
+
+        def conv(n):
+            return (ConvolutionLayer.Builder().nOut(n).kernelSize([3, 3])
+                    .convolutionMode(ConvolutionMode.SAME)
+                    .activation("relu").build())
+
+        def pool():
+            return (SubsamplingLayer.Builder().kernelSize([2, 2])
+                    .stride([2, 2]).build())
+
+        for n, reps in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
+            for _ in range(reps):
+                b = b.layer(conv(n))
+            b = b.layer(pool())
+        conf = (b
+                .layer(DenseLayer.Builder().nOut(4096).activation("relu")
+                       .dropOut(0.5).build())
+                .layer(DenseLayer.Builder().nOut(4096).activation("relu")
+                       .dropOut(0.5).build())
+                .layer(OutputLayer.Builder().nOut(self.numClasses)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class Darknet19(ZooModel):
+    """Reference: zoo.model.Darknet19."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224)):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+
+    def init(self) -> MultiLayerNetwork:
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(Adam(1e-3)).weightInit(WeightInit.RELU).list())
+
+        def conv(n, k):
+            return (ConvolutionLayer.Builder().nOut(n).kernelSize([k, k])
+                    .convolutionMode(ConvolutionMode.SAME)
+                    .activation("leakyrelu").build())
+
+        def bn():
+            return BatchNormalization.Builder().build()
+
+        def pool():
+            return (SubsamplingLayer.Builder().kernelSize([2, 2])
+                    .stride([2, 2]).build())
+
+        plan = [(32, 3), "P", (64, 3), "P", (128, 3), (64, 1), (128, 3),
+                "P", (256, 3), (128, 1), (256, 3), "P", (512, 3), (256, 1),
+                (512, 3), (256, 1), (512, 3), "P", (1024, 3), (512, 1),
+                (1024, 3), (512, 1), (1024, 3)]
+        for item in plan:
+            if item == "P":
+                b = b.layer(pool())
+            else:
+                n, k = item
+                b = b.layer(conv(n, k)).layer(bn())
+        conf = (b.layer(ConvolutionLayer.Builder()
+                        .nOut(self.numClasses).kernelSize([1, 1])
+                        .convolutionMode(ConvolutionMode.SAME)
+                        .activation("identity").build())
+                .layer(GlobalPoolingLayer.Builder().build())
+                .layer(LossLayer(lossFunction="mcxent",
+                                 activation="softmax"))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class ResNet50(ZooModel):
+    """Reference: zoo.model.ResNet50 (the data-parallel throughput
+    baseline, BASELINE.json configs[1]) — built as a ComputationGraph of
+    bottleneck blocks with identity/projection shortcuts."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224),
+                 updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.updater = updater or Nesterovs(1e-2, 0.9)
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder()
+             .addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def conv(name, n, k, s, inp, act="identity", pad_same=True):
+            g.addLayer(name,
+                       ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([k, k]).stride([s, s])
+                       .convolutionMode(ConvolutionMode.SAME if pad_same
+                                        else ConvolutionMode.TRUNCATE)
+                       .activation(act).build(), inp)
+            return name
+
+        def bn(name, inp, act="identity"):
+            g.addLayer(name,
+                       BatchNormalization.Builder().activation(act).build(),
+                       inp)
+            return name
+
+        # stem
+        x = conv("conv1", 64, 7, 2, "in")
+        x = bn("bn1", x, "relu")
+        g.addLayer("pool1",
+                   SubsamplingLayer.Builder().kernelSize([3, 3])
+                   .stride([2, 2]).convolutionMode(ConvolutionMode.SAME)
+                   .build(), x)
+        x = "pool1"
+
+        def bottleneck(tag, inp, filters, stride, project):
+            f1, f2, f3 = filters
+            a = conv(f"{tag}_c1", f1, 1, stride, inp)
+            a = bn(f"{tag}_b1", a, "relu")
+            a = conv(f"{tag}_c2", f2, 3, 1, a)
+            a = bn(f"{tag}_b2", a, "relu")
+            a = conv(f"{tag}_c3", f3, 1, 1, a)
+            a = bn(f"{tag}_b3", a)
+            if project:
+                s = conv(f"{tag}_proj", f3, 1, stride, inp)
+                s = bn(f"{tag}_projbn", s)
+            else:
+                s = inp
+            g.addVertex(f"{tag}_add", ElementWiseVertex("Add"), a, s)
+            g.addLayer(f"{tag}_out",
+                       ActivationLayer.Builder().activation("relu").build(),
+                       f"{tag}_add")
+            return f"{tag}_out"
+
+        stages = [
+            ("s2", 3, (64, 64, 256), 1),
+            ("s3", 4, (128, 128, 512), 2),
+            ("s4", 6, (256, 256, 1024), 2),
+            ("s5", 3, (512, 512, 2048), 2),
+        ]
+        for stage, blocks, filters, stride in stages:
+            for i in range(blocks):
+                x = bottleneck(f"{stage}_{i}", x, filters,
+                               stride if i == 0 else 1, i == 0)
+
+        g.addLayer("avgpool", GlobalPoolingLayer.Builder().build(), x)
+        g.addLayer("out",
+                   OutputLayer.Builder().nOut(self.numClasses)
+                   .activation("softmax").lossFunction("mcxent").build(),
+                   "avgpool")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """Reference: zoo.model.TextGenerationLSTM (GravesLSTM char-RNN
+    baseline, BASELINE.json configs[2])."""
+
+    def __init__(self, vocabSize=77, hidden=256, seqLength=100, seed=123,
+                 updater=None):
+        self.vocabSize = vocabSize
+        self.hidden = hidden
+        self.seqLength = seqLength
+        self.seed = seed
+        self.updater = updater or Adam(2e-3)
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder().seed(self.seed)
+                .updater(self.updater).weightInit(WeightInit.XAVIER)
+                .list()
+                .layer(LSTM.Builder().nOut(self.hidden).activation("tanh")
+                       .build())
+                .layer(LSTM.Builder().nOut(self.hidden).activation("tanh")
+                       .build())
+                .layer(RnnOutputLayer.Builder().nOut(self.vocabSize)
+                       .activation("softmax").lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(self.vocabSize,
+                                                  self.seqLength))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

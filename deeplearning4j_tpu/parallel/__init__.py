@@ -1,0 +1,14 @@
+"""Parallelism layer (reference L5: ParallelWrapper / Spark / parameter
+server — SURVEY.md §2.6 — rebuilt as mesh + GSPMD shardings + in-step XLA
+collectives)."""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, EXPERT_AXIS, MeshConfig, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+    replicated, shard_batch, spec_for)
+from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
+    ParallelInference, ParallelWrapper, ParameterAveragingTrainingMaster,
+    ShardedTrainer, SharedTrainingMaster, SparkDl4jMultiLayer)
+from deeplearning4j_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention)
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
+    alternating_dense_specs, replicated_specs)

@@ -1,0 +1,94 @@
+"""Device mesh & topology configuration.
+
+Reference capability: the role of VoidConfiguration + ParallelWrapper's
+device management (SURVEY.md §2.6). The reference organizes devices via
+host threads (CudaAffinityManager) and UDP mesh membership
+(MeshOrganizer); here topology is a jax.sharding.Mesh with named axes and
+ALL communication is XLA collectives over ICI/DCN compiled into the step
+(SURVEY.md §5 "Distributed communication backend" — the transport layer is
+deleted, not ported).
+
+Axis names (the scaling-book convention):
+  data   — batch (data parallel), gradients all-reduced over this axis
+  model  — tensor parallel (weights sharded)
+  seq    — sequence/context parallel (ring attention over this axis)
+  pipe   — pipeline stages
+  expert — MoE expert parallel
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+class MeshConfig:
+    """Declarative mesh: MeshConfig(data=4, model=2) -> 8-device mesh.
+
+    Unspecified axes get size 1; data absorbs leftover devices when
+    data=-1 (the common 'use everything for DP' case)."""
+
+    def __init__(self, data=-1, model=1, seq=1, pipe=1, expert=1,
+                 devices=None):
+        self.sizes = {DATA_AXIS: data, MODEL_AXIS: model, SEQ_AXIS: seq,
+                      PIPE_AXIS: pipe, EXPERT_AXIS: expert}
+        self.devices = devices
+
+    def build(self) -> Mesh:
+        devices = self.devices if self.devices is not None else jax.devices()
+        n = len(devices)
+        fixed = math.prod(v for v in self.sizes.values() if v > 0)
+        sizes = dict(self.sizes)
+        n_auto = sum(1 for v in sizes.values() if v <= 0)
+        if n_auto > 1:
+            raise ValueError("at most one axis may be -1 (auto)")
+        if n_auto == 1:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"{n} devices not divisible by fixed axes {fixed}")
+            auto = n // fixed
+            for k, v in sizes.items():
+                if v <= 0:
+                    sizes[k] = auto
+        total = math.prod(sizes.values())
+        if total != n:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {n}")
+        # drop size-1 axes from the physical mesh but remember them so
+        # PartitionSpecs referencing them resolve to None
+        axis_names = [a for a in (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS,
+                                  EXPERT_AXIS) if sizes[a] > 1]
+        if not axis_names:
+            axis_names = [DATA_AXIS]
+        shape = [sizes[a] if sizes[a] > 1 else 1 for a in axis_names]
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, axis_names)
+
+    @staticmethod
+    def data_parallel(devices=None) -> Mesh:
+        return MeshConfig(data=-1, devices=devices).build()
+
+
+def spec_for(mesh: Mesh, *axes) -> P:
+    """PartitionSpec dropping axes the mesh doesn't have (size-1 axes)."""
+    names = set(mesh.axis_names)
+    return P(*[a if (a in names) else None for a in axes])
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host array sharded over the data axis."""
+    spec = spec_for(mesh, DATA_AXIS)
+    return jax.device_put(batch, NamedSharding(mesh, spec))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

@@ -1,0 +1,58 @@
+"""Tensor-parallel sharding recipes.
+
+Reference capability: NONE — SURVEY.md §2.6 records TP as absent in the
+reference; per its prescription TP is provided via GSPMD sharding
+annotations on the lowered net, not a new runtime: build a param_specs
+pytree (same structure as net._params) and hand it to ShardedTrainer.
+XLA then partitions the matmuls over the 'model' axis and inserts the
+activation all-reduces (Megatron-style column/row parallel pairs)."""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def replicated_specs(net):
+    import jax
+
+    return jax.tree_util.tree_map(lambda _: P(), net._params)
+
+
+def alternating_dense_specs(net, axis: str = MODEL_AXIS, axis_size=None):
+    """Megatron MLP pattern over a dense stack: even dense layers are
+    column-parallel (W [in, out] sharded on out, bias sharded), odd ones
+    row-parallel (W sharded on in, bias replicated). XLA inserts one
+    all-reduce after each row-parallel matmul. Output layers and any dim
+    not divisible by axis_size stay replicated (a small class head does
+    not benefit from TP anyway)."""
+    from deeplearning4j_tpu.nn.conf.layers import (
+        DenseLayer, OUTPUT_LAYER_TYPES)
+
+    def divisible(dim):
+        return axis_size is None or dim % axis_size == 0
+
+    specs = []
+    col = True  # start column-parallel
+    for i, lr in enumerate(net.layers):
+        p = net._params[i]
+        if isinstance(lr, DenseLayer) and "W" in p \
+                and not isinstance(lr, OUTPUT_LAYER_TYPES):
+            w_shape = p["W"].shape
+            if col and divisible(w_shape[1]):
+                s = {"W": P(None, axis)}
+                if "b" in p:
+                    s["b"] = P(axis)
+                col = False
+            elif not col and divisible(w_shape[0]):
+                s = {"W": P(axis, None)}
+                if "b" in p:
+                    s["b"] = P()
+                col = True
+            else:
+                s = {k: P() for k in p}
+            specs.append(s)
+        else:
+            specs.append({k: P() for k in p})
+    return specs

@@ -1,0 +1,355 @@
+"""Sharded training: the TPU-native replacement for the reference's entire
+scale-out stack.
+
+Reference capability: ParallelWrapper + SharedTrainingMaster +
+VoidParameterServer/Aeron (SURVEY.md §2.6, call stack §3.5). The reference
+clones the model per device thread, trains asynchronously, and exchanges
+threshold-compressed updates over UDP. Here ONE jitted train step is
+compiled with GSPMD shardings over a named mesh:
+
+  - batch sharded over the 'data' axis, params replicated (DP) or sharded
+    per the param_specs pytree (TP);
+  - XLA emits the gradient all-reduce (psum over 'data') INSIDE the step
+    HLO, riding ICI — there is no transport layer to port, and sync is
+    exact (vs the reference's stale-tolerant async updates, a convergence
+    semantics difference SURVEY.md §3.5 flags);
+  - donation keeps params device-resident across steps.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MeshConfig, spec_for)
+
+
+def _pad_batch(arr, multiple):
+    """Pad the batch axis up to a multiple by repeating the last row, and
+    return (padded, real_count). The loss weighting uses real_count so
+    padding rows do not bias gradients."""
+    n = arr.shape[0]
+    rem = n % multiple
+    if rem == 0:
+        return arr, n
+    pad = multiple - rem
+    reps = np.repeat(arr[-1:], pad, axis=0)
+    return np.concatenate([arr, reps], axis=0), n
+
+
+class ShardedTrainer:
+    """Data/tensor-parallel trainer around a MultiLayerNetwork.
+
+    param_specs: optional pytree (same structure as net._params) of
+    PartitionSpec for tensor parallelism; default fully replicated."""
+
+    def __init__(self, net, mesh: Mesh | None = None, param_specs=None):
+        self.net = net
+        self.mesh = mesh or MeshConfig.data_parallel()
+        self.param_specs = param_specs
+        self._step_fn = None
+        self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
+
+    def _shardings(self):
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        if self.param_specs is None:
+            p_shard = jax.tree_util.tree_map(lambda _: repl,
+                                             self.net._params)
+        else:
+            p_shard = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), self.param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        s_shard = jax.tree_util.tree_map(lambda _: repl, self.net._states)
+        # optimizer state mirrors param sharding (TP memory savings depend
+        # on m/v being sharded like their params); updater states are
+        # param-shaped subtrees ({"m": params_like, ...}), so map each
+        # state entry through the layer's param shardings
+        o_shard = []
+        for i, ost in enumerate(self.net._opt_states):
+            if not ost:
+                o_shard.append(())
+                continue
+            try:
+                o_shard.append({
+                    k: jax.tree_util.tree_map(lambda _, s: s, v, p_shard[i])
+                    for k, v in ost.items()})
+            except (ValueError, TypeError):
+                o_shard.append(jax.tree_util.tree_map(lambda _: repl, ost))
+        batch = NamedSharding(mesh, spec_for(mesh, DATA_AXIS))
+        return p_shard, s_shard, o_shard, batch, repl
+
+    def _build_step(self):
+        net = self.net
+        updaters = [net._layer_updater(i) for i in range(len(net.layers))]
+        p_sh, s_sh, o_sh, b_sh, repl = self._shardings()
+
+        from deeplearning4j_tpu.nn.multilayer import _normalize_grads
+
+        def step(params, states, opt_states, f, l, mask, rng, it):
+            def loss_fn(p):
+                loss, ns = net._loss_from(p, states, f, l, True, rng,
+                                          mask=mask)
+                return loss, ns
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opts = [], []
+            for i, lr in enumerate(net.layers):
+                g = grads[i]
+                if not g:
+                    new_params.append(params[i])
+                    new_opts.append(opt_states[i])
+                    continue
+                g = _normalize_grads(g, lr.gradientNormalization,
+                                     lr.gradientNormalizationThreshold
+                                     or 1.0)
+                upd, new_opt = updaters[i].apply(g, opt_states[i],
+                                                 params[i], it)
+                new_params.append(jax.tree_util.tree_map(
+                    lambda p, u: p - u, params[i], upd))
+                new_opts.append(new_opt)
+            return loss, new_params, new_states, new_opts
+
+        return jax.jit(
+            step,
+            in_shardings=(p_sh, s_sh, o_sh, b_sh, b_sh, b_sh, repl, repl),
+            out_shardings=(repl, p_sh, s_sh, o_sh),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def place_params(self):
+        """Device_put params/states/opt with their shardings (replicates or
+        shards across the mesh)."""
+        p_sh, s_sh, o_sh, _, repl = self._shardings()
+        net = self.net
+        net._params = jax.device_put(net._params, p_sh)
+        net._states = jax.device_put(net._states, s_sh)
+        net._opt_states = jax.device_put(net._opt_states, o_sh)
+
+    def fit(self, data, epochs: int = 1):
+        from deeplearning4j_tpu.autodiff.samediff import (
+            _as_batches, _split_dataset)
+
+        net = self.net
+        if self._step_fn is None:
+            self.place_params()
+            self._step_fn = self._build_step()
+        params, states, opts = net._params, net._states, net._opt_states
+        base_key = jax.random.key(net.conf.seed + 1)
+        last = None
+        for _ in range(epochs):
+            for ds in _as_batches(data):
+                feats, labels = _split_dataset(ds)
+                f = np.asarray(feats[0])
+                l = np.asarray(labels[0])
+                f, real = _pad_batch(f, self._n_data)
+                l, _ = _pad_batch(l, self._n_data)
+                # zero-weight the padding rows so repeated examples do not
+                # bias gradients ([N] for 2D labels, [N,T] for NCW labels)
+                mshape = ((l.shape[0], l.shape[2]) if l.ndim == 3
+                          else (l.shape[0],))
+                mask = np.ones(mshape, np.float32)
+                mask[real:] = 0.0
+                rng = jax.random.fold_in(base_key, net._iteration)
+                loss, params, states, opts = self._step_fn(
+                    params, states, opts, f, l, mask, rng, net._iteration)
+                net._params, net._states, net._opt_states = (
+                    params, states, opts)
+                net._iteration += 1
+                last = loss
+                if net._listeners:
+                    net._score = float(loss)
+                    for listener in net._listeners:
+                        listener.iterationDone(net, net._iteration,
+                                               net._epoch)
+            net._epoch += 1
+        if last is not None:
+            net._score = float(last)
+        return net
+
+
+# ---------------------------------------------------------------------------
+# facades with the reference's API shapes
+# ---------------------------------------------------------------------------
+
+class ParallelWrapper:
+    """Reference: org.deeplearning4j.parallelism.ParallelWrapper.Builder
+    (SURVEY.md §2.6). workers() picks how many devices join the data axis;
+    averaging/gradient-sharing knobs are accepted for API parity but the
+    sync is always the exact in-step all-reduce."""
+
+    class Builder:
+        def __init__(self, net):
+            self._net = net
+            self._workers = None
+            self._prefetch = 2
+
+        def workers(self, n):
+            self._workers = n
+            return self
+
+        def prefetchBuffer(self, n):
+            self._prefetch = n
+            return self
+
+        def averagingFrequency(self, n):
+            return self  # exact sync every step; knob kept for parity
+
+        def trainingMode(self, *_):
+            return self
+
+        def workspaceMode(self, *_):
+            return self
+
+        def build(self):
+            devices = jax.devices()
+            n = self._workers or len(devices)
+            mesh = MeshConfig(data=n, devices=devices[:n]).build()
+            return ParallelWrapper(self._net, mesh, self._prefetch)
+
+    def __init__(self, net, mesh, prefetch=2):
+        self.net = net
+        self.mesh = mesh
+        self.prefetch = prefetch
+        self._trainer = ShardedTrainer(net, mesh)
+
+    def fit(self, iterator, epochs: int = 1):
+        from deeplearning4j_tpu.datasets.iterator import (
+            AsyncDataSetIterator, DataSetIterator)
+
+        data = iterator
+        if isinstance(iterator, DataSetIterator) and self.prefetch > 0 \
+                and iterator.asyncSupported():
+            data = AsyncDataSetIterator(iterator, self.prefetch)
+        self._trainer.fit(data, epochs)
+        return self.net
+
+    def shutdown(self):
+        pass
+
+
+class ParallelInference:
+    """Reference: org.deeplearning4j.parallelism.ParallelInference —
+    batched inference over all devices (batch sharded over 'data')."""
+
+    class Builder:
+        def __init__(self, net):
+            self._net = net
+            self._batch_limit = 32
+
+        def inferenceMode(self, *_):
+            return self
+
+        def batchLimit(self, n):
+            self._batch_limit = n
+            return self
+
+        def workers(self, n):
+            return self
+
+        def build(self):
+            return ParallelInference(self._net, self._batch_limit)
+
+    def __init__(self, net, batch_limit=32):
+        self.net = net
+        self.batch_limit = batch_limit
+        self.mesh = MeshConfig.data_parallel()
+        self._fn = None
+        self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
+
+    def output(self, x):
+        from deeplearning4j_tpu.ndarray import INDArray
+
+        net = self.net
+        if self._fn is None:
+            mesh = self.mesh
+            repl = NamedSharding(mesh, P())
+            b_sh = NamedSharding(mesh, spec_for(mesh, DATA_AXIS))
+            p_sh = jax.tree_util.tree_map(lambda _: repl, net._params)
+            s_sh = jax.tree_util.tree_map(lambda _: repl, net._states)
+
+            def fn(params, states, xb):
+                y, _ = net._forward(params, states, xb, False, None)
+                return y
+
+            self._fn = jax.jit(fn, in_shardings=(p_sh, s_sh, b_sh),
+                               out_shardings=b_sh)
+        xb = np.asarray(x)
+        xb, real = _pad_batch(xb, self._n_data)
+        y = self._fn(net._params, net._states, xb)
+        return INDArray(y[:real])
+
+
+class ParameterAveragingTrainingMaster:
+    """Reference: dl4j-spark ParameterAveragingTrainingMaster.Builder —
+    kept as a mesh-size configuration facade (averaging IS all-reduce when
+    done every step)."""
+
+    class Builder:
+        def __init__(self, *_args):
+            self._batch = 32
+
+        def batchSizePerWorker(self, n):
+            self._batch = n
+            return self
+
+        def averagingFrequency(self, n):
+            return self
+
+        def workerPrefetchNumBatches(self, n):
+            return self
+
+        def build(self):
+            return ParameterAveragingTrainingMaster(self._batch)
+
+    def __init__(self, batch_per_worker=32):
+        self.batch_per_worker = batch_per_worker
+
+
+class SharedTrainingMaster(ParameterAveragingTrainingMaster):
+    """Reference: gradient-sharing SharedTrainingMaster (threshold-
+    compressed async updates). The compression knobs are accepted and
+    ignored: dense synchronous all-reduce over ICI replaces sparse async
+    UDP (SURVEY.md §2.6 item 'Gradient sharing')."""
+
+    class Builder(ParameterAveragingTrainingMaster.Builder):
+        def thresholdAlgorithm(self, *_):
+            return self
+
+        def residualPostProcessor(self, *_):
+            return self
+
+        def build(self):
+            return SharedTrainingMaster(self._batch)
+
+
+class SparkDl4jMultiLayer:
+    """Reference: org.deeplearning4j.spark.impl.multilayer
+    .SparkDl4jMultiLayer — the Spark driver role collapses to 'shard the
+    batch over the mesh'; `sc` is accepted for signature parity."""
+
+    def __init__(self, sc, net_or_conf, training_master=None):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if hasattr(net_or_conf, "layers") and not hasattr(net_or_conf,
+                                                          "fit"):
+            net = MultiLayerNetwork(net_or_conf)
+            net.init()
+        else:
+            net = net_or_conf
+        self.net = net
+        self.training_master = training_master
+        self._trainer = ShardedTrainer(net)
+
+    def fit(self, data, epochs: int = 1):
+        self._trainer.fit(data, epochs)
+        return self.net
+
+    def getNetwork(self):
+        return self.net

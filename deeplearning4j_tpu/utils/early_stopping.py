@@ -17,7 +17,9 @@ class MaxEpochsTerminationCondition:
         self.maxEpochs = maxEpochs
 
     def terminate(self, epoch, score, best_epoch):
-        return epoch >= self.maxEpochs
+        # reference semantics: train exactly maxEpochs epochs (0-indexed
+        # epoch counter checked after the epoch completes)
+        return epoch + 1 >= self.maxEpochs
 
 
 class ScoreImprovementEpochTerminationCondition:
@@ -201,7 +203,14 @@ class EarlyStoppingTrainer:
                     reason = "IterationTerminationCondition"
                     details = type(c).__name__
                     stop = True
+            # score-based epoch conditions only fire on epochs where the
+            # score was actually measured (a patience condition must not
+            # consume its window on unevaluated epochs); MaxEpochs has no
+            # score dependency and runs every epoch
             for c in cfg.epochConditions:
+                score_based = not isinstance(c, MaxEpochsTerminationCondition)
+                if score_based and epoch not in score_vs_epoch:
+                    continue
                 if c.terminate(epoch, score_vs_epoch.get(epoch, best_score),
                                best_epoch):
                     reason = "EpochTerminationCondition"

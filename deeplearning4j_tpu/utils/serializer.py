@@ -4,9 +4,11 @@ Reference capability: org.deeplearning4j.util.ModelSerializer (SURVEY.md §5
 "Checkpoint / resume"): a ZIP holding configuration.json + coefficients
 (flat params) + updater state + optional normalizer — the same artifact
 shape, so checkpoints carry config + weights + optimizer state in one file.
-Coefficients are stored as a raw little-endian float32 flat vector
-('coefficients.bin') exactly in MultiLayerNetwork.params() order, plus an
-npz with per-layer named arrays for robust restore."""
+Params are stored as an npz of per-layer named arrays (canonical restore
+source). Pass includeFlatCoefficients=True to additionally write
+'coefficients.bin' — a raw little-endian float32 flat vector in
+MultiLayerNetwork.params() order for DL4J-artifact-shape compatibility
+(doubles the weight payload, so off by default)."""
 
 from __future__ import annotations
 
@@ -18,9 +20,13 @@ import numpy as np
 import jax.numpy as jnp
 
 
+_SEP = "\x1f"  # unit separator: cannot appear in layer names
+
+
 class ModelSerializer:
     @staticmethod
-    def writeModel(model, path, saveUpdater: bool = True, normalizer=None):
+    def writeModel(model, path, saveUpdater: bool = True, normalizer=None,
+                   includeFlatCoefficients: bool = False):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         is_graph = isinstance(model, ComputationGraph)
@@ -29,24 +35,25 @@ class ModelSerializer:
             zf.writestr("modelType",
                         "ComputationGraph" if is_graph
                         else "MultiLayerNetwork")
-            flat = model.params().toNumpy().astype("<f4")
-            zf.writestr("coefficients.bin", flat.tobytes())
-            # named per-layer arrays (robust against ordering drift)
+            if includeFlatCoefficients:
+                flat = model.params().toNumpy().astype("<f4")
+                zf.writestr("coefficients.bin", flat.tobytes())
+            # named per-layer arrays (the canonical restore source)
             named = {}
             if is_graph:
                 for name, p in model._params.items():
                     for k, v in p.items():
-                        named[f"p|{name}|{k}"] = np.asarray(v)
+                        named[_SEP.join(("p", name, k))] = np.asarray(v)
                 for name, s in model._states.items():
                     for k, v in s.items():
-                        named[f"s|{name}|{k}"] = np.asarray(v)
+                        named[_SEP.join(("s", name, k))] = np.asarray(v)
             else:
                 for i, p in enumerate(model._params):
                     for k, v in p.items():
-                        named[f"p|{i}|{k}"] = np.asarray(v)
+                        named[_SEP.join(("p", str(i), k))] = np.asarray(v)
                 for i, s in enumerate(model._states):
                     for k, v in s.items():
-                        named[f"s|{i}|{k}"] = np.asarray(v)
+                        named[_SEP.join(("s", str(i), k))] = np.asarray(v)
             buf = io.BytesIO()
             np.savez(buf, **named)
             zf.writestr("params.npz", buf.getvalue())
@@ -91,7 +98,7 @@ class ModelSerializer:
             model.init()
             named = np.load(io.BytesIO(zf.read("params.npz")))
             for key in named.files:
-                kind, idx, pname = key.split("|", 2)
+                kind, idx, pname = key.split(_SEP, 2)
                 arr = jnp.asarray(named[key])
                 if mtype == "ComputationGraph":
                     target = model._params if kind == "p" else model._states

@@ -1,0 +1,182 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (the conftest forces
+--xla_force_host_platform_device_count=8; SURVEY.md §4 'distributed without
+a cluster' — the reference simulates multi-node in one JVM over Aeron
+loopback, we simulate multi-chip in one process over the forced host
+platform)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import (
+    DenseLayer, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    MeshConfig, ParallelInference, ParallelWrapper, ShardedTrainer,
+    SparkDl4jMultiLayer, alternating_dense_specs, ring_attention)
+from deeplearning4j_tpu.parallel.ring_attention import _dense_attention
+
+
+def _xy(n=64, fin=12, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, fin)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return X, y
+
+
+def _net(seed=5, updater=None):
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(updater or Sgd(0.1)).list()
+            .layer(DenseLayer.Builder().nIn(12).nOut(32)
+                   .activation("relu").build())
+            .layer(DenseLayer.Builder().nOut(32).activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                   .lossFunction("mcxent").build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestMeshConfig:
+    def test_auto_data_axis(self):
+        mesh = MeshConfig.data_parallel()
+        assert mesh.shape["data"] == len(jax.devices())
+
+    def test_mixed_axes(self):
+        mesh = MeshConfig(data=4, model=2).build()
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_bad_sizes_rejected(self):
+        with pytest.raises(ValueError):
+            MeshConfig(data=3, model=3).build()  # 9 != 8
+
+
+class TestShardedTrainer:
+    def test_dp_matches_single_device(self):
+        """The sharded DP step must produce the SAME updates as the
+        single-device step (exact synchronous all-reduce)."""
+        X, y = _xy(64)
+        net_a = _net(seed=5)
+        net_b = _net(seed=5)
+        net_a.fit([(X, y)], 10)
+        ShardedTrainer(net_b, MeshConfig.data_parallel()).fit([(X, y)], 10)
+        np.testing.assert_allclose(net_a.params().numpy(),
+                                   net_b.params().numpy(), rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_dp_loss_decreases(self):
+        X, y = _xy(64)
+        net = _net(seed=7, updater=Adam(1e-2))
+        s0 = net.score((X, y))
+        ShardedTrainer(net).fit([(X, y)], 20)
+        assert net.score((X, y)) < s0 * 0.7
+
+    def test_uneven_batch_padded_matches_single_device(self):
+        """Padding rows must be zero-masked: updates on a 61-row batch
+        equal the single-device updates on the same 61 rows."""
+        X, y = _xy(61)  # not divisible by 8
+        net_a = _net(seed=9)
+        net_b = _net(seed=9)
+        net_a.fit([(X, y)], 5)
+        ShardedTrainer(net_b).fit([(X, y)], 5)
+        np.testing.assert_allclose(net_a.params().numpy(),
+                                   net_b.params().numpy(), rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_tensor_parallel_matches_replicated(self):
+        X, y = _xy(32)
+        net_a = _net(seed=11)
+        net_b = _net(seed=11)
+        mesh = MeshConfig(data=4, model=2).build()
+        specs = alternating_dense_specs(net_b, axis_size=2)
+        ShardedTrainer(net_a, MeshConfig(data=8).build()).fit([(X, y)], 5)
+        ShardedTrainer(net_b, mesh, param_specs=specs).fit([(X, y)], 5)
+        np.testing.assert_allclose(net_a.params().numpy(),
+                                   net_b.params().numpy(), rtol=2e-4,
+                                   atol=1e-5)
+
+
+class TestFacades:
+    def test_parallel_wrapper(self):
+        from deeplearning4j_tpu.datasets import (
+            DataSet, ListDataSetIterator)
+
+        X, y = _xy(64)
+        net = _net(seed=3, updater=Adam(1e-2))
+        s0 = net.score((X, y))
+        wrapper = (ParallelWrapper.Builder(net)
+                   .workers(8).prefetchBuffer(2).averagingFrequency(5)
+                   .build())
+        wrapper.fit(ListDataSetIterator(DataSet(X, y), batch_size=16), 10)
+        assert net.score((X, y)) < s0
+
+    def test_parallel_inference(self):
+        X, _ = _xy(40)
+        net = _net()
+        pi = ParallelInference.Builder(net).batchLimit(64).build()
+        out = pi.output(X)
+        assert out.shape() == (40, 3)
+        np.testing.assert_allclose(out.numpy(), net.output(X).numpy(),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_spark_facade(self):
+        X, y = _xy(64)
+        net = _net(seed=13, updater=Adam(1e-2))
+        spark_net = SparkDl4jMultiLayer(None, net)
+        s0 = net.score((X, y))
+        spark_net.fit([(X, y)], 10)
+        assert spark_net.getNetwork().score((X, y)) < s0
+
+
+class TestRingAttention:
+    def _qkv(self, b=2, h=4, t=16, d=8, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.normal(size=(b, h, t, d)).astype(np.float32))
+        return mk(), mk(), mk()
+
+    def test_matches_dense_attention(self):
+        q, k, v = self._qkv()
+        mesh = MeshConfig(data=1, seq=8).build()
+        out_ring = ring_attention(q, k, v, mesh)
+        out_dense = _dense_attention(q, k, v, causal=False, scaled=True)
+        np.testing.assert_allclose(np.asarray(out_ring),
+                                   np.asarray(out_dense), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_causal_matches_dense(self):
+        q, k, v = self._qkv(seed=1)
+        mesh = MeshConfig(data=1, seq=8).build()
+        out_ring = ring_attention(q, k, v, mesh, causal=True)
+        out_dense = _dense_attention(q, k, v, causal=True, scaled=True)
+        np.testing.assert_allclose(np.asarray(out_ring),
+                                   np.asarray(out_dense), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_degenerate_mesh_falls_back(self):
+        q, k, v = self._qkv(t=8)
+        mesh = MeshConfig(data=8).build()  # no seq axis
+        out = ring_attention(q, k, v, mesh)
+        out_dense = _dense_attention(q, k, v, causal=False, scaled=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_dense),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grad_flows(self):
+        q, k, v = self._qkv(t=8, seed=2)
+        mesh = MeshConfig(data=1, seq=8).build()
+
+        def f(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+        gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        def fd(q, k, v):
+            return jnp.sum(
+                _dense_attention(q, k, v, causal=True, scaled=True) ** 2)
+
+        dq, dk, dv = jax.grad(fd, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(gq), np.asarray(dq),
+                                   rtol=5e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gv), np.asarray(dv),
+                                   rtol=5e-3, atol=1e-4)

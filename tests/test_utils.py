@@ -143,7 +143,7 @@ class TestEarlyStopping:
                .scoreCalculator(DataSetLossCalculator(val_it))
                .build())
         result = EarlyStoppingTrainer(cfg, net, [(X, y)]).fit()
-        assert result.totalEpochs == 5  # 0..4 inclusive
+        assert result.totalEpochs == 4  # exactly maxEpochs epochs
         assert result.getBestModel() is not None
         assert result.terminationReason == "EpochTerminationCondition"
 
