@@ -1,0 +1,179 @@
+"""Hyperparameter search.
+
+Reference capability: arbiter (org.deeplearning4j.arbiter.optimize.*,
+SURVEY.md §2.7): ParameterSpace declarations, candidate generators
+(random / grid), an OptimizationConfiguration, and a LocalOptimizationRunner
+that builds-trains-scores each candidate and tracks the best. The model
+builder is a user callable candidate_params -> model; the score function a
+callable (model, data) -> float."""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+
+
+# -- parameter spaces --------------------------------------------------------
+
+class ContinuousParameterSpace:
+    def __init__(self, minValue, maxValue, log=False):
+        self.lo = float(minValue)
+        self.hi = float(maxValue)
+        self.log = log
+
+    def sample(self, rng):
+        if self.log:
+            return float(np.exp(rng.uniform(math.log(self.lo),
+                                            math.log(self.hi))))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def grid(self, n):
+        if self.log:
+            return list(np.exp(np.linspace(math.log(self.lo),
+                                           math.log(self.hi), n)))
+        return list(np.linspace(self.lo, self.hi, n))
+
+
+class IntegerParameterSpace:
+    def __init__(self, minValue, maxValue):
+        self.lo = int(minValue)
+        self.hi = int(maxValue)
+
+    def sample(self, rng):
+        return int(rng.integers(self.lo, self.hi + 1))
+
+    def grid(self, n):
+        return sorted({int(v) for v in
+                       np.linspace(self.lo, self.hi, n)})
+
+
+class DiscreteParameterSpace:
+    def __init__(self, *values):
+        self.values = list(values[0]) if len(values) == 1 and isinstance(
+            values[0], (list, tuple)) else list(values)
+
+    def sample(self, rng):
+        return self.values[rng.integers(len(self.values))]
+
+    def grid(self, n):
+        return list(self.values)
+
+
+# -- candidate generators ----------------------------------------------------
+
+class CandidateGenerator:
+    def __init__(self, space: dict):
+        self.space = space
+
+    def candidates(self, limit):
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    def __init__(self, space: dict, seed=0):
+        super().__init__(space)
+        self.seed = seed
+
+    def candidates(self, limit):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(limit):
+            yield {k: (v.sample(rng) if hasattr(v, "sample") else v)
+                   for k, v in self.space.items()}
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    def __init__(self, space: dict, discretizationCount=3):
+        super().__init__(space)
+        self.n = discretizationCount
+
+    def candidates(self, limit):
+        keys = list(self.space)
+        axes = [self.space[k].grid(self.n) if hasattr(self.space[k], "grid")
+                else [self.space[k]] for k in keys]
+        for i, combo in enumerate(itertools.product(*axes)):
+            if i >= limit:
+                return
+            yield dict(zip(keys, combo))
+
+
+# -- runner ------------------------------------------------------------------
+
+class OptimizationConfiguration:
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def candidateGenerator(self, g):
+            self._kw["generator"] = g
+            return self
+
+        def modelBuilder(self, fn):
+            """fn(candidate: dict) -> model with fit/score capability."""
+            self._kw["model_builder"] = fn
+            return self
+
+        def scoreFunction(self, fn, minimize=True):
+            self._kw["score_fn"] = fn
+            self._kw["minimize"] = minimize
+            return self
+
+        def terminationConditions(self, maxCandidates=10,
+                                  maxTimeSeconds=None):
+            self._kw["max_candidates"] = maxCandidates
+            self._kw["max_time"] = maxTimeSeconds
+            return self
+
+        def build(self):
+            cfg = OptimizationConfiguration()
+            cfg.__dict__.update(self._kw)
+            return cfg
+
+
+class OptimizationResult:
+    def __init__(self, candidate, score, index, model):
+        self.candidate = candidate
+        self.score = score
+        self.index = index
+        self.model = model
+
+    def getBestCandidate(self):
+        return self.candidate
+
+    def getBestScore(self):
+        return self.score
+
+
+class LocalOptimizationRunner:
+    def __init__(self, config: OptimizationConfiguration):
+        self.config = config
+        self.results: list[OptimizationResult] = []
+
+    def execute(self) -> OptimizationResult:
+        import time
+
+        cfg = self.config
+        minimize = getattr(cfg, "minimize", True)
+        best = None
+        t0 = time.time()
+        for i, cand in enumerate(
+                cfg.generator.candidates(cfg.max_candidates)):
+            if cfg.max_time and time.time() - t0 > cfg.max_time:
+                break
+            model = cfg.model_builder(cand)
+            score = cfg.score_fn(model)
+            res = OptimizationResult(cand, score, i, model)
+            self.results.append(res)
+            if best is None or ((score < best.score) if minimize
+                                else (score > best.score)):
+                best = res
+        if best is None:
+            raise ValueError("no candidates evaluated")
+        return best
+
+    def bestScore(self):
+        if not self.results:
+            return None
+        minimize = getattr(self.config, "minimize", True)
+        return (min if minimize else max)(r.score for r in self.results)

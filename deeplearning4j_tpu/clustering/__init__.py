@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401,E501

@@ -1,0 +1,50 @@
+"""K-means clustering (reference: deeplearning4j-nearestneighbors-parent's
+org.deeplearning4j.clustering.kmeans.KMeansClustering, SURVEY.md §2.7).
+Lloyd iterations as jitted device ops — assignment is one big argmin over
+a distance matrix (MXU-friendly), update is a segment mean."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class KMeansClustering:
+    def __init__(self, k, maxIterations=100, distance="euclidean", seed=0):
+        self.k = int(k)
+        self.maxIterations = maxIterations
+        self.seed = seed
+        self.centers = None
+
+    @staticmethod
+    def setup(k, maxIterations=100, distanceFunction="euclidean", seed=0):
+        return KMeansClustering(k, maxIterations, distanceFunction, seed)
+
+    def applyTo(self, points):
+        x = jnp.asarray(np.asarray(points, np.float32))
+        n = x.shape[0]
+        rng = np.random.default_rng(self.seed)
+        centers = x[rng.choice(n, self.k, replace=False)]
+
+        @jax.jit
+        def step(centers):
+            d = (jnp.sum(x * x, 1)[:, None]
+                 - 2 * x @ centers.T + jnp.sum(centers * centers, 1)[None])
+            assign = jnp.argmin(d, axis=1)
+            one_hot = jax.nn.one_hot(assign, self.k, dtype=x.dtype)
+            counts = jnp.maximum(one_hot.sum(0), 1.0)
+            new_centers = (one_hot.T @ x) / counts[:, None]
+            # keep empty clusters where they were
+            empty = (one_hot.sum(0) == 0)[:, None]
+            return jnp.where(empty, centers, new_centers), assign
+
+        assign = None
+        for _ in range(self.maxIterations):
+            new_centers, assign = step(centers)
+            if bool(jnp.allclose(new_centers, centers, atol=1e-6)):
+                centers = new_centers
+                break
+            centers = new_centers
+        self.centers = np.asarray(centers)
+        return np.asarray(assign)

@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.graph.deepwalk import (  # noqa: F401
+    DeepWalk, Graph, RandomWalkIterator)

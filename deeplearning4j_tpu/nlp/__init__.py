@@ -1,0 +1,11 @@
+"""NLP layer (reference L7: deeplearning4j-nlp — SURVEY.md §2.7)."""
+
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, SentenceIterator, TokenPreProcess, Tokenizer)
+from deeplearning4j_tpu.nlp.word2vec import (  # noqa: F401
+    VocabCache, VocabWord, Word2Vec)
+from deeplearning4j_tpu.nlp.paragraph_vectors import (  # noqa: F401
+    LabelledDocument, ParagraphVectors)
+from deeplearning4j_tpu.nlp.serializer import (  # noqa: F401
+    WordVectorSerializer)

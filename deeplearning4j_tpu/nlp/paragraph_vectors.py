@@ -1,0 +1,159 @@
+"""ParagraphVectors (doc2vec).
+
+Reference capability: org.deeplearning4j.models.paragraphvectors
+.ParagraphVectors (SURVEY.md §2.7) — PV-DBOW: a document vector predicts
+the words it contains (skip-gram with the doc id as the 'center');
+inferVector() runs gradient steps on a fresh doc vector with word vectors
+frozen. Same batched device step as Word2Vec."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _sgns_loss
+
+
+class LabelledDocument:
+    def __init__(self, content, label):
+        self.content = content
+        self.label = label
+
+
+class ParagraphVectors(Word2Vec):
+    class Builder(Word2Vec.Builder):
+        def __init__(self):
+            super().__init__()
+            self._docs = None
+
+        def iterate(self, docs):
+            """docs: list of LabelledDocument or (label, text) tuples."""
+            self._docs = [
+                d if isinstance(d, LabelledDocument)
+                else LabelledDocument(d[1], d[0]) for d in docs
+            ]
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            from deeplearning4j_tpu.nlp.tokenization import (
+                CollectionSentenceIterator)
+
+            sentences = CollectionSentenceIterator(
+                [d.content for d in self._docs])
+            pv = ParagraphVectors(sentences,
+                                  self._tok or DefaultTokenizerFactory(),
+                                  **self._kw)
+            pv.docs = self._docs
+            return pv
+
+    def __init__(self, sentence_iterator, tokenizer_factory, **kw):
+        super().__init__(sentence_iterator, tokenizer_factory, **kw)
+        self.docs: list[LabelledDocument] = []
+        self.doc_vecs = None
+        self._labels: list[str] = []
+        self._doc_step = None
+
+    def fit(self):
+        self.buildVocab() if self.vocab.numWords() == 0 else None
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg["seed"])
+        key = jax.random.key(cfg["seed"] + 1)
+        v, d = self.vocab.numWords(), cfg["layerSize"]
+        n_docs = len(self.docs)
+        self._labels = [doc.label for doc in self.docs]
+        if self.syn0 is None:
+            self.syn0 = (jax.random.uniform(key, (v, d), jnp.float32)
+                         - 0.5) / d
+            self.syn1 = jnp.zeros((v, d), jnp.float32)
+        if self.doc_vecs is None:
+            self.doc_vecs = (jax.random.uniform(
+                jax.random.fold_in(key, 1), (n_docs, d), jnp.float32)
+                - 0.5) / d
+        lr = cfg["learningRate"]
+        k_neg = cfg["negative"]
+
+        def step(doc_vecs, syn1, doc_ids, words, negs):
+            loss, (gd, g1) = jax.value_and_grad(
+                _sgns_loss, argnums=(0, 1))(doc_vecs, syn1, doc_ids, words,
+                                            negs)
+            return loss, doc_vecs - lr * gd, syn1 - lr * g1
+
+        step = jax.jit(step, donate_argnums=(0, 1))
+        doc_vecs, syn1 = self.doc_vecs, self.syn1
+        bsz = cfg["batchSize"]
+        for _epoch in range(cfg["epochs"]):
+            doc_ids, words = [], []
+            for di, doc in enumerate(self.docs):
+                for tok in self.tokenizer.create(doc.content).getTokens():
+                    wi = self.vocab.indexOf(tok)
+                    if wi >= 0:
+                        doc_ids.append(di)
+                        words.append(wi)
+            doc_ids = np.asarray(doc_ids, np.int32)
+            words = np.asarray(words, np.int32)
+            order = rng.permutation(len(doc_ids))
+            doc_ids, words = doc_ids[order], words[order]
+            for i in range(0, len(doc_ids), bsz):
+                dids = doc_ids[i:i + bsz]
+                ws = words[i:i + bsz]
+                negs = rng.choice(v, size=(len(dids), k_neg),
+                                  p=self._neg_table).astype(np.int32)
+                loss, doc_vecs, syn1 = step(doc_vecs, syn1, dids, ws, negs)
+        self.doc_vecs, self.syn1 = doc_vecs, syn1
+        return self
+
+    def getVector(self, label) -> np.ndarray:
+        return np.asarray(self.doc_vecs[self._labels.index(label)])
+
+    def inferVector(self, text, steps=20) -> np.ndarray:
+        """Fit a fresh doc vector against frozen word output vectors."""
+        cfg = self.cfg
+        rng = np.random.default_rng(0)
+        words = [self.vocab.indexOf(t)
+                 for t in self.tokenizer.create(text).getTokens()]
+        words = np.asarray([w for w in words if w >= 0], np.int32)
+        if len(words) == 0:
+            return np.zeros(cfg["layerSize"], np.float32)
+        vec = (rng.random(cfg["layerSize"]).astype(np.float32) - 0.5) \
+            / cfg["layerSize"]
+        vec = jnp.asarray(vec)
+        syn1 = self.syn1
+        lr = cfg["learningRate"]
+
+        @jax.jit
+        def istep(vec, words, negs):
+            def loss_fn(v):
+                dv = jnp.broadcast_to(v, (len(words), v.shape[0]))
+                pos = syn1[words]
+                neg = syn1[negs]
+                p = jnp.sum(dv * pos, axis=-1)
+                ns = jnp.einsum("bd,bkd->bk", dv, neg)
+                # mean here: inferVector fits ONE vector, so per-word sum
+                # would scale the step with document length
+                return jnp.mean(jax.nn.softplus(-p)
+                                + jnp.sum(jax.nn.softplus(ns), axis=-1))
+
+            g = jax.grad(loss_fn)(vec)
+            return vec - lr * g
+
+        for _ in range(steps):
+            negs = rng.choice(self.vocab.numWords(),
+                              size=(len(words), cfg["negative"]),
+                              p=self._neg_table).astype(np.int32)
+            vec = istep(vec, words, negs)
+        return np.asarray(vec)
+
+    def similarityToLabel(self, text, label) -> float:
+        a = self.inferVector(text)
+        b = self.getVector(label)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def nearestLabels(self, text, n=5) -> list:
+        a = self.inferVector(text)
+        m = np.asarray(self.doc_vecs)
+        sims = m @ a / np.maximum(
+            np.linalg.norm(m, axis=1) * (np.linalg.norm(a) + 1e-12), 1e-12)
+        order = np.argsort(-sims)[:n]
+        return [self._labels[int(i)] for i in order]

@@ -1,0 +1,44 @@
+"""Word-vector persistence.
+
+Reference capability: org.deeplearning4j.models.embeddings.loader
+.WordVectorSerializer (SURVEY.md §2.7): the word2vec text format
+(header 'V D', then 'word v1 v2 ...' per line) readable by the original
+word2vec tooling and gensim."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def writeWord2VecModel(model, path):
+        m = model.getWordVectorMatrix()
+        with open(path, "w") as f:
+            f.write(f"{m.shape[0]} {m.shape[1]}\n")
+            for i in range(m.shape[0]):
+                word = model.vocab.wordAtIndex(i)
+                vec = " ".join(f"{x:.6f}" for x in m[i])
+                f.write(f"{word} {vec}\n")
+
+    @staticmethod
+    def readWord2VecModel(path):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        import jax.numpy as jnp
+
+        with open(path) as f:
+            header = f.readline().split()
+            v, d = int(header[0]), int(header[1])
+            model = Word2Vec(None, None, minWordFrequency=1, layerSize=d,
+                             windowSize=5, negative=5, learningRate=0.025,
+                             epochs=1, iterations=1, seed=0, batchSize=1024,
+                             sampling=0, algorithm="skipgram")
+            mat = np.zeros((v, d), np.float32)
+            for i in range(v):
+                parts = f.readline().rstrip("\n").split(" ")
+                model.vocab.add(parts[0], 1)
+                mat[i] = [float(x) for x in parts[1:d + 1]]
+            model.syn0 = jnp.asarray(mat)
+            model.syn1 = jnp.zeros_like(model.syn0)
+        return model

@@ -1,0 +1,356 @@
+"""Word2Vec: SkipGram / CBOW with negative sampling, device-resident.
+
+Reference capability: deeplearning4j-nlp org.deeplearning4j.models.word2vec
+.Word2Vec + SkipGram/CBOW learning algorithms (BASELINE.json configs[4],
+SURVEY.md §2.7). The reference's hot loop is a host-driven sparse custom op
+(libnd4j `skipgram`) per word pair; here training is BATCHED on device
+(SURVEY.md §7 hard part 6): one jitted step takes [B] centers, [B]
+contexts, [B,K] negatives, and jax.grad's gather VJP produces exactly the
+sparse scatter-add update the reference hand-codes — fused with the SGD
+apply, params donated.
+
+Vocab build, frequent-word subsampling, window pairing, and unigram^0.75
+negative-table sampling are host-side numpy (they are ETL, not math)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, SentenceIterator)
+
+
+class VocabWord:
+    def __init__(self, word, count, index):
+        self.word = word
+        self.count = count
+        self.index = index
+
+
+class VocabCache:
+    def __init__(self):
+        self.words: list[VocabWord] = []
+        self._by_word: dict[str, VocabWord] = {}
+
+    def add(self, word, count):
+        vw = VocabWord(word, count, len(self.words))
+        self.words.append(vw)
+        self._by_word[word] = vw
+        return vw
+
+    def containsWord(self, w):
+        return w in self._by_word
+
+    def indexOf(self, w):
+        return self._by_word[w].index if w in self._by_word else -1
+
+    def wordAtIndex(self, i):
+        return self.words[i].word
+
+    def wordFrequency(self, w):
+        return self._by_word[w].count if w in self._by_word else 0
+
+    def numWords(self):
+        return len(self.words)
+
+    def totalWordOccurrences(self):
+        return sum(w.count for w in self.words)
+
+
+def _sgns_loss(syn0, syn1, centers, contexts, negatives):
+    """Skip-gram negative sampling loss for a batch.
+    centers [B], contexts [B], negatives [B,K]."""
+    c = syn0[centers]                      # [B,D]
+    pos = syn1[contexts]                   # [B,D]
+    neg = syn1[negatives]                  # [B,K,D]
+    pos_score = jnp.sum(c * pos, axis=-1)
+    neg_score = jnp.einsum("bd,bkd->bk", c, neg)
+    # -log sigma(pos) - sum log sigma(-neg), numerically stable.
+    # SUM over the batch (not mean): each pair must contribute a full
+    # per-pair SGD update like the reference's sequential loop — a mean
+    # would divide the learning rate by the batch size.
+    loss = jnp.sum(
+        jax.nn.softplus(-pos_score) + jnp.sum(jax.nn.softplus(neg_score),
+                                              axis=-1))
+    return loss
+
+
+def _cbow_loss(syn0, syn1, contexts_mat, context_mask, centers, negatives):
+    """CBOW: mean of context word vectors predicts the center.
+    contexts_mat [B,W], context_mask [B,W], centers [B], negatives [B,K]."""
+    ctx = syn0[contexts_mat]               # [B,W,D]
+    m = context_mask[..., None]
+    mean = jnp.sum(ctx * m, axis=1) / jnp.maximum(
+        jnp.sum(m, axis=1), 1.0)           # [B,D]
+    pos = syn1[centers]
+    neg = syn1[negatives]
+    pos_score = jnp.sum(mean * pos, axis=-1)
+    neg_score = jnp.einsum("bd,bkd->bk", mean, neg)
+    return jnp.sum(
+        jax.nn.softplus(-pos_score) + jnp.sum(jax.nn.softplus(neg_score),
+                                              axis=-1))
+
+
+class Word2Vec:
+    class Builder:
+        def __init__(self):
+            self._kw = dict(minWordFrequency=5, layerSize=100, windowSize=5,
+                            negative=5, learningRate=0.025, epochs=1,
+                            iterations=1, seed=42, batchSize=2048,
+                            sampling=1e-3, algorithm="skipgram")
+            self._iter = None
+            self._tok = None
+
+        def minWordFrequency(self, n):
+            self._kw["minWordFrequency"] = n
+            return self
+
+        def layerSize(self, n):
+            self._kw["layerSize"] = n
+            return self
+
+        def windowSize(self, n):
+            self._kw["windowSize"] = n
+            return self
+
+        def negativeSampling(self, n):
+            self._kw["negative"] = int(n)
+            return self
+
+        def negative(self, n):
+            return self.negativeSampling(n)
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = lr
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = n
+            return self
+
+        def iterations(self, n):
+            self._kw["iterations"] = n
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def batchSize(self, n):
+            self._kw["batchSize"] = n
+            return self
+
+        def sampling(self, s):
+            self._kw["sampling"] = s
+            return self
+
+        def elementsLearningAlgorithm(self, name):
+            self._kw["algorithm"] = ("cbow" if "cbow" in str(name).lower()
+                                     else "skipgram")
+            return self
+
+        def iterate(self, sentence_iterator: SentenceIterator):
+            self._iter = sentence_iterator
+            return self
+
+        def tokenizerFactory(self, tok):
+            self._tok = tok
+            return self
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(self._iter, self._tok or
+                            DefaultTokenizerFactory(), **self._kw)
+
+    def __init__(self, sentence_iterator, tokenizer_factory, **kw):
+        self.sentences = sentence_iterator
+        self.tokenizer = tokenizer_factory
+        self.cfg = kw
+        self.vocab = VocabCache()
+        self.syn0 = None     # input vectors [V,D]
+        self.syn1 = None     # output vectors [V,D]
+        self._neg_table = None
+        self._step_fn = None
+
+    # -- vocab ---------------------------------------------------------------
+    def buildVocab(self):
+        counts: dict[str, int] = {}
+        for sent in self.sentences:
+            for t in self.tokenizer.create(sent).getTokens():
+                counts[t] = counts.get(t, 0) + 1
+        min_f = self.cfg["minWordFrequency"]
+        for w, c in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+            if c >= min_f:
+                self.vocab.add(w, c)
+        if self.vocab.numWords() == 0:
+            raise ValueError(
+                f"empty vocab: no word reaches minWordFrequency={min_f}")
+        freqs = np.array([w.count for w in self.vocab.words], np.float64)
+        probs = freqs ** 0.75
+        self._neg_table = (probs / probs.sum()).astype(np.float64)
+        return self
+
+    # -- pair generation (host ETL) -----------------------------------------
+    def _encode_corpus(self, rng):
+        total = self.vocab.totalWordOccurrences()
+        t = self.cfg["sampling"]
+        encoded = []
+        for sent in self.sentences:
+            idxs = []
+            for tok in self.tokenizer.create(sent).getTokens():
+                i = self.vocab.indexOf(tok)
+                if i < 0:
+                    continue
+                if t > 0:
+                    f = self.vocab.words[i].count / total
+                    keep = (math.sqrt(f / t) + 1) * (t / f) if f > t else 1.0
+                    if rng.random() > keep:
+                        continue
+                idxs.append(i)
+            if len(idxs) > 1:
+                encoded.append(np.asarray(idxs, np.int32))
+        return encoded
+
+    def _make_pairs(self, encoded, rng):
+        win = self.cfg["windowSize"]
+        centers, contexts = [], []
+        for idxs in encoded:
+            n = len(idxs)
+            # reference-style reduced window: b ~ U[1, win] per center
+            bs = rng.integers(1, win + 1, n)
+            for pos in range(n):
+                b = bs[pos]
+                lo, hi = max(0, pos - b), min(n, pos + b + 1)
+                for j in range(lo, hi):
+                    if j != pos:
+                        centers.append(idxs[pos])
+                        contexts.append(idxs[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    # -- training ------------------------------------------------------------
+    def _build_step(self, cbow):
+        lr = self.cfg["learningRate"]
+        loss_fn = _cbow_loss if cbow else _sgns_loss
+
+        def step(syn0, syn1, *batch):
+            loss, (g0, g1) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(syn0, syn1, *batch)
+            return loss, syn0 - lr * g0, syn1 - lr * g1
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit(self):
+        if self.vocab.numWords() == 0:
+            self.buildVocab()
+        cfg = self.cfg
+        v, d = self.vocab.numWords(), cfg["layerSize"]
+        rng = np.random.default_rng(cfg["seed"])
+        key = jax.random.key(cfg["seed"])
+        if self.syn0 is None:
+            self.syn0 = (jax.random.uniform(key, (v, d), jnp.float32)
+                         - 0.5) / d
+            self.syn1 = jnp.zeros((v, d), jnp.float32)
+        cbow = cfg["algorithm"] == "cbow"
+        if self._step_fn is None:
+            self._step_fn = self._build_step(cbow)
+        k_neg = cfg["negative"]
+        bsz = cfg["batchSize"]
+        syn0, syn1 = self.syn0, self.syn1
+        for _epoch in range(cfg["epochs"]):
+            encoded = self._encode_corpus(rng)
+            if cbow:
+                batches = self._cbow_batches(encoded, rng, bsz)
+            else:
+                centers, contexts = self._make_pairs(encoded, rng)
+                order = rng.permutation(len(centers))
+                centers, contexts = centers[order], contexts[order]
+                batches = [
+                    (centers[i:i + bsz], contexts[i:i + bsz])
+                    for i in range(0, len(centers) - bsz + 1, bsz)
+                ] or [(centers, contexts)]
+            for _ in range(cfg["iterations"]):
+                for batch in batches:
+                    b = len(batch[0])
+                    negs = rng.choice(v, size=(b, k_neg),
+                                      p=self._neg_table).astype(np.int32)
+                    if cbow:
+                        ctx_mat, mask, cent = batch
+                        loss, syn0, syn1 = self._step_fn(
+                            syn0, syn1, ctx_mat, mask, cent, negs)
+                    else:
+                        cent, ctx = batch
+                        loss, syn0, syn1 = self._step_fn(
+                            syn0, syn1, cent, ctx, negs)
+        self.syn0, self.syn1 = syn0, syn1
+        return self
+
+    def _cbow_batches(self, encoded, rng, bsz):
+        win = self.cfg["windowSize"]
+        rows_ctx, rows_mask, rows_center = [], [], []
+        width = 2 * win
+        for idxs in encoded:
+            n = len(idxs)
+            bs = rng.integers(1, win + 1, n)
+            for pos in range(n):
+                b = bs[pos]
+                lo, hi = max(0, pos - b), min(n, pos + b + 1)
+                ctx = [idxs[j] for j in range(lo, hi) if j != pos]
+                if not ctx:
+                    continue
+                row = np.zeros(width, np.int32)
+                msk = np.zeros(width, np.float32)
+                row[:len(ctx)] = ctx
+                msk[:len(ctx)] = 1.0
+                rows_ctx.append(row)
+                rows_mask.append(msk)
+                rows_center.append(idxs[pos])
+        ctx_m = np.stack(rows_ctx)
+        mask = np.stack(rows_mask)
+        cent = np.asarray(rows_center, np.int32)
+        order = np.random.default_rng(0).permutation(len(cent))
+        ctx_m, mask, cent = ctx_m[order], mask[order], cent[order]
+        out = [(ctx_m[i:i + bsz], mask[i:i + bsz], cent[i:i + bsz])
+               for i in range(0, len(cent) - bsz + 1, bsz)]
+        return out or [(ctx_m, mask, cent)]
+
+    # -- lookups -------------------------------------------------------------
+    def getWordVector(self, word) -> np.ndarray:
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(word)
+        return np.asarray(self.syn0[i])
+
+    def getWordVectorMatrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def hasWord(self, w):
+        return self.vocab.containsWord(w)
+
+    def similarity(self, a, b) -> float:
+        va, vb = self.getWordVector(a), self.getWordVector(b)
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)
+                                + 1e-12))
+
+    def wordsNearest(self, word_or_vec, n=10) -> list:
+        if isinstance(word_or_vec, str):
+            vec = self.getWordVector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            vec = np.asarray(word_or_vec)
+            exclude = set()
+        m = self.getWordVectorMatrix()
+        norms = np.linalg.norm(m, axis=1) * (np.linalg.norm(vec) + 1e-12)
+        sims = m @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.wordAtIndex(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out

@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.rl.mdp import MDP, SimpleGridWorld  # noqa: F401
+from deeplearning4j_tpu.rl.dqn import (  # noqa: F401
+    DQNPolicy, QLearningConfiguration, QLearningDiscreteDense)
+from deeplearning4j_tpu.rl.a2c import (  # noqa: F401
+    A2CConfiguration, A2CDiscreteDense)

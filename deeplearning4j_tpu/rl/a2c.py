@@ -1,0 +1,158 @@
+"""Advantage actor-critic (synchronous A2C).
+
+Reference capability: rl4j's A3C (org.deeplearning4j.rl4j.learning.async
+.a3c.A3CDiscreteDense, SURVEY.md §2.7). The reference runs asynchronous
+actor threads against a shared DL4J net; on TPU the idiomatic equivalent
+is SYNCHRONOUS batched advantage actor-critic: N environment copies
+stepped on host, one jitted update over the joint rollout (the async
+hogwild scheme exists only to keep GPUs busy from the JVM — a compiled
+batched step makes it unnecessary)."""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.rl.dqn import _init_mlp, _mlp
+
+
+@dataclass
+class A2CConfiguration:
+    seed: int = 0
+    nThreads: int = 8            # parity name: number of parallel envs
+    nSteps: int = 5              # rollout length
+    maxStep: int = 20000
+    gamma: float = 0.95
+    learningRate: float = 7e-4
+    entropyCoef: float = 0.01
+    valueCoef: float = 0.5
+    hidden: tuple = (64,)
+
+
+class A2CDiscreteDense:
+    def __init__(self, mdp_factory, conf: A2CConfiguration):
+        """mdp_factory: zero-arg callable producing fresh MDP instances."""
+        self.conf = conf
+        self.envs = [mdp_factory() for _ in range(conf.nThreads)]
+        probe = self.envs[0]
+        obs_dim = int(np.prod(probe.observationShape()))
+        self.n_act = probe.actionSpaceSize()
+        key = jax.random.key(conf.seed)
+        trunk_sizes = (obs_dim,) + tuple(conf.hidden)
+        self.params = {
+            "trunk": _init_mlp(key, trunk_sizes + (conf.hidden[-1],)),
+            "pi": _init_mlp(jax.random.fold_in(key, 1),
+                            (conf.hidden[-1], self.n_act)),
+            "v": _init_mlp(jax.random.fold_in(key, 2),
+                           (conf.hidden[-1], 1)),
+        }
+        self.opt = {
+            "m": jax.tree_util.tree_map(jnp.zeros_like, self.params),
+            "v": jax.tree_util.tree_map(jnp.zeros_like, self.params),
+        }
+        self._t = 0
+        self._rng = np.random.default_rng(conf.seed)
+        self._step_fn = self._build()
+        self._logits_fn = jax.jit(self._net)
+
+    def _net(self, params, x):
+        h = jax.nn.relu(_mlp(params["trunk"], x))
+        return _mlp(params["pi"], h), _mlp(params["v"], h)[..., 0]
+
+    def _build(self):
+        conf = self.conf
+
+        def step(params, opt, obs, act, ret, t):
+            def loss_fn(p):
+                logits, value = self._net(p, obs)
+                logp = jax.nn.log_softmax(logits)
+                probs = jnp.exp(logp)
+                adv = ret - value
+                pg = -jnp.mean(
+                    jnp.take_along_axis(logp, act[:, None], 1)[:, 0]
+                    * jax.lax.stop_gradient(adv))
+                v_loss = jnp.mean(adv ** 2)
+                entropy = -jnp.mean(jnp.sum(probs * logp, axis=1))
+                return (pg + conf.valueCoef * v_loss
+                        - conf.entropyCoef * entropy)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree_util.tree_map(
+                lambda m_, g_: b1 * m_ + (1 - b1) * g_, opt["m"], g)
+            v = jax.tree_util.tree_map(
+                lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, opt["v"], g)
+            tt = t + 1
+            params = jax.tree_util.tree_map(
+                lambda p_, m_, v_: p_ - conf.learningRate
+                * (m_ / (1 - b1 ** tt))
+                / (jnp.sqrt(v_ / (1 - b2 ** tt)) + eps),
+                params, m, v)
+            return loss, params, {"m": m, "v": v}
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train(self):
+        conf = self.conf
+        obs = np.stack([env.reset() for env in self.envs])
+        steps = 0
+        ep_rewards = [0.0] * len(self.envs)
+        finished: list[float] = []
+        while steps < conf.maxStep:
+            traj_obs, traj_act, traj_rew, traj_done = [], [], [], []
+            for _ in range(conf.nSteps):
+                logits, _ = self._logits_fn(self.params,
+                                            jnp.asarray(obs, jnp.float32))
+                p = np.asarray(jax.nn.softmax(logits))
+                acts = np.array([self._rng.choice(self.n_act, p=pi)
+                                 for pi in p])
+                nxt, rews, dones = [], [], []
+                for i, env in enumerate(self.envs):
+                    o, r, d, _ = env.step(int(acts[i]))
+                    ep_rewards[i] += r
+                    if d:
+                        finished.append(ep_rewards[i])
+                        ep_rewards[i] = 0.0
+                        o = env.reset()
+                    nxt.append(o)
+                    rews.append(r)
+                    dones.append(float(d))
+                traj_obs.append(obs)
+                traj_act.append(acts)
+                traj_rew.append(np.asarray(rews, np.float32))
+                traj_done.append(np.asarray(dones, np.float32))
+                obs = np.stack(nxt)
+                steps += len(self.envs)
+            # bootstrap returns
+            _, v_last = self._logits_fn(self.params,
+                                        jnp.asarray(obs, jnp.float32))
+            ret = np.asarray(v_last)
+            returns = []
+            for r, d in zip(reversed(traj_rew), reversed(traj_done)):
+                ret = r + conf.gamma * ret * (1.0 - d)
+                returns.append(ret)
+            returns.reverse()
+            flat_obs = np.concatenate(traj_obs).astype(np.float32)
+            flat_act = np.concatenate(traj_act).astype(np.int32)
+            flat_ret = np.concatenate(returns).astype(np.float32)
+            loss, self.params, self.opt = self._step_fn(
+                self.params, self.opt, flat_obs, flat_act, flat_ret,
+                self._t)
+            self._t += 1
+        return finished
+
+    def play(self, mdp, max_steps=200) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            logits, _ = self._logits_fn(
+                self.params, jnp.asarray(obs, jnp.float32)[None])
+            obs, r, done, _ = mdp.step(int(jnp.argmax(logits[0])))
+            total += r
+            if done:
+                break
+        return total

@@ -1,0 +1,209 @@
+"""DQN (Q-learning with replay + target network).
+
+Reference capability: rl4j org.deeplearning4j.rl4j.learning.sync.qlearning
+.discrete.QLearningDiscreteDense (SURVEY.md §2.7): epsilon-greedy
+environment interaction (host), experience replay, and a double-buffered
+target network. The learning update is ONE jitted step over a sampled
+batch (gather-max target + Huber loss + Adam), params donated — the
+reference instead fits its DL4J net per batch through the per-op path."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class QLearningConfiguration:
+    seed: int = 0
+    maxEpochStep: int = 200
+    maxStep: int = 15000
+    expRepMaxSize: int = 10000
+    batchSize: int = 64
+    targetDqnUpdateFreq: int = 100
+    updateStart: int = 100
+    rewardFactor: float = 1.0
+    gamma: float = 0.95
+    errorClamp: float = 1.0
+    minEpsilon: float = 0.05
+    epsilonDecay: float = 0.995
+    learningRate: float = 1e-3
+    hidden: tuple = (64, 64)
+
+    @staticmethod
+    def builder():
+        return _QConfBuilder()
+
+
+class _QConfBuilder:
+    def __init__(self):
+        self._kw = {}
+
+    def __getattr__(self, item):
+        def setter(v):
+            self._kw[item] = v
+            return self
+
+        return setter
+
+    def build(self):
+        return QLearningConfiguration(**self._kw)
+
+
+def _init_mlp(key, sizes):
+    params = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k = jax.random.fold_in(key, i)
+        params.append({
+            "W": jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a),
+            "b": jnp.zeros((b,)),
+        })
+    return params
+
+
+def _mlp(params, x):
+    for i, p in enumerate(params):
+        x = x @ p["W"] + p["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+class DQNPolicy:
+    """Greedy policy over a trained Q-network (reference: DQNPolicy)."""
+
+    def __init__(self, params, n_actions):
+        self.params = params
+        self.n_actions = n_actions
+        self._fn = jax.jit(_mlp)
+
+    def nextAction(self, obs) -> int:
+        q = self._fn(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q[0]))
+
+    def play(self, mdp, max_steps=1000) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = mdp.step(self.nextAction(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class QLearningDiscreteDense:
+    def __init__(self, mdp, conf: QLearningConfiguration):
+        self.mdp = mdp
+        self.conf = conf
+        obs_dim = int(np.prod(mdp.observationShape()))
+        n_act = mdp.actionSpaceSize()
+        sizes = (obs_dim,) + tuple(conf.hidden) + (n_act,)
+        key = jax.random.key(conf.seed)
+        self.params = _init_mlp(key, sizes)
+        # real copy: params is donated each step, so the target must not
+        # alias its buffers (f(donate(a), a) is invalid)
+        self.target = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), self.params)
+        self.opt = {
+            "m": jax.tree_util.tree_map(jnp.zeros_like, self.params),
+            "v": jax.tree_util.tree_map(jnp.zeros_like, self.params),
+        }
+        self.n_act = n_act
+        self._train_step = self._build()
+        self._rng = np.random.default_rng(conf.seed)
+        self.epsilon = 1.0
+        self._t = 0
+
+    def _build(self):
+        gamma = self.conf.gamma
+        lr = self.conf.learningRate
+        clamp = self.conf.errorClamp
+
+        def step(params, target, opt, obs, act, rew, nxt, done, t):
+            def loss_fn(p):
+                q = _mlp(p, obs)
+                q_sa = jnp.take_along_axis(q, act[:, None], axis=1)[:, 0]
+                q_next = jnp.max(_mlp(target, nxt), axis=1)
+                y = rew + gamma * q_next * (1.0 - done)
+                err = q_sa - jax.lax.stop_gradient(y)
+                # Huber with errorClamp delta
+                abs_e = jnp.abs(err)
+                return jnp.mean(jnp.where(
+                    abs_e <= clamp, 0.5 * err * err,
+                    clamp * (abs_e - 0.5 * clamp)))
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree_util.tree_map(
+                lambda m_, g_: b1 * m_ + (1 - b1) * g_, opt["m"], g)
+            v = jax.tree_util.tree_map(
+                lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_, opt["v"], g)
+            tt = t + 1
+            params = jax.tree_util.tree_map(
+                lambda p, m_, v_: p - lr * (m_ / (1 - b1 ** tt))
+                / (jnp.sqrt(v_ / (1 - b2 ** tt)) + eps),
+                params, m, v)
+            return loss, params, {"m": m, "v": v}
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def train(self):
+        conf = self.conf
+        obs_dim = int(np.prod(self.mdp.observationShape()))
+        cap = conf.expRepMaxSize
+        buf = {
+            "obs": np.zeros((cap, obs_dim), np.float32),
+            "act": np.zeros(cap, np.int32),
+            "rew": np.zeros(cap, np.float32),
+            "nxt": np.zeros((cap, obs_dim), np.float32),
+            "done": np.zeros(cap, np.float32),
+        }
+        size = pos = 0
+        steps = 0
+        rewards = []
+        q_fn = jax.jit(_mlp)
+        while steps < conf.maxStep:
+            obs = self.mdp.reset()
+            ep_rew = 0.0
+            for _ in range(conf.maxEpochStep):
+                if self._rng.random() < self.epsilon:
+                    a = int(self._rng.integers(self.n_act))
+                else:
+                    q = q_fn(self.params,
+                             jnp.asarray(obs, jnp.float32)[None])
+                    a = int(jnp.argmax(q[0]))
+                nxt, r, done, _ = self.mdp.step(a)
+                r *= conf.rewardFactor
+                buf["obs"][pos] = obs
+                buf["act"][pos] = a
+                buf["rew"][pos] = r
+                buf["nxt"][pos] = nxt
+                buf["done"][pos] = float(done)
+                pos = (pos + 1) % cap
+                size = min(size + 1, cap)
+                obs = nxt
+                ep_rew += r
+                steps += 1
+                if size >= conf.updateStart:
+                    idx = self._rng.integers(0, size, conf.batchSize)
+                    loss, self.params, self.opt = self._train_step(
+                        self.params, self.target, self.opt,
+                        buf["obs"][idx], buf["act"][idx], buf["rew"][idx],
+                        buf["nxt"][idx], buf["done"][idx], self._t)
+                    self._t += 1
+                    if self._t % conf.targetDqnUpdateFreq == 0:
+                        self.target = jax.tree_util.tree_map(
+                            lambda x: jnp.array(x, copy=True), self.params)
+                if done or steps >= conf.maxStep:
+                    break
+            self.epsilon = max(conf.minEpsilon,
+                               self.epsilon * conf.epsilonDecay)
+            rewards.append(ep_rew)
+        return rewards
+
+    def getPolicy(self) -> DQNPolicy:
+        return DQNPolicy(self.params, self.n_act)

@@ -1,0 +1,69 @@
+"""MDP interface + a built-in test environment.
+
+Reference capability: rl4j's MDP abstraction (org.deeplearning4j.rl4j.mdp
+.MDP wrapping gym envs, SURVEY.md §2.7). The gym dependency is replaced by
+a plain protocol: reset() -> obs, step(a) -> (obs, reward, done, info)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MDP:
+    def reset(self):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+    def actionSpaceSize(self) -> int:
+        raise NotImplementedError
+
+    def observationShape(self) -> tuple:
+        raise NotImplementedError
+
+    def isDone(self) -> bool:
+        raise NotImplementedError
+
+
+class SimpleGridWorld(MDP):
+    """n x n grid, start top-left, goal bottom-right; actions URDL; -0.01
+    per step, +1 at goal; episode cap 4*n steps. Solvable by short-horizon
+    Q-learning — the in-repo equivalent of rl4j's toy MDPs."""
+
+    ACTIONS = [(-1, 0), (0, 1), (1, 0), (0, -1)]
+
+    def __init__(self, n=4, seed=0):
+        self.n = n
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = False
+
+    def observationShape(self):
+        return (2,)
+
+    def actionSpaceSize(self):
+        return 4
+
+    def _obs(self):
+        return np.asarray(self._pos, np.float32) / (self.n - 1)
+
+    def reset(self):
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = False
+        return self._obs()
+
+    def isDone(self):
+        return self._done
+
+    def step(self, action):
+        dr, dc = self.ACTIONS[int(action)]
+        r = min(max(self._pos[0] + dr, 0), self.n - 1)
+        c = min(max(self._pos[1] + dc, 0), self.n - 1)
+        self._pos = (r, c)
+        self._steps += 1
+        at_goal = self._pos == (self.n - 1, self.n - 1)
+        self._done = at_goal or self._steps >= 4 * self.n
+        reward = 1.0 if at_goal else -0.01
+        return self._obs(), reward, self._done, {}

@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.ui.stats import (  # noqa: F401
+    FileStatsStorage, InMemoryStatsStorage, StatsListener)

@@ -1,0 +1,100 @@
+"""Training statistics collection.
+
+Reference capability: deeplearning4j-ui's StatsListener + StatsStorage
+(SURVEY.md §2.7/§5 observability): per-iteration score, parameter/update
+histograms and ratios, persisted to a storage backend. The vertx browser
+dashboard is replaced by JSON-lines storage that any plotting tool reads
+(per SURVEY.md §5: 'emit scalars to TensorBoard event files instead of
+mapdb/vertx UI first' — JSONL is the dependency-free equivalent)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.listeners import TrainingListener
+
+
+class InMemoryStatsStorage:
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def put(self, record: dict):
+        self.records.append(record)
+
+    def listSessionIDs(self):
+        return sorted({r["session"] for r in self.records})
+
+    def getRecords(self, session=None):
+        if session is None:
+            return list(self.records)
+        return [r for r in self.records if r["session"] == session]
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """JSON-lines file storage (one record per iteration)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = path
+
+    def put(self, record: dict):
+        super().put(record)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    @staticmethod
+    def load(path):
+        s = FileStatsStorage.__new__(FileStatsStorage)
+        s.path = path
+        s.records = []
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    s.records.append(json.loads(line))
+        return s
+
+
+class StatsListener(TrainingListener):
+    """Collects score + per-layer param/update statistics every N
+    iterations (reference: StatsListener(statsStorage, frequency))."""
+
+    def __init__(self, storage, frequency=1, sessionId=None,
+                 collectHistograms=False):
+        self.storage = storage
+        self.frequency = frequency
+        self.session = sessionId or f"session_{int(time.time())}"
+        self.collectHistograms = collectHistograms
+        self._prev_params = None
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency != 0:
+            return
+        record = {
+            "session": self.session,
+            "iteration": iteration,
+            "epoch": epoch,
+            "timestamp": time.time(),
+            "score": model.score(),
+            "layers": {},
+        }
+        params = getattr(model, "_params", None)
+        if params is not None:
+            items = (params.items() if isinstance(params, dict)
+                     else enumerate(params))
+            for li, p in items:
+                for k, v in p.items():
+                    arr = np.asarray(v)
+                    st = {
+                        "mean": float(arr.mean()),
+                        "std": float(arr.std()),
+                        "meanAbs": float(np.abs(arr).mean()),
+                    }
+                    if self.collectHistograms:
+                        hist, edges = np.histogram(arr, bins=20)
+                        st["histogram"] = hist.tolist()
+                        st["edges"] = edges.tolist()
+                    record["layers"][f"{li}_{k}"] = st
+        self.storage.put(record)

@@ -1,0 +1,171 @@
+"""Domain library tests: DeepWalk, VPTree, KMeans, RL (DQN/A2C), Arbiter,
+stats storage (reference test style per module, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph
+from deeplearning4j_tpu.clustering import KMeansClustering, VPTree
+from deeplearning4j_tpu.rl import (
+    A2CConfiguration, A2CDiscreteDense, QLearningConfiguration,
+    QLearningDiscreteDense, SimpleGridWorld)
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace, DiscreteParameterSpace,
+    GridSearchCandidateGenerator, IntegerParameterSpace,
+    LocalOptimizationRunner, OptimizationConfiguration,
+    RandomSearchGenerator)
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener)
+
+
+class TestDeepWalk:
+    def test_two_cliques_embed_apart(self):
+        # two 6-cliques joined by one edge
+        g = Graph(12)
+        for base in (0, 6):
+            for i in range(6):
+                for j in range(i + 1, 6):
+                    g.addEdge(base + i, base + j)
+        g.addEdge(0, 6)
+        dw = (DeepWalk.Builder().vectorSize(16).windowSize(3)
+              .learningRate(0.02).epochs(5).walkLength(10)
+              .walksPerVertex(8).seed(1).build())
+        dw.fit(g)
+        within = dw.similarity(1, 2)
+        across = dw.similarity(1, 8)
+        assert within > across, (within, across)
+        near = dw.verticesNearest(1, 4)
+        assert sum(1 for v in near if v < 6) >= 3, near
+
+
+class TestVPTree:
+    def test_exact_vs_bruteforce(self):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(200, 8))
+        tree = VPTree(pts)
+        q = rng.normal(size=8)
+        idxs, dists = tree.search(q, 5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert set(idxs) == set(brute.tolist())
+        assert dists == sorted(dists)
+
+    def test_cosine_distance(self):
+        pts = np.array([[1, 0], [0, 1], [1, 0.1], [-1, 0]], np.float64)
+        tree = VPTree(pts, distance="cosine")
+        idxs, _ = tree.search(np.array([1.0, 0.0]), 2)
+        assert set(idxs) == {0, 2}
+
+    def test_single_point(self):
+        tree = VPTree(np.zeros((1, 3)))
+        idxs, dists = tree.search(np.ones(3), 1)
+        assert idxs == [0]
+
+
+class TestKMeans:
+    def test_separated_clusters(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 0.3, (50, 2))
+        b = rng.normal(5, 0.3, (50, 2))
+        assign = KMeansClustering.setup(2, seed=3).applyTo(
+            np.concatenate([a, b]))
+        assert len(set(assign[:50].tolist())) == 1
+        assert len(set(assign[50:].tolist())) == 1
+        assert assign[0] != assign[50]
+
+
+class TestRL:
+    def test_dqn_solves_gridworld(self):
+        conf = QLearningConfiguration(
+            seed=1, maxStep=6000, batchSize=64, gamma=0.9,
+            targetDqnUpdateFreq=50, updateStart=200, epsilonDecay=0.98,
+            hidden=(32, 32))
+        dqn = QLearningDiscreteDense(SimpleGridWorld(4), conf)
+        dqn.train()
+        policy = dqn.getPolicy()
+        reward = policy.play(SimpleGridWorld(4))
+        # optimal: 6 steps * -0.01 + 1 = 0.95; random walk often times out
+        assert reward > 0.5, reward
+
+    def test_a2c_improves(self):
+        conf = A2CConfiguration(seed=2, maxStep=12000, nThreads=8, nSteps=8,
+                                gamma=0.9, learningRate=3e-3, hidden=(32,))
+        a2c = A2CDiscreteDense(lambda: SimpleGridWorld(3), conf)
+        episodes = a2c.train()
+        assert len(episodes) > 10
+        early = np.mean(episodes[:10])
+        late = np.mean(episodes[-10:])
+        assert late > early, (early, late)
+
+    def test_qconf_builder(self):
+        conf = (QLearningConfiguration.builder()
+                .maxStep(123).gamma(0.5).build())
+        assert conf.maxStep == 123 and conf.gamma == 0.5
+
+
+class TestArbiter:
+    def test_random_search_finds_minimum(self):
+        space = {
+            "x": ContinuousParameterSpace(-5.0, 5.0),
+            "k": IntegerParameterSpace(1, 3),
+            "mode": DiscreteParameterSpace("a", "b"),
+        }
+        cfg = (OptimizationConfiguration.Builder()
+               .candidateGenerator(RandomSearchGenerator(space, seed=0))
+               .modelBuilder(lambda c: c)
+               .scoreFunction(lambda c: (c["x"] - 1.0) ** 2 + c["k"])
+               .terminationConditions(maxCandidates=200)
+               .build())
+        best = LocalOptimizationRunner(cfg).execute()
+        assert abs(best.candidate["x"] - 1.0) < 0.5
+        assert best.candidate["k"] == 1
+
+    def test_grid_search_enumerates(self):
+        space = {"x": ContinuousParameterSpace(0.0, 1.0),
+                 "mode": DiscreteParameterSpace("a", "b")}
+        gen = GridSearchCandidateGenerator(space, discretizationCount=3)
+        cands = list(gen.candidates(100))
+        assert len(cands) == 6
+
+    def test_log_scale_space(self):
+        s = ContinuousParameterSpace(1e-5, 1e-1, log=True)
+        vals = [s.sample(np.random.default_rng(i)) for i in range(50)]
+        assert min(vals) >= 1e-5 and max(vals) <= 1e-1
+        assert sum(1 for v in vals if v < 1e-3) > 10  # log-uniform spread
+
+
+class TestStats:
+    def _train_with(self, storage):
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, MultiLayerNetwork, NeuralNetConfiguration,
+            OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer.Builder().nIn(4).nOut(8)
+                       .activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.setListeners(StatsListener(storage, frequency=1,
+                                       sessionId="s1"))
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit([(X, y)], 5)
+
+    def test_in_memory_storage(self):
+        storage = InMemoryStatsStorage()
+        self._train_with(storage)
+        assert len(storage.records) == 5
+        rec = storage.records[0]
+        assert "score" in rec and "0_W" in rec["layers"]
+        assert storage.listSessionIDs() == ["s1"]
+
+    def test_file_storage_roundtrip(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        self._train_with(FileStatsStorage(p))
+        loaded = FileStatsStorage.load(p)
+        assert len(loaded.records) == 5
+        assert loaded.records[-1]["iteration"] == 5

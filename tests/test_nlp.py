@@ -1,0 +1,124 @@
+"""NLP tests: vocab building, skip-gram learning on a structured synthetic
+corpus (words that co-occur must end up similar), CBOW, doc vectors, serde
+(reference test style for deeplearning4j-nlp, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, LabelledDocument, ParagraphVectors, Word2Vec,
+    WordVectorSerializer)
+
+
+def _synthetic_corpus(n=400, seed=0):
+    """Two topic clusters; words within a topic co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep"]
+    tech = ["cpu", "gpu", "ram", "disk", "net"]
+    sents = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        sents.append(" ".join(rng.choice(topic, 6)))
+    return sents
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tok = DefaultTokenizerFactory().create("Hello  world foo")
+        assert tok.getTokens() == ["Hello", "world", "foo"]
+        assert tok.countTokens() == 3
+
+    def test_common_preprocessor(self):
+        f = DefaultTokenizerFactory()
+        f.setTokenPreProcessor(CommonPreprocessor())
+        assert f.create("Hello, World! 123").getTokens() == ["hello",
+                                                            "world"]
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "c.txt"
+        p.write_text("one two\nthree four\n\n")
+        it = BasicLineIterator(str(p))
+        assert list(it) == ["one two", "three four"]
+        assert list(it) == ["one two", "three four"]  # reset works
+
+
+class TestWord2Vec:
+    def _fit(self, algorithm="skipgram", epochs=3):
+        # sampling(0): the default frequent-word subsampling assumes a
+        # natural corpus; with a 10-word vocab every word is "frequent"
+        # and ~90% of tokens would be dropped. batchSize small vs vocab:
+        # summed-batch SGD steps accumulate per repeated word.
+        return (Word2Vec.Builder()
+                .minWordFrequency(2).layerSize(24).windowSize(3)
+                .negativeSampling(5).learningRate(0.025).epochs(epochs)
+                .seed(1).batchSize(128).sampling(0)
+                .elementsLearningAlgorithm(algorithm)
+                .iterate(CollectionSentenceIterator(_synthetic_corpus()))
+                .tokenizerFactory(DefaultTokenizerFactory())
+                .build().fit())
+
+    def test_vocab_built(self):
+        vec = self._fit(epochs=1)
+        assert vec.vocab.numWords() == 10
+        assert vec.hasWord("cat") and vec.hasWord("cpu")
+
+    def test_topic_structure_learned(self):
+        vec = self._fit()
+        within = vec.similarity("cat", "dog")
+        across = vec.similarity("cat", "cpu")
+        assert within > across + 0.2, (within, across)
+
+    def test_words_nearest(self):
+        vec = self._fit()
+        nearest = vec.wordsNearest("cat", 4)
+        animals = {"dog", "horse", "cow", "sheep"}
+        assert len(set(nearest) & animals) >= 3, nearest
+
+    def test_cbow_learns_too(self):
+        vec = self._fit(algorithm="cbow")
+        assert vec.similarity("cat", "dog") > vec.similarity("cat", "cpu")
+
+    def test_word_vector_shape(self):
+        vec = self._fit(epochs=1)
+        assert vec.getWordVector("cat").shape == (24,)
+        with pytest.raises(KeyError):
+            vec.getWordVector("zebra")
+
+    def test_serialization_roundtrip(self, tmp_path):
+        vec = self._fit(epochs=1)
+        p = str(tmp_path / "w2v.txt")
+        WordVectorSerializer.writeWord2VecModel(vec, p)
+        loaded = WordVectorSerializer.readWord2VecModel(p)
+        np.testing.assert_allclose(loaded.getWordVector("cat"),
+                                   vec.getWordVector("cat"), atol=1e-5)
+        assert loaded.vocab.numWords() == vec.vocab.numWords()
+
+    def test_empty_vocab_raises(self):
+        with pytest.raises(ValueError):
+            (Word2Vec.Builder().minWordFrequency(100)
+             .iterate(CollectionSentenceIterator(["a b c"]))
+             .build().buildVocab())
+
+
+class TestParagraphVectors:
+    def test_doc_clusters(self):
+        rng = np.random.default_rng(3)
+        animals = ["cat", "dog", "horse", "cow"]
+        tech = ["cpu", "gpu", "ram", "disk"]
+        docs = []
+        for i in range(20):
+            topic, name = ((animals, f"animal_{i}") if i % 2 == 0
+                           else (tech, f"tech_{i}"))
+            docs.append(LabelledDocument(
+                " ".join(rng.choice(topic, 12)), name))
+        pv = (ParagraphVectors.Builder()
+              .minWordFrequency(1).layerSize(16).epochs(30)
+              .learningRate(0.01).seed(2).batchSize(64).sampling(0)
+              .iterate(docs).build().fit())
+        a = pv.getVector("animal_0")
+        assert a.shape == (16,)
+        # inferred vector for an animal text lands nearer animal docs
+        labels = pv.nearestLabels("cat dog cow horse cat dog", 4)
+        n_animal = sum(1 for l in labels if l.startswith("animal"))
+        assert n_animal >= 3, labels
