@@ -945,6 +945,56 @@ def _json_attrs(attrs: dict) -> dict:
     return out
 
 
+def _host_array(x, dtype=None):
+    """Host numpy view WITHOUT bouncing through the device (np.asarray on a
+    jnp array is a D2H copy; on numpy it is free)."""
+    if hasattr(x, "toNumpy"):
+        x = x.toNumpy()
+    return np.asarray(x, dtype=dtype)
+
+
+def _prepare_batches(data, epoch_i, epochs):
+    """Batches for one epoch. Materializes a one-shot iterable (generator)
+    on the first epoch so later epochs see the data instead of silently
+    training on nothing. Returns (batches, data) — rebind data to the
+    second element."""
+    batches = _as_batches(data)
+    if (epoch_i == 0 and epochs > 1 and not hasattr(data, "reset")
+            and not isinstance(batches, (list, tuple))):
+        batches = list(batches)
+        data = batches
+    return batches, data
+
+
+def _ones_mask(labels):
+    """Example mask of ones matching the loss's per-example view: [N, T]
+    for NCW time-series labels, else [N]."""
+    if labels.ndim == 3:
+        return np.ones((labels.shape[0], labels.shape[2]), np.float32)
+    return np.ones((labels.shape[0],), np.float32)
+
+
+def _pad_to_bucket(arrs, mask, bucket):
+    """Pad batch axis of every array (and the mask) up to `bucket` rows by
+    repeating the last row; padding rows get mask 0 so they cannot bias the
+    loss. Keeps ONE compiled executable across a ragged final minibatch
+    (SURVEY.md §7 hard part 1: recompile storms; the reference never had
+    this problem because it never compiled)."""
+    n = arrs[0].shape[0]
+    if n == bucket:
+        return arrs, mask, n
+    pad = bucket - n
+    out = []
+    for a in arrs:
+        a = np.asarray(a)
+        out.append(np.concatenate([a, np.repeat(a[-1:], pad, axis=0)],
+                                  axis=0))
+    mask = np.concatenate(
+        [np.asarray(mask),
+         np.zeros((pad,) + np.asarray(mask).shape[1:], np.float32)], axis=0)
+    return out, mask, n
+
+
 def _as_batches(data):
     if data is None:
         raise ValueError("fit() requires data")
@@ -973,3 +1023,24 @@ def _split_dataset(ds):
     if not isinstance(l, (list, tuple)):
         l = [l]
     return f, l
+
+
+def _split_dataset_full(ds):
+    """Like _split_dataset but also returns (featuresMasks, labelsMasks)
+    lists (None entries when absent). Reference: DataSet.getFeaturesMaskArray
+    / getLabelsMaskArray — masks mark valid timesteps for variable-length
+    sequences and MUST reach the loss (SURVEY.md §2.5 masking row)."""
+    f, l = _split_dataset(ds)
+    fm = lm = None
+    if hasattr(ds, "getFeaturesMaskArray"):
+        fm = ds.getFeaturesMaskArray()
+        lm = ds.getLabelsMaskArray()
+    elif hasattr(ds, "featuresMasks"):
+        fm, lm = ds.featuresMasks, ds.labelsMasks
+    elif hasattr(ds, "featuresMask"):
+        fm, lm = ds.featuresMask, ds.labelsMask
+    if not isinstance(fm, (list, tuple)):
+        fm = [fm] * len(f) if fm is None else [fm]
+    if not isinstance(lm, (list, tuple)):
+        lm = [lm] * len(l) if lm is None else [lm]
+    return f, l, fm, lm

@@ -48,8 +48,10 @@ class Evaluation:
         if mask is not None:
             m = _to_np(mask).reshape(-1).astype(bool)
             t, p = t[m], p[m]
-        n = self.numClasses or int(max(t.max(initial=0),
-                                       p.max(initial=0))) + 1
+        # grow past a fixed numClasses too: an out-of-range class index
+        # must widen the matrix, not crash np.add.at with an IndexError
+        n = max(self.numClasses or 0,
+                int(max(t.max(initial=0), p.max(initial=0))) + 1)
         if self._conf is None or n > self._conf.shape[0]:
             conf = np.zeros((n, n), np.int64)
             if self._conf is not None:
@@ -109,7 +111,10 @@ class Evaluation:
     def stats(self) -> str:
         c = self._require()
         n = c.shape[0]
-        names = self.labelsList or [str(i) for i in range(n)]
+        names = list(self.labelsList or [])
+        # the matrix may have grown past the provided labels list (an
+        # out-of-range class index widens it); pad names to match
+        names += [str(i) for i in range(len(names), n)]
         lines = [
             "========================Evaluation Metrics========================",
             f" # of classes:    {n}",

@@ -74,10 +74,10 @@ class ParagraphVectors(Word2Vec):
         lr = cfg["learningRate"]
         k_neg = cfg["negative"]
 
-        def step(doc_vecs, syn1, doc_ids, words, negs):
+        def step(doc_vecs, syn1, doc_ids, words, negs, weights):
             loss, (gd, g1) = jax.value_and_grad(
                 _sgns_loss, argnums=(0, 1))(doc_vecs, syn1, doc_ids, words,
-                                            negs)
+                                            negs, weights)
             return loss, doc_vecs - lr * gd, syn1 - lr * g1
 
         step = jax.jit(step, donate_argnums=(0, 1))
@@ -98,9 +98,21 @@ class ParagraphVectors(Word2Vec):
             for i in range(0, len(doc_ids), bsz):
                 dids = doc_ids[i:i + bsz]
                 ws = words[i:i + bsz]
-                negs = rng.choice(v, size=(len(dids), k_neg),
+                b = len(dids)
+                if b == 0:
+                    continue
+                # zero-weight-pad the tail to one stable compiled shape
+                full = max(bsz, b)
+                pad = full - b
+                weights = np.concatenate(
+                    [np.ones(b, np.float32), np.zeros(pad, np.float32)])
+                if pad:
+                    dids = np.concatenate([dids, np.zeros(pad, np.int32)])
+                    ws = np.concatenate([ws, np.zeros(pad, np.int32)])
+                negs = rng.choice(v, size=(full, k_neg),
                                   p=self._neg_table).astype(np.int32)
-                loss, doc_vecs, syn1 = step(doc_vecs, syn1, dids, ws, negs)
+                loss, doc_vecs, syn1 = step(doc_vecs, syn1, dids, ws, negs,
+                                            weights)
         self.doc_vecs, self.syn1 = doc_vecs, syn1
         return self
 

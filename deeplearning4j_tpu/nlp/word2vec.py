@@ -61,9 +61,9 @@ class VocabCache:
         return sum(w.count for w in self.words)
 
 
-def _sgns_loss(syn0, syn1, centers, contexts, negatives):
+def _sgns_loss(syn0, syn1, centers, contexts, negatives, weights):
     """Skip-gram negative sampling loss for a batch.
-    centers [B], contexts [B], negatives [B,K]."""
+    centers [B], contexts [B], negatives [B,K], weights [B] (0 = padding)."""
     c = syn0[centers]                      # [B,D]
     pos = syn1[contexts]                   # [B,D]
     neg = syn1[negatives]                  # [B,K,D]
@@ -72,16 +72,18 @@ def _sgns_loss(syn0, syn1, centers, contexts, negatives):
     # -log sigma(pos) - sum log sigma(-neg), numerically stable.
     # SUM over the batch (not mean): each pair must contribute a full
     # per-pair SGD update like the reference's sequential loop — a mean
-    # would divide the learning rate by the batch size.
-    loss = jnp.sum(
-        jax.nn.softplus(-pos_score) + jnp.sum(jax.nn.softplus(neg_score),
-                                              axis=-1))
-    return loss
+    # would divide the learning rate by the batch size. Weights zero out
+    # tail-padding pairs exactly (sum, so no denominator to bias).
+    per_pair = (jax.nn.softplus(-pos_score)
+                + jnp.sum(jax.nn.softplus(neg_score), axis=-1))
+    return jnp.sum(per_pair * weights)
 
 
-def _cbow_loss(syn0, syn1, contexts_mat, context_mask, centers, negatives):
+def _cbow_loss(syn0, syn1, contexts_mat, context_mask, centers, negatives,
+               weights):
     """CBOW: mean of context word vectors predicts the center.
-    contexts_mat [B,W], context_mask [B,W], centers [B], negatives [B,K]."""
+    contexts_mat [B,W], context_mask [B,W], centers [B], negatives [B,K],
+    weights [B] (0 = padding)."""
     ctx = syn0[contexts_mat]               # [B,W,D]
     m = context_mask[..., None]
     mean = jnp.sum(ctx * m, axis=1) / jnp.maximum(
@@ -90,9 +92,9 @@ def _cbow_loss(syn0, syn1, contexts_mat, context_mask, centers, negatives):
     neg = syn1[negatives]
     pos_score = jnp.sum(mean * pos, axis=-1)
     neg_score = jnp.einsum("bd,bkd->bk", mean, neg)
-    return jnp.sum(
-        jax.nn.softplus(-pos_score) + jnp.sum(jax.nn.softplus(neg_score),
-                                              axis=-1))
+    per_pair = (jax.nn.softplus(-pos_score)
+                + jnp.sum(jax.nn.softplus(neg_score), axis=-1))
+    return jnp.sum(per_pair * weights)
 
 
 class Word2Vec:
@@ -270,21 +272,36 @@ class Word2Vec:
                 centers, contexts = centers[order], contexts[order]
                 batches = [
                     (centers[i:i + bsz], contexts[i:i + bsz])
-                    for i in range(0, len(centers) - bsz + 1, bsz)
+                    for i in range(0, len(centers), bsz)
                 ] or [(centers, contexts)]
             for _ in range(cfg["iterations"]):
                 for batch in batches:
                     b = len(batch[0])
-                    negs = rng.choice(v, size=(b, k_neg),
+                    if b == 0:
+                        continue
+                    # pad the tail batch to the full batch size with
+                    # zero-weighted pairs: ONE compiled shape regardless of
+                    # how the stochastic subsampling changes the pair count
+                    # across epochs (the loss is a weighted SUM, so the
+                    # padding contributes exactly zero loss and gradient)
+                    full = max(bsz, b)
+                    pad = full - b
+                    weights = np.concatenate(
+                        [np.ones(b, np.float32), np.zeros(pad, np.float32)])
+                    batch = tuple(
+                        np.concatenate(
+                            [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                        if pad else a for a in batch)
+                    negs = rng.choice(v, size=(full, k_neg),
                                       p=self._neg_table).astype(np.int32)
                     if cbow:
                         ctx_mat, mask, cent = batch
                         loss, syn0, syn1 = self._step_fn(
-                            syn0, syn1, ctx_mat, mask, cent, negs)
+                            syn0, syn1, ctx_mat, mask, cent, negs, weights)
                     else:
                         cent, ctx = batch
                         loss, syn0, syn1 = self._step_fn(
-                            syn0, syn1, cent, ctx, negs)
+                            syn0, syn1, cent, ctx, negs, weights)
         self.syn0, self.syn1 = syn0, syn1
         return self
 
@@ -314,7 +331,7 @@ class Word2Vec:
         order = np.random.default_rng(0).permutation(len(cent))
         ctx_m, mask, cent = ctx_m[order], mask[order], cent[order]
         out = [(ctx_m[i:i + bsz], mask[i:i + bsz], cent[i:i + bsz])
-               for i in range(0, len(cent) - bsz + 1, bsz)]
+               for i in range(0, len(cent), bsz)]
         return out or [(ctx_m, mask, cent)]
 
     # -- lookups -------------------------------------------------------------

@@ -855,15 +855,18 @@ class BaseOutputLayer(DenseLayer):
     def __init__(self, lossFunction="mcxent", **kw):
         super().__init__(**kw)
         self.lossFunction = lossFunction
+        # remember whether the user set the activation explicitly so a
+        # global .activation(...) default can propagate (DL4J semantics:
+        # softmax is the fallback only when NO global default exists)
+        self._explicit_activation = self.activation is not None
         if self.activation is None:
             self.activation = "softmax"
 
     def apply_defaults(self, defaults):
-        act = self.activation
+        if (not getattr(self, "_explicit_activation", True)
+                and defaults.get("activation") is not None):
+            self.activation = defaults["activation"]
         super().apply_defaults(defaults)
-        if act is None and defaults.get("activation") is not None:
-            # output layers keep softmax default unless set explicitly
-            self.activation = "softmax"
 
     def pre_output(self, params, x):
         return self._linear(params, x)

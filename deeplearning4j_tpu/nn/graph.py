@@ -12,7 +12,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.autodiff.samediff import _as_batches, _split_dataset
+from deeplearning4j_tpu.autodiff.samediff import (
+    _as_batches, _host_array, _ones_mask, _pad_to_bucket, _prepare_batches,
+    _split_dataset_full)
 from deeplearning4j_tpu.evaluation import Evaluation
 from deeplearning4j_tpu.ndarray import INDArray
 from deeplearning4j_tpu.nn.conf.graph_conf import (
@@ -35,6 +37,7 @@ class ComputationGraph:
         self._opt_states: dict = {}
         self._listeners: list = []
         self._train_step = None
+        self._bucket = None  # fit batch-size bucket (pad ragged tail)
         self._infer_fn_cache = {}
         self._iteration = 0
         self._epoch = 0
@@ -92,14 +95,16 @@ class ComputationGraph:
                 new_states[name] = st
         return env, new_states
 
-    def _loss_from(self, params, states, inputs, labels: dict, training, rng):
+    def _loss_from(self, params, states, inputs, labels: dict, training, rng,
+                   masks: dict | None = None):
         env, new_states = self._forward(params, states, inputs, training, rng,
                                         stop_before_output=True)
         loss = 0.0
         for out in self.conf.outputs:
             node, _ = self.conf.nodes[out]
+            mask = None if masks is None else masks.get(out)
             loss = loss + node.compute_loss(params[out], env[out],
-                                            labels[out])
+                                            labels[out], mask)
         # regularization
         for name, (node, _) in self.conf.nodes.items():
             p = params.get(name)
@@ -117,9 +122,10 @@ class ComputationGraph:
 
     # -- training ------------------------------------------------------------
     def _build_train_step(self):
-        def step(params, states, opt_states, inputs, labels, rng, it):
+        def step(params, states, opt_states, inputs, labels, masks, rng, it):
             def loss_fn(p):
-                return self._loss_from(p, states, inputs, labels, True, rng)
+                return self._loss_from(p, states, inputs, labels, True, rng,
+                                       masks)
 
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -143,11 +149,20 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _feeds(self, ds):
-        feats, labels = _split_dataset(ds)
-        inputs = {n: _unwrap(f) for n, f in zip(self.conf.inputs, feats)}
-        lab = {n: _unwrap(l) for n, l in zip(self.conf.outputs, labels)}
-        return inputs, lab
+    def _feeds(self, ds, with_ones_masks=False):
+        """Host-side feed dicts (numpy throughout: committed-vs-uncommitted
+        inputs key separate jit cache entries even at identical avals, and
+        a jnp bounce would cost a device round-trip per batch)."""
+        feats, labels, _, lmasks = _split_dataset_full(ds)
+        inputs = {n: _host_array(f) for n, f in zip(self.conf.inputs, feats)}
+        lab = {n: _host_array(l) for n, l in zip(self.conf.outputs, labels)}
+        masks = {}
+        for n, m in zip(self.conf.outputs, lmasks):
+            if m is not None:
+                masks[n] = _host_array(m, np.float32)
+            elif with_ones_masks:
+                masks[n] = _ones_mask(lab[n])
+        return inputs, lab, masks
 
     def fit(self, data, epochs: int = 1):
         self._check_init()
@@ -156,12 +171,26 @@ class ComputationGraph:
         params, states, opts = self._params, self._states, self._opt_states
         base_key = jax.random.key(self.conf.seed + 1)
         last = None
-        for _ in range(epochs):
-            for ds in _as_batches(data):
-                inputs, labels = self._feeds(ds)
+        for epoch_i in range(epochs):
+            batches, data = _prepare_batches(data, epoch_i, epochs)
+            for ds in batches:
+                # explicit ones masks keep the jit signature stable across
+                # masked/unmasked and padded batches (one executable)
+                inputs, labels, masks = self._feeds(ds, with_ones_masks=True)
+                n = next(iter(inputs.values())).shape[0]
+                if self._bucket is None or n > self._bucket:
+                    self._bucket = n
+                if n < self._bucket:
+                    for k in inputs:
+                        (inputs[k],), _, _ = _pad_to_bucket(
+                            [inputs[k]], np.ones((n,), np.float32),
+                            self._bucket)
+                    for k in labels:
+                        (labels[k],), masks[k], _ = _pad_to_bucket(
+                            [labels[k]], masks[k], self._bucket)
                 rng = jax.random.fold_in(base_key, self._iteration)
                 loss, params, states, opts = self._train_step(
-                    params, states, opts, inputs, labels, rng,
+                    params, states, opts, inputs, labels, masks, rng,
                     self._iteration)
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
@@ -202,18 +231,18 @@ class ComputationGraph:
             if self._score is None:
                 raise ValueError("no score yet")
             return self._score
-        inputs, labels = self._feeds(dataset)
+        inputs, labels, masks = self._feeds(dataset)
         loss, _ = self._loss_from(self._params, self._states, inputs, labels,
-                                  False, None)
+                                  False, None, masks)
         return float(loss)
 
     def evaluate(self, iterator, numClasses=None) -> Evaluation:
         self._check_init()
         ev = Evaluation(numClasses)
         for ds in _as_batches(iterator):
-            feats, labels = _split_dataset(ds)
+            feats, labels, _, lmasks = _split_dataset_full(ds)
             out = self.output(*feats)[0]
-            ev.eval(labels[0], out)
+            ev.eval(labels[0], out, mask=lmasks[0])
         return ev
 
     def numParams(self) -> int:
@@ -240,11 +269,11 @@ class ComputationGraph:
     def gradients(self, inputs_and_labels) -> dict:
         """Per-node analytic gradients for the gradient-check harness."""
         self._check_init()
-        inputs, labels = self._feeds(inputs_and_labels)
+        inputs, labels, masks = self._feeds(inputs_and_labels)
 
         def loss_fn(p):
             loss, _ = self._loss_from(p, self._states, inputs, labels, False,
-                                      None)
+                                      None, masks)
             return loss
 
         return jax.grad(loss_fn)(self._params)

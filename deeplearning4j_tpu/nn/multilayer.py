@@ -17,7 +17,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.autodiff.samediff import _as_batches, _split_dataset
+from deeplearning4j_tpu.autodiff.samediff import (
+    _as_batches, _host_array, _ones_mask, _pad_to_bucket, _prepare_batches,
+    _split_dataset_full)
 from deeplearning4j_tpu.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.ndarray import INDArray
 from deeplearning4j_tpu.nn.conf.configuration import (
@@ -66,6 +68,7 @@ class MultiLayerNetwork:
         self._opt_states: list = []
         self._listeners: list = []
         self._train_step = None
+        self._bucket = None  # fit batch-size bucket (pad ragged tail to it)
         self._infer_fns: dict = {}
         self._iteration = 0
         self._epoch = 0
@@ -139,9 +142,10 @@ class MultiLayerNetwork:
     def _build_train_step(self):
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
-        def step(params, states, opt_states, f, l, rng, it):
+        def step(params, states, opt_states, f, l, lmask, rng, it):
             def loss_fn(p):
-                loss, ns = self._loss_from(p, states, f, l, True, rng)
+                loss, ns = self._loss_from(p, states, f, l, True, rng,
+                                           mask=lmask)
                 return loss, ns
 
             (loss, new_states), grads = jax.value_and_grad(
@@ -178,14 +182,25 @@ class MultiLayerNetwork:
         params, states, opts = self._params, self._states, self._opt_states
         base_key = jax.random.key(self.conf.seed + 1)
         last_loss = None
-        for _ in range(epochs):
-            for ds in _as_batches(data):
-                feats, labels = _split_dataset(ds)
-                f = _unwrap(feats[0])
-                l = _unwrap(labels[0])
+        for epoch_i in range(epochs):
+            batches, data = _prepare_batches(data, epoch_i, epochs)
+            for ds in batches:
+                feats, labels, _, lmasks = _split_dataset_full(ds)
+                f = _host_array(feats[0])
+                l = _host_array(labels[0])
+                # always train with an explicit mask so the jit signature
+                # (and hence the ONE compiled executable) is stable whether
+                # or not the batch is ragged/masked
+                lmask = (_host_array(lmasks[0], np.float32)
+                         if lmasks[0] is not None else _ones_mask(l))
+                if self._bucket is None or f.shape[0] > self._bucket:
+                    self._bucket = f.shape[0]
+                if f.shape[0] < self._bucket:
+                    (f, l), lmask, _ = _pad_to_bucket([f, l], lmask,
+                                                      self._bucket)
                 rng = jax.random.fold_in(base_key, self._iteration)
                 loss, params, states, opts = self._train_step(
-                    params, states, opts, f, l, rng, self._iteration)
+                    params, states, opts, f, l, lmask, rng, self._iteration)
                 # rebind before anything can observe donated buffers
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
@@ -243,27 +258,28 @@ class MultiLayerNetwork:
             if self._score is None:
                 raise ValueError("no score yet: call fit() or score(dataset)")
             return self._score
-        feats, labels = _split_dataset(dataset)
+        feats, labels, _, lmasks = _split_dataset_full(dataset)
+        lmask = None if lmasks[0] is None else _unwrap(lmasks[0])
         loss, _ = self._loss_from(self._params, self._states,
                                   _unwrap(feats[0]), _unwrap(labels[0]),
-                                  False, None)
+                                  False, None, mask=lmask)
         return float(loss)
 
     def evaluate(self, iterator, numClasses=None) -> Evaluation:
         self._check_init()
         ev = Evaluation(numClasses)
         for ds in _as_batches(iterator):
-            feats, labels = _split_dataset(ds)
+            feats, labels, _, lmasks = _split_dataset_full(ds)
             out = self.output(feats[0])
-            ev.eval(labels[0], out)
+            ev.eval(labels[0], out, mask=lmasks[0])
         return ev
 
     def evaluateRegression(self, iterator) -> RegressionEvaluation:
         ev = RegressionEvaluation()
         for ds in _as_batches(iterator):
-            feats, labels = _split_dataset(ds)
+            feats, labels, _, lmasks = _split_dataset_full(ds)
             out = self.output(feats[0])
-            ev.eval(labels[0], out)
+            ev.eval(labels[0], out, mask=lmasks[0])
         return ev
 
     # -- params --------------------------------------------------------------

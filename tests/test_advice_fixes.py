@@ -1,0 +1,145 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+masked-sequence training, one-shot-generator epochs, word2vec tail batch,
+output-layer activation inheritance, Evaluation numClasses growth."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.nn import (
+    DenseLayer, InputType, LossFunction, LSTM, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _rnn_conf(seed=7):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(5e-3))
+            .list()
+            .layer(LSTM.Builder().nOut(8).build())
+            .layer(RnnOutputLayer.Builder().nOut(4).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .setInputType(InputType.recurrent(3, 6))
+            .build())
+
+
+class TestLabelsMaskThreading:
+    """ADVICE medium: featuresMask/labelsMask silently dropped in fit/eval."""
+
+    def _data(self, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(4, 3, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[
+            rng.integers(0, 4, (4, 6))].transpose(0, 2, 1)
+        mask = np.ones((4, 6), np.float32)
+        mask[:, 4:] = 0.0  # last two timesteps padded
+        return X, y, mask
+
+    def test_masked_fit_ignores_padded_timesteps(self):
+        X, y, mask = self._data()
+        # poison the padded region: with the mask applied, training must be
+        # invariant to garbage in masked-out label positions
+        y_poisoned = y.copy()
+        y_poisoned[:, :, 4:] = 7.5
+
+        net_a = MultiLayerNetwork(_rnn_conf()).init()
+        net_b = MultiLayerNetwork(_rnn_conf()).init()
+        ds_a = DataSet(X, y, labelsMask=mask)
+        ds_b = DataSet(X, y_poisoned, labelsMask=mask)
+        net_a.fit([ds_a], 5)
+        net_b.fit([ds_b], 5)
+        pa = net_a.params().toNumpy()
+        pb = net_b.params().toNumpy()
+        np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
+
+    def test_masked_score_matches_truncated(self):
+        X, y, mask = self._data()
+        net = MultiLayerNetwork(_rnn_conf()).init()
+        masked = net.score(DataSet(X, y, labelsMask=mask))
+        truncated = net.score((X[:, :, :4], y[:, :, :4]))
+        assert masked == pytest.approx(truncated, rel=1e-4)
+
+    def test_masked_evaluate_excludes_padding(self):
+        X, y, mask = self._data()
+        net = MultiLayerNetwork(_rnn_conf()).init()
+        ev = net.evaluate([DataSet(X, y, labelsMask=mask)])
+        # 4 examples x 4 valid timesteps
+        assert int(ev.confusionMatrix().sum()) == 16
+
+
+class TestGeneratorEpochs:
+    """ADVICE low: fit(generator, epochs>1) silently trained one epoch."""
+
+    def test_generator_trains_all_epochs(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer.Builder().nIn(5).nOut(8)
+                       .activation("tanh").build())
+                .layer(OutputLayer.Builder().nIn(8).nOut(3)
+                       .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        gen = ((X, y) for _ in range(2))  # one-shot generator, 2 batches
+        net.fit(gen, 10)
+        assert net.getIterationCount() == 20  # 2 batches x 10 epochs
+
+
+class TestOutputActivationInheritance:
+    """ADVICE low: global .activation() must propagate into output layers."""
+
+    def _conf(self, global_act, out_act=None):
+        b = NeuralNetConfiguration.Builder().seed(1)
+        if global_act:
+            b = b.activation(global_act)
+        out = OutputLayer.Builder().nIn(4).nOut(2).lossFunction("mse")
+        if out_act:
+            out = out.activation(out_act)
+        return b.list().layer(out.build()).build()
+
+    def test_global_activation_propagates(self):
+        conf = self._conf("tanh")
+        assert conf.layers[-1].activation == "tanh"
+
+    def test_explicit_wins_over_global(self):
+        conf = self._conf("tanh", out_act="sigmoid")
+        assert conf.layers[-1].activation == "sigmoid"
+
+    def test_softmax_default_when_no_global(self):
+        conf = self._conf(None)
+        assert conf.layers[-1].activation == "softmax"
+
+
+class TestEvaluationNumClasses:
+    """ADVICE low: out-of-range class index must grow, not IndexError."""
+
+    def test_out_of_range_grows_matrix(self):
+        ev = Evaluation(numClasses=2)
+        labels = np.eye(2, dtype=np.float32)[[0, 1]]
+        preds = np.eye(2, dtype=np.float32)[[0, 1]]
+        ev.eval(labels, preds)
+        # now feed 4-class one-hots through the same accumulator
+        labels4 = np.eye(4, dtype=np.float32)[[3, 2]]
+        preds4 = np.eye(4, dtype=np.float32)[[3, 1]]
+        ev.eval(labels4, preds4)
+        assert ev.numClasses == 4
+        assert int(ev.confusionMatrix().sum()) == 4
+
+
+class TestWord2VecTailBatch:
+    """ADVICE low: last partial batch must be trained, not dropped."""
+
+    def test_small_corpus_trains_with_large_batch(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sentences = [f"alpha beta gamma delta epsilon w{i}" for i in range(6)]
+        w2v = (Word2Vec.Builder().minWordFrequency(1).layerSize(8)
+               .windowSize(2).batchSize(4096).epochs(1).seed(1)
+               .iterate(sentences).build())
+        w2v.fit()
+        # with batchSize >> corpus pairs, round 1 trained nothing past
+        # init; any vector must now differ from its init
+        v = w2v.getWordVector("alpha")
+        assert v is not None and np.abs(v).sum() > 0
