@@ -381,6 +381,7 @@ class SameDiff:
         self._step = 0
         self._fn_cache: dict = {}
         self._seed = 0
+        self._profiler_cfg = None  # ProfilerConfig for NAN_PANIC checks
         # namespaces
         self.math = SDMath(self)
         self.nn = SDNN(self)
@@ -708,6 +709,12 @@ class SameDiff:
         return out
 
     # -- training ------------------------------------------------------------
+    def setProfilerConfig(self, cfg):
+        """ProfilerConfig with checkForNaN/checkForInf enables per-step
+        finite checks (reference: OpProfiler NAN_PANIC, SURVEY.md §2.3)."""
+        self._profiler_cfg = cfg
+        return self
+
     def setTrainingConfig(self, cfg: TrainingConfig):
         self.trainingConfig = cfg
         if cfg.lossVariables:
@@ -800,6 +807,13 @@ class SameDiff:
                 self._updater_state = opt_state
                 self._step += 1
                 epoch_losses.append(loss)  # device array; no host sync here
+                if self._profiler_cfg is not None:
+                    from deeplearning4j_tpu.utils.profiler import (
+                        nan_panic_check)
+
+                    nan_panic_check(self._profiler_cfg, loss, params,
+                                    where="variables",
+                                    context=f" at step {self._step}")
                 if listeners:
                     lv = float(loss)
                     for listener in listeners:

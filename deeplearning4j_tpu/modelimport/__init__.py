@@ -1,0 +1,5 @@
+"""Model import (reference L6: deeplearning4j-modelimport + nd4j-api
+org.nd4j.imports — SURVEY.md §2.7 Keras/TF import rows)."""
+
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
+    KerasModelImport)

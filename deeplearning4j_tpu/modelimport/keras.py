@@ -1,0 +1,358 @@
+"""Keras HDF5 model import.
+
+Reference capability: `deeplearning4j-modelimport`
+`org.deeplearning4j.nn.modelimport.keras.KerasModelImport` (SURVEY.md
+§2.7: ~40k LoC Java over the JavaCPP hdf5 preset; VERDICT.md round-1
+missing item 1). Reads a Keras 2.x HDF5 file (the `model_config` JSON
+attr + `model_weights` groups) via h5py and builds a native
+MultiLayerNetwork (Sequential) or ComputationGraph (Functional) with the
+trained weights installed.
+
+Layout conventions (same conversions the reference performs):
+- Conv2D kernels HWIO -> OIHW; imported conv nets take NCHW inputs.
+- Recurrent inputs: Keras [N, T, C] -> DL4J NCW [N, C, T].
+- The final Dense/softmax layer becomes an OutputLayer (loss inferred
+  from the activation: softmax -> MCXENT, sigmoid -> XENT, else MSE) so
+  the imported model is trainable, matching the reference's
+  `importKerasSequentialModelAndWeights(..., enforceTrainingConfig)`.
+
+Scope: the baseline architectures (MLP / CNN / LSTM, sequential and
+functional) — Dense, Conv2D, MaxPooling2D, AveragePooling2D, Flatten,
+Dropout, BatchNormalization, Activation, Embedding, LSTM, SimpleRNN,
+InputLayer, concatenate/add merges.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalization, ComputationGraph, ConvolutionLayer,
+    DenseLayer, DropoutLayer, EmbeddingSequenceLayer, InputType,
+    LastTimeStep, LossFunction, LSTM, MergeVertex, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, RnnOutputLayer, SimpleRnn,
+    SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers import ElementWiseVertexOp, PoolingType
+
+_ACTIVATIONS = {
+    "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid",
+    "softmax": "softmax", "linear": "identity", "elu": "elu",
+    "selu": "selu", "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "gelu": "gelu",
+    "leaky_relu": "leakyrelu",
+}
+
+
+def _act(name):
+    if name is None:
+        return "identity"
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unsupported Keras activation: {name!r}")
+    return _ACTIVATIONS[name]
+
+
+def _loss_for_output(activation):
+    return {"softmax": LossFunction.MCXENT,
+            "sigmoid": LossFunction.XENT}.get(activation, LossFunction.MSE)
+
+
+class KerasModelImport:
+    """Entry points mirroring the reference class."""
+
+    @staticmethod
+    def importKerasSequentialModelAndWeights(path) -> MultiLayerNetwork:
+        cfg, weights = _read_h5(path)
+        if cfg["class_name"] != "Sequential":
+            raise ValueError(
+                f"not a Sequential model: {cfg['class_name']} "
+                f"(use importKerasModelAndWeights)")
+        return _build_sequential(cfg, weights)
+
+    @staticmethod
+    def importKerasModelAndWeights(path):
+        cfg, weights = _read_h5(path)
+        if cfg["class_name"] == "Sequential":
+            return _build_sequential(cfg, weights)
+        return _build_functional(cfg, weights)
+
+
+# ---------------------------------------------------------------------------
+# HDF5 reading
+# ---------------------------------------------------------------------------
+
+def _read_h5(path):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        cfg = json.loads(raw)
+        weights = {}
+        mw = f["model_weights"]
+        for lname in mw:
+            g = mw[lname]
+            names = g.attrs.get("weight_names", [])
+            arrs = []
+            for wn in names:
+                if isinstance(wn, bytes):
+                    wn = wn.decode("utf-8")
+                arrs.append(np.array(g[wn]))
+            if arrs:
+                weights[lname] = arrs
+    return cfg, weights
+
+
+# ---------------------------------------------------------------------------
+# layer conversion
+# ---------------------------------------------------------------------------
+
+def _input_type_from_shape(shape):
+    """batch_input_shape (without batch dim) -> InputType. Keras NHWC conv
+    input -> convolutional(h, w, c); [T, C] -> recurrent(C, T)."""
+    shape = [s for s in shape if s is not None]
+    if len(shape) == 3:
+        h, w, c = shape
+        return InputType.convolutional(h, w, c)
+    if len(shape) == 2:
+        t, c = shape
+        return InputType.recurrent(c, t)
+    if len(shape) == 1:
+        return InputType.feedForward(shape[0])
+    raise ValueError(f"unsupported input shape {shape}")
+
+
+def _convert_layer(class_name, kc, is_last, prev_returns_sequences):
+    """One Keras layer config -> (our layer or None, activation_carryover).
+
+    Returns None for structural layers (Flatten/InputLayer) that our
+    config DSL expresses through input-type inference."""
+    if class_name in ("InputLayer", "Flatten"):
+        return None
+    if class_name == "Dense":
+        act = _act(kc.get("activation"))
+        if is_last:
+            return OutputLayer.Builder().nOut(kc["units"]).activation(act) \
+                .lossFunction(_loss_for_output(act)) \
+                .hasBias(kc.get("use_bias", True)).build()
+        return DenseLayer.Builder().nOut(kc["units"]).activation(act) \
+            .hasBias(kc.get("use_bias", True)).build()
+    if class_name == "Conv2D":
+        ks = kc["kernel_size"]
+        st = kc.get("strides", (1, 1))
+        b = (ConvolutionLayer.Builder().nOut(kc["filters"])
+             .kernelSize(list(ks)).stride(list(st))
+             .activation(_act(kc.get("activation")))
+             .hasBias(kc.get("use_bias", True)))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        pt = (PoolingType.MAX if class_name == "MaxPooling2D"
+              else PoolingType.AVG)
+        ps = kc.get("pool_size", (2, 2))
+        st = kc.get("strides") or ps
+        return SubsamplingLayer.Builder(poolingType=pt) \
+            .kernelSize(list(ps)).stride(list(st)).build()
+    if class_name == "Dropout":
+        return DropoutLayer.Builder().dropOut(1.0 - kc["rate"]).build()
+    if class_name == "BatchNormalization":
+        return BatchNormalization.Builder() \
+            .eps(kc.get("epsilon", 1e-3)) \
+            .decay(kc.get("momentum", 0.99)).build()
+    if class_name == "Activation":
+        return ActivationLayer.Builder() \
+            .activation(_act(kc.get("activation"))).build()
+    if class_name == "Embedding":
+        return EmbeddingSequenceLayer.Builder() \
+            .nIn(kc["input_dim"]).nOut(kc["output_dim"]).build()
+    if class_name in ("LSTM", "SimpleRNN"):
+        cls = LSTM if class_name == "LSTM" else SimpleRnn
+        act = _act(kc.get("activation", "tanh"))
+        rnn = cls.Builder().nOut(kc["units"]).activation(act).build()
+        if not kc.get("return_sequences", False):
+            return LastTimeStep(rnn)
+        return rnn
+    raise ValueError(f"unsupported Keras layer: {class_name}")
+
+
+def _keras_layers(cfg):
+    layers = cfg["config"]["layers"]
+    out = []
+    for spec in layers:
+        kc = spec.get("config", {})
+        out.append((spec["class_name"], kc,
+                    kc.get("name") or spec.get("name")))
+    return out
+
+
+def _build_sequential(cfg, weights) -> MultiLayerNetwork:
+    specs = _keras_layers(cfg)
+    input_type = None
+    for class_name, kc, _name in specs:
+        shape = kc.get("batch_input_shape")
+        if shape is not None:
+            input_type = _input_type_from_shape(shape[1:])
+            break
+    if input_type is None:
+        raise ValueError("model has no input shape recorded")
+
+    # find the index of the last WEIGHTED layer (it becomes the output)
+    last_w = max(i for i, (cn, _kc, _n) in enumerate(specs)
+                 if cn in ("Dense", "Conv2D", "LSTM", "SimpleRNN"))
+
+    built = []
+    names = []
+    for i, (class_name, kc, name) in enumerate(specs):
+        lr = _convert_layer(class_name, kc, i == last_w, False)
+        if lr is None:
+            continue
+        built.append(lr)
+        names.append(name)
+    if not isinstance(built[-1], type(built[-1])) or not built:
+        raise ValueError("empty model")
+
+    lb = (NeuralNetConfiguration.Builder().seed(12345).list())
+    for lr in built:
+        lb = lb.layer(lr)
+    conf = lb.setInputType(input_type).build()
+    net = MultiLayerNetwork(conf).init()
+    _install_weights_mln(net, names, weights)
+    return net
+
+
+def _build_functional(cfg, weights) -> ComputationGraph:
+    layers = cfg["config"]["layers"]
+    inputs = [li[0] for li in cfg["config"]["input_layers"]]
+    outputs = [lo[0] for lo in cfg["config"]["output_layers"]]
+
+    gb = NeuralNetConfiguration.Builder().seed(12345).graphBuilder()
+    gb = gb.addInputs(*inputs)
+    input_type = None
+    name_map = {}
+    for spec in layers:
+        cn = spec["class_name"]
+        kc = spec.get("config", {})
+        name = spec.get("name") or kc.get("name")
+        inbound = spec.get("inbound_nodes") or []
+        srcs = []
+        if inbound:
+            node = inbound[0]
+            if isinstance(node, dict):  # keras 3 style
+                node = node.get("args", [[]])[0]
+            for ref in node:
+                if isinstance(ref, (list, tuple)):
+                    srcs.append(ref[0])
+        srcs = [name_map.get(s, s) for s in srcs]
+        if cn == "InputLayer":
+            shape = kc.get("batch_input_shape")
+            if shape is not None and input_type is None:
+                input_type = _input_type_from_shape(shape[1:])
+            name_map[name] = name  # identity: it's a graph input
+            continue
+        if cn in ("Concatenate", "Merge"):
+            gb = gb.addVertex(name, MergeVertex(), *srcs)
+            name_map[name] = name
+            continue
+        if cn == "Add":
+            from deeplearning4j_tpu.nn import ElementWiseVertex
+
+            gb = gb.addVertex(name, ElementWiseVertex("add"), *srcs)
+            name_map[name] = name
+            continue
+        if cn == "Flatten":
+            # expressed via input-type inference; alias to its source
+            name_map[name] = srcs[0]
+            continue
+        lr = _convert_layer(cn, kc, name in outputs, False)
+        gb = gb.addLayer(name, lr, *srcs)
+        name_map[name] = name
+    outputs = [name_map.get(o, o) for o in outputs]
+    gb = gb.setOutputs(*outputs)
+    if input_type is not None:
+        gb = gb.setInputTypes(input_type)
+    conf = gb.build()
+    net = ComputationGraph(conf).init()
+    _install_weights_graph(net, weights)
+    return net
+
+
+# ---------------------------------------------------------------------------
+# weight installation
+# ---------------------------------------------------------------------------
+
+def _convert_weights(layer, arrs):
+    """Keras weight list -> our param dict for one layer."""
+    if isinstance(layer, LastTimeStep):
+        return _convert_weights(layer.rnn, arrs)
+    if isinstance(layer, ConvolutionLayer):
+        w = np.transpose(arrs[0], (3, 2, 0, 1))  # HWIO -> OIHW
+        out = {"W": w}
+        if len(arrs) > 1:
+            out["b"] = arrs[1]
+        return out
+    if isinstance(layer, (LSTM,)):
+        # Keras gate order i,f,c,o == ours i,f,g,o
+        out = {"W": arrs[0], "R": arrs[1]}
+        out["b"] = arrs[2] if len(arrs) > 2 else np.zeros(
+            arrs[0].shape[1], np.float32)
+        return out
+    if isinstance(layer, SimpleRnn):
+        out = {"W": arrs[0], "R": arrs[1]}
+        out["b"] = arrs[2] if len(arrs) > 2 else np.zeros(
+            arrs[0].shape[1], np.float32)
+        return out
+    if isinstance(layer, BatchNormalization):
+        # gamma, beta, moving_mean, moving_variance
+        return {"gamma": arrs[0], "beta": arrs[1],
+                "_mean": arrs[2], "_var": arrs[3]}
+    if isinstance(layer, EmbeddingSequenceLayer):
+        return {"W": arrs[0]}
+    # Dense / OutputLayer
+    out = {"W": arrs[0]}
+    if len(arrs) > 1:
+        out["b"] = arrs[1]
+    return out
+
+
+def _set_params(net_set_param, layer, idx_or_name, arrs, set_state):
+    conv = _convert_weights(layer, arrs)
+    state = {}
+    for k in ("_mean", "_var"):
+        if k in conv:
+            state[k.lstrip("_")] = conv.pop(k)
+    for k, v in conv.items():
+        net_set_param(idx_or_name, k, np.asarray(v, np.float32))
+    if state:
+        set_state(idx_or_name, state)
+
+
+def _install_weights_mln(net: MultiLayerNetwork, names, weights):
+    for i, (lr, name) in enumerate(zip(net.layers, names)):
+        arrs = weights.get(name)
+        if not arrs:
+            continue
+
+        def set_state(idx, st):
+            net._states[idx] = {k: np.asarray(v, np.float32)
+                                for k, v in st.items()}
+
+        _set_params(net.setParam, lr, i, arrs, set_state)
+
+
+def _install_weights_graph(net: ComputationGraph, weights):
+    for name, (node, _ins) in net.conf.nodes.items():
+        arrs = weights.get(name)
+        if not arrs:
+            continue
+
+        def set_param(n, k, v):
+            net._params[n][k] = v
+
+        def set_state(n, st):
+            net._states[n] = {k: np.asarray(v, np.float32)
+                              for k, v in st.items()}
+
+        _set_params(set_param, node, name, arrs, set_state)

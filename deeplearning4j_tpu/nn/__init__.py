@@ -5,7 +5,7 @@ from deeplearning4j_tpu.nn.weights import WeightInit  # noqa: F401
 from deeplearning4j_tpu.nn.losses import LossFunction  # noqa: F401
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
-    MultiLayerConfiguration, NeuralNetConfiguration)
+    BackpropType, MultiLayerConfiguration, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.conf.graph_conf import (  # noqa: F401
     ComputationGraphConfiguration, ElementWiseVertex, GraphVertex,
     L2NormalizeVertex, MergeVertex, ReshapeVertex, ScaleVertex, ShiftVertex,

@@ -41,14 +41,24 @@ def _apply_preprocessor(pp, x):
     raise ValueError(f"unknown preprocessor {kind}")
 
 
+class BackpropType:
+    """Reference: org.deeplearning4j.nn.conf.BackpropType."""
+
+    Standard = "Standard"
+    TruncatedBPTT = "TruncatedBPTT"
+
+
 class MultiLayerConfiguration:
     def __init__(self, layers, defaults=None, inputType=None, seed=12345,
-                 dataType="float32"):
+                 dataType="float32", backpropType=BackpropType.Standard,
+                 tbpttLength=None):
         self.layers: list[BaseLayer] = layers
         self.defaults = defaults or {}
         self.inputType = inputType
         self.seed = seed
         self.dataType = dataType
+        self.backpropType = backpropType
+        self.tbpttLength = tbpttLength
         self.preprocessors: list = [None] * len(layers)
         self.layer_input_types: list = [None] * len(layers)
         self._finalize()
@@ -104,6 +114,8 @@ class MultiLayerConfiguration:
             "inputType": self.inputType.to_json() if self.inputType else None,
             "seed": self.seed,
             "dataType": self.dataType,
+            "backpropType": self.backpropType,
+            "tbpttLength": self.tbpttLength,
         }, indent=1)
 
     toJson = to_json
@@ -116,9 +128,11 @@ class MultiLayerConfiguration:
             defaults["updater"] = updater_from_config(defaults["updater"])
         layers = [BaseLayer.from_json(ld) for ld in d["layers"]]
         it = InputType.from_json(d["inputType"]) if d.get("inputType") else None
-        return MultiLayerConfiguration(layers, defaults, it,
-                                       d.get("seed", 12345),
-                                       d.get("dataType", "float32"))
+        return MultiLayerConfiguration(
+            layers, defaults, it, d.get("seed", 12345),
+            d.get("dataType", "float32"),
+            d.get("backpropType", BackpropType.Standard),
+            d.get("tbpttLength"))
 
     fromJson = from_json
 
@@ -151,6 +165,8 @@ class ListBuilder:
         self._dataType = dataType
         self._layers: list = []
         self._input_type = None
+        self._backprop_type = BackpropType.Standard
+        self._tbptt_length = None
 
     def layer(self, idx_or_layer, layer=None):
         if layer is None:
@@ -169,12 +185,32 @@ class ListBuilder:
     def inputType(self, input_type):
         return self.setInputType(input_type)
 
+    def backpropType(self, bt):
+        """Reference: ListBuilder.backpropType(BackpropType.TruncatedBPTT)."""
+        self._backprop_type = bt
+        return self
+
+    def tBPTTLength(self, n):
+        self._backprop_type = BackpropType.TruncatedBPTT
+        self._tbptt_length = int(n)
+        return self
+
+    # the reference splits fwd/bwd lengths; equal lengths is the common case
+    def tBPTTForwardLength(self, n):
+        return self.tBPTTLength(n)
+
+    def tBPTTBackwardLength(self, n):
+        self._tbptt_length = min(self._tbptt_length or int(n), int(n))
+        return self
+
     def build(self) -> MultiLayerConfiguration:
         if any(lr is None for lr in self._layers):
             raise ValueError("layer list has gaps")
         return MultiLayerConfiguration(self._layers, dict(self._defaults),
                                        self._input_type, self._seed,
-                                       self._dataType)
+                                       self._dataType,
+                                       self._backprop_type,
+                                       self._tbptt_length)
 
 
 class NeuralNetConfiguration:

@@ -139,6 +139,12 @@ class BaseLayer:
         return jnp.where(keep, x / p, jnp.zeros_like(x))
 
     def _act(self, x):
+        # softmax normalizes the CLASS axis: dim 1 in the DL4J NCW
+        # time-series layout [N, C, T] (axis -1 there is time)
+        if x.ndim == 3 and self.activation in ("softmax", "logsoftmax"):
+            fn = (jax.nn.softmax if self.activation == "softmax"
+                  else jax.nn.log_softmax)
+            return fn(x, axis=1)
         return resolve_activation(self.activation or "identity")(x)
 
     # -- serde ---------------------------------------------------------------
@@ -732,12 +738,26 @@ class LSTM(BaseLayer):
             "b": jnp.zeros((4 * h,), dtype),
         }
 
+    IS_RECURRENT = True
+
     def apply(self, params, state, x, training, rng):
+        """When `state` carries {"h","c"} (streaming rnnTimeStep or a TBPTT
+        segment, SURVEY.md §2.5 TBPTT row), the recurrence starts from it
+        and the updated state is returned; otherwise zero-init stateless."""
         x = self._dropout(x, training, rng)
+        h0 = state.get("h") if isinstance(state, dict) else None
+        c0 = state.get("c") if isinstance(state, dict) else None
         out, hT, cT = OPS["lstmLayer"](
-            x, params["W"], params["R"], params["b"],
+            x, params["W"], params["R"], params["b"], h0=h0, c0=c0,
             forgetBias=self.forgetGateBiasInit)
+        if h0 is not None:
+            return out, {"h": hT, "c": cT}
         return out, state
+
+    def streaming_state(self, batch_size, dtype=jnp.float32):
+        """Zero carried state for rnnTimeStep / TBPTT segments."""
+        h = jnp.zeros((batch_size, self.nOut), dtype)
+        return {"h": h, "c": jnp.zeros_like(h)}
 
 
 @_register
@@ -771,11 +791,19 @@ class SimpleRnn(BaseLayer):
             "b": jnp.zeros((self.nOut,), dtype),
         }
 
+    IS_RECURRENT = True
+
     def apply(self, params, state, x, training, rng):
+        h0 = state.get("h") if isinstance(state, dict) else None
         out, hT = OPS["simpleRnnLayer"](x, params["W"], params["R"],
-                                        params["b"],
+                                        params["b"], h0=h0,
                                         activation=self.activation)
+        if h0 is not None:
+            return out, {"h": hT}
         return out, state
+
+    def streaming_state(self, batch_size, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch_size, self.nOut), dtype)}
 
 
 @_register

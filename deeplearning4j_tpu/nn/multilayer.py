@@ -23,7 +23,7 @@ from deeplearning4j_tpu.autodiff.samediff import (
 from deeplearning4j_tpu.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.ndarray import INDArray
 from deeplearning4j_tpu.nn.conf.configuration import (
-    MultiLayerConfiguration, _apply_preprocessor)
+    BackpropType, MultiLayerConfiguration, _apply_preprocessor)
 from deeplearning4j_tpu.nn.conf.layers import OUTPUT_LAYER_TYPES
 
 
@@ -70,6 +70,9 @@ class MultiLayerNetwork:
         self._train_step = None
         self._bucket = None  # fit batch-size bucket (pad ragged tail to it)
         self._infer_fns: dict = {}
+        self._profiler_cfg = None
+        self._stream_states = None   # rnnTimeStep carried state per layer
+        self._stream_batch = None
         self._iteration = 0
         self._epoch = 0
         self._score = None
@@ -198,14 +201,29 @@ class MultiLayerNetwork:
                 if f.shape[0] < self._bucket:
                     (f, l), lmask, _ = _pad_to_bucket([f, l], lmask,
                                                       self._bucket)
-                rng = jax.random.fold_in(base_key, self._iteration)
-                loss, params, states, opts = self._train_step(
-                    params, states, opts, f, l, lmask, rng, self._iteration)
+                tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
+                         and self.conf.tbpttLength and f.ndim == 3
+                         and f.shape[2] > self.conf.tbpttLength)
+                if tbptt:
+                    loss, params, states, opts = self._fit_tbptt(
+                        params, states, opts, f, l, lmask, base_key)
+                else:
+                    rng = jax.random.fold_in(base_key, self._iteration)
+                    loss, params, states, opts = self._train_step(
+                        params, states, opts, f, l, lmask, rng,
+                        self._iteration)
+                    self._iteration += 1
                 # rebind before anything can observe donated buffers
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
-                self._iteration += 1
                 last_loss = loss
+                if self._profiler_cfg is not None:
+                    from deeplearning4j_tpu.utils.profiler import (
+                        nan_panic_check)
+
+                    nan_panic_check(
+                        self._profiler_cfg, loss, params,
+                        context=f" at iteration {self._iteration}")
                 if self._listeners:
                     lv = float(loss)
                     self._score = lv
@@ -216,6 +234,130 @@ class MultiLayerNetwork:
         if last_loss is not None:
             self._score = float(last_loss)
         return self
+
+    # -- TBPTT (reference: MultiLayerNetwork truncated BPTT, SURVEY.md §2.5:
+    # tBPTTLength splits each minibatch sequence into segments; hidden state
+    # carries ACROSS segments (no gradient flow — states enter the next
+    # compiled step as inputs), and resets at minibatch boundaries) --------
+    def _recurrent_indices(self, forbid_bidirectional=False):
+        from deeplearning4j_tpu.nn.conf.layers import Bidirectional
+
+        out = []
+        for i, lr in enumerate(self.layers):
+            if isinstance(lr, Bidirectional):
+                if forbid_bidirectional:
+                    # the backward direction needs the FULL sequence; DL4J
+                    # likewise rejects rnnTimeStep/TBPTT on bidirectional
+                    raise ValueError(
+                        f"layer {i} is Bidirectional: streaming rnnTimeStep"
+                        f"/TBPTT cannot carry state through a layer that "
+                        f"consumes the whole sequence")
+                continue
+            if getattr(lr, "IS_RECURRENT", False) or getattr(
+                    getattr(lr, "rnn", None), "IS_RECURRENT", False):
+                out.append(i)
+        return out
+
+    def _seed_rnn_states(self, states, batch_size):
+        dtype = self.conf.dtype
+        out = list(states)
+        for i in self._recurrent_indices():
+            lr = self.layers[i]
+            target = lr.rnn if hasattr(lr, "rnn") and getattr(
+                lr.rnn, "IS_RECURRENT", False) and not getattr(
+                lr, "IS_RECURRENT", False) else lr
+            out[i] = target.streaming_state(batch_size, dtype)
+        return out
+
+    def _strip_rnn_states(self, states):
+        out = list(states)
+        for i in self._recurrent_indices():
+            out[i] = {}
+        return out
+
+    def _fit_tbptt(self, params, states, opts, f, l, lmask, base_key):
+        L = self.conf.tbpttLength
+        T = f.shape[2]
+        self._recurrent_indices(forbid_bidirectional=True)
+        states = self._seed_rnn_states(states, f.shape[0])
+        loss = None
+        for t0 in range(0, T, L):
+            fc = f[:, :, t0:t0 + L]
+            lc = l[:, :, t0:t0 + L] if l.ndim == 3 else l
+            mc = lmask[:, t0:t0 + L] if lmask.ndim == 2 else lmask
+            if fc.shape[2] < L:
+                # zero-pad the tail segment to the fixed tbptt shape and
+                # mask the padded timesteps out of the loss
+                pad = L - fc.shape[2]
+                fc = np.concatenate(
+                    [fc, np.zeros(fc.shape[:2] + (pad,), fc.dtype)], axis=2)
+                if lc.ndim == 3:
+                    lc = np.concatenate(
+                        [lc, np.zeros(lc.shape[:2] + (pad,), lc.dtype)],
+                        axis=2)
+                if mc.ndim == 2:
+                    mc = np.concatenate(
+                        [mc, np.zeros((mc.shape[0], pad), mc.dtype)], axis=1)
+            rng = jax.random.fold_in(base_key, self._iteration)
+            loss, params, states, opts = self._train_step(
+                params, states, opts, fc, lc, mc, rng, self._iteration)
+            self._iteration += 1
+        return loss, params, self._strip_rnn_states(states), opts
+
+    # -- streaming inference (reference: rnnTimeStep / rnnClearPreviousState,
+    # SURVEY.md §2.5 TBPTT row) ---------------------------------------------
+    def rnnTimeStep(self, x):
+        """Streaming inference with carried hidden state: x is [N, C]
+        (one timestep) or [N, C, T] (a chunk). Successive calls continue
+        the sequence; rnnClearPreviousState() resets."""
+        self._check_init()
+        x = _unwrap(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, :, None]
+        n = x.shape[0]
+        self._recurrent_indices(forbid_bidirectional=True)
+        if self._stream_states is None or self._stream_batch != n:
+            self._stream_states = self._seed_rnn_states(self._states, n)
+            self._stream_batch = n
+        key = "stream"
+        if key not in self._infer_fns:
+            def fn(params, states, x):
+                return self._forward(params, states, x, False, None)
+
+            self._infer_fns[key] = jax.jit(fn)
+        y, new_states = self._infer_fns[key](self._params,
+                                             self._stream_states, x)
+        # keep only the recurrent carry; BN etc. stay at their trained state
+        rec = set(self._recurrent_indices())
+        self._stream_states = [
+            ns if i in rec else self._stream_states[i]
+            for i, ns in enumerate(new_states)]
+        y = INDArray(y[:, :, 0]) if single and y.ndim == 3 else INDArray(y)
+        return y
+
+    def rnnClearPreviousState(self):
+        self._stream_states = None
+        self._stream_batch = None
+
+    def rnnGetPreviousState(self, layer_idx: int) -> dict:
+        if self._stream_states is None:
+            return {}
+        return {k: INDArray(v)
+                for k, v in self._stream_states[layer_idx].items()}
+
+    def rnnSetPreviousState(self, layer_idx: int, state: dict):
+        """Install carried state (e.g. restoring a saved streaming session).
+        Works after rnnClearPreviousState: a fresh session is seeded from
+        the given state's batch size."""
+        vals = {k: _unwrap(v) for k, v in state.items()}
+        if self._stream_states is None:
+            if not vals:
+                raise ValueError("cannot infer batch size from empty state")
+            n = next(iter(vals.values())).shape[0]
+            self._stream_states = self._seed_rnn_states(self._states, n)
+            self._stream_batch = n
+        self._stream_states[layer_idx] = vals
 
     # -- inference -----------------------------------------------------------
     def _infer_fn(self, training=False):
@@ -245,11 +387,6 @@ class MultiLayerNetwork:
             x, _ = lr.apply(self._params[i], states[i], x, train, None)
             acts.append(INDArray(x))
         return acts
-
-    def rnnTimeStep(self, x):
-        """Minimal streaming inference (TBPTT capability, SURVEY.md §2.5):
-        full-sequence output of the final step."""
-        return self.output(x)
 
     # -- scoring / eval ------------------------------------------------------
     def score(self, dataset=None) -> float:
@@ -343,6 +480,13 @@ class MultiLayerNetwork:
         loss, grads = jax.value_and_grad(loss_fn)(self._params)
         self._score = float(loss)
         return grads, self._score
+
+    # -- profiler / debug (reference: OpProfiler NAN_PANIC, SURVEY.md §2.3)
+    def setProfilerConfig(self, cfg):
+        """ProfilerConfig with checkForNaN/checkForInf enables a per-step
+        finite check that raises naming the offending parameter."""
+        self._profiler_cfg = cfg
+        return self
 
     # -- listeners / misc ----------------------------------------------------
     def setListeners(self, *listeners):

@@ -1,0 +1,135 @@
+"""Mixture-of-Experts with expert parallelism over the `expert` mesh axis.
+
+Reference capability: ABSENT in the reference (SURVEY.md §2.6 marks
+expert parallel "NO") — additive capability, built the TPU-native way
+(GShard/Switch formulation): top-k gating produces dense one-hot
+dispatch/combine tensors, expert FFNs are batched einsums with the expert
+axis sharded over `expert`, and XLA inserts the all-to-alls that move
+tokens to their experts. No custom scheduler, no per-expert kernels —
+the MXU sees E parallel [C, H] x [H, F] matmuls.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, EXPERT_AXIS, spec_for)
+
+
+def moe_init(key, hidden: int, ffn: int, n_experts: int,
+             dtype=jnp.float32) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / math.sqrt(hidden)
+    s2 = 1.0 / math.sqrt(ffn)
+    return {
+        "gate_w": jax.random.normal(k1, (hidden, n_experts), dtype) * s1,
+        "w1": jax.random.normal(k2, (n_experts, hidden, ffn), dtype) * s1,
+        "b1": jnp.zeros((n_experts, ffn), dtype),
+        "w2": jax.random.normal(k3, (n_experts, ffn, hidden), dtype) * s2,
+        "b2": jnp.zeros((n_experts, hidden), dtype),
+    }
+
+
+def moe_param_specs() -> dict:
+    """PartitionSpecs: experts sharded over the expert axis."""
+    return {
+        "gate_w": P(),
+        "w1": P(EXPERT_AXIS), "b1": P(EXPERT_AXIS),
+        "w2": P(EXPERT_AXIS), "b2": P(EXPERT_AXIS),
+    }
+
+
+def moe_apply(params, x, k: int = 2, capacity_factor: float = 1.5):
+    """x: [N, H] tokens -> ([N, H], aux_loss).
+
+    Top-k gating with per-expert capacity C = ceil(k*N/E * cf). Overflow
+    tokens are dropped (standard GShard behavior); aux_loss is the load-
+    balancing loss (Switch Transformer eq. 4)."""
+    n, h = x.shape
+    e = params["gate_w"].shape[1]
+    c = int(math.ceil(k * n / e * capacity_factor))
+
+    logits = x @ params["gate_w"]                     # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # load-balancing aux loss: E * sum_e (frac tokens to e * mean prob e)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=probs.dtype), axis=0)
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+    # top-k expert choice per token
+    topk_p, topk_i = jax.lax.top_k(probs, k)          # [N, k]
+    topk_p = topk_p / jnp.maximum(
+        jnp.sum(topk_p, axis=-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert's capacity:
+    # cumulative count of earlier tokens routed to the same expert
+    oh = jax.nn.one_hot(topk_i, e, dtype=jnp.int32)   # [N, k, E]
+    flat = oh.reshape(n * k, e)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat        # [N*k, E]
+    pos = jnp.sum(pos_flat.reshape(n, k, e) * oh, axis=-1)  # [N, k]
+    keep = pos < c                                    # capacity mask
+
+    # dense dispatch/combine tensors [N, E, C]
+    pos_oh = jax.nn.one_hot(pos, c, dtype=x.dtype) * keep[..., None]
+    disp = jnp.einsum("nke,nkc->nec", oh.astype(x.dtype), pos_oh)
+    comb = jnp.einsum("nk,nke,nkc->nec", topk_p, oh.astype(x.dtype), pos_oh)
+
+    # to experts, through the FFN, back — XLA turns the sharded-E einsums
+    # into all-to-alls over the expert axis
+    expert_in = jnp.einsum("nec,nh->ech", disp, x)
+    hmid = jax.nn.gelu(
+        jnp.einsum("ech,ehf->ecf", expert_in, params["w1"])
+        + params["b1"][:, None, :])
+    expert_out = (jnp.einsum("ecf,efh->ech", hmid, params["w2"])
+                  + params["b2"][:, None, :])
+    y = jnp.einsum("nec,ech->nh", comb, expert_out)
+    return y, aux
+
+
+class MoELayerTrainer:
+    """Minimal expert-parallel trainer: one MoE FFN block regressing
+    targets, params expert-sharded, batch data-sharded."""
+
+    def __init__(self, mesh: Mesh, hidden=16, ffn=32, n_experts=4, k=2,
+                 lr=1e-2, aux_weight=1e-2, seed=0):
+        self.mesh = mesh
+        self.k = k
+        self.lr = lr
+        self.aux_weight = aux_weight
+        params = moe_init(jax.random.key(seed), hidden, ffn, n_experts)
+        to_sh = lambda s: NamedSharding(  # noqa: E731
+            mesh, P(*[a if a in mesh.axis_names else None for a in s]))
+        self.p_sh = {kk: to_sh(s) for kk, s in moe_param_specs().items()}
+        self.params = jax.device_put(params, self.p_sh)
+        self.x_sh = NamedSharding(mesh, spec_for(mesh, DATA_AXIS))
+        self._step_fn = None
+
+    def loss(self, params, x, y):
+        out, aux = moe_apply(params, x, k=self.k)
+        return jnp.mean((out - y) ** 2) + self.aux_weight * aux
+
+    def _build(self):
+        repl = NamedSharding(self.mesh, P())
+
+        def step(params, x, y):
+            loss, grads = jax.value_and_grad(self.loss)(params, x, y)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - self.lr * g, params, grads)
+            return loss, params
+
+        return jax.jit(step, in_shardings=(self.p_sh, self.x_sh, self.x_sh),
+                       out_shardings=(repl, self.p_sh), donate_argnums=(0,))
+
+    def train_step(self, x, y):
+        if self._step_fn is None:
+            self._step_fn = self._build()
+        loss, self.params = self._step_fn(self.params, np.asarray(x),
+                                          np.asarray(y))
+        return loss

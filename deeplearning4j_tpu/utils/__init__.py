@@ -15,3 +15,5 @@ from deeplearning4j_tpu.utils.early_stopping import (  # noqa: F401
     ScoreImprovementEpochTerminationCondition)
 from deeplearning4j_tpu.utils.transfer import (  # noqa: F401
     FineTuneConfiguration, TransferLearning)
+from deeplearning4j_tpu.utils.profiler import (  # noqa: F401
+    ProfilerConfig, StepTimer, assert_finite, profile_step)

@@ -40,11 +40,14 @@ class ScoreIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Iterations/sec + examples/sec (reference: PerformanceListener)."""
+    """Iterations/sec, mean step time and examples/sec (reference:
+    PerformanceListener + PerformanceTracker, SURVEY.md §5 tracing row).
+    Pass batchSize to also report examples/sec."""
 
-    def __init__(self, frequency=10, reportScore=False):
+    def __init__(self, frequency=10, reportScore=False, batchSize=None):
         self.frequency = frequency
         self.reportScore = reportScore
+        self.batchSize = batchSize
         self._last_time = None
         self._last_iter = None
         self.samples: list = []  # (iteration, iters_per_sec)
@@ -57,7 +60,10 @@ class PerformanceListener(TrainingListener):
             dt = now - self._last_time
             its = (iteration - self._last_iter) / dt if dt > 0 else 0.0
             self.samples.append((iteration, its))
-            msg = f"iteration {iteration}: {its:.2f} iters/sec"
+            msg = (f"iteration {iteration}: {its:.2f} iters/sec "
+                   f"({1e3 / its if its > 0 else 0:.1f} ms/step)")
+            if self.batchSize:
+                msg += f", {its * self.batchSize:.1f} examples/sec"
             if self.reportScore:
                 msg += f", score {model.score()}"
             log.info(msg)
@@ -66,6 +72,12 @@ class PerformanceListener(TrainingListener):
         elif self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
+
+    def mean_step_ms(self) -> float:
+        if not self.samples:
+            return 0.0
+        rates = [r for _, r in self.samples if r > 0]
+        return 1e3 / (sum(rates) / len(rates)) if rates else 0.0
 
 
 class CheckpointListener(TrainingListener):

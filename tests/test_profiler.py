@@ -1,0 +1,122 @@
+"""Profiler + debug-mode tests (reference capability: OpProfiler /
+ProfilerConfig / PerformanceTracker — SURVEY.md §2.3, §5 tracing rows;
+VERDICT.md round-1 item 6)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    DenseLayer, LossFunction, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.runtime import RuntimeConfig
+from deeplearning4j_tpu.utils.profiler import (
+    ProfilerConfig, StepTimer, assert_finite, profile_step)
+
+
+def _net(lr=0.1):
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(lr))
+            .list()
+            .layer(DenseLayer.Builder().nIn(4).nOut(8)
+                   .activation("tanh").build())
+            .layer(OutputLayer.Builder().nIn(8).nOut(2)
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestNanPanic:
+    def test_nan_input_raises_with_message(self):
+        net = _net()
+        net.setProfilerConfig(ProfilerConfig(checkForNaN=True))
+        X = np.full((4, 4), np.nan, np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        with pytest.raises(FloatingPointError):
+            net.fit([(X, y)], 1)
+
+    def test_exploding_lr_names_parameter_or_batch(self):
+        # identity+MSE with an absurd lr diverges to inf within a few steps
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(1e30))
+                .list()
+                .layer(OutputLayer.Builder().nIn(4).nOut(2)
+                       .activation("identity")
+                       .lossFunction(LossFunction.MSE).build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.setProfilerConfig(ProfilerConfig(checkForNaN=True))
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(8, 4)).astype(np.float32) * 100
+        y = rng.normal(size=(8, 2)).astype(np.float32)
+        with pytest.raises(FloatingPointError):
+            net.fit([(X, y)], 50)
+
+    def test_finite_training_unaffected(self):
+        net = _net()
+        net.setProfilerConfig(ProfilerConfig(checkForNaN=True))
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net.fit([(X, y)], 5)
+        assert net.getIterationCount() == 5
+
+
+class TestAssertFinite:
+    def test_names_offending_leaf(self):
+        tree = {"layer0": {"W": np.ones((2, 2)),
+                           "b": np.array([1.0, np.nan])}}
+        with pytest.raises(FloatingPointError, match="b"):
+            assert_finite(tree)
+
+    def test_passes_on_finite(self):
+        assert_finite({"W": np.ones(3)})
+
+
+class TestProfilerTrace:
+    def test_trace_produces_xplane_files(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "trace")
+        cfg = ProfilerConfig(trace_dir=d)
+        out, where = cfg.trace(lambda: jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        found = []
+        for root, _dirs, files in os.walk(where):
+            found.extend(files)
+        assert found, "profiler produced no trace files"
+
+    def test_profile_step_helper(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: (x @ x).sum())
+        d = profile_step(f, jnp.ones((32, 32)),
+                         trace_dir=str(tmp_path / "t2"), steps=2)
+        assert os.path.isdir(d)
+
+
+class TestStepTimer:
+    def test_throughput(self):
+        t = StepTimer()
+        for _ in range(3):
+            t.start()
+            t.stop()
+        s = t.summary(items_per_step=128)
+        assert s["steps"] == 3 and s["items_per_sec"] > 0
+
+
+class TestRuntimeConfig:
+    def test_environment_dump(self):
+        env = RuntimeConfig.environment()
+        assert env["device_count"] >= 1
+        assert env["backend"] == "cpu"  # the test conftest pins cpu
+
+    def test_xla_flag_merge(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS",
+                           "--xla_force_host_platform_device_count=4 --foo")
+        RuntimeConfig(host_device_count=8,
+                      extra_xla_flags=["--bar"]).apply()
+        flags = os.environ["XLA_FLAGS"]
+        assert "--xla_force_host_platform_device_count=8" in flags
+        assert "--foo" in flags and "--bar" in flags
+        assert "device_count=4" not in flags

@@ -1,0 +1,154 @@
+"""TBPTT + streaming RNN state tests (reference: MultiLayerNetwork
+truncated BPTT and rnnTimeStep/rnnClearPreviousState — SURVEY.md §2.5;
+VERDICT.md round-1 item 7: the round-1 rnnTimeStep was a pass-through)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    BackpropType, InputType, LossFunction, LSTM, MultiLayerNetwork,
+    NeuralNetConfiguration, RnnOutputLayer, SimpleRnn)
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _char_rnn_conf(vocab=5, t=None, tbptt=None, seed=3, lr=5e-3,
+                   hidden=10):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(lr))
+         .list()
+         .layer(LSTM.Builder().nOut(hidden).build())
+         .layer(RnnOutputLayer.Builder().nOut(vocab).activation("softmax")
+                .lossFunction(LossFunction.MCXENT).build())
+         .setInputType(InputType.recurrent(vocab, t)))
+    if tbptt:
+        b = b.tBPTTLength(tbptt)
+    return b.build()
+
+
+def _seq_data(vocab=5, n=4, t=24, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (n, t + 1))
+    X = np.eye(vocab, dtype=np.float32)[ids[:, :-1]].transpose(0, 2, 1)
+    y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]].transpose(0, 2, 1)
+    return X, y
+
+
+class TestTbpttTraining:
+    def test_config_roundtrip(self):
+        conf = _char_rnn_conf(t=24, tbptt=8)
+        assert conf.backpropType == BackpropType.TruncatedBPTT
+        assert conf.tbpttLength == 8
+        from deeplearning4j_tpu.nn import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.backpropType == BackpropType.TruncatedBPTT
+        assert conf2.tbpttLength == 8
+
+    def test_tbptt_trains_and_counts_segments(self):
+        conf = _char_rnn_conf(t=24, tbptt=8)
+        net = MultiLayerNetwork(conf).init()
+        X, y = _seq_data(t=24)
+        s0 = net.score((X, y))
+        net.fit([(X, y)], 10)
+        # 24/8 = 3 segments per batch, 10 epochs
+        assert net.getIterationCount() == 30
+        assert net.score((X, y)) < s0
+
+    def test_tbptt_ragged_tail_segment(self):
+        conf = _char_rnn_conf(t=20, tbptt=8)  # 8+8+4: padded tail
+        net = MultiLayerNetwork(conf).init()
+        X, y = _seq_data(t=20)
+        s0 = net.score((X, y))
+        net.fit([(X, y)], 10)
+        assert net.score((X, y)) < s0
+
+    def test_tbptt_matches_full_bptt_loss_trend_short_seq(self):
+        """On sequences shorter than tbpttLength the TBPTT path is inactive
+        and must match standard training exactly."""
+        X, y = _seq_data(t=6)
+        net_a = MultiLayerNetwork(_char_rnn_conf(t=6, tbptt=8)).init()
+        net_b = MultiLayerNetwork(_char_rnn_conf(t=6)).init()
+        net_a.fit([(X, y)], 5)
+        net_b.fit([(X, y)], 5)
+        np.testing.assert_allclose(net_a.params().toNumpy(),
+                                   net_b.params().toNumpy(), rtol=1e-6)
+
+
+class TestRnnTimeStep:
+    def test_stepwise_matches_full_sequence(self):
+        conf = _char_rnn_conf(t=12)
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        full = net.output(X).toNumpy()          # [N, C, T]
+        net.rnnClearPreviousState()
+        outs = []
+        for t in range(12):
+            outs.append(net.rnnTimeStep(X[:, :, t]).toNumpy())
+        step = np.stack(outs, axis=2)
+        np.testing.assert_allclose(step, full, rtol=2e-4, atol=1e-5)
+
+    def test_chunked_matches_full_sequence(self):
+        conf = _char_rnn_conf(t=12)
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        full = net.output(X).toNumpy()
+        net.rnnClearPreviousState()
+        a = net.rnnTimeStep(X[:, :, :5]).toNumpy()
+        b = net.rnnTimeStep(X[:, :, 5:]).toNumpy()
+        np.testing.assert_allclose(np.concatenate([a, b], axis=2), full,
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_clear_resets_state(self):
+        conf = _char_rnn_conf(t=12)
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        y1 = net.rnnTimeStep(X[:, :, 0]).toNumpy()
+        net.rnnTimeStep(X[:, :, 1])
+        net.rnnClearPreviousState()
+        y2 = net.rnnTimeStep(X[:, :, 0]).toNumpy()
+        np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+    def test_set_state_after_clear_restores_session(self):
+        """Restore-a-saved-session pattern: clear -> set -> continue."""
+        conf = _char_rnn_conf(t=12)
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        net.rnnTimeStep(X[:, :, 0])
+        saved = net.rnnGetPreviousState(0)
+        y_continued = net.rnnTimeStep(X[:, :, 1]).toNumpy()
+        net.rnnClearPreviousState()
+        net.rnnSetPreviousState(0, saved)
+        y_restored = net.rnnTimeStep(X[:, :, 1]).toNumpy()
+        np.testing.assert_allclose(y_restored, y_continued, rtol=1e-6)
+
+    def test_bidirectional_rejected(self):
+        from deeplearning4j_tpu.nn import Bidirectional
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(Bidirectional(LSTM.Builder().nOut(6).build()))
+                .layer(RnnOutputLayer.Builder().nOut(5)
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.recurrent(5, 12))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        with pytest.raises(ValueError, match="Bidirectional"):
+            net.rnnTimeStep(X[:, :, 0])
+
+    def test_state_accessors(self):
+        conf = _char_rnn_conf(t=12)
+        net = MultiLayerNetwork(conf).init()
+        X, _ = _seq_data(t=12)
+        net.rnnTimeStep(X[:, :, 0])
+        st = net.rnnGetPreviousState(0)
+        assert set(st) == {"h", "c"}
+        assert st["h"].shape() == (4, 10)
+        # simple_rnn state too
+        conf2 = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+                 .list()
+                 .layer(SimpleRnn.Builder().nOut(7).build())
+                 .layer(RnnOutputLayer.Builder().nOut(5)
+                        .lossFunction("mcxent").build())
+                 .setInputType(InputType.recurrent(5, 12))
+                 .build())
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.rnnTimeStep(X[:, :, 0])
+        assert set(net2.rnnGetPreviousState(0)) == {"h"}
