@@ -3,3 +3,5 @@ org.nd4j.imports — SURVEY.md §2.7 Keras/TF import rows)."""
 
 from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
     KerasModelImport)
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: F401
+    TFGraphMapper, TFImportError)

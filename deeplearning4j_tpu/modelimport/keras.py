@@ -34,7 +34,7 @@ from deeplearning4j_tpu.nn import (
     LastTimeStep, LossFunction, LSTM, MergeVertex, MultiLayerNetwork,
     NeuralNetConfiguration, OutputLayer, RnnOutputLayer, SimpleRnn,
     SubsamplingLayer)
-from deeplearning4j_tpu.nn.conf.layers import ElementWiseVertexOp, PoolingType
+from deeplearning4j_tpu.nn.conf.layers import PoolingType
 
 _ACTIVATIONS = {
     "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid",
@@ -211,8 +211,8 @@ def _build_sequential(cfg, weights) -> MultiLayerNetwork:
             continue
         built.append(lr)
         names.append(name)
-    if not isinstance(built[-1], type(built[-1])) or not built:
-        raise ValueError("empty model")
+    if not built:
+        raise ValueError("model has no convertible layers")
 
     lb = (NeuralNetConfiguration.Builder().seed(12345).list())
     for lr in built:

@@ -1,0 +1,779 @@
+"""TF GraphDef import into SameDiff.
+
+Reference capability: `nd4j-api` `org.nd4j.imports.graphmapper.tf.
+TFGraphMapper#importGraph` (SURVEY.md §2.3/§3.4: ~30k LoC of per-op
+mapping classes; VERDICT.md round-1 missing item 1 — the reference's
+BERT baseline config exists only through this path). The reference walks
+a frozen GraphDef and interprets each NodeDef op-by-op at execution
+time; here import is a one-shot translation into the native define-then-
+run SameDiff graph, which then compiles to a single XLA executable —
+imported models get the same jit/sharding treatment as natively built
+ones.
+
+Scope: the frozen-inference op set of BERT-class encoders and the
+baseline MLP/CNN/LSTM architectures — constants, placeholders, linear
+algebra, elementwise math, reductions, shape manipulation, gather/
+concat/split/strided-slice, softmax/layer-norm/gelu decompositions,
+conv/pool/fused-batch-norm (NHWC handled via explicit permutes), and
+host-side constant folding for shape-carrying tensors (Shape/Pack/
+Range/... feeding Reshape etc.), mirroring how the reference resolves
+"array args that are really attributes".
+
+Control deps (`^name`) are dropped: a frozen graph's control edges only
+sequence stateful ops, and the imported graph is purely functional.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.modelimport.protobuf import (
+    GraphDef, dtype_to_numpy)
+
+
+class TFImportError(ValueError):
+    pass
+
+
+def _ref(name):
+    """'node:k' -> (node, k); '^node' -> control dep (None)."""
+    if name.startswith("^"):
+        return None, 0
+    if ":" in name:
+        node, idx = name.rsplit(":", 1)
+        return node, int(idx)
+    return name, 0
+
+
+class TFGraphMapper:
+    """Entry points mirroring org.nd4j.imports.graphmapper.tf."""
+
+    @staticmethod
+    def importGraph(path_or_graphdef, placeholder_shapes=None) -> SameDiff:
+        """placeholder_shapes: {placeholder_name: concrete shape} for
+        graphs whose recorded input shapes have unknown (-1) dims; the
+        import specializes to them (like feeding fixed shapes to the
+        reference's TFGraphMapper)."""
+        if isinstance(path_or_graphdef, GraphDef):
+            gd = path_or_graphdef
+        else:
+            gd = GraphDef.parse(path_or_graphdef)
+        return _Importer(gd, placeholder_shapes).run()
+
+
+class _Importer:
+    def __init__(self, gd: GraphDef, placeholder_shapes=None):
+        self.gd = gd
+        self.placeholder_shapes = dict(placeholder_shapes or {})
+        self.nodes = {n.name: n for n in gd.nodes}
+        self.sd = SameDiff.create()
+        self.vars = {}        # tf tensor name "node:k" -> SDVariable
+        self.shapes = {}      # tf tensor name -> tuple (static)
+        self.dtypes = {}      # tf tensor name -> np.dtype
+        self.consts = {}      # node name -> np.ndarray (host-foldable)
+
+    # -- public ------------------------------------------------------------
+
+    def run(self) -> SameDiff:
+        for node in self._topo_order():
+            handler = _HANDLERS.get(node.op)
+            if handler is None:
+                raise TFImportError(
+                    f"unsupported TF op {node.op!r} (node {node.name!r})")
+            handler(self, node)
+        return self.sd
+
+    # -- graph walking -----------------------------------------------------
+
+    def _topo_order(self):
+        """Iterative DFS post-order (BERT-class graphs have serial chains
+        far deeper than Python's recursion limit)."""
+        order, seen, visiting = [], set(), set()
+        for root in self.gd.nodes:
+            stack = [(root.name, False)]
+            while stack:
+                name, expanded = stack.pop()
+                if expanded:
+                    visiting.discard(name)
+                    seen.add(name)
+                    order.append(self.nodes[name])
+                    continue
+                if name in seen:
+                    continue
+                if name in visiting:
+                    raise TFImportError(
+                        f"cycle at node {name!r} (control flow loops are "
+                        "not supported)")
+                node = self.nodes.get(name)
+                if node is None:
+                    raise TFImportError(f"missing node {name!r}")
+                visiting.add(name)
+                stack.append((name, True))
+                for inp in node.inputs:
+                    src, _ = _ref(inp)
+                    if src is not None and src not in seen:
+                        stack.append((src, False))
+        return order
+
+    # -- tensor accessors ----------------------------------------------------
+
+    def data_inputs(self, node):
+        return [i for i in node.inputs if not i.startswith("^")]
+
+    def var(self, ref):
+        """SDVariable for a tf tensor ref, materializing host constants."""
+        node, idx = _ref(ref)
+        key = f"{node}:{idx}"
+        if key in self.vars:
+            return self.vars[key]
+        if node in self.consts and idx == 0:
+            v = self.sd.constant(node, np.asarray(self.consts[node]))
+            self.vars[key] = v
+            return v
+        raise TFImportError(f"no tensor produced for {ref!r}")
+
+    def const(self, ref):
+        """numpy value of a host-foldable tensor ref, or None."""
+        node, idx = _ref(ref)
+        if idx != 0:
+            return None
+        return self._fold(node)
+
+    def need_const(self, ref, what):
+        v = self.const(ref)
+        if v is None:
+            raise TFImportError(
+                f"{what} must be statically resolvable, but {ref!r} is not "
+                "constant-foldable")
+        return v
+
+    def shape(self, ref):
+        node, idx = _ref(ref)
+        key = f"{node}:{idx}"
+        if key not in self.shapes:
+            raise TFImportError(f"no static shape for {ref!r}")
+        return self.shapes[key]
+
+    def dtype(self, ref):
+        node, idx = _ref(ref)
+        return self.dtypes.get(f"{node}:{idx}", np.dtype(np.float32))
+
+    # -- emission ------------------------------------------------------------
+
+    def bind(self, node_name, var, shape, dtype, out_idx=0):
+        key = f"{node_name}:{out_idx}"
+        self.vars[key] = var
+        self.shapes[key] = tuple(int(s) for s in shape)
+        self.dtypes[key] = np.dtype(dtype)
+        return var
+
+    def emit(self, node, fn_name, in_refs, attrs=None, out_dtype=None,
+             out_idx_base=0):
+        """Emit one SameDiff op; static shape via jax.eval_shape."""
+        import jax
+
+        from deeplearning4j_tpu.autodiff.ops import OPS
+
+        in_vars = [self.var(r) for r in in_refs]
+        structs = [jax.ShapeDtypeStruct(self.shape(r), self.dtype(r))
+                   for r in in_refs]
+        attrs = {k: v for k, v in (attrs or {}).items() if v is not None}
+        out_struct = jax.eval_shape(lambda *a: OPS[fn_name](*a, **attrs),
+                                    *structs)
+        multi = isinstance(out_struct, (tuple, list))
+        n_out = len(out_struct) if multi else 1
+        res = self.sd._op(fn_name, in_vars, attrs, node.name, n_out=n_out)
+        outs = res if multi else (res,)
+        structs_out = out_struct if multi else (out_struct,)
+        for i, (v, st) in enumerate(zip(outs, structs_out)):
+            self.bind(node.name, v, st.shape,
+                      out_dtype or st.dtype, out_idx=i)
+        return res
+
+    # -- host constant folding ----------------------------------------------
+
+    def _fold(self, node_name, _depth=0):
+        """numpy value of node_name if computable on the host (memoized)."""
+        if node_name in self.consts:
+            return self.consts[node_name]
+        if _depth > 64:
+            return None
+        node = self.nodes.get(node_name)
+        if node is None:
+            return None
+        ins = self.data_inputs(node)
+
+        def rec(ref):
+            src, idx = _ref(ref)
+            if idx != 0:
+                return None
+            return self._fold(src, _depth + 1)
+
+        val = None
+        op = node.op
+        if op in ("Identity", "StopGradient", "PreventGradient"):
+            val = rec(ins[0])
+        elif op in ("Shape", "Size", "Rank"):
+            key = f"{_ref(ins[0])[0]}:{_ref(ins[0])[1]}"
+            if key in self.shapes:
+                sh = self.shapes[key]
+                val = {"Shape": np.asarray(sh, np.int32),
+                       "Size": np.asarray(int(np.prod(sh)), np.int32),
+                       "Rank": np.asarray(len(sh), np.int32)}[op]
+        elif op in ("Pack", "ConcatV2", "Add", "AddV2", "Sub", "Mul",
+                    "Cast", "Range", "StridedSlice", "Reshape", "Squeeze",
+                    "ExpandDims", "Prod", "Maximum", "Minimum", "Floor",
+                    "GatherV2", "Neg", "RealDiv", "FloorDiv"):
+            vals = [rec(r) for r in ins]
+            if all(v is not None for v in vals):
+                val = self._fold_compute(node, vals)
+        if val is not None:
+            self.consts[node_name] = val
+        return val
+
+    @staticmethod
+    def _fold_compute(node, vals):
+        op = node.op
+        if op == "Pack":
+            return np.stack(vals, axis=node.attrs.get("axis").i
+                            if "axis" in node.attrs else 0)
+        if op == "ConcatV2":
+            axis = int(vals[-1])
+            return np.concatenate(vals[:-1], axis=axis)
+        if op in ("Add", "AddV2"):
+            return vals[0] + vals[1]
+        if op == "Sub":
+            return vals[0] - vals[1]
+        if op == "Mul":
+            return vals[0] * vals[1]
+        if op == "RealDiv":
+            return vals[0] / vals[1]
+        if op == "FloorDiv":
+            return vals[0] // vals[1]
+        if op == "Maximum":
+            return np.maximum(vals[0], vals[1])
+        if op == "Minimum":
+            return np.minimum(vals[0], vals[1])
+        if op == "Floor":
+            return np.floor(vals[0])
+        if op == "Neg":
+            return -vals[0]
+        if op == "Cast":
+            return np.asarray(
+                vals[0], dtype_to_numpy(node.attrs["DstT"].type))
+        if op == "Range":
+            return np.arange(int(vals[0]), int(vals[1]), int(vals[2]),
+                             dtype=np.int32)
+        if op == "StridedSlice":
+            return _apply_strided_slice(node, vals[0], vals[1], vals[2],
+                                        vals[3])[0]
+        if op == "Reshape":
+            return np.reshape(vals[0], [int(s) for s in vals[1]])
+        if op == "Squeeze":
+            dims = [d.i if hasattr(d, "i") else int(d) for d in
+                    (node.attrs.get("squeeze_dims").list["i"]
+                     if "squeeze_dims" in node.attrs else [])]
+            return np.squeeze(vals[0], axis=tuple(dims) if dims else None)
+        if op == "ExpandDims":
+            return np.expand_dims(vals[0], int(vals[1]))
+        if op == "Prod":
+            return np.prod(vals[0], axis=tuple(np.atleast_1d(vals[1])))
+        if op == "GatherV2":
+            axis = int(vals[2]) if len(vals) > 2 else 0
+            return np.take(vals[0], np.asarray(vals[1], np.int64), axis=axis)
+        return None
+
+
+def _apply_strided_slice(node, x, begin, end, strides):
+    """numpy semantics of TF StridedSlice incl. masks. Returns (result,
+    py_slices) — py_slices reusable for the symbolic path."""
+    begin = np.atleast_1d(begin).astype(np.int64)
+    end = np.atleast_1d(end).astype(np.int64)
+    strides = (np.atleast_1d(strides).astype(np.int64) if strides is not None
+               else np.ones_like(begin))
+    get = lambda a: node.attrs[a].i if a in node.attrs else 0  # noqa: E731
+    bm, em = get("begin_mask"), get("end_mask")
+    sm, nm = get("shrink_axis_mask"), get("new_axis_mask")
+    if get("ellipsis_mask"):
+        raise TFImportError("StridedSlice ellipsis_mask unsupported")
+    idx = []
+    for i in range(len(begin)):
+        if nm & (1 << i):
+            idx.append(None)  # np.newaxis
+            continue
+        if sm & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if bm & (1 << i) else int(begin[i])
+        e = None if em & (1 << i) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return np.asarray(x)[tuple(idx)], idx
+
+
+# ---------------------------------------------------------------------------
+# per-op handlers
+# ---------------------------------------------------------------------------
+
+_HANDLERS = {}
+
+
+def handler(*names):
+    def deco(fn):
+        for n in names:
+            _HANDLERS[n] = fn
+        return fn
+
+    return deco
+
+
+@handler("Const")
+def _h_const(im, node):
+    arr = node.attrs["value"].tensor.to_numpy()
+    im.consts[node.name] = np.asarray(arr)
+    v = im.sd.constant(node.name, np.asarray(arr))
+    im.bind(node.name, v, arr.shape, arr.dtype)
+
+
+@handler("Placeholder", "PlaceholderWithDefault")
+def _h_placeholder(im, node):
+    dt = dtype_to_numpy(node.attrs["dtype"].type)
+    shape = None
+    if "shape" in node.attrs and node.attrs["shape"].shape is not None \
+            and not node.attrs["shape"].shape.unknown_rank:
+        shape = [int(d) if d is not None else -1
+                 for d in node.attrs["shape"].shape.dims]
+    if node.name in im.placeholder_shapes:
+        given = [int(d) for d in im.placeholder_shapes[node.name]]
+        if shape is not None and len(given) != len(shape):
+            raise TFImportError(
+                f"placeholder_shapes[{node.name!r}] rank {len(given)} != "
+                f"recorded rank {len(shape)}")
+        shape = given
+    if shape is None or any(d is None or d < 0 for d in shape):
+        # Do NOT fabricate unknown dims: Shape const-folding would bake
+        # them into every downstream Reshape (silently wrong at runtime).
+        raise TFImportError(
+            f"placeholder {node.name!r} has unknown dims {shape}; pass "
+            "concrete shapes via importGraph(..., placeholder_shapes="
+            "{name: shape}) — the import specializes the graph to them "
+            "(re-import to run a different batch size)")
+    v = im.sd.placeHolder(node.name, jnp.dtype(dt), *shape)
+    im.bind(node.name, v, shape, dt)
+
+
+@handler("Identity", "StopGradient", "PreventGradient", "Snapshot")
+def _h_identity(im, node):
+    ref = im.data_inputs(node)[0]
+    src = im.var(ref)
+    im.bind(node.name, src, im.shape(ref), im.dtype(ref))
+
+
+@handler("NoOp", "Assert")
+def _h_noop(im, node):
+    pass
+
+
+_UNARY = {
+    "Relu": "relu", "Relu6": "relu6", "Elu": "elu", "Selu": "selu",
+    "Softplus": "softplus", "Softsign": "softsign", "Tanh": "tanh",
+    "Sigmoid": "sigmoid", "Erf": "erf", "Exp": "exp", "Log": "log",
+    "Log1p": "log1p", "Neg": "neg", "Sqrt": "sqrt", "Rsqrt": "rsqrt",
+    "Square": "square", "Abs": "abs", "Sign": "sign", "Floor": "floor",
+    "Ceil": "ceil", "Round": "round", "Sin": "sin", "Cos": "cos",
+    "Tan": "tan", "Asin": "asin", "Acos": "acos", "Atan": "atan",
+    "Sinh": "sinh", "Cosh": "cosh", "Reciprocal": "reciprocal",
+    "IsNan": "isnan", "IsInf": "isinf", "LogicalNot": "not_op",
+}
+
+
+@handler(*_UNARY)
+def _h_unary(im, node):
+    im.emit(node, _UNARY[node.op], im.data_inputs(node))
+
+
+_BINARY = {
+    "Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul",
+    "RealDiv": "div", "Div": "div", "FloorDiv": "floordiv",
+    "Pow": "pow", "Maximum": "maximum", "Minimum": "minimum",
+    "SquaredDifference": "squaredDifference", "FloorMod": "mod",
+    "Equal": "eq", "NotEqual": "neq", "Greater": "gt",
+    "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
+    "LogicalAnd": "and_op", "LogicalOr": "or_op",
+}
+
+
+@handler(*_BINARY)
+def _h_binary(im, node):
+    im.emit(node, _BINARY[node.op], im.data_inputs(node))
+
+
+@handler("AddN")
+def _h_addn(im, node):
+    ins = im.data_inputs(node)
+    ref = ins[0]
+    acc = im.var(ref)
+    if len(ins) == 1:
+        im.bind(node.name, acc, im.shape(ref), im.dtype(ref))
+        return
+    for i, nxt in enumerate(ins[1:]):
+        last = i == len(ins) - 2
+        nm = node.name if last else f"{node.name}__addn{i}"
+        acc = im.sd._op("add", [acc, im.var(nxt)], {}, nm)
+    im.bind(node.name, acc, im.shape(ref), im.dtype(ref))
+
+
+@handler("MatMul")
+def _h_matmul(im, node):
+    a = node.attrs.get("transpose_a")
+    b = node.attrs.get("transpose_b")
+    im.emit(node, "matmul", im.data_inputs(node),
+            {"transposeA": bool(a.b) if a else False,
+             "transposeB": bool(b.b) if b else False})
+
+
+@handler("BatchMatMul", "BatchMatMulV2")
+def _h_batch_matmul(im, node):
+    adj_x = node.attrs.get("adj_x")
+    adj_y = node.attrs.get("adj_y")
+    im.emit(node, "matmul", im.data_inputs(node),
+            {"transposeA": bool(adj_x.b) if adj_x else False,
+             "transposeB": bool(adj_y.b) if adj_y else False})
+
+
+@handler("BiasAdd")
+def _h_bias_add(im, node):
+    fmt = node.attrs.get("data_format")
+    ins = im.data_inputs(node)
+    if fmt is not None and fmt.s == b"NCHW":
+        x_shape = im.shape(ins[0])
+        bshape = [1] * len(x_shape)
+        bshape[1] = x_shape[1]
+        b = im.sd._op("reshape", [im.var(ins[1])],
+                      {"shape": bshape}, f"{node.name}__b")
+        im.bind(f"{node.name}__b", b, bshape, im.dtype(ins[1]))
+        im.emit(node, "add", [ins[0], f"{node.name}__b:0"])
+        return
+    im.emit(node, "add", ins)
+
+
+@handler("Softmax")
+def _h_softmax(im, node):
+    im.emit(node, "softmax", im.data_inputs(node), {"dimension": -1})
+
+
+@handler("LogSoftmax")
+def _h_log_softmax(im, node):
+    im.emit(node, "logSoftmax", im.data_inputs(node), {"dimension": -1})
+
+
+_REDUCTIONS = {"Mean": "mean", "Sum": "sum", "Max": "max", "Min": "min",
+               "Prod": "prod", "All": "all", "Any": "any"}
+
+
+@handler(*_REDUCTIONS)
+def _h_reduce(im, node):
+    ins = im.data_inputs(node)
+    axes = im.need_const(ins[1], f"{node.op} reduction indices")
+    keep = node.attrs.get("keep_dims")
+    rank = len(im.shape(ins[0]))
+    dims = [int(a) % rank for a in np.atleast_1d(axes)]
+    im.emit(node, _REDUCTIONS[node.op], [ins[0]],
+            {"dimensions": dims, "keepDims": bool(keep.b) if keep else False})
+
+
+@handler("ArgMax", "ArgMin")
+def _h_argmax(im, node):
+    ins = im.data_inputs(node)
+    axis = int(im.need_const(ins[1], "ArgMax axis")) if len(ins) > 1 else 0
+    im.emit(node, "_argmax" if node.op == "ArgMax" else "_argmin", [ins[0]],
+            {"dim": axis}, out_dtype=np.int64)
+
+
+@handler("Reshape")
+def _h_reshape(im, node):
+    ins = im.data_inputs(node)
+    target = [int(s) for s in
+              im.need_const(ins[1], "Reshape shape")]
+    in_shape = im.shape(ins[0])
+    if -1 in target:
+        known = int(np.prod([s for s in target if s != -1]))
+        total = int(np.prod(in_shape))
+        target[target.index(-1)] = total // max(known, 1)
+    im.emit(node, "reshape", [ins[0]], {"shape": target})
+
+
+@handler("Transpose")
+def _h_transpose(im, node):
+    ins = im.data_inputs(node)
+    perm = [int(p) for p in im.need_const(ins[1], "Transpose perm")]
+    im.emit(node, "permute", [ins[0]], {"dimensions": perm})
+
+
+@handler("ExpandDims")
+def _h_expand_dims(im, node):
+    ins = im.data_inputs(node)
+    axis = int(im.need_const(ins[1], "ExpandDims axis"))
+    im.emit(node, "expandDims", [ins[0]], {"axis": axis})
+
+
+@handler("Squeeze")
+def _h_squeeze(im, node):
+    ins = im.data_inputs(node)
+    dims = None
+    if "squeeze_dims" in node.attrs:
+        lst = node.attrs["squeeze_dims"].list
+        if lst and lst["i"]:
+            dims = tuple(int(i) for i in lst["i"])
+    im.emit(node, "squeeze", ins, {"axis": dims})
+
+
+@handler("ConcatV2")
+def _h_concat(im, node):
+    ins = im.data_inputs(node)
+    axis = int(im.need_const(ins[-1], "ConcatV2 axis"))
+    im.emit(node, "concat", ins[:-1], {"dimension": axis})
+
+
+@handler("Pack")
+def _h_pack(im, node):
+    axis = node.attrs["axis"].i if "axis" in node.attrs else 0
+    im.emit(node, "stack", im.data_inputs(node), {"axis": int(axis)})
+
+
+@handler("Unpack")
+def _h_unpack(im, node):
+    axis = node.attrs["axis"].i if "axis" in node.attrs else 0
+    num = node.attrs["num"].i
+    im.emit(node, "unstack", im.data_inputs(node),
+            {"axis": int(axis), "num": int(num)})
+
+
+@handler("Split")
+def _h_split(im, node):
+    ins = im.data_inputs(node)  # [axis, value]
+    axis = int(im.need_const(ins[0], "Split axis"))
+    num = int(node.attrs["num_split"].i)
+    im.emit(node, "split", [ins[1]],
+            {"numSplit": num, "dimension": axis})
+
+
+@handler("StridedSlice")
+def _h_strided_slice(im, node):
+    ins = im.data_inputs(node)
+    begin = im.need_const(ins[1], "StridedSlice begin")
+    end = im.need_const(ins[2], "StridedSlice end")
+    strides = im.need_const(ins[3], "StridedSlice strides") \
+        if len(ins) > 3 else None
+    in_shape = im.shape(ins[0])
+    probe = np.zeros(in_shape, np.int8)
+    _, idx = _apply_strided_slice(node, probe, begin, end, strides)
+
+    from deeplearning4j_tpu.autodiff.ops import OPS, op as _op_reg  # noqa
+
+    key = "tfStridedSlice"
+    if key not in OPS:
+        OPS[key] = lambda x, idx=None: x[tuple(
+            (np.newaxis if i is None else
+             (slice(*i) if isinstance(i, (list, tuple)) else i))
+            for i in idx)]
+    ser = [None if i is None else
+           ([i.start, i.stop, i.step] if isinstance(i, slice) else int(i))
+           for i in idx]
+    im.emit(node, key, [ins[0]], {"idx": tuple(
+        tuple(s) if isinstance(s, list) else s for s in ser)})
+
+
+@handler("Slice")
+def _h_slice(im, node):
+    ins = im.data_inputs(node)
+    begin = [int(b) for b in im.need_const(ins[1], "Slice begin")]
+    size = [int(s) for s in im.need_const(ins[2], "Slice size")]
+    im.emit(node, "slice", [ins[0]], {"begin": begin, "size": size})
+
+
+@handler("Gather", "GatherV2")
+def _h_gather(im, node):
+    ins = im.data_inputs(node)
+    axis = 0
+    if node.op == "GatherV2" and len(ins) > 2:
+        axis = int(im.need_const(ins[2], "GatherV2 axis"))
+    im.emit(node, "gather", ins[:2], {"axis": axis})
+
+
+@handler("GatherNd")
+def _h_gather_nd(im, node):
+    im.emit(node, "gatherNd", im.data_inputs(node))
+
+
+@handler("OneHot")
+def _h_one_hot(im, node):
+    ins = im.data_inputs(node)
+    depth = int(im.need_const(ins[1], "OneHot depth"))
+    on = float(im.need_const(ins[2], "OneHot on_value"))
+    off = float(im.need_const(ins[3], "OneHot off_value"))
+    axis = node.attrs["axis"].i if "axis" in node.attrs else -1
+    im.emit(node, "oneHot", [ins[0]],
+            {"depth": depth, "on": on, "off": off, "axis": int(axis)})
+
+
+@handler("Cast")
+def _h_cast(im, node):
+    dt = dtype_to_numpy(node.attrs["DstT"].type)
+    im.emit(node, "cast", im.data_inputs(node), {"dtype": jnp.dtype(dt)},
+            out_dtype=dt)
+
+
+@handler("Shape", "Size", "Rank")
+def _h_shape(im, node):
+    ins = im.data_inputs(node)
+    val = im._fold(node.name)
+    if val is None:
+        sh = im.shape(ins[0])
+        val = {"Shape": np.asarray(sh, np.int32),
+               "Size": np.asarray(int(np.prod(sh)), np.int32),
+               "Rank": np.asarray(len(sh), np.int32)}[node.op]
+        im.consts[node.name] = val
+    v = im.sd.constant(node.name, val)
+    im.bind(node.name, v, np.asarray(val).shape, np.asarray(val).dtype)
+
+
+@handler("Range")
+def _h_range(im, node):
+    val = im._fold(node.name)
+    if val is None:
+        raise TFImportError(f"Range node {node.name!r} with non-constant "
+                            "inputs")
+    v = im.sd.constant(node.name, val)
+    im.bind(node.name, v, val.shape, val.dtype)
+
+
+@handler("Fill")
+def _h_fill(im, node):
+    ins = im.data_inputs(node)
+    dims = [int(d) for d in im.need_const(ins[0], "Fill dims")]
+    value = im.need_const(ins[1], "Fill value")
+    arr = np.full(dims, value)
+    im.consts[node.name] = arr
+    v = im.sd.constant(node.name, arr)
+    im.bind(node.name, v, arr.shape, arr.dtype)
+
+
+@handler("Tile")
+def _h_tile(im, node):
+    ins = im.data_inputs(node)
+    reps = [int(r) for r in im.need_const(ins[1], "Tile multiples")]
+    im.emit(node, "tile", [ins[0]], {"reps": reps})
+
+
+@handler("Pad", "PadV2")
+def _h_pad(im, node):
+    ins = im.data_inputs(node)
+    pads = [[int(a), int(b)] for a, b in
+            im.need_const(ins[1], "Pad paddings")]
+    const = 0.0
+    if node.op == "PadV2" and len(ins) > 2:
+        const = float(im.need_const(ins[2], "PadV2 constant"))
+    im.emit(node, "pad", [ins[0]], {"paddings": pads, "constant": const})
+
+
+@handler("Select", "SelectV2")
+def _h_select(im, node):
+    im.emit(node, "where_op", im.data_inputs(node))
+
+
+@handler("Conv2D")
+def _h_conv2d(im, node):
+    ins = im.data_inputs(node)
+    fmt = node.attrs.get("data_format")
+    nhwc = fmt is None or fmt.s in (b"NHWC", None)
+    strides = [int(s) for s in node.attrs["strides"].list["i"]]
+    pad = node.attrs["padding"].s.decode()
+    dil = [int(d) for d in node.attrs["dilations"].list["i"]] \
+        if "dilations" in node.attrs else [1, 1, 1, 1]
+    x_ref = ins[0]
+    if nhwc:
+        x_ref = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+        s_hw, d_hw = (strides[1], strides[2]), (dil[1], dil[2])
+    else:
+        s_hw, d_hw = (strides[2], strides[3]), (dil[2], dil[3])
+    # TF kernel HWIO -> our OIHW
+    w_ref = _permute(im, node, ins[1], (3, 2, 0, 1), "__oihw")
+    attrs = {"strides": s_hw,
+             "dilation": d_hw,
+             "sameMode": pad == "SAME",
+             "padding": (0, 0)}
+    out_name = node.name if not nhwc else f"{node.name}__conv"
+    conv = im.sd._op("conv2d", [im.var(x_ref), im.var(w_ref)], attrs,
+                     out_name)
+    import jax
+
+    from deeplearning4j_tpu.autodiff.ops import OPS
+
+    st = jax.eval_shape(
+        lambda x, w: OPS["conv2d"](x, w, **attrs),
+        jax.ShapeDtypeStruct(im.shape(x_ref), im.dtype(x_ref)),
+        jax.ShapeDtypeStruct(im.shape(w_ref), im.dtype(w_ref)))
+    im.bind(out_name, conv, st.shape, st.dtype)
+    if nhwc:
+        _permute(im, node, f"{out_name}:0", (0, 2, 3, 1), "", node.name)
+
+
+@handler("MaxPool", "AvgPool")
+def _h_pool(im, node):
+    ins = im.data_inputs(node)
+    fmt = node.attrs.get("data_format")
+    nhwc = fmt is None or fmt.s in (b"NHWC", None)
+    ks = [int(s) for s in node.attrs["ksize"].list["i"]]
+    st = [int(s) for s in node.attrs["strides"].list["i"]]
+    pad = node.attrs["padding"].s.decode()
+    x_ref = ins[0]
+    if nhwc:
+        x_ref = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+        k_hw, s_hw = (ks[1], ks[2]), (st[1], st[2])
+    else:
+        k_hw, s_hw = (ks[2], ks[3]), (st[2], st[3])
+    fn = "maxPooling2d" if node.op == "MaxPool" else "avgPooling2d"
+    out_name = node.name if not nhwc else f"{node.name}__pool"
+    attrs = {"kernel": k_hw, "strides": s_hw, "sameMode": pad == "SAME",
+             "padding": (0, 0)}
+    import jax
+
+    from deeplearning4j_tpu.autodiff.ops import OPS
+
+    v = im.sd._op(fn, [im.var(x_ref)], attrs, out_name)
+    sh = jax.eval_shape(lambda x: OPS[fn](x, **attrs),
+                        jax.ShapeDtypeStruct(im.shape(x_ref),
+                                             im.dtype(x_ref)))
+    im.bind(out_name, v, sh.shape, sh.dtype)
+    if nhwc:
+        _permute(im, node, f"{out_name}:0", (0, 2, 3, 1), "", node.name)
+
+
+@handler("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _h_fused_bn(im, node):
+    ins = im.data_inputs(node)  # x, scale, offset, mean, variance
+    fmt = node.attrs.get("data_format")
+    nhwc = fmt is None or fmt.s in (b"NHWC", None)
+    eps = node.attrs["epsilon"].f if "epsilon" in node.attrs else 1e-3
+    axis = 3 if nhwc else 1
+    im.emit(node, "batchNorm",
+            [ins[0], ins[3], ins[4], ins[1], ins[2]],
+            {"epsilon": float(eps), "axis": axis})
+
+
+def _permute(im, node, ref, perm, suffix, out_name=None):
+    """Emit a permute helper node; returns the new tensor ref string."""
+    import jax
+
+    from deeplearning4j_tpu.autodiff.ops import OPS
+
+    name = out_name or f"{node.name}{suffix}"
+    v = im.sd._op("permute", [im.var(ref)],
+                  {"dimensions": tuple(perm)}, name)
+    sh = jax.eval_shape(
+        lambda x: OPS["permute"](x, dimensions=tuple(perm)),
+        jax.ShapeDtypeStruct(im.shape(ref), im.dtype(ref)))
+    im.bind(name, v, sh.shape, sh.dtype)
+    return f"{name}:0"

@@ -65,6 +65,12 @@ class INDArray:
 
     numpy = toNumpy  # pythonic alias
 
+    def __array__(self, dtype=None, copy=None):
+        # without this, np.asarray(ind) falls back to the sequence
+        # protocol and loops forever issuing one-element device gathers
+        a = np.asarray(self._arr)
+        return a.astype(dtype) if dtype is not None else a
+
     def _set(self, new_arr) -> "INDArray":
         """Rebind this handle; views write back through the parent chain."""
         cur = self._arr

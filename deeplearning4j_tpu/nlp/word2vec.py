@@ -126,6 +126,10 @@ class Word2Vec:
         def negative(self, n):
             return self.negativeSampling(n)
 
+        def negativeSample(self, n):
+            # DL4J name: Word2Vec.Builder#negativeSample(double)
+            return self.negativeSampling(n)
+
         def learningRate(self, lr):
             self._kw["learningRate"] = lr
             return self
@@ -218,11 +222,23 @@ class Word2Vec:
 
     def _make_pairs(self, encoded, rng):
         win = self.cfg["windowSize"]
+        # reference-style reduced window: b ~ U[1, win] per center; drawn
+        # up front so the native and Python paths see identical draws
+        n_tokens = sum(len(s) for s in encoded)
+        bs_all = rng.integers(1, win + 1, n_tokens).astype(np.int32)
+
+        from deeplearning4j_tpu import native
+
+        if native.available():
+            pairs = native.sg_pairs(encoded, bs_all)
+            if pairs is not None:
+                return pairs
         centers, contexts = [], []
+        off = 0
         for idxs in encoded:
             n = len(idxs)
-            # reference-style reduced window: b ~ U[1, win] per center
-            bs = rng.integers(1, win + 1, n)
+            bs = bs_all[off:off + n]
+            off += n
             for pos in range(n):
                 b = bs[pos]
                 lo, hi = max(0, pos - b), min(n, pos + b + 1)

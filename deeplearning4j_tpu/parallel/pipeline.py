@@ -50,10 +50,10 @@ def pipeline_apply(stage_fn, stage_params, x_mb, mesh):
     n_stages = mesh.shape.get(PIPE_AXIS, 1)
     if n_stages == 1:
         def seq(params, x):
-            s = params and jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            s = jax.tree_util.tree_leaves(params)[0].shape[0]
             y = x
             for i in range(s):
-                p_i = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+                p_i = jax.tree_util.tree_map(lambda a: a[i], params)
                 y = stage_fn(p_i, y)
             return y
         return jax.vmap(lambda mb: seq(stage_params, mb))(x_mb)

@@ -1,0 +1,172 @@
+"""Keras HDF5 import conformance tests.
+
+Fixtures are Keras-2.x-layout HDF5 files written directly with h5py
+(Keras/TF are not installed — same golden-file strategy as the TF
+GraphDef tests): `model_config` JSON attr + `model_weights` groups with
+`weight_names` attrs. Reference: deeplearning4j-modelimport
+KerasModelImport + KerasSequentialModel tests (SURVEY.md §2.7)."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import KerasModelImport
+from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+
+def _write_h5(path, model_config, layer_weights):
+    """layer_weights: {layer_name: [(weight_name, array), ...]}"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        mw = f.create_group("model_weights")
+        for lname, pairs in layer_weights.items():
+            g = mw.create_group(lname)
+            names = []
+            for wn, arr in pairs:
+                full = f"{lname}/{wn}"
+                g.create_dataset(full, data=arr)
+                names.append(full.encode())
+            g.attrs["weight_names"] = names
+
+
+def _dense_cfg(name, units, activation, input_shape=None):
+    cfg = {"name": name, "units": units, "activation": activation,
+           "use_bias": True}
+    if input_shape is not None:
+        cfg["batch_input_shape"] = [None] + list(input_shape)
+    return {"class_name": "Dense", "config": cfg}
+
+
+class TestSequentialMLP:
+    def _fixture(self, tmp_path):
+        rng = np.random.default_rng(0)
+        w1 = rng.normal(size=(8, 16)).astype(np.float32)
+        b1 = rng.normal(size=(16,)).astype(np.float32)
+        w2 = rng.normal(size=(16, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            _dense_cfg("d1", 16, "relu", input_shape=[8]),
+            _dense_cfg("d2", 3, "softmax"),
+        ]}}
+        p = tmp_path / "mlp.h5"
+        _write_h5(p, cfg, {
+            "d1": [("kernel:0", w1), ("bias:0", b1)],
+            "d2": [("kernel:0", w2), ("bias:0", b2)]})
+        return str(p), (w1, b1, w2, b2)
+
+    def test_forward_matches_numpy(self, tmp_path):
+        path, (w1, b1, w2, b2) = self._fixture(tmp_path)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(path)
+        assert isinstance(net, MultiLayerNetwork)
+        x = np.random.default_rng(1).normal(size=(5, 8)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_imported_model_is_trainable(self, tmp_path):
+        path, _ = self._fixture(tmp_path)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(path)
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        s0 = float(net.score((X, y)))
+        net.fit([(X, y)], 5)
+        assert float(net.score((X, y))) < s0
+
+
+class TestSequentialCNN:
+    def test_conv_pool_dense(self, tmp_path):
+        rng = np.random.default_rng(0)
+        wc = rng.normal(size=(3, 3, 1, 4)).astype(np.float32) * 0.2  # HWIO
+        bc = np.zeros(4, np.float32)
+        wd = rng.normal(size=(4 * 13 * 13, 5)).astype(np.float32) * 0.05
+        bd = np.zeros(5, np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Conv2D", "config": {
+                "name": "c1", "filters": 4, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "valid", "activation": "relu",
+                "use_bias": True,
+                "batch_input_shape": [None, 28, 28, 1]}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "p1", "pool_size": [2, 2], "strides": [2, 2]}},
+            {"class_name": "Flatten", "config": {"name": "f1"}},
+            _dense_cfg("out", 5, "softmax"),
+        ]}}
+        p = tmp_path / "cnn.h5"
+        _write_h5(p, cfg, {
+            "c1": [("kernel:0", wc), ("bias:0", bc)],
+            "out": [("kernel:0", wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        x = rng.normal(size=(2, 1, 28, 28)).astype(np.float32)  # NCHW
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+        # conv weights installed with HWIO->OIHW conversion
+        got = np.asarray(net.getParam(0, "W"))
+        np.testing.assert_allclose(got, wc.transpose(3, 2, 0, 1), rtol=1e-6)
+
+
+class TestFunctionalGraph:
+    def test_two_branch_concat(self, tmp_path):
+        rng = np.random.default_rng(0)
+        wa = rng.normal(size=(6, 4)).astype(np.float32)
+        ba = np.zeros(4, np.float32)
+        wb = rng.normal(size=(6, 4)).astype(np.float32)
+        bb = np.zeros(4, np.float32)
+        wo = rng.normal(size=(8, 2)).astype(np.float32)
+        bo = np.zeros(2, np.float32)
+        cfg = {"class_name": "Functional", "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in",
+                 "config": {"name": "in",
+                            "batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "a",
+                 "config": {"name": "a", "units": 4, "activation": "relu",
+                            "use_bias": True},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "b",
+                 "config": {"name": "b", "units": 4, "activation": "tanh",
+                            "use_bias": True},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "name": "cat",
+                 "config": {"name": "cat"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 2,
+                            "activation": "softmax", "use_bias": True},
+                 "inbound_nodes": [[["cat", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        }}
+        p = tmp_path / "func.h5"
+        _write_h5(p, cfg, {
+            "a": [("kernel:0", wa), ("bias:0", ba)],
+            "b": [("kernel:0", wb), ("bias:0", bb)],
+            "out": [("kernel:0", wo), ("bias:0", bo)]})
+        net = KerasModelImport.importKerasModelAndWeights(str(p))
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        out = np.asarray(net.output(x)[0])  # one array per graph output
+        ha = np.maximum(x @ wa + ba, 0)
+        hb = np.tanh(x @ wb + bb)
+        logits = np.concatenate([ha, hb], -1) @ wo + bo
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestErrors:
+    def test_functional_rejected_by_sequential_entry(self, tmp_path):
+        cfg = {"class_name": "Functional",
+               "config": {"layers": [], "input_layers": [],
+                          "output_layers": []}}
+        p = tmp_path / "f.h5"
+        _write_h5(p, cfg, {})
+        with pytest.raises(ValueError, match="not a Sequential"):
+            KerasModelImport.importKerasSequentialModelAndWeights(str(p))

@@ -1,0 +1,373 @@
+"""TF GraphDef import conformance tests.
+
+SURVEY.md §4 golden-file strategy: fixtures are GraphDefs constructed
+with the in-repo protobuf encoder (TensorFlow itself is not installed),
+imported through TFGraphMapper, and checked against independent numpy
+math. Reference: org.nd4j.imports.graphmapper.tf.TFGraphMapper and the
+nd4j-tests TFGraphTestAllSameDiff suite."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.protobuf import (
+    AttrValue, GraphDef, NodeDef, TensorShapeProto, attr_b, attr_f, attr_i,
+    attr_ilist, attr_s, attr_shape, attr_tensor, attr_type)
+from deeplearning4j_tpu.modelimport.tensorflow import (
+    TFGraphMapper, TFImportError)
+
+F32 = attr_type(np.float32)
+
+
+def const(name, arr):
+    arr = np.asarray(arr)
+    return NodeDef(name, "Const", [], {
+        "dtype": attr_type(arr.dtype), "value": attr_tensor(arr)})
+
+
+def placeholder(name, shape, dtype=np.float32):
+    return NodeDef(name, "Placeholder", [], {
+        "dtype": attr_type(dtype), "shape": attr_shape(shape)})
+
+
+class TestMLPImport:
+    def _graph(self):
+        rng = np.random.default_rng(0)
+        w1 = rng.normal(size=(8, 16)).astype(np.float32)
+        b1 = rng.normal(size=(16,)).astype(np.float32)
+        w2 = rng.normal(size=(16, 10)).astype(np.float32)
+        b2 = rng.normal(size=(10,)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [4, 8]),
+            const("w1", w1), const("b1", b1),
+            const("w2", w2), const("b2", b2),
+            NodeDef("mm1", "MatMul", ["x", "w1"],
+                    {"transpose_a": attr_b(False),
+                     "transpose_b": attr_b(False), "T": F32}),
+            NodeDef("ba1", "BiasAdd", ["mm1", "b1"], {"T": F32}),
+            NodeDef("relu", "Relu", ["ba1"], {"T": F32}),
+            NodeDef("mm2", "MatMul", ["relu", "w2"], {"T": F32}),
+            NodeDef("ba2", "BiasAdd", ["mm2", "b2"], {"T": F32}),
+            NodeDef("probs", "Softmax", ["ba2"], {"T": F32}),
+        ])
+        return gd, (w1, b1, w2, b2)
+
+    def test_forward_matches_numpy(self):
+        gd, (w1, b1, w2, b2) = self._graph()
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+        out = sd.output({"x": x}, "probs")["probs"].numpy()
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        expect = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+    def test_roundtrip_through_file(self, tmp_path):
+        gd, _ = self._graph()
+        p = tmp_path / "model.pb"
+        gd.save(p)
+        sd = TFGraphMapper.importGraph(str(p))
+        x = np.zeros((4, 8), np.float32)
+        assert sd.output({"x": x}, "probs")["probs"].shape() == (4, 10)
+
+    def test_imported_graph_is_differentiable(self):
+        gd, _ = self._graph()
+        sd = TFGraphMapper.importGraph(gd)
+        # attach a scalar loss on top of the imported graph
+        loss = sd.getVariable("probs").sum()
+        loss.markAsLoss()
+        x = np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32)
+        g = sd.calculateGradients({"x": x}, "x")["x"].numpy()
+        assert g.shape == (4, 8)
+        assert np.isfinite(g).all()
+
+
+class TestShapeAndConstFolding:
+    def test_shape_pack_reshape_flatten(self):
+        """Reshape(x, Pack([StridedSlice(Shape(x)), -1])) — the dynamic
+        flatten idiom every frozen TF graph contains."""
+        gd = GraphDef([
+            placeholder("x", [2, 3, 4]),
+            NodeDef("shape", "Shape", ["x"], {"T": F32}),
+            const("zero", np.int32(0)),
+            const("one", np.int32(1)),
+            NodeDef("dim0", "StridedSlice", ["shape", "zero", "one", "one"],
+                    {"shrink_axis_mask": attr_i(1), "begin_mask": attr_i(0),
+                     "end_mask": attr_i(0)}),
+            const("minus1", np.int32(-1)),
+            NodeDef("target", "Pack", ["dim0", "minus1"],
+                    {"axis": attr_i(0), "N": attr_i(2)}),
+            NodeDef("flat", "Reshape", ["x", "target"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        out = sd.output({"x": x}, "flat")["flat"].numpy()
+        np.testing.assert_array_equal(out, x.reshape(2, 12))
+
+    def test_reductions_transpose_concat(self):
+        gd = GraphDef([
+            placeholder("x", [3, 4]),
+            const("axes", np.array([1], np.int32)),
+            NodeDef("m", "Mean", ["x", "axes"],
+                    {"keep_dims": attr_b(True), "T": F32}),
+            const("perm", np.array([1, 0], np.int32)),
+            NodeDef("xt", "Transpose", ["x", "perm"], {"T": F32}),
+            const("cax", np.int32(0)),
+            NodeDef("cat", "ConcatV2", ["xt", "xt", "cax"],
+                    {"N": attr_i(2), "T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        outs = sd.output({"x": x}, "m", "cat")
+        np.testing.assert_allclose(outs["m"].numpy(),
+                                   x.mean(1, keepdims=True), rtol=1e-6)
+        np.testing.assert_allclose(outs["cat"].numpy(),
+                                   np.concatenate([x.T, x.T], 0), rtol=1e-6)
+
+    def test_strided_slice_masks(self):
+        gd = GraphDef([
+            placeholder("x", [4, 6]),
+            const("b", np.array([1, 2], np.int32)),
+            const("e", np.array([3, 0], np.int32)),
+            const("s", np.array([1, 1], np.int32)),
+            NodeDef("ss", "StridedSlice", ["x", "b", "e", "s"],
+                    {"begin_mask": attr_i(0), "end_mask": attr_i(2),
+                     "shrink_axis_mask": attr_i(0)}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        out = sd.output({"x": x}, "ss")["ss"].numpy()
+        np.testing.assert_array_equal(out, x[1:3, 2:])
+
+    def test_gather_onehot_cast(self):
+        emb = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("ids", [3], np.int32),
+            const("emb", emb),
+            const("gax", np.int32(0)),
+            NodeDef("vecs", "GatherV2", ["emb", "ids", "gax"], {"T": F32}),
+            const("depth", np.int32(10)),
+            const("on", np.float32(1.0)),
+            const("off", np.float32(0.0)),
+            NodeDef("oh", "OneHot", ["ids", "depth", "on", "off"],
+                    {"axis": attr_i(-1)}),
+            NodeDef("ohf", "Cast", ["oh"],
+                    {"SrcT": F32, "DstT": attr_type(np.int32)}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        ids = np.array([1, 7, 3], np.int32)
+        outs = sd.output({"ids": ids}, "vecs", "ohf")
+        np.testing.assert_allclose(outs["vecs"].numpy(), emb[ids], rtol=1e-6)
+        np.testing.assert_array_equal(outs["ohf"].numpy(),
+                                      np.eye(10)[ids])
+
+    def test_unknown_batch_dim_requires_explicit_shape(self):
+        gd = GraphDef([
+            placeholder("x", [-1, 4]),
+            NodeDef("y", "Relu", ["x"], {"T": F32}),
+        ])
+        with pytest.raises(TFImportError, match="placeholder_shapes"):
+            TFGraphMapper.importGraph(gd)
+        sd = TFGraphMapper.importGraph(
+            gd, placeholder_shapes={"x": [3, 4]})
+        x = -np.ones((3, 4), np.float32)
+        assert sd.output({"x": x}, "y")["y"].numpy().max() == 0.0
+
+    def test_unsupported_op_raises(self):
+        gd = GraphDef([
+            placeholder("x", [2]),
+            NodeDef("z", "SomeExoticOp", ["x"], {}),
+        ])
+        with pytest.raises(TFImportError, match="SomeExoticOp"):
+            TFGraphMapper.importGraph(gd)
+
+
+def _mini_attention_graph(b, t, h, nh):
+    """Single-head-count frozen self-attention block, the BERT shape:
+    x -> qkv matmuls -> BatchMatMulV2 -> scale -> Softmax -> context."""
+    rng = np.random.default_rng(42)
+    hd = h // nh
+    wq = rng.normal(size=(h, h)).astype(np.float32) * 0.1
+    wk = rng.normal(size=(h, h)).astype(np.float32) * 0.1
+    wv = rng.normal(size=(h, h)).astype(np.float32) * 0.1
+    nodes = [placeholder("x", [b, t, h]),
+             const("wq", wq), const("wk", wk), const("wv", wv),
+             const("hshape", np.array([b, t, nh, hd], np.int32)),
+             const("perm", np.array([0, 2, 1, 3], np.int32)),
+             const("scale", np.float32(1.0 / np.sqrt(hd)))]
+
+    def proj(tag, w):
+        nodes.extend([
+            NodeDef(f"{tag}0", "BatchMatMulV2", ["x", w], {"T": F32}),
+            NodeDef(f"{tag}1", "Reshape", [f"{tag}0", "hshape"], {"T": F32}),
+            NodeDef(tag, "Transpose", [f"{tag}1", "perm"], {"T": F32}),
+        ])
+
+    proj("q", "wq")
+    proj("k", "wk")
+    proj("v", "wv")
+    nodes.extend([
+        NodeDef("scores0", "BatchMatMulV2", ["q", "k"],
+                {"adj_x": attr_b(False), "adj_y": attr_b(True), "T": F32}),
+        NodeDef("scores", "Mul", ["scores0", "scale"], {"T": F32}),
+        NodeDef("probs", "Softmax", ["scores"], {"T": F32}),
+        NodeDef("ctx", "BatchMatMulV2", ["probs", "v"], {"T": F32}),
+    ])
+    return GraphDef(nodes), (wq, wk, wv)
+
+
+class TestBertClassBlocks:
+    def test_self_attention_block(self):
+        b, t, h, nh = 2, 5, 8, 2
+        gd, (wq, wk, wv) = _mini_attention_graph(b, t, h, nh)
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.random.default_rng(3).normal(size=(b, t, h)) \
+            .astype(np.float32)
+        out = sd.output({"x": x}, "ctx")["ctx"].numpy()
+
+        hd = h // nh
+        q = (x @ wq).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = (x @ wk).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        v = (x @ wv).reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, p @ v, rtol=1e-4, atol=1e-5)
+
+    def test_layer_norm_decomposition(self):
+        """Frozen TF graphs express LayerNorm as Mean/SquaredDifference/
+        Rsqrt elementwise chains — exactly what a BERT GraphDef contains."""
+        h = 6
+        g = np.linspace(0.5, 1.5, h).astype(np.float32)
+        be = np.linspace(-0.1, 0.1, h).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [3, h]),
+            const("axes", np.array([1], np.int32)),
+            const("gamma", g), const("beta", be),
+            const("eps", np.float32(1e-6)),
+            NodeDef("mu", "Mean", ["x", "axes"],
+                    {"keep_dims": attr_b(True), "T": F32}),
+            NodeDef("sqd", "SquaredDifference", ["x", "mu"], {"T": F32}),
+            NodeDef("var", "Mean", ["sqd", "axes"],
+                    {"keep_dims": attr_b(True), "T": F32}),
+            NodeDef("veps", "AddV2", ["var", "eps"], {"T": F32}),
+            NodeDef("rstd", "Rsqrt", ["veps"], {"T": F32}),
+            NodeDef("xc", "Sub", ["x", "mu"], {"T": F32}),
+            NodeDef("xn", "Mul", ["xc", "rstd"], {"T": F32}),
+            NodeDef("xg", "Mul", ["xn", "gamma"], {"T": F32}),
+            NodeDef("y", "AddV2", ["xg", "beta"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.random.default_rng(4).normal(size=(3, h)).astype(np.float32)
+        out = sd.output({"x": x}, "y")["y"].numpy()
+        mu = x.mean(1, keepdims=True)
+        var = ((x - mu) ** 2).mean(1, keepdims=True)
+        expect = (x - mu) / np.sqrt(var + 1e-6) * g + be
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+    def test_gelu_erf_decomposition(self):
+        gd = GraphDef([
+            placeholder("x", [4]),
+            const("c", np.float32(1.0 / np.sqrt(2))),
+            const("half", np.float32(0.5)),
+            const("one", np.float32(1.0)),
+            NodeDef("xs", "Mul", ["x", "c"], {"T": F32}),
+            NodeDef("erf", "Erf", ["xs"], {"T": F32}),
+            NodeDef("erf1", "AddV2", ["erf", "one"], {"T": F32}),
+            NodeDef("xh", "Mul", ["x", "half"], {"T": F32}),
+            NodeDef("gelu", "Mul", ["xh", "erf1"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+        out = sd.output({"x": x}, "gelu")["gelu"].numpy()
+        from scipy.special import erf  # scipy ships with the image
+        expect = 0.5 * x * (1 + erf(x / np.sqrt(2)))
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestConvImport:
+    def test_nhwc_conv_bias_pool(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)  # HWIO
+        b = rng.normal(size=(4,)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [1, 8, 8, 2]),
+            const("w", w), const("b", b),
+            NodeDef("conv", "Conv2D", ["x", "w"],
+                    {"strides": attr_ilist([1, 1, 1, 1]),
+                     "padding": attr_s("SAME"),
+                     "data_format": attr_s("NHWC"), "T": F32}),
+            NodeDef("ba", "BiasAdd", ["conv", "b"],
+                    {"data_format": attr_s("NHWC"), "T": F32}),
+            NodeDef("act", "Relu", ["ba"], {"T": F32}),
+            NodeDef("pool", "MaxPool", ["act"],
+                    {"ksize": attr_ilist([1, 2, 2, 1]),
+                     "strides": attr_ilist([1, 2, 2, 1]),
+                     "padding": attr_s("VALID"),
+                     "data_format": attr_s("NHWC"), "T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = rng.normal(size=(1, 8, 8, 2)).astype(np.float32)
+        out = sd.output({"x": x}, "pool")["pool"].numpy()
+        assert out.shape == (1, 4, 4, 4)
+
+        # independent check via jax on NCHW
+        import jax.numpy as jnp
+        from jax import lax
+        y = lax.conv_general_dilated(
+            jnp.asarray(x.transpose(0, 3, 1, 2)),
+            jnp.asarray(w.transpose(3, 2, 0, 1)),
+            (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y = np.maximum(np.asarray(y) + b.reshape(1, -1, 1, 1), 0)
+        expect = y.reshape(1, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.transpose(0, 3, 1, 2), expect,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dilated_conv(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(3, 3, 1, 2)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [1, 9, 9, 1]),
+            const("w", w),
+            NodeDef("conv", "Conv2D", ["x", "w"],
+                    {"strides": attr_ilist([1, 1, 1, 1]),
+                     "dilations": attr_ilist([1, 2, 2, 1]),
+                     "padding": attr_s("VALID"),
+                     "data_format": attr_s("NHWC"), "T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = rng.normal(size=(1, 9, 9, 1)).astype(np.float32)
+        out = sd.output({"x": x}, "conv")["conv"].numpy()
+        assert out.shape == (1, 5, 5, 2)  # 9 - (3-1)*2 = 5 with d=2
+        import jax.numpy as jnp
+        from jax import lax
+        expect = lax.conv_general_dilated(
+            jnp.asarray(x.transpose(0, 3, 1, 2)),
+            jnp.asarray(w.transpose(3, 2, 0, 1)),
+            (1, 1), "VALID", rhs_dilation=(2, 2),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(out.transpose(0, 3, 1, 2),
+                                   np.asarray(expect), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_batch_norm_nhwc(self):
+        c = 3
+        scale = np.array([1.0, 2.0, 0.5], np.float32)
+        offset = np.array([0.1, -0.2, 0.0], np.float32)
+        mean = np.array([0.5, -0.5, 1.0], np.float32)
+        var = np.array([1.0, 4.0, 0.25], np.float32)
+        gd = GraphDef([
+            placeholder("x", [2, 4, 4, c]),
+            const("scale", scale), const("offset", offset),
+            const("mean", mean), const("var", var),
+            NodeDef("bn", "FusedBatchNormV3",
+                    ["x", "scale", "offset", "mean", "var"],
+                    {"epsilon": attr_f(1e-3), "is_training": attr_b(False),
+                     "data_format": attr_s("NHWC"), "T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.random.default_rng(5).normal(size=(2, 4, 4, c)) \
+            .astype(np.float32)
+        out = sd.output({"x": x}, "bn")["bn"].numpy()
+        expect = (x - mean) / np.sqrt(var + 1e-3) * scale + offset
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)

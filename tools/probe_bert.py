@@ -1,0 +1,64 @@
+"""A/B probe for BERT step-time on the real chip: attention impl x
+dropout x batch size. Prints one JSON line per variant."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def probe(attention_impl, batch, dropout, k=10, trials=3):
+    import jax
+
+    from deeplearning4j_tpu.models.bert import (
+        BertConfig, BertTrainer, synthetic_mlm_batch)
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+
+    cfg = BertConfig(vocab_size=30522, hidden=768, num_layers=12,
+                     num_heads=12, ffn=3072, max_len=512,
+                     dropout=dropout, attention_impl=attention_impl)
+    seq = 512
+    mesh = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+    trainer = BertTrainer(cfg, mesh, lr=1e-4)
+    stacks = [synthetic_mlm_batch(cfg, batch, seq, seed=s) for s in range(k)]
+    tokens_k = np.stack([s[0] for s in stacks])
+    labels_k = np.stack([s[1] for s in stacks])
+    float(trainer.train_steps(tokens_k, labels_k)[-1])
+    float(trainer.train_steps(tokens_k, labels_k)[-1])
+    dt = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        losses = trainer.train_steps(tokens_k, labels_k)
+        float(losses[-1])
+        dt = min(dt, (time.perf_counter() - t0) / k)
+    tps = batch * seq / dt
+    print(json.dumps({"impl": attention_impl, "batch": batch,
+                      "dropout": dropout, "ms_per_step": round(dt * 1e3, 2),
+                      "tokens_per_sec": round(tps, 1)}), flush=True)
+    del trainer
+
+
+if __name__ == "__main__":
+    import sys
+    variants = [
+        ("flash", 16, 0.1),
+        ("dense", 16, 0.1),
+        ("flash", 16, 0.0),
+        ("dense", 16, 0.0),
+        ("flash", 32, 0.1),
+        ("dense", 32, 0.1),
+        ("flash", 64, 0.1),
+    ]
+    if len(sys.argv) > 1:
+        sel = int(sys.argv[1])
+        variants = variants[sel:sel + 1]
+    for v in variants:
+        try:
+            probe(*v)
+        except Exception as e:
+            print(json.dumps({"impl": v[0], "batch": v[1], "dropout": v[2],
+                              "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
