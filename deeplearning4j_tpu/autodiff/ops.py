@@ -897,3 +897,53 @@ def _kld(predictions, labels, reduction="MEAN_BY_NONZERO_WEIGHT_COUNT"):
                     jnp.log(jnp.maximum(predictions, 1e-12)))
     per_ex = jnp.sum(per, axis=tuple(range(1, per.ndim)))
     return _reduce_loss(per_ex, None, reduction)
+
+
+# ---------------------------------------------------------------------------
+# control flow
+# ---------------------------------------------------------------------------
+# Reference capability: libnd4j control-flow declarables + SameDiff's
+# interpretation of TF Enter/Exit/Merge/Switch loops (SURVEY.md §2.1/§3.4;
+# VERDICT.md round-1 missing item 5). TPU-first design: the loop/branch
+# bodies are ordinary traced functions lowered to lax.while_loop /
+# lax.cond / lax.scan — ONE compiled XLA op each, no per-iteration
+# dispatch. Bodies are Python callables over jnp arrays, captured as op
+# attrs; graphs holding them execute and (for cond/scan) differentiate,
+# but cannot be serialized (same boundary the reference draws: its
+# control-flow sub-graphs serialize as FlatBuffers function defs, ours
+# would need the callable's source).
+
+@op("whileLoop")
+def _while_loop(*state, cond_fn=None, body_fn=None):
+    """state -> final state after `while cond_fn(*state): state =
+    body_fn(*state)`. Forward-only (XLA while has no reverse-mode)."""
+    out = lax.while_loop(lambda s: cond_fn(*s),
+                         lambda s: tuple(body_fn(*s)), tuple(state))
+    return out if len(out) > 1 else out[0]
+
+
+@op("ifCond")
+def _if_cond(pred, *operands, true_fn=None, false_fn=None):
+    out = lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                   lambda ops: _as_tuple(true_fn(*ops)),
+                   lambda ops: _as_tuple(false_fn(*ops)), tuple(operands))
+    return out if len(out) > 1 else out[0]
+
+
+@op("scanOp")
+def _scan_op(init, xs, body_fn=None):
+    """lax.scan over leading axis of xs; body_fn(carry, x) -> (carry, y).
+    Returns (final_carry, stacked_ys); reverse-mode differentiable."""
+    return lax.scan(body_fn, init, xs)
+
+
+@op("forLoop")
+def _for_loop(*state, n=None, body_fn=None):
+    """n fixed iterations: state = body_fn(i, *state) (fori_loop)."""
+    out = lax.fori_loop(0, n, lambda i, s: tuple(body_fn(i, *s)),
+                        tuple(state))
+    return out if len(out) > 1 else out[0]
+
+
+def _as_tuple(v):
+    return v if isinstance(v, tuple) else (v,)

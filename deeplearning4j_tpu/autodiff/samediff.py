@@ -555,6 +555,38 @@ class SameDiff:
     def identity(self, x, name=None):
         return self._op("identity", [self._as_var(x)], {}, name)
 
+    # -- control flow (reference: SDBaseOps.whileLoop/ifCond; TF
+    # Enter/Exit/Merge/Switch interpreted as whole loops, SURVEY.md §3.4).
+    # Bodies are Python callables over jnp arrays; compiled into ONE
+    # lax.while_loop/cond/scan XLA op — graphs holding them run and (for
+    # ifCond/scan) differentiate, but cannot be save()d.
+    def whileLoop(self, condBody, loopBody, *loopVars, name=None):
+        """loopVars -> final vars after `while condBody(*v): v =
+        loopBody(*v)`. Forward-only (XLA while has no reverse-mode)."""
+        vs = [self._as_var(v) for v in loopVars]
+        return self._op("whileLoop", vs,
+                        {"cond_fn": condBody, "body_fn": loopBody},
+                        name, n_out=len(vs) if len(vs) > 1 else 1)
+
+    def ifCond(self, predicate, trueBody, falseBody, *operands, name=None,
+               n_out=1):
+        ops_ = [self._as_var(v) for v in operands]
+        return self._op("ifCond", [self._as_var(predicate)] + ops_,
+                        {"true_fn": trueBody, "false_fn": falseBody},
+                        name, n_out=n_out)
+
+    def scan(self, body, init, xs, name=None):
+        """lax.scan: body(carry, x) -> (carry, y). Returns
+        (final_carry, stacked_ys); reverse-mode differentiable."""
+        return self._op("scanOp", [self._as_var(init), self._as_var(xs)],
+                        {"body_fn": body}, name, n_out=2)
+
+    def forLoop(self, n, body, *loopVars, name=None):
+        """n fixed iterations of body(i, *vars) (lax.fori_loop)."""
+        vs = [self._as_var(v) for v in loopVars]
+        return self._op("forLoop", vs, {"n": int(n), "body_fn": body},
+                        name, n_out=len(vs) if len(vs) > 1 else 1)
+
     def getVariable(self, name: str) -> SDVariable:
         return self._vars[name]
 
@@ -944,6 +976,11 @@ class _BatchOutputBuilder:
 def _json_attrs(attrs: dict) -> dict:
     out = {}
     for k, v in attrs.items():
+        if callable(v):
+            raise ValueError(
+                "graphs holding control-flow ops (whileLoop/ifCond/scan/"
+                "forLoop) cannot be serialized: the loop body is a Python "
+                "callable, not graph data")
         if isinstance(v, tuple):
             v = list(v)
         elif hasattr(v, "dtype") and hasattr(v, "tolist"):

@@ -14,3 +14,12 @@ from deeplearning4j_tpu.datasets.records import (  # noqa: F401
 from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler, Normalizer, NormalizerMinMaxScaler,
     NormalizerStandardize)
+from deeplearning4j_tpu.datasets.transform import (  # noqa: F401
+    CategoricalColumnCondition, ColumnType, ConditionOp,
+    DoubleColumnCondition, MathFunction, MathOp, Schema,
+    StringColumnCondition, TransformProcess, TransformProcessRecordReader)
+from deeplearning4j_tpu.datasets.image import (  # noqa: F401
+    CropImageTransform, FlipImageTransform, ImageRecordReader,
+    ImageTransform, NativeImageLoader, ParentPathLabelGenerator,
+    PathLabelGenerator, PipelineImageTransform, ResizeImageTransform,
+    ScaleImageTransform)

@@ -81,11 +81,25 @@ class CSVRecordReader(RecordReader):
             self._records = [r for r in rows][self.skip:]
         else:
             for path in split.locations():
-                with open(path, newline="") as f:
-                    rows = list(csv.reader(f, delimiter=self.delimiter))
+                rows = self._read_file(path)
                 self._records.extend(rows[self.skip:])
         self._pos = 0
         return self
+
+    def _read_file(self, path):
+        """Plain numeric CSVs parse through the native C kernel (one call
+        per file); anything it rejects — quoting, non-numeric columns,
+        ragged rows — falls back to the general csv module."""
+        from deeplearning4j_tpu import native
+
+        if native.available():
+            with open(path, "rb") as f:
+                blob = f.read()
+            mat = native.csv_parse(blob, self.delimiter)
+            if mat is not None:
+                return mat.tolist()
+        with open(path, newline="") as f:
+            return list(csv.reader(f, delimiter=self.delimiter))
 
     def hasNext(self):
         return self._pos < len(self._records)
@@ -154,6 +168,10 @@ class RecordReaderDataSetIterator(DataSetIterator):
         """Full pre-scan so every batch one-hots with the same width (a
         first-batch-only guess breaks when a later batch holds a higher
         class index)."""
+        n = getattr(self.reader, "numLabels", None)
+        if callable(n) and n():
+            self.numPossibleLabels = n()
+            return
         li = self.labelIndex
         max_idx = -1
         while self.reader.hasNext():
@@ -170,7 +188,13 @@ class RecordReaderDataSetIterator(DataSetIterator):
             self._infer_num_labels()
         feats, labels = [], []
         while len(feats) < self._batch and self.reader.hasNext():
-            rec = [float(v) for v in self.reader.next()]
+            rec = self.reader.next()
+            if rec and isinstance(rec[0], np.ndarray) and rec[0].ndim > 1:
+                # tensor record (ImageRecordReader): [tensor, classIdx]
+                feats.append(np.asarray(rec[0], np.float32))
+                labels.append([float(rec[1])] if len(rec) > 1 else [0.0])
+                continue
+            rec = [float(v) for v in rec]
             li, lj = self.labelIndex, self.labelIndexTo
             if li < 0:
                 li = lj = len(rec) + li

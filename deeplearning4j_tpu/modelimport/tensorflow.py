@@ -264,8 +264,14 @@ class _Importer:
             return np.asarray(
                 vals[0], dtype_to_numpy(node.attrs["DstT"].type))
         if op == "Range":
-            return np.arange(int(vals[0]), int(vals[1]), int(vals[2]),
-                             dtype=np.int32)
+            start, limit, delta = (np.asarray(v) for v in vals)
+            try:
+                out = np.arange(start[()], limit[()], delta[()])
+            except ValueError as e:
+                raise TFImportError(f"Range node {node.name!r}: {e}")
+            all_int = all(np.issubdtype(v.dtype, np.integer)
+                          for v in (start, limit, delta))
+            return out.astype(np.int32 if all_int else np.float32)
         if op == "StridedSlice":
             return _apply_strided_slice(node, vals[0], vals[1], vals[2],
                                         vals[3])[0]
@@ -365,9 +371,11 @@ def _h_placeholder(im, node):
 
 @handler("Identity", "StopGradient", "PreventGradient", "Snapshot")
 def _h_identity(im, node):
-    ref = im.data_inputs(node)[0]
-    src = im.var(ref)
-    im.bind(node.name, src, im.shape(ref), im.dtype(ref))
+    # Emit a real identity op so the node's name is fetchable from the
+    # SameDiff graph — freeze_graph conventionally names the OUTPUT with
+    # tf.identity(logits, name='output'), and sd.output(..., 'output')
+    # must resolve it.
+    im.emit(node, "identity", [im.data_inputs(node)[0]])
 
 
 @handler("NoOp", "Assert")
@@ -567,7 +575,8 @@ def _h_strided_slice(im, node):
     strides = im.need_const(ins[3], "StridedSlice strides") \
         if len(ins) > 3 else None
     in_shape = im.shape(ins[0])
-    probe = np.zeros(in_shape, np.int8)
+    # allocation-free shape probe (broadcast view, never materialized)
+    probe = np.broadcast_to(np.int8(0), in_shape)
     _, idx = _apply_strided_slice(node, probe, begin, end, strides)
 
     from deeplearning4j_tpu.autodiff.ops import OPS, op as _op_reg  # noqa

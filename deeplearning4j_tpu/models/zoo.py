@@ -24,10 +24,44 @@ class ZooModel:
     def init(self):
         raise NotImplementedError
 
-    def initPretrained(self, *_):
-        raise NotImplementedError(
-            "pretrained weights need network access; load a checkpoint with "
-            "ModelSerializer.restoreMultiLayerNetwork instead")
+    def initPretrained(self, weightsFile=None):
+        """Reference: ZooModel.initPretrained() downloads + checksums a
+        weight file, then loads it. No egress here, so the weight file
+        must already be local: a Dl4jCheckpoint zip, a ModelSerializer
+        zip, or a save_params_npz .npz of named layer params."""
+        if weightsFile is None:
+            raise ValueError(
+                "no network access in this environment: pass "
+                "initPretrained(weightsFile=...) pointing at a local "
+                "checkpoint zip or params .npz")
+        path = str(weightsFile)
+        if path.endswith(".npz"):
+            from deeplearning4j_tpu.utils.checkpoint import load_params_npz
+
+            return load_params_npz(self.init(), path)
+        import zipfile
+
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+        if "coefficients.bin" in names:
+            from deeplearning4j_tpu.utils.checkpoint import Dl4jCheckpoint
+
+            loaded = Dl4jCheckpoint.load(path)
+        else:
+            from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+            loaded = ModelSerializer._restore(path, None, loadUpdater=False)
+        # the zip rebuilds from its own configuration.json — reject a
+        # checkpoint for a different architecture instead of silently
+        # returning whatever network the file holds
+        expect = self.init()
+        if loaded.numParams() != expect.numParams():
+            raise ValueError(
+                f"checkpoint {path!r} holds a "
+                f"{loaded.numParams()}-param model, but "
+                f"{type(self).__name__} has {expect.numParams()} params "
+                "— wrong weights for this zoo model")
+        return loaded
 
     def metaData(self):
         return {"name": type(self).__name__}

@@ -1,0 +1,131 @@
+"""Native runtime layer: on-demand compiled C++ ETL kernels + ctypes
+bindings.
+
+Reference capability: the reference's C++ runtime tier (libnd4j host
+helpers; SURVEY.md §2.1 — its ops AND its ETL loops are native). Here
+the device math is XLA-compiled, so the native tier covers host ETL hot
+loops (see etl.cpp). pybind11 isn't in the image, so bindings are
+ctypes over an `extern "C"` surface; the .so is built with g++ on first
+use and cached beside the source (rebuilt when etl.cpp changes).
+`available()` reports whether the fast path is live — every call site
+falls back to numpy when it isn't."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "etl.cpp")
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _so_path():
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_DIR, f"_etl_{tag}.so")
+
+
+def _build(so):
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", so]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _load():
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            so = _so_path()
+            if not os.path.exists(so):
+                _build(so)
+            lib = ctypes.CDLL(so)
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.sg_pairs.restype = ctypes.c_long
+            lib.sg_pairs.argtypes = [i32p, i64p, ctypes.c_int64, i32p,
+                                     i32p, i32p]
+            lib.csv_parse.restype = ctypes.c_long
+            lib.csv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, f32p,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+            lib.hwc_to_chw.restype = None
+            lib.hwc_to_chw.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int, ctypes.c_float, ctypes.c_float, f32p]
+            _LIB = lib
+        except Exception:  # toolchain missing/failed -> numpy fallback
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def sg_pairs(encoded_sentences, bs):
+    """Skip-gram pairs across sentences. encoded_sentences: list of int32
+    arrays; bs: int32 window draws, concatenated per token. Returns
+    (centers, contexts) int32 arrays, or None if the native lib is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not encoded_sentences:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    idxs = np.ascontiguousarray(np.concatenate(encoded_sentences),
+                                dtype=np.int32)
+    offsets = np.zeros(len(encoded_sentences) + 1, np.int64)
+    np.cumsum([len(s) for s in encoded_sentences], out=offsets[1:])
+    bs = np.ascontiguousarray(bs, dtype=np.int32)
+    cap = int(2 * bs.sum())
+    centers = np.empty(cap, np.int32)
+    contexts = np.empty(cap, np.int32)
+    n = lib.sg_pairs(idxs, offsets, len(encoded_sentences), bs, centers,
+                     contexts)
+    return centers[:n].copy(), contexts[:n].copy()
+
+
+def csv_parse(text: bytes, delimiter=",") -> np.ndarray | None:
+    """Parse a numeric CSV blob -> [rows, cols] float32, or None when the
+    native lib is unavailable or the data isn't plain numeric CSV."""
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(text, str):
+        text = text.encode()
+    cap = max(16, text.count(b",") + text.count(b"\n") + 2)
+    out = np.empty(cap, np.float32)
+    cols = ctypes.c_int64(0)
+    rows = lib.csv_parse(text, len(text), delimiter.encode()[:1], out,
+                         cap, ctypes.byref(cols))
+    if rows < 0 or cols.value == 0:
+        return None
+    return out[:rows * cols.value].reshape(rows, cols.value).copy()
+
+
+def hwc_to_chw(img_u8: np.ndarray, flip_h=False, scale=1.0, shift=0.0):
+    """[H,W,C] uint8 -> [C,H,W] float32 (optionally h-flipped and affine
+    scaled), or None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    img_u8 = np.ascontiguousarray(img_u8, dtype=np.uint8)
+    if img_u8.ndim == 2:
+        img_u8 = img_u8[:, :, None]
+    h, w, c = img_u8.shape
+    dst = np.empty((c, h, w), np.float32)
+    lib.hwc_to_chw(img_u8, h, w, c, int(bool(flip_h)), float(scale),
+                   float(shift), dst)
+    return dst

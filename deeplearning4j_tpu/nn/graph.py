@@ -259,6 +259,20 @@ class ComputationGraph:
             return INDArray(jnp.zeros((0,)))
         return INDArray(jnp.concatenate(leaves))
 
+    def setParams(self, flat):
+        """Install a flat vector in params() order (topo order, sorted
+        param names per node)."""
+        flat = jnp.asarray(flat).reshape(-1)
+        off = 0
+        for name in self.conf.topo_order:
+            p = self._params[name]
+            for k in sorted(p):
+                n = int(np.prod(p[k].shape)) if p[k].shape else 1
+                p[k] = flat[off: off + n].reshape(p[k].shape).astype(
+                    p[k].dtype)
+                off += n
+        self._train_step = None
+
     def getParam(self, node: str, name: str) -> INDArray:
         return INDArray(self._params[node][name])
 

@@ -3,3 +3,5 @@ from deeplearning4j_tpu.rl.dqn import (  # noqa: F401
     DQNPolicy, QLearningConfiguration, QLearningDiscreteDense)
 from deeplearning4j_tpu.rl.a2c import (  # noqa: F401
     A2CConfiguration, A2CDiscreteDense)
+from deeplearning4j_tpu.rl.a3c import (  # noqa: F401
+    A3CConfiguration, A3CDiscreteDense)

@@ -1,0 +1,140 @@
+"""Training UI server.
+
+Reference capability: deeplearning4j-ui-parent's vertx dashboard
+(`UIServer.getInstance().attach(statsStorage)`, SURVEY.md §2.7) — score
+curves for attached training sessions in a browser. Implemented on the
+stdlib http.server (no vertx, no js deps): "/" renders an auto-refreshing
+SVG score chart, "/data" serves the attached storages' records as JSON."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html>
+<html><head><title>dl4j-tpu training UI</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; }
+ .axis { stroke: #999; stroke-width: 1; }
+ .curve { fill: none; stroke: #2563eb; stroke-width: 1.5; }
+ text { font-size: 11px; fill: #555; }
+</style></head>
+<body>
+<h2>Training score</h2>
+<div id="chart"></div>
+<script>
+async function draw() {
+  const res = await fetch('/data');
+  const sessions = await res.json();
+  const el = document.getElementById('chart');
+  el.innerHTML = '';
+  for (const [sid, recs] of Object.entries(sessions)) {
+    const pts = recs.map(r => [r.iteration, r.score]);
+    if (!pts.length) continue;
+    const W = 640, H = 240, P = 40;
+    const xs = pts.map(p => p[0]), ys = pts.map(p => p[1]);
+    const xmin = Math.min(...xs), xmax = Math.max(...xs, xmin + 1);
+    const ymin = Math.min(...ys), ymax = Math.max(...ys, ymin + 1e-9);
+    const sx = x => P + (x - xmin) / (xmax - xmin) * (W - 2 * P);
+    const sy = y => H - P - (y - ymin) / (ymax - ymin) * (H - 2 * P);
+    const d = pts.map((p, i) => (i ? 'L' : 'M') + sx(p[0]) + ',' + sy(p[1])).join(' ');
+    el.innerHTML += `<h4>${sid}</h4>
+      <svg width="${W}" height="${H}">
+       <line class="axis" x1="${P}" y1="${H - P}" x2="${W - P}" y2="${H - P}"/>
+       <line class="axis" x1="${P}" y1="${P}" x2="${P}" y2="${H - P}"/>
+       <text x="${P}" y="${H - P + 14}">${xmin}</text>
+       <text x="${W - P - 20}" y="${H - P + 14}">${xmax}</text>
+       <text x="2" y="${H - P}">${ymin.toFixed(3)}</text>
+       <text x="2" y="${P + 4}">${ymax.toFixed(3)}</text>
+       <path class="curve" d="${d}"/>
+      </svg>`;
+  }
+}
+draw();
+setInterval(draw, 2000);
+</script>
+</body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpuUI/1.0"
+
+    def do_GET(self):
+        if self.path == "/data":
+            body = json.dumps(self.server.ui._sessions()).encode()
+            ctype = "application/json"
+        elif self.path == "/":
+            body = _PAGE.encode()
+            ctype = "text/html; charset=utf-8"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class UIServer:
+    """Singleton mirroring org.deeplearning4j.ui.api.UIServer."""
+
+    _instance = None
+
+    def __init__(self):
+        self._storages = []
+        self._httpd = None
+        self._thread = None
+        self.port = None
+
+    @classmethod
+    def getInstance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, statsStorage):
+        self._storages.append(statsStorage)
+        return self
+
+    def detach(self, statsStorage):
+        self._storages.remove(statsStorage)
+
+    def _sessions(self):
+        out = {}
+        for storage in self._storages:
+            for sid in storage.listSessionIDs():
+                out.setdefault(sid, []).extend(
+                    {"iteration": r.get("iteration"),
+                     "score": r.get("score"),
+                     "epoch": r.get("epoch")}
+                    for r in storage.getRecords(sid))
+        return out
+
+    def enableRemoteListener(self):  # API parity no-op (single-process)
+        return self
+
+    def start(self, port=9000):
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.ui = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+        return self

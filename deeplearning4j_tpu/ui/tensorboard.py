@@ -1,0 +1,179 @@
+"""TensorBoard event-file emission, dependency-free.
+
+Reference capability: the SURVEY.md §5 observability prescription ("emit
+scalars to TensorBoard event files") standing in for deeplearning4j-ui's
+vertx dashboard. TensorFlow/tensorboard are not installed, so this
+writes the TFRecord + Event/Summary protos directly with the in-repo
+protobuf encoder: a TFRecord frame is
+
+    uint64 length (LE) | uint32 masked-crc32c(length bytes) |
+    payload          | uint32 masked-crc32c(payload)
+
+and the payload is an `Event` proto (tensorflow/core/util/event.proto:
+wall_time=1 double, step=2 int64, file_version=3 string, summary=5)
+whose `Summary` (summary.proto) holds value=1 entries {tag=1,
+simple_value=2 float}. Any stock TensorBoard install can open the
+resulting events file."""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+from deeplearning4j_tpu.modelimport.protobuf import (
+    emit_bytes, emit_varint, _emit_tag, _I64, _I32)
+from deeplearning4j_tpu.utils.listeners import TrainingListener
+
+_CRC_TABLE = []
+
+
+def _crc32c_table():
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    tbl = _crc32c_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _tfrecord_frame(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header)) + payload
+            + struct.pack("<I", _masked_crc(payload)))
+
+
+def _emit_double(out, field, value):
+    _emit_tag(out, field, _I64)
+    out.extend(struct.pack("<d", value))
+
+
+def _emit_float(out, field, value):
+    _emit_tag(out, field, _I32)
+    out.extend(struct.pack("<f", value))
+
+
+def _event(wall_time, step=None, file_version=None, summary=None) -> bytes:
+    ev = bytearray()
+    _emit_double(ev, 1, wall_time)
+    if step is not None:
+        emit_varint(ev, 2, step)
+    if file_version is not None:
+        emit_bytes(ev, 3, file_version.encode())
+    if summary is not None:
+        emit_bytes(ev, 5, summary)
+    return bytes(ev)
+
+
+def _scalar_summary(scalars: dict) -> bytes:
+    s = bytearray()
+    for tag, value in scalars.items():
+        v = bytearray()
+        emit_bytes(v, 1, tag.encode())
+        _emit_float(v, 2, float(value))
+        emit_bytes(s, 1, v)
+    return bytes(s)
+
+
+class SummaryWriter:
+    """Minimal tf.summary-style scalar writer."""
+
+    def __init__(self, logdir, filename_suffix=""):
+        os.makedirs(logdir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}{filename_suffix}")
+        self.path = os.path.join(logdir, fname)
+        self._f = open(self.path, "ab")
+        self._f.write(_tfrecord_frame(
+            _event(time.time(), file_version="brain.Event:2")))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, step):
+        self.add_scalars({tag: value}, step)
+
+    def add_scalars(self, scalars: dict, step):
+        self._f.write(_tfrecord_frame(_event(
+            time.time(), step=int(step),
+            summary=_scalar_summary(scalars))))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.flush()
+        self._f.close()
+
+
+def read_events(path):
+    """Parse an events file back into [(step, {tag: value})] — the test
+    oracle, and a migration path for tooling."""
+    from deeplearning4j_tpu.modelimport.protobuf import iter_fields
+
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        (length,) = struct.unpack("<Q", data[pos:pos + 8])
+        (hcrc,) = struct.unpack("<I", data[pos + 8:pos + 12])
+        if hcrc != _masked_crc(data[pos:pos + 8]):
+            raise ValueError("corrupt tfrecord header crc")
+        payload = data[pos + 12:pos + 12 + length]
+        (pcrc,) = struct.unpack(
+            "<I", data[pos + 12 + length:pos + 16 + length])
+        if pcrc != _masked_crc(payload):
+            raise ValueError("corrupt tfrecord payload crc")
+        pos += 16 + length
+        step, scalars = None, {}
+        for field, wt, v in iter_fields(payload):
+            if field == 2:
+                step = v
+            elif field == 5:
+                for f2, _w2, v2 in iter_fields(v):
+                    if f2 != 1:
+                        continue
+                    tag, val = None, None
+                    for f3, _w3, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            tag = bytes(v3).decode()
+                        elif f3 == 2:
+                            val = struct.unpack("<f", v3)[0]
+                    if tag is not None:
+                        scalars[tag] = val
+        if scalars:
+            out.append((step, scalars))
+    return out
+
+
+class TensorBoardStatsListener(TrainingListener):
+    """Per-iteration score -> TensorBoard scalars (the reference's
+    StatsListener wired to an event-file backend)."""
+
+    def __init__(self, logdir, frequency=1):
+        self.writer = SummaryWriter(logdir)
+        self.frequency = frequency
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        self.writer.add_scalars(
+            {"score": float(model.score()), "epoch": float(epoch)},
+            iteration)
+        self.writer.flush()

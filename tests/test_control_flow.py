@@ -1,0 +1,112 @@
+"""SameDiff control-flow op tests (reference: SDBaseOps whileLoop/ifCond
++ libnd4j control-flow declarables, SURVEY.md §2.1/§3.4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class TestWhileLoop:
+    def test_countdown_sum(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32, 1)
+        acc0 = sd.constant("acc0", np.zeros(1, np.float32))
+        out = sd.whileLoop(
+            lambda v, acc: (v > 0).all(),
+            lambda v, acc: (v - 1.0, acc + v),
+            x, acc0, name="loop")
+        final_v, final_acc = out
+        res = sd.output({"x": np.array([5.0], np.float32)},
+                        final_acc.name())
+        assert float(res[final_acc.name()].numpy()[0]) == 15.0
+
+    def test_single_var(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32)
+        doubled = sd.whileLoop(lambda v: (v < 100).all(),
+                               lambda v: (v * 2.0,), x)
+        res = sd.output({"x": np.float32(3.0)}, doubled.name())
+        assert float(res[doubled.name()].numpy()) == 192.0
+
+
+class TestIfCond:
+    def test_branches(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        p = sd.placeHolder("p", jnp.float32)
+        x = sd.placeHolder("x", jnp.float32, 3)
+        y = sd.ifCond(p, lambda a: a * 2.0, lambda a: a - 1.0, x)
+        xs = np.array([1.0, 2.0, 3.0], np.float32)
+        hi = sd.output({"p": np.float32(1), "x": xs}, y.name())
+        lo = sd.output({"p": np.float32(0), "x": xs}, y.name())
+        np.testing.assert_allclose(hi[y.name()].numpy(), xs * 2)
+        np.testing.assert_allclose(lo[y.name()].numpy(), xs - 1)
+
+    def test_cond_is_differentiable(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        p = sd.constant("p", np.float32(1.0))
+        x = sd.placeHolder("x", jnp.float32, 3)
+        y = sd.ifCond(p, lambda a: a * a, lambda a: a, x, name="branch")
+        y.sum().markAsLoss()
+        xs = np.array([1.0, 2.0, 3.0], np.float32)
+        g = sd.calculateGradients({"x": xs}, "x")["x"].numpy()
+        np.testing.assert_allclose(g, 2 * xs)  # chose the square branch
+
+
+class TestScan:
+    def test_cumulative_carry(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        xs = sd.placeHolder("xs", jnp.float32, 4)
+        init = sd.constant("c0", np.float32(0.0))
+        carry, ys = sd.scan(lambda c, x: (c + x, c + x), init, xs,
+                            name="cumsum")
+        data = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        res = sd.output({"xs": data}, carry.name(), ys.name())
+        assert float(res[carry.name()].numpy()) == 10.0
+        np.testing.assert_allclose(res[ys.name()].numpy(),
+                                   np.cumsum(data))
+
+    def test_scan_gradient(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        xs = sd.placeHolder("xs", jnp.float32, 3)
+        init = sd.constant("c0", np.float32(1.0))
+        carry, _ys = sd.scan(lambda c, x: (c * x, c), init, xs)
+        carry.markAsLoss()
+        data = np.array([2.0, 3.0, 4.0], np.float32)
+        g = sd.calculateGradients({"xs": data}, "xs")["xs"].numpy()
+        # d(prod)/dx_i = prod / x_i
+        np.testing.assert_allclose(g, 24.0 / data)
+
+
+class TestForLoop:
+    def test_fixed_iterations(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32)
+        out = sd.forLoop(4, lambda i, v: (v + 10.0 ** 0 * (i + 1),), x)
+        res = sd.output({"x": np.float32(0.0)}, out.name())
+        assert float(res[out.name()].numpy()) == 10.0  # 1+2+3+4
+
+
+class TestSerializationGuard:
+    def test_save_raises_with_clear_message(self, tmp_path):
+        import jax.numpy as jnp
+
+        sd = SameDiff.create()
+        x = sd.placeHolder("x", jnp.float32)
+        sd.whileLoop(lambda v: (v < 2).all(), lambda v: (v + 1,), x)
+        with pytest.raises(ValueError, match="control-flow"):
+            sd.save(str(tmp_path / "g.sd"))

@@ -96,6 +96,21 @@ class TestRL:
         late = np.mean(episodes[-10:])
         assert late > early, (early, late)
 
+    def test_a3c_async_workers_improve(self):
+        from deeplearning4j_tpu.rl import A3CConfiguration, A3CDiscreteDense
+
+        conf = A3CConfiguration(seed=3, maxStep=9000, nThreads=4, nSteps=8,
+                                gamma=0.9, learningRate=3e-3, hidden=(32,))
+        a3c = A3CDiscreteDense(lambda: SimpleGridWorld(3), conf)
+        episodes = a3c.train()
+        assert len(episodes) > 10
+        # async actors learn the 3x3 grid: late episodes should reach the
+        # goal (reward near 1) much more often than the random start
+        late = np.mean(episodes[-10:])
+        assert late > np.mean(episodes[:10]), episodes[:5]
+        # the learner actually consumed rollouts
+        assert a3c._t > 10
+
     def test_qconf_builder(self):
         conf = (QLearningConfiguration.builder()
                 .maxStep(123).gamma(0.5).build())

@@ -1,0 +1,137 @@
+"""Native C++ ETL kernel tests: build, bindings, and parity with the
+numpy fallbacks (SURVEY.md §2.1 native tier; kernels in
+deeplearning4j_tpu/native/etl.cpp)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="g++ toolchain unavailable")
+
+
+@needs_native
+class TestSgPairs:
+    def test_matches_python_reference(self):
+        rng = np.random.default_rng(0)
+        encoded = [rng.integers(0, 50, n).astype(np.int32)
+                   for n in (7, 3, 12, 2)]
+        n_tokens = sum(len(s) for s in encoded)
+        bs = rng.integers(1, 6, n_tokens).astype(np.int32)
+
+        centers, contexts = native.sg_pairs(encoded, bs)
+
+        exp_c, exp_x = [], []
+        off = 0
+        for idxs in encoded:
+            n = len(idxs)
+            for pos in range(n):
+                b = bs[off + pos]
+                for j in range(max(0, pos - b), min(n, pos + b + 1)):
+                    if j != pos:
+                        exp_c.append(idxs[pos])
+                        exp_x.append(idxs[j])
+            off += n
+        np.testing.assert_array_equal(centers, exp_c)
+        np.testing.assert_array_equal(contexts, exp_x)
+
+    def test_empty(self):
+        c, x = native.sg_pairs([], np.zeros(0, np.int32))
+        assert len(c) == 0 and len(x) == 0
+
+    def test_word2vec_uses_native_path(self):
+        """Same corpus+seed must give identical embeddings whether pairs
+        come from C++ or the Python loop."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        def build():
+            return (Word2Vec.Builder().minWordFrequency(1).layerSize(8)
+                    .windowSize(3).negativeSample(2).batchSize(64)
+                    .epochs(1).seed(5)
+                    .iterate(["the quick brown fox jumps over the dog",
+                              "pack my box with five dozen jugs"] * 4)
+                    .build())
+        w2v_native = build()
+        w2v_native.fit()
+        import unittest.mock as mock
+
+        with mock.patch.object(native, "available", lambda: False):
+            w2v_py = build()
+            w2v_py.fit()
+        np.testing.assert_allclose(np.asarray(w2v_native.syn0),
+                                   np.asarray(w2v_py.syn0), rtol=1e-5,
+                                   atol=1e-6)
+
+
+@needs_native
+class TestCsvParse:
+    def test_basic(self):
+        out = native.csv_parse(b"1,2.5,3\n4,5,6\n")
+        np.testing.assert_allclose(out, [[1, 2.5, 3], [4, 5, 6]])
+
+    def test_crlf_and_blank_lines(self):
+        out = native.csv_parse(b"1,2\r\n\r\n3,4\r\n")
+        np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+    def test_ragged_returns_none(self):
+        assert native.csv_parse(b"1,2\n3\n") is None
+
+    def test_non_numeric_returns_none(self):
+        assert native.csv_parse(b"a,b\n") is None
+
+    def test_negative_and_exponent(self):
+        out = native.csv_parse(b"-1.5e2,2e-3\n")
+        np.testing.assert_allclose(out, [[-150.0, 0.002]])
+
+    def test_csv_record_reader_uses_native_for_numeric_files(self, tmp_path):
+        from deeplearning4j_tpu.datasets.records import (
+            CSVRecordReader, FileSplit)
+
+        p = tmp_path / "data.csv"
+        p.write_text("1,2,0\n4,5,1\n")
+        rr = CSVRecordReader().initialize(FileSplit(str(p)))
+        rows = [rr.next() for _ in range(2)]
+        assert [[float(v) for v in r] for r in rows] == [
+            [1.0, 2.0, 0.0], [4.0, 5.0, 1.0]]
+        # a non-numeric file falls back to the csv module (strings)
+        q = tmp_path / "mixed.csv"
+        q.write_text("5.0,setosa\n6.1,virginica\n")
+        rr2 = CSVRecordReader().initialize(FileSplit(str(q)))
+        assert rr2.next() == ["5.0", "setosa"]
+
+
+@needs_native
+class TestHwcToChw:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (5, 7, 3), np.uint8)
+        out = native.hwc_to_chw(img)
+        np.testing.assert_allclose(
+            out, img.transpose(2, 0, 1).astype(np.float32))
+
+    def test_flip_and_affine(self):
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 255, (4, 6, 3), np.uint8)
+        out = native.hwc_to_chw(img, flip_h=True, scale=1 / 255.0,
+                                shift=-0.5)
+        expect = img[:, ::-1, :].transpose(2, 0, 1) / 255.0 - 0.5
+        np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-6)
+
+    def test_grayscale_2d(self):
+        img = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        out = native.hwc_to_chw(img)
+        np.testing.assert_allclose(out, img[None].astype(np.float32))
+
+    def test_image_loader_uses_native(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu.datasets.image import NativeImageLoader
+
+        rng = np.random.default_rng(2)
+        arr = rng.integers(0, 255, (9, 11, 3), np.uint8)
+        p = tmp_path / "img.png"
+        Image.fromarray(arr, "RGB").save(p)
+        out = NativeImageLoader(9, 11, 3).asMatrix(str(p))
+        np.testing.assert_allclose(
+            out, arr.transpose(2, 0, 1).astype(np.float32))

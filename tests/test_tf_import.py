@@ -161,6 +161,31 @@ class TestShapeAndConstFolding:
         np.testing.assert_array_equal(outs["ohf"].numpy(),
                                       np.eye(10)[ids])
 
+    def test_identity_output_node_is_fetchable(self):
+        """freeze_graph names the output via tf.identity(...,
+        name='output') — that name must resolve in the imported graph."""
+        gd = GraphDef([
+            placeholder("x", [2, 3]),
+            NodeDef("act", "Relu", ["x"], {"T": F32}),
+            NodeDef("output", "Identity", ["act"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.array([[-1, 2, -3], [4, -5, 6]], np.float32)
+        out = sd.output({"x": x}, "output")["output"].numpy()
+        np.testing.assert_array_equal(out, np.maximum(x, 0))
+
+    def test_float_range_folding(self):
+        gd = GraphDef([
+            const("start", np.float32(0.0)),
+            const("limit", np.float32(4.5)),
+            const("delta", np.float32(1.5)),
+            NodeDef("r", "Range", ["start", "limit", "delta"], {}),
+            NodeDef("y", "Mul", ["r", "r"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        out = sd.output({}, "y")["y"].numpy()
+        np.testing.assert_allclose(out, np.array([0.0, 2.25, 9.0]) ** 1)
+
     def test_unknown_batch_dim_requires_explicit_shape(self):
         gd = GraphDef([
             placeholder("x", [-1, 4]),

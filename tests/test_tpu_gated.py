@@ -1,0 +1,93 @@
+"""Real-TPU test tier, gated behind DL4J_TPU_TESTS=1.
+
+VERDICT.md round-1 weak item 6: the suite pins the CPU platform
+(conftest), so nothing exercised the axon/TPU path in CI — mirror the
+reference's CUDA-gated test tier (SURVEY.md §4 implication 4). These
+tests run real-chip work in SUBPROCESSES because the parent process has
+already initialized the CPU backend; each child inherits the
+environment's JAX_PLATFORMS=axon default (and must NOT set PYTHONPATH —
+it breaks the axon plugin; cwd-based import is used instead).
+
+Run:  DL4J_TPU_TESTS=1 python -m pytest tests/test_tpu_gated.py -v
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+gated = pytest.mark.skipif(
+    os.environ.get("DL4J_TPU_TESTS") != "1",
+    reason="real-TPU tier: set DL4J_TPU_TESTS=1 (needs the axon tunnel)")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, timeout=420):
+    # keep JAX_PLATFORMS (=axon) AND PYTHONPATH (=/root/.axon_site — it
+    # loads the axon plugin; only *overriding* it breaks the tunnel);
+    # strip just the CPU-mesh XLA_FLAGS the conftest may have set
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    res = subprocess.run([sys.executable, "-c", script], cwd=_REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=timeout)
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    return res.stdout
+
+
+@gated
+class TestRealChip:
+    def test_device_is_tpu(self):
+        out = _run("import jax; d = jax.devices()[0]; "
+                   "print(d.platform, d.device_kind)")
+        assert "tpu" in out.lower()
+
+    def test_bert_step_trains_on_chip(self):
+        out = _run("""
+import numpy as np, jax
+from deeplearning4j_tpu.models.bert import (BertConfig, BertTrainer,
+                                            synthetic_mlm_batch)
+from deeplearning4j_tpu.parallel.mesh import MeshConfig
+cfg = BertConfig(vocab_size=500, hidden=64, num_layers=2, num_heads=2,
+                 ffn=128, max_len=64)
+mesh = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+tr = BertTrainer(cfg, mesh, lr=1e-3)
+tok, lab = synthetic_mlm_batch(cfg, 4, 64, seed=0)
+l0 = float(tr.train_step(tok, lab))
+for _ in range(4):
+    l1 = float(tr.train_step(tok, lab))
+assert np.isfinite(l0) and l1 < l0, (l0, l1)
+print('OK', l0, l1)
+""")
+        assert "OK" in out
+
+    def test_flash_attention_matches_dense_on_chip(self):
+        out = _run("""
+import numpy as np, jax, jax.numpy as jnp
+from deeplearning4j_tpu.models.bert import (BertConfig, _attention)
+cfg_d = BertConfig(attention_impl='dense')
+cfg_f = BertConfig(attention_impl='flash')
+k = jax.random.key(0)
+q, kk, v = (jax.random.normal(jax.random.fold_in(k, i),
+            (2, 4, 256, 64), jnp.bfloat16) for i in range(3))
+d = np.asarray(_attention(q, kk, v, None, cfg_d).astype(jnp.float32))
+f = np.asarray(_attention(q, kk, v, None, cfg_f).astype(jnp.float32))
+np.testing.assert_allclose(d, f, rtol=5e-2, atol=5e-2)
+print('OK')
+""")
+        assert "OK" in out
+
+    def test_inference_sync_semantics(self):
+        """The axon tunnel's block_until_ready-doesn't-sync quirk
+        (bench.py): float() materialization is the reliable sync —
+        assert a timed float() read returns a real value."""
+        out = _run("""
+import time, numpy as np, jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+y = (x @ x).sum()
+v = float(y)   # must materialize through the tunnel
+assert abs(v - 256**3) < 1e-3, v
+print('OK')
+""")
+        assert "OK" in out

@@ -1,0 +1,95 @@
+"""UI tests: TensorBoard event emission + HTTP dashboard (SURVEY.md
+§2.7/§5 observability; reference: deeplearning4j-ui StatsListener +
+UIServer)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import FileStatsStorage, StatsListener
+from deeplearning4j_tpu.ui.tensorboard import (
+    SummaryWriter, TensorBoardStatsListener, crc32c, read_events)
+
+
+class TestTfRecordCrc:
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vectors
+        assert crc32c(b"") == 0
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+        assert crc32c(bytes(range(32))) == 0x46DD794E
+
+    def test_writer_reader_round_trip(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        w.add_scalar("loss", 1.5, 0)
+        w.add_scalars({"loss": 1.25, "acc": 0.5}, 1)
+        w.close()
+        events = read_events(w.path)
+        assert events[0] == (0, {"loss": 1.5})
+        step, scalars = events[1]
+        assert step == 1
+        np.testing.assert_allclose(scalars["loss"], 1.25)
+        np.testing.assert_allclose(scalars["acc"], 0.5)
+
+
+class TestTensorBoardListener:
+    def test_training_emits_scalars(self, tmp_path):
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, LossFunction, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).list()
+                .layer(DenseLayer.Builder().nIn(4).nOut(8)
+                       .activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        listener = TensorBoardStatsListener(str(tmp_path))
+        net.setListeners(listener)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit([(X, y)], 3)
+        listener.writer.close()
+        events = read_events(listener.writer.path)
+        assert len(events) == 3
+        scores = [s["score"] for _, s in events]
+        assert all(np.isfinite(scores))
+
+
+class TestUIServer:
+    def test_dashboard_serves_attached_storage(self, tmp_path):
+        storage = FileStatsStorage(str(tmp_path / "stats.jsonl"))
+        storage.put({"session": "s1", "iteration": 0, "score": 2.0,
+                     "epoch": 0})
+        storage.put({"session": "s1", "iteration": 1, "score": 1.5,
+                     "epoch": 0})
+        ui = UIServer.getInstance().attach(storage).start(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            page = urllib.request.urlopen(f"{base}/").read().decode()
+            assert "Training score" in page
+            data = json.loads(
+                urllib.request.urlopen(f"{base}/data").read())
+            assert [r["score"] for r in data["s1"]] == [2.0, 1.5]
+            assert urllib.request.urlopen(f"{base}/").status == 200
+        finally:
+            ui.stop()
+            ui.detach(storage)
+
+    def test_404(self):
+        ui = UIServer.getInstance().start(port=0)
+        try:
+            import urllib.error
+
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ui.port}/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            ui.stop()
