@@ -106,17 +106,40 @@ def _fit_throughput(net, batches, epochs_warm=2, epochs_meas=4):
     return n_examples * epochs_meas / dt
 
 
+def _scan_throughput(net, X_k, y_k, trials=4):
+    """Steady-state step throughput in examples/sec via fitMultiBatch:
+    K optimizer steps per device launch (lax.scan), so the axon tunnel's
+    per-dispatch RPC round-trip (~25-100 ms — more than a whole step for
+    every zoo config) is amortized and the chip is what gets measured,
+    exactly like the BERT bench. X_k/y_k: stacked [K, B, ...]."""
+    import jax
+
+    k = X_k.shape[0]
+    n_examples = k * X_k.shape[1]
+    # device-resident once: the tunnel uploads ~0.4 s per 40 MB, which
+    # would otherwise dominate the measurement
+    X_k = jax.device_put(jax.numpy.asarray(X_k))
+    y_k = jax.device_put(jax.numpy.asarray(y_k))
+    float(net.fitMultiBatch(X_k, y_k)[-1])  # compile
+    float(net.fitMultiBatch(X_k, y_k)[-1])  # warm
+    dt = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        float(net.fitMultiBatch(X_k, y_k)[-1])  # [-1] read = full sync
+        dt = min(dt, time.perf_counter() - t0)
+    return n_examples / dt
+
+
 def bench_lenet():
     from deeplearning4j_tpu.models.zoo import LeNet
 
     net = LeNet().init()
     rng = np.random.default_rng(0)
     bsz, nb = 512, 8
-    batches = [
-        (rng.normal(size=(bsz, 1, 28, 28)).astype(np.float32),
-         np.eye(10, dtype=np.float32)[rng.integers(0, 10, bsz)])
-        for _ in range(nb)]
-    ips = _fit_throughput(net, batches)
+    X_k = rng.normal(size=(nb, bsz, 1, 28, 28)).astype(np.float32)
+    y_k = np.stack([np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, bsz)] for _ in range(nb)])
+    ips = _scan_throughput(net, X_k, y_k)
     return {
         "metric": "lenet_mnist_images_per_sec",
         "value": round(ips, 1),
@@ -133,17 +156,26 @@ def resnet50_train_flops(batch):
 def bench_resnet50():
     from deeplearning4j_tpu.models.zoo import ResNet50
 
-    net = ResNet50(numClasses=1000).init()
+    import jax.numpy as jnp
+
+    # bfloat16: the TPU-idiomatic training dtype (reference analog:
+    # dataType(DataType.HALF)); batch 256 saturates the chip (measured
+    # 595 imgs/s f32/b64 -> 1467 imgs/s bf16/b256)
+    net = ResNet50(numClasses=1000, dataType="bfloat16").init()
     rng = np.random.default_rng(0)
-    bsz = 64
-    X = rng.normal(size=(bsz, 3, 224, 224)).astype(np.float32)
-    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, bsz)]
-    ips = _fit_throughput(net, [(X, y)], epochs_warm=2, epochs_meas=6)
+    bsz, k = 256, 3
+    X_k = rng.normal(size=(k, bsz, 3, 224, 224)).astype(np.float32)
+    y_k = np.stack([np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, bsz)] for _ in range(k)])
+    X_k = jnp.asarray(X_k, jnp.bfloat16)
+    y_k = jnp.asarray(y_k, jnp.bfloat16)
+    ips = _scan_throughput(net, X_k, y_k, trials=3)
     mfu = resnet50_train_flops(1) * ips / V5E_PEAK_BF16
     return {
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec",
+        "dataType": "bfloat16",
         "vs_baseline": round(mfu / MFU_TARGET, 3),
         "mfu": round(mfu, 4),
     }
@@ -156,10 +188,13 @@ def bench_graves_lstm():
     net = TextGenerationLSTM(vocabSize=vocab, hidden=256,
                              seqLength=seq).init()
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, vocab, (bsz, seq + 1))
-    X = np.eye(vocab, dtype=np.float32)[ids[:, :-1]].transpose(0, 2, 1)
-    y = np.eye(vocab, dtype=np.float32)[ids[:, 1:]].transpose(0, 2, 1)
-    eps = _fit_throughput(net, [(X, y)], epochs_warm=2, epochs_meas=8)
+    k = 8
+    ids = rng.integers(0, vocab, (k, bsz, seq + 1))
+    X_k = np.stack([np.eye(vocab, dtype=np.float32)[ids[i, :, :-1]]
+                    .transpose(0, 2, 1) for i in range(k)])
+    y_k = np.stack([np.eye(vocab, dtype=np.float32)[ids[i, :, 1:]]
+                    .transpose(0, 2, 1) for i in range(k)])
+    eps = _scan_throughput(net, X_k, y_k)
     return {
         "metric": "graves_lstm_char_rnn_tokens_per_sec",
         "value": round(eps * seq, 1),

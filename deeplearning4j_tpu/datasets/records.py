@@ -87,17 +87,28 @@ class CSVRecordReader(RecordReader):
         return self
 
     def _read_file(self, path):
-        """Plain numeric CSVs parse through the native C kernel (one call
-        per file); anything it rejects — quoting, non-numeric columns,
-        ragged rows — falls back to the general csv module."""
+        """Fully-numeric CSV files parse to float records — through the
+        native C kernel when the toolchain is available, else through
+        numpy — so record values are IDENTICAL with or without g++.
+        Anything non-numeric (quoting, string columns, ragged rows)
+        falls back to the general csv module and yields strings."""
         from deeplearning4j_tpu import native
 
+        with open(path, "rb") as f:
+            blob = f.read()
         if native.available():
-            with open(path, "rb") as f:
-                blob = f.read()
             mat = native.csv_parse(blob, self.delimiter)
             if mat is not None:
                 return mat.tolist()
+        else:
+            try:
+                import io
+
+                mat = np.loadtxt(io.BytesIO(blob), dtype=np.float32,
+                                 delimiter=self.delimiter, ndmin=2)
+                return mat.tolist()
+            except ValueError:
+                pass
         with open(path, newline="") as f:
             return list(csv.reader(f, delimiter=self.delimiter))
 

@@ -131,6 +131,23 @@ class ConditionOp:
     }
 
 
+class _SchemaMemo:
+    """Per-schema cached computation: rec_fns run once per RECORD, but
+    their schema is fixed per step — cache index lookups on schema id
+    (schemas live for the TransformProcess lifetime in self._schemas)."""
+
+    def __init__(self, compute):
+        self.compute = compute
+        self._cache = {}
+
+    def __call__(self, schema):
+        key = id(schema)
+        got = self._cache.get(key)
+        if got is None:
+            got = self._cache[key] = self.compute(schema)
+        return got
+
+
 class _Condition:
     def applies(self, schema, record):
         raise NotImplementedError
@@ -139,18 +156,20 @@ class _Condition:
 class DoubleColumnCondition(_Condition):
     def __init__(self, column, op, value):
         self.column, self.op, self.value = column, op, value
+        self._idx = _SchemaMemo(lambda s: s.getIndexOfColumn(self.column))
 
     def applies(self, schema, record):
-        v = float(record[schema.getIndexOfColumn(self.column)])
+        v = float(record[self._idx(schema)])
         return ConditionOp._FNS[self.op](v, self.value)
 
 
 class CategoricalColumnCondition(_Condition):
     def __init__(self, column, op, value):
         self.column, self.op, self.value = column, op, value
+        self._idx = _SchemaMemo(lambda s: s.getIndexOfColumn(self.column))
 
     def applies(self, schema, record):
-        v = str(record[schema.getIndexOfColumn(self.column)])
+        v = str(record[self._idx(schema)])
         return ConditionOp._FNS[self.op](v, self.value)
 
 
@@ -260,10 +279,11 @@ class TransformProcess:
             def schema_fn(s):
                 return Schema([c for c in s.columns if c[0] not in names])
 
+            keep_memo = _SchemaMemo(lambda s: [
+                i for i, c in enumerate(s.columns) if c[0] not in names])
+
             def rec_fn(s, r):
-                keep = [i for i, c in enumerate(s.columns)
-                        if c[0] not in names]
-                return [r[i] for i in keep]
+                return [r[i] for i in keep_memo(s)]
 
             return self._add(f"removeColumns{sorted(names)}", schema_fn,
                              rec_fn)
@@ -274,10 +294,11 @@ class TransformProcess:
             def schema_fn(s):
                 return Schema([c for c in s.columns if c[0] in keep_names])
 
+            keep_memo = _SchemaMemo(lambda s: [
+                i for i, c in enumerate(s.columns) if c[0] in keep_names])
+
             def rec_fn(s, r):
-                keep = [i for i, c in enumerate(s.columns)
-                        if c[0] in keep_names]
-                return [r[i] for i in keep]
+                return [r[i] for i in keep_memo(s)]
 
             return self._add("removeAllExcept", schema_fn, rec_fn)
 
@@ -287,10 +308,13 @@ class TransformProcess:
                 picked = [s.columns[s.getIndexOfColumn(n)] for n in names]
                 return Schema(picked + rest)
 
+            order_memo = _SchemaMemo(lambda s: (
+                lambda idx: idx + [i for i in range(s.numColumns())
+                                   if i not in set(idx)])(
+                [s.getIndexOfColumn(n) for n in names]))
+
             def rec_fn(s, r):
-                idx = [s.getIndexOfColumn(n) for n in names]
-                rest = [i for i in range(len(r)) if i not in set(idx)]
-                return [r[i] for i in idx + rest]
+                return [r[i] for i in order_memo(s)]
 
             return self._add("reorder", schema_fn, rec_fn)
 
@@ -329,11 +353,13 @@ class TransformProcess:
                     (c[0], ColumnType.Integer if c[0] in names_set
                      else c[1], c[2]) for c in s.columns])
 
+            cols_memo = _SchemaMemo(lambda s: [
+                (s.getIndexOfColumn(n), s.getMetaData(n)["categories"])
+                for n in names_set])
+
             def rec_fn(s, r):
                 out = list(r)
-                for n in names_set:
-                    i = s.getIndexOfColumn(n)
-                    cats = s.getMetaData(n)["categories"]
+                for i, cats in cols_memo(s):
                     out[i] = cats.index(str(r[i]))
                 return out
 
@@ -379,10 +405,17 @@ class TransformProcess:
                         cols.append(c)
                 return Schema(cols)
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
+                v = int(r[i])
+                if not minValue <= v <= maxValue:
+                    raise ValueError(
+                        f"integerToOneHot({name!r}): value {v} outside "
+                        f"[{minValue}, {maxValue}]")
                 onehot = [0] * width
-                onehot[int(r[i]) - minValue] = 1
+                onehot[v - minValue] = 1
                 return list(r[:i]) + onehot + list(r[i + 1:])
 
             return self._add("intToOneHot", schema_fn, rec_fn)
@@ -406,8 +439,10 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
                 out = list(r)
                 out[i] = MathOp._FNS[op](float(r[i]), scalar)
                 return out
@@ -420,8 +455,10 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
                 out = list(r)
                 out[i] = MathFunction._FNS[fn](float(r[i]))
                 return out
@@ -435,8 +472,10 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
                 out = list(r)
                 out[i] = (float(r[i]) - minValue) / span
                 return out
@@ -449,8 +488,10 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
                 out = list(r)
                 out[i] = mapping.get(str(r[i]), r[i])
                 return out
@@ -461,8 +502,10 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
-                i = s.getIndexOfColumn(name)
+                i = idx_memo(s)
                 out = list(r)
                 out[i] = str(r[i]) + toAppend
                 return out
@@ -474,10 +517,12 @@ class TransformProcess:
             def schema_fn(s):
                 return s
 
+            idx_memo = _SchemaMemo(lambda s: s.getIndexOfColumn(name))
+
             def rec_fn(s, r):
                 out = list(r)
                 if condition.applies(s, r):
-                    out[s.getIndexOfColumn(name)] = new_value
+                    out[idx_memo(s)] = new_value
                 return out
 
             return self._add("condReplace", schema_fn, rec_fn)

@@ -146,6 +146,11 @@ def _attention(q, k, v, mesh, cfg: BertConfig):
     impl = cfg.attention_impl
     if impl == "auto":
         impl = "dense"
+    if impl == "dpa":
+        # jax.nn.dot_product_attention expects [B,T,H,D]
+        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        out = jax.nn.dot_product_attention(qt, kt, vt)
+        return jnp.swapaxes(out, 1, 2)
     if impl != "flash":
         return _dense_attention(q, k, v)
     if _pallas_flash is None:

@@ -105,7 +105,8 @@ def csv_parse(text: bytes, delimiter=",") -> np.ndarray | None:
         return None
     if isinstance(text, str):
         text = text.encode()
-    cap = max(16, text.count(b",") + text.count(b"\n") + 2)
+    delim = delimiter.encode()[:1]
+    cap = max(16, text.count(delim) + text.count(b"\n") + 2)
     out = np.empty(cap, np.float32)
     cols = ctypes.c_int64(0)
     rows = lib.csv_parse(text, len(text), delimiter.encode()[:1], out,

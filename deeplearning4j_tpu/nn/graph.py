@@ -37,6 +37,7 @@ class ComputationGraph:
         self._opt_states: dict = {}
         self._listeners: list = []
         self._train_step = None
+        self._multi_step = None
         self._bucket = None  # fit batch-size bucket (pad ragged tail)
         self._infer_fn_cache = {}
         self._iteration = 0
@@ -75,7 +76,13 @@ class ComputationGraph:
     # -- pure forward over the DAG ------------------------------------------
     def _forward(self, params, states, inputs: dict, training, rng,
                  stop_before_output=False):
-        env = dict(inputs)
+        # float inputs follow the configured dataType (bf16 nets accept
+        # f32-fed batches); int inputs (embedding ids) pass through
+        dt = self.conf.dtype
+        env = {k: (v.astype(dt)
+                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                   and jnp.asarray(v).dtype != dt else v)
+               for k, v in inputs.items()}
         new_states = {}
         for i, name in enumerate(self.conf.topo_order):
             node, ins = self.conf.nodes[name]
@@ -121,33 +128,85 @@ class ComputationGraph:
         return loss, new_states
 
     # -- training ------------------------------------------------------------
+    def _step_math(self, params, states, opt_states, inputs, labels, masks,
+                   rng, it):
+        """One optimizer step as a pure traced function (shared by the
+        single-step jit and the scan-of-K-steps jit)."""
+        def loss_fn(p):
+            return self._loss_from(p, states, inputs, labels, True, rng,
+                                   masks)
+
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opts = {}, {}
+        for name, (node, _) in self.conf.nodes.items():
+            g = grads.get(name)
+            if not g:
+                new_params[name] = params[name]
+                new_opts[name] = opt_states[name]
+                continue
+            g = _normalize_grads(
+                g, getattr(node, "gradientNormalization", None),
+                getattr(node, "gradientNormalizationThreshold", None)
+                or 1.0)
+            upd, new_opt = self._updater(name).apply(
+                g, opt_states[name], params[name], it)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda p, u: p - u, params[name], upd)
+            new_opts[name] = new_opt
+        return loss, new_params, new_states, new_opts
+
     def _build_train_step(self):
         def step(params, states, opt_states, inputs, labels, masks, rng, it):
-            def loss_fn(p):
-                return self._loss_from(p, states, inputs, labels, True, rng,
-                                       masks)
-
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            new_params, new_opts = {}, {}
-            for name, (node, _) in self.conf.nodes.items():
-                g = grads.get(name)
-                if not g:
-                    new_params[name] = params[name]
-                    new_opts[name] = opt_states[name]
-                    continue
-                g = _normalize_grads(
-                    g, getattr(node, "gradientNormalization", None),
-                    getattr(node, "gradientNormalizationThreshold", None)
-                    or 1.0)
-                upd, new_opt = self._updater(name).apply(
-                    g, opt_states[name], params[name], it)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p, u: p - u, params[name], upd)
-                new_opts[name] = new_opt
-            return loss, new_params, new_states, new_opts
+            return self._step_math(params, states, opt_states, inputs,
+                                   labels, masks, rng, it)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_multi_step(self):
+        def many(params, states, opts, inputs_k, labels_k, masks_k, rng0,
+                 it0):
+            def body(carry, xs):
+                params, states, opts, it = carry
+                inputs, labels, masks = xs
+                rng = jax.random.fold_in(rng0, it)
+                loss, params, states, opts = self._step_math(
+                    params, states, opts, inputs, labels, masks, rng, it)
+                return (params, states, opts, it + 1), loss
+
+            (params, states, opts, _), losses = jax.lax.scan(
+                body, (params, states, opts, it0),
+                (inputs_k, labels_k, masks_k))
+            return losses, params, states, opts
+
+        return jax.jit(many, donate_argnums=(0, 1, 2))
+
+    def fitMultiBatch(self, features_k, labels_k):
+        """K optimizer steps in ONE device launch over stacked [K, B, ...]
+        minibatches via lax.scan (see MultiLayerNetwork.fitMultiBatch:
+        amortizes per-dispatch RPC latency). Single-input single-output
+        graphs only. Returns the [K] losses."""
+        self._check_init()
+        if getattr(self, "_multi_step", None) is None:
+            self._multi_step = self._build_multi_step()
+        # keep device-resident stacks on device (a _host_array bounce
+        # would round-trip the whole [K,B,...] block D2H then H2D)
+        f_k = _unwrap(features_k) if isinstance(
+            features_k, (jax.Array, INDArray)) else _host_array(features_k)
+        l_k = _unwrap(labels_k) if isinstance(
+            labels_k, (jax.Array, INDArray)) else _host_array(labels_k)
+        inputs_k = {self.conf.inputs[0]: f_k}
+        labels_k = {self.conf.outputs[0]: l_k}
+        masks_k = {self.conf.outputs[0]: np.ones(
+            (l_k.shape[0],) + _ones_mask(l_k[0]).shape, np.float32)}
+        rng0 = jax.random.key(self.conf.seed + 1)
+        losses, self._params, self._states, self._opt_states = \
+            self._multi_step(self._params, self._states, self._opt_states,
+                             inputs_k, labels_k, masks_k, rng0,
+                             jnp.asarray(self._iteration, jnp.int32))
+        self._iteration += int(f_k.shape[0])
+        self._score = float(losses[-1])
+        return losses
 
     def _feeds(self, ds, with_ones_masks=False):
         """Host-side feed dicts (numpy throughout: committed-vs-uncommitted
@@ -272,6 +331,7 @@ class ComputationGraph:
                     p[k].dtype)
                 off += n
         self._train_step = None
+        self._multi_step = None
 
     def getParam(self, node: str, name: str) -> INDArray:
         return INDArray(self._params[node][name])

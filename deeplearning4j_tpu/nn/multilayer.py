@@ -68,6 +68,7 @@ class MultiLayerNetwork:
         self._opt_states: list = []
         self._listeners: list = []
         self._train_step = None
+        self._multi_step = None
         self._bucket = None  # fit batch-size bucket (pad ragged tail to it)
         self._infer_fns: dict = {}
         self._profiler_cfg = None
@@ -104,6 +105,12 @@ class MultiLayerNetwork:
 
     # -- pure forward --------------------------------------------------------
     def _forward(self, params, states, x, training, rng, upto=None):
+        # float inputs follow the configured dataType (bf16 nets accept
+        # f32-fed batches); int inputs (embedding ids) pass through
+        dt = self.conf.dtype
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+            x = x.astype(dt)
         new_states = []
         n = len(self.layers) if upto is None else upto
         for i in range(n):
@@ -142,34 +149,86 @@ class MultiLayerNetwork:
         return loss + reg, new_states
 
     # -- compiled train step -------------------------------------------------
+    def _step_math(self, updaters, params, states, opt_states, f, l, lmask,
+                   rng, it):
+        """One optimizer step as a pure traced function (shared by the
+        single-step jit and the scan-of-K-steps jit)."""
+        def loss_fn(p):
+            loss, ns = self._loss_from(p, states, f, l, True, rng,
+                                       mask=lmask)
+            return loss, ns
+
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opts = [], []
+        for i, lr in enumerate(self.layers):
+            g = grads[i]
+            if not g:
+                new_params.append(params[i])
+                new_opts.append(opt_states[i])
+                continue
+            g = _normalize_grads(g, lr.gradientNormalization,
+                                 lr.gradientNormalizationThreshold or 1.0)
+            upd, new_opt = updaters[i].apply(g, opt_states[i], params[i],
+                                             it)
+            new_params.append(jax.tree_util.tree_map(
+                lambda p, u: p - u, params[i], upd))
+            new_opts.append(new_opt)
+        return loss, new_params, new_states, new_opts
+
     def _build_train_step(self):
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
         def step(params, states, opt_states, f, l, lmask, rng, it):
-            def loss_fn(p):
-                loss, ns = self._loss_from(p, states, f, l, True, rng,
-                                           mask=lmask)
-                return loss, ns
-
-            (loss, new_states), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            new_params, new_opts = [], []
-            for i, lr in enumerate(self.layers):
-                g = grads[i]
-                if not g:
-                    new_params.append(params[i])
-                    new_opts.append(opt_states[i])
-                    continue
-                g = _normalize_grads(g, lr.gradientNormalization,
-                                     lr.gradientNormalizationThreshold or 1.0)
-                upd, new_opt = updaters[i].apply(g, opt_states[i], params[i],
-                                                 it)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, u: p - u, params[i], upd))
-                new_opts.append(new_opt)
-            return loss, new_params, new_states, new_opts
+            return self._step_math(updaters, params, states, opt_states, f,
+                                   l, lmask, rng, it)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_multi_step(self):
+        updaters = [self._layer_updater(i) for i in range(len(self.layers))]
+
+        def many(params, states, opts, f_k, l_k, m_k, rng0, it0):
+            def body(carry, xs):
+                params, states, opts, it = carry
+                f, l, m = xs
+                rng = jax.random.fold_in(rng0, it)
+                loss, params, states, opts = self._step_math(
+                    updaters, params, states, opts, f, l, m, rng, it)
+                return (params, states, opts, it + 1), loss
+
+            (params, states, opts, _), losses = jax.lax.scan(
+                body, (params, states, opts, it0), (f_k, l_k, m_k))
+            return losses, params, states, opts
+
+        return jax.jit(many, donate_argnums=(0, 1, 2))
+
+    def fitMultiBatch(self, features_k, labels_k):
+        """K optimizer steps in ONE device launch: features_k/labels_k are
+        stacked [K, batch, ...] minibatches consumed by a lax.scan. This
+        amortizes per-dispatch host/RPC latency (on the axon TPU tunnel a
+        single dispatch round-trip exceeds a whole small-model step) the
+        way an on-device input pipeline would; semantics match K
+        successive fit() calls on the K slices. Returns the [K] losses."""
+        self._check_init()
+        if self._multi_step is None:
+            self._multi_step = self._build_multi_step()
+        # keep device-resident stacks on device (a _host_array bounce
+        # would round-trip the whole [K,B,...] block D2H then H2D)
+        f_k = _unwrap(features_k) if isinstance(
+            features_k, (jax.Array, INDArray)) else _host_array(features_k)
+        l_k = _unwrap(labels_k) if isinstance(
+            labels_k, (jax.Array, INDArray)) else _host_array(labels_k)
+        m_k = np.ones((l_k.shape[0],) + _ones_mask(l_k[0]).shape,
+                      np.float32)
+        rng0 = jax.random.key(self.conf.seed + 1)
+        losses, self._params, self._states, self._opt_states = \
+            self._multi_step(self._params, self._states, self._opt_states,
+                             f_k, l_k, m_k, rng0,
+                             jnp.asarray(self._iteration, jnp.int32))
+        self._iteration += int(f_k.shape[0])
+        self._score = float(losses[-1])
+        return losses
 
     def fit(self, data, epochs: int | None = None):
         """fit(iterator) / fit(iterator, nEpochs) / fit(features, labels) /
@@ -443,6 +502,7 @@ class MultiLayerNetwork:
                     p[k].dtype)
                 off += n
         self._train_step = None
+        self._multi_step = None
 
     def numParams(self) -> int:
         return sum(int(np.prod(v.shape)) for p in self._params

@@ -51,9 +51,12 @@ class A3CDiscreteDense(A2CDiscreteDense):
         steps_lock = threading.Lock()
 
         # actors read this snapshot; the learner swaps it after updates.
-        # (numpy copy: actors must not hold references into donated bufs)
-        snapshot = {"params": jax.tree_util.tree_map(np.asarray,
-                                                     self.params)}
+        # jnp.copy = fresh DEVICE buffers (safe against the learner's
+        # donation, and actors don't re-upload params every env step the
+        # way a numpy snapshot would)
+        snap_copy = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.copy, p))
+        snapshot = {"params": snap_copy(self.params)}
         infer = jax.jit(self._net)
 
         def actor(tid):
@@ -116,8 +119,7 @@ class A3CDiscreteDense(A2CDiscreteDense):
             _loss, self.params, self.opt = self._step_fn(
                 self.params, self.opt, obs_b, act_b, ret_b, self._t)
             self._t += 1
-            snapshot["params"] = jax.tree_util.tree_map(np.asarray,
-                                                        self.params)
+            snapshot["params"] = snap_copy(self.params)
         for t in threads:
             t.join(timeout=5.0)
         return finished

@@ -306,3 +306,70 @@ class TestLayerBits:
         assert abs(w.std() - np.sqrt(2.0 / 500)) < 0.01
         w = np.asarray(init_weight("relu", key, (200, 300), 200, 300))
         assert abs(w.std() - np.sqrt(2.0 / 200)) < 0.01
+
+
+class TestFitMultiBatch:
+    """K steps per device launch (lax.scan) must equal K sequential
+    fit() calls — the dispatch-amortizing path the benches measure."""
+
+    def test_mln_matches_sequential_fit(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4, 16, 10)).astype(np.float32)
+        y = np.stack([np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+                      for _ in range(4)])
+        a = MultiLayerNetwork(_mlp()).init()
+        losses = a.fitMultiBatch(X, y)
+        b = MultiLayerNetwork(_mlp()).init()
+        for i in range(4):
+            b.fit([(X[i], y[i])], 1)
+        np.testing.assert_allclose(np.asarray(a.params()),
+                                   np.asarray(b.params()), rtol=1e-6)
+        assert len(losses) == 4 and a._iteration == 4
+
+    def test_graph_matches_sequential_fit(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(3, 8, 10)).astype(np.float32)
+        y = np.stack([np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+                      for _ in range(3)])
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .updater(Adam(1e-2)).graphBuilder()
+                .addInputs("in")
+                .addLayer("d1", DenseLayer.Builder().nIn(10).nOut(12)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(12).nOut(3)
+                          .activation("softmax").lossFunction("mcxent")
+                          .build(), "d1")
+                .setOutputs("out").build())
+        a = ComputationGraph(conf).init()
+        losses = a.fitMultiBatch(X, y)
+        b = ComputationGraph(conf).init()  # re-init resets params/updaters
+        for i in range(3):
+            b.fit([(X[i], y[i])], 1)
+        np.testing.assert_allclose(np.asarray(a.params()),
+                                   np.asarray(b.params()), rtol=1e-6)
+        assert len(losses) == 3
+
+
+class TestBfloat16DataType:
+    """dataType("bfloat16") — the reference's dataType(DataType.HALF)
+    analog — must train end-to-end with bf16 params/activations."""
+
+    def test_conv_net_trains_in_bf16(self):
+        import jax.numpy as jnp
+
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .dataType("bfloat16").updater(Adam(1e-2)).list()
+                .layer(ConvolutionLayer.Builder().nOut(4).kernelSize([3, 3])
+                       .stride([1, 1]).activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .setInputType(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert net._params[0]["W"].dtype == jnp.bfloat16
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 1, 8, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        s0 = float(net.score((X, y)))
+        net.fit([(X, y)], 10)
+        assert float(net.score((X, y))) < s0

@@ -41,6 +41,9 @@ def probe(attention_impl, batch, dropout, k=10, trials=3):
     del trainer
 
 
+# extra variant list for round-2 tuning: python tools/probe_bert.py dpa
+DPA_VARIANTS = [("dpa", 16, 0.1), ("dense", 24, 0.1), ("dpa", 24, 0.1)]
+
 if __name__ == "__main__":
     import sys
     variants = [
@@ -52,7 +55,9 @@ if __name__ == "__main__":
         ("dense", 32, 0.1),
         ("flash", 64, 0.1),
     ]
-    if len(sys.argv) > 1:
+    if len(sys.argv) > 1 and sys.argv[1] == "dpa":
+        variants = DPA_VARIANTS
+    elif len(sys.argv) > 1:
         sel = int(sys.argv[1])
         variants = variants[sel:sel + 1]
     for v in variants:
