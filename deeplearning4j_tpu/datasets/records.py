@@ -105,7 +105,8 @@ class CSVRecordReader(RecordReader):
                 import io
 
                 mat = np.loadtxt(io.BytesIO(blob), dtype=np.float32,
-                                 delimiter=self.delimiter, ndmin=2)
+                                 delimiter=self.delimiter, ndmin=2,
+                                 comments=None)
                 return mat.tolist()
             except ValueError:
                 pass

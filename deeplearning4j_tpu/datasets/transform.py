@@ -141,11 +141,13 @@ class _SchemaMemo:
         self._cache = {}
 
     def __call__(self, schema):
-        key = id(schema)
-        got = self._cache.get(key)
-        if got is None:
-            got = self._cache[key] = self.compute(schema)
-        return got
+        # the schema itself is kept in the entry: a bare id() key could
+        # alias a new Schema allocated at a freed address
+        entry = self._cache.get(id(schema))
+        if entry is None or entry[0] is not schema:
+            entry = (schema, self.compute(schema))
+            self._cache[id(schema)] = entry
+        return entry[1]
 
 
 class _Condition:

@@ -175,6 +175,45 @@ def _convert_layer(class_name, kc, is_last, prev_returns_sequences):
         if not kc.get("return_sequences", False):
             return LastTimeStep(rnn)
         return rnn
+    if class_name in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
+        from deeplearning4j_tpu.nn import GlobalPoolingLayer
+
+        pt = (PoolingType.AVG if "Average" in class_name
+              else PoolingType.MAX)
+        return GlobalPoolingLayer.Builder().poolingType(pt).build()
+    if class_name == "ZeroPadding2D":
+        from deeplearning4j_tpu.nn import ZeroPaddingLayer
+
+        pad = kc.get("padding", (1, 1))
+        if isinstance(pad, int):
+            pads = [pad] * 4
+        elif pad and isinstance(pad[0], (list, tuple)):
+            # ((top, bottom), (left, right))
+            pads = [pad[0][0], pad[0][1], pad[1][0], pad[1][1]]
+        else:  # (sym_h, sym_w)
+            pads = [pad[0], pad[0], pad[1], pad[1]]
+        return ZeroPaddingLayer.Builder().padding(pads).build()
+    if class_name == "UpSampling2D":
+        from deeplearning4j_tpu.nn import Upsampling2D
+
+        size = kc.get("size", (2, 2))
+        return Upsampling2D.Builder().size(list(size)).build()
+    if class_name == "SeparableConv2D":
+        from deeplearning4j_tpu.nn import SeparableConvolution2D
+
+        ks = kc["kernel_size"]
+        st = kc.get("strides", (1, 1))
+        b = (SeparableConvolution2D.Builder().nOut(kc["filters"])
+             .kernelSize(list(ks)).stride(list(st))
+             .activation(_act(kc.get("activation")))
+             .hasBias(kc.get("use_bias", True)))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
+    if class_name == "LeakyReLU":
+        alpha = kc.get("alpha", 0.3)  # Keras default slope
+        return ActivationLayer.Builder() \
+            .activation(f"leakyrelu:{alpha}").build()
     raise ValueError(f"unsupported Keras layer: {class_name}")
 
 
@@ -287,6 +326,16 @@ def _convert_weights(layer, arrs):
     """Keras weight list -> our param dict for one layer."""
     if isinstance(layer, LastTimeStep):
         return _convert_weights(layer.rnn, arrs)
+    from deeplearning4j_tpu.nn import SeparableConvolution2D
+
+    if isinstance(layer, SeparableConvolution2D):
+        # Keras: depthwise (kh,kw,in,mult), pointwise (1,1,in*mult,out)
+        dw = np.transpose(arrs[0], (3, 2, 0, 1))   # -> (mult,in,kh,kw)
+        pw = np.transpose(arrs[1], (3, 2, 0, 1))   # -> (out,in*mult,1,1)
+        out = {"dW": dw, "pW": pw}
+        if len(arrs) > 2:
+            out["b"] = arrs[2]
+        return out
     if isinstance(layer, ConvolutionLayer):
         w = np.transpose(arrs[0], (3, 2, 0, 1))  # HWIO -> OIHW
         out = {"W": w}

@@ -51,16 +51,56 @@ class TFGraphMapper:
     """Entry points mirroring org.nd4j.imports.graphmapper.tf."""
 
     @staticmethod
-    def importGraph(path_or_graphdef, placeholder_shapes=None) -> SameDiff:
+    def importGraph(path_or_graphdef, placeholder_shapes=None,
+                    trainable=False) -> SameDiff:
         """placeholder_shapes: {placeholder_name: concrete shape} for
         graphs whose recorded input shapes have unknown (-1) dims; the
         import specializes to them (like feeding fixed shapes to the
-        reference's TFGraphMapper)."""
+        reference's TFGraphMapper).
+
+        trainable=True converts the imported weight constants to
+        VARIABLEs (see makeTrainable) so the graph can be fine-tuned —
+        the reference's imported-BERT training flow (SURVEY.md §3.4)."""
         if isinstance(path_or_graphdef, GraphDef):
             gd = path_or_graphdef
         else:
             gd = GraphDef.parse(path_or_graphdef)
-        return _Importer(gd, placeholder_shapes).run()
+        sd = _Importer(gd, placeholder_shapes).run()
+        if trainable:
+            TFGraphMapper.makeTrainable(sd)
+        return sd
+
+    @staticmethod
+    def makeTrainable(sd: SameDiff, names=None) -> list:
+        """Convert imported weight constants to trainable VARIABLEs.
+
+        A frozen GraphDef stores every weight as a Const; fine-tuning
+        needs them as variables (reference: imported SameDiff graphs
+        train after TFGraphMapper import). names=None converts every
+        float constant with more than one element (weights/biases),
+        leaving scalars and integer tables (shape consts, ids) frozen.
+        Returns the converted names."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+        converted = []
+        for name, v in sd._vars.items():
+            if v.variableType != VariableType.CONSTANT:
+                continue
+            if names is not None:
+                if name in names:
+                    sd.convertToVariable(v)
+                    converted.append(name)
+                continue
+            arr = sd._values.get(name)
+            if arr is None:
+                continue
+            arr = jnp.asarray(arr)
+            if jnp.issubdtype(arr.dtype, jnp.floating) and arr.size > 1:
+                sd.convertToVariable(v)
+                converted.append(name)
+        return converted
 
 
 class _Importer:

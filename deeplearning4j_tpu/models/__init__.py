@@ -2,8 +2,8 @@
 SURVEY.md §2.7)."""
 
 from deeplearning4j_tpu.models.zoo import (  # noqa: F401
-    AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, TextGenerationLSTM,
-    VGG16, ZooModel)
+    AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, SqueezeNet,
+    TextGenerationLSTM, UNet, VGG16, Xception, ZooModel)
 from deeplearning4j_tpu.models.bert import (  # noqa: F401
     BertConfig, BertTrainer, forward as bert_forward,
     init_params as bert_init_params, mlm_loss, param_specs as

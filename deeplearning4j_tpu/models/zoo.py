@@ -13,10 +13,11 @@ from __future__ import annotations
 
 from deeplearning4j_tpu.nn import (
     ActivationLayer, BatchNormalization, ComputationGraph, ConvolutionLayer,
-    ConvolutionMode, DenseLayer, DropoutLayer, ElementWiseVertex,
-    GlobalPoolingLayer, InputType, LocalResponseNormalization, LossLayer,
-    LSTM, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
-    PoolingType, RnnOutputLayer, SubsamplingLayer, WeightInit)
+    ConvolutionMode, Deconvolution2D, DenseLayer, DropoutLayer,
+    ElementWiseVertex, GlobalPoolingLayer, InputType,
+    LocalResponseNormalization, LossLayer, LSTM, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, PoolingType, RnnOutputLayer,
+    SeparableConvolution2D, SubsamplingLayer, WeightInit)
 from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs
 
 
@@ -424,3 +425,222 @@ class TextGenerationLSTM(ZooModel):
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init()
+
+
+class UNet(ZooModel):
+    """Reference: zoo.model.UNet (encoder-decoder segmentation net with
+    skip concatenations; Deconvolution2D upsampling). Width `base` scales
+    the published 64-filter config down for small inputs."""
+
+    def __init__(self, numClasses=1, seed=123, inputShape=(3, 128, 128),
+                 base=64, updater=None, dataType="float32"):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.base = base
+        self.updater = updater or Adam(1e-3)
+        self.dataType = dataType
+
+    def conf(self):
+        from deeplearning4j_tpu.nn import MergeVertex
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .dataType(self.dataType)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def conv(name, n, inp, act="relu", k=3):
+            g.addLayer(name, ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([k, k]).stride([1, 1])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation(act).build(), inp)
+            return name
+
+        def down(tag, n, inp):
+            a = conv(f"{tag}_c1", n, inp)
+            a = conv(f"{tag}_c2", n, a)
+            g.addLayer(f"{tag}_pool", SubsamplingLayer.Builder()
+                       .kernelSize([2, 2]).stride([2, 2]).build(), a)
+            return a, f"{tag}_pool"
+
+        def up(tag, n, inp, skip):
+            g.addLayer(f"{tag}_up", Deconvolution2D.Builder().nOut(n)
+                       .kernelSize([2, 2]).stride([2, 2])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build(), inp)
+            g.addVertex(f"{tag}_cat", MergeVertex(), f"{tag}_up", skip)
+            a = conv(f"{tag}_c1", n, f"{tag}_cat")
+            return conv(f"{tag}_c2", n, a)
+
+        b = self.base
+        s1, x = down("d1", b, "in")
+        s2, x = down("d2", b * 2, x)
+        s3, x = down("d3", b * 4, x)
+        x = conv("mid_c1", b * 8, x)
+        x = conv("mid_c2", b * 8, x)
+        x = up("u3", b * 4, x, s3)
+        x = up("u2", b * 2, x, s2)
+        x = up("u1", b, x, s1)
+        # 1x1 conv to class logits + per-pixel sigmoid loss (UNet's
+        # published single-channel mask head)
+        conv("logits", self.numClasses, x, act="identity", k=1)
+        g.addLayer("out", LossLayer(lossFunction="xent",
+                                    activation="sigmoid"), "logits")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+class SqueezeNet(ZooModel):
+    """Reference: zoo.model.SqueezeNet (v1.1: fire modules — 1x1
+    squeeze, parallel 1x1/3x3 expands concatenated)."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 227, 227),
+                 updater=None, dataType="float32"):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.updater = updater or Adam(1e-3)
+        self.dataType = dataType
+
+    def conf(self):
+        from deeplearning4j_tpu.nn import MergeVertex
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .dataType(self.dataType)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def fire(tag, inp, squeeze, expand):
+            g.addLayer(f"{tag}_sq", ConvolutionLayer.Builder().nOut(squeeze)
+                       .kernelSize([1, 1]).stride([1, 1])
+                       .activation("relu").build(), inp)
+            g.addLayer(f"{tag}_e1", ConvolutionLayer.Builder().nOut(expand)
+                       .kernelSize([1, 1]).stride([1, 1])
+                       .activation("relu").build(), f"{tag}_sq")
+            g.addLayer(f"{tag}_e3", ConvolutionLayer.Builder().nOut(expand)
+                       .kernelSize([3, 3]).stride([1, 1])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build(), f"{tag}_sq")
+            g.addVertex(f"{tag}_cat", MergeVertex(), f"{tag}_e1",
+                        f"{tag}_e3")
+            return f"{tag}_cat"
+
+        g.addLayer("conv1", ConvolutionLayer.Builder().nOut(64)
+                   .kernelSize([3, 3]).stride([2, 2]).activation("relu")
+                   .build(), "in")
+        g.addLayer("pool1", SubsamplingLayer.Builder().kernelSize([3, 3])
+                   .stride([2, 2]).build(), "conv1")
+        x = fire("f2", "pool1", 16, 64)
+        x = fire("f3", x, 16, 64)
+        g.addLayer("pool3", SubsamplingLayer.Builder().kernelSize([3, 3])
+                   .stride([2, 2]).build(), x)
+        x = fire("f4", "pool3", 32, 128)
+        x = fire("f5", x, 32, 128)
+        g.addLayer("pool5", SubsamplingLayer.Builder().kernelSize([3, 3])
+                   .stride([2, 2]).build(), x)
+        x = fire("f6", "pool5", 48, 192)
+        x = fire("f7", x, 48, 192)
+        x = fire("f8", x, 64, 256)
+        x = fire("f9", x, 64, 256)
+        g.addLayer("drop", DropoutLayer.Builder().dropOut(0.5).build(), x)
+        g.addLayer("conv10", ConvolutionLayer.Builder()
+                   .nOut(self.numClasses).kernelSize([1, 1]).stride([1, 1])
+                   .activation("relu").build(), "drop")
+        g.addLayer("gap", GlobalPoolingLayer.Builder().build(), "conv10")
+        g.addLayer("out", LossLayer(lossFunction="mcxent",
+                                    activation="softmax"), "gap")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+class Xception(ZooModel):
+    """Reference: zoo.model.Xception (depthwise-separable convolutions
+    with residual shortcuts; `blocks` scales the published 8-block middle
+    flow for small inputs)."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 299, 299),
+                 blocks=8, updater=None, dataType="float32"):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.blocks = blocks
+        self.updater = updater or Adam(1e-3)
+        self.dataType = dataType
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .dataType(self.dataType)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def sep(name, n, inp, act="relu"):
+            g.addLayer(name, SeparableConvolution2D.Builder().nOut(n)
+                       .kernelSize([3, 3]).stride([1, 1])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation(act).build(), inp)
+            return name
+
+        def bn(name, inp, act="identity"):
+            g.addLayer(name, BatchNormalization.Builder().activation(act)
+                       .build(), inp)
+            return name
+
+        # entry flow (compressed: conv stem + one strided sep block)
+        g.addLayer("conv1", ConvolutionLayer.Builder().nOut(32)
+                   .kernelSize([3, 3]).stride([2, 2]).activation("relu")
+                   .build(), "in")
+        x = bn("bn1", "conv1", "relu")
+        g.addLayer("conv2", ConvolutionLayer.Builder().nOut(64)
+                   .kernelSize([3, 3]).stride([1, 1]).activation("relu")
+                   .build(), x)
+        x = bn("bn2", "conv2", "relu")
+        mid = 128
+        a = sep("entry_s1", mid, x)
+        a = bn("entry_b1", a, "relu")
+        a = sep("entry_s2", mid, a)
+        a = bn("entry_b2", a)
+        g.addLayer("entry_pool", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), a)
+        g.addLayer("entry_proj", ConvolutionLayer.Builder().nOut(mid)
+                   .kernelSize([1, 1]).stride([2, 2]).build(), x)
+        g.addVertex("entry_add", ElementWiseVertex("Add"), "entry_pool",
+                    "entry_proj")
+        x = "entry_add"
+
+        # middle flow: residual triple-separable blocks
+        for i in range(self.blocks):
+            tag = f"mid{i}"
+            a = sep(f"{tag}_s1", mid, x)
+            a = bn(f"{tag}_b1", a, "relu")
+            a = sep(f"{tag}_s2", mid, a)
+            a = bn(f"{tag}_b2", a, "relu")
+            a = sep(f"{tag}_s3", mid, a)
+            a = bn(f"{tag}_b3", a)
+            g.addVertex(f"{tag}_add", ElementWiseVertex("Add"), a, x)
+            x = f"{tag}_add"
+
+        # exit flow
+        a = sep("exit_s1", mid * 2, x)
+        a = bn("exit_b1", a, "relu")
+        g.addLayer("gap", GlobalPoolingLayer.Builder().build(), a)
+        g.addLayer("out", OutputLayer.Builder().nOut(self.numClasses)
+                   .activation("softmax").lossFunction("mcxent").build(),
+                   "gap")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

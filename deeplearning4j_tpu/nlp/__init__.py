@@ -9,3 +9,4 @@ from deeplearning4j_tpu.nlp.paragraph_vectors import (  # noqa: F401
     LabelledDocument, ParagraphVectors)
 from deeplearning4j_tpu.nlp.serializer import (  # noqa: F401
     WordVectorSerializer)
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401

@@ -1,0 +1,236 @@
+"""GloVe word embeddings.
+
+Reference capability: `deeplearning4j-nlp` org.deeplearning4j.models.glove
+.Glove (SURVEY.md §2.7 NLP row): co-occurrence-count factorization with
+the weighted least-squares objective
+
+    J = sum_ij f(X_ij) (w_i . w~_j + b_i + b~_j - log X_ij)^2,
+    f(x) = min(1, (x / xMax)^alpha)
+
+The reference accumulates a co-occurrence map on worker threads and
+updates vectors with per-parameter AdaGrad; here the co-occurrence pass
+is host ETL (dict accumulation, 1/distance weighting like the
+reference's windowed iteration) and ALL nonzero cells train as shuffled
+device-resident batches through one jitted donated AdaGrad step —
+gather/scatter-add on the embedding tables, the same MXU/VPU pattern as
+the Word2Vec trainer (word2vec.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import VocabCache, Word2Vec
+
+
+def _glove_loss(params, rows, cols, logx, weight):
+    w, wt, b, bt = params["w"], params["wt"], params["b"], params["bt"]
+    dots = jnp.sum(w[rows] * wt[cols], axis=-1)
+    diff = dots + b[rows] + bt[cols] - logx
+    return jnp.sum(weight * diff * diff)
+
+
+class Glove:
+    class Builder:
+        def __init__(self):
+            self._kw = {
+                "minWordFrequency": 1, "vectorLength": 100,
+                "windowSize": 5, "xMax": 100.0, "alpha": 0.75,
+                "learningRate": 0.05, "epochs": 5, "batchSize": 4096,
+                "seed": 0, "symmetric": True, "shuffle": True,
+            }
+            self._iter = None
+            self._tok = None
+
+        def minWordFrequency(self, n):
+            self._kw["minWordFrequency"] = int(n)
+            return self
+
+        def vectorLength(self, n):
+            self._kw["vectorLength"] = int(n)
+            return self
+
+        # DL4J name alias
+        layerSize = vectorLength
+
+        def windowSize(self, n):
+            self._kw["windowSize"] = int(n)
+            return self
+
+        def xMax(self, x):
+            self._kw["xMax"] = float(x)
+            return self
+
+        def alpha(self, a):
+            self._kw["alpha"] = float(a)
+            return self
+
+        def learningRate(self, lr):
+            self._kw["learningRate"] = float(lr)
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batchSize"] = int(n)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def symmetric(self, b):
+            self._kw["symmetric"] = bool(b)
+            return self
+
+        def shuffle(self, b):
+            self._kw["shuffle"] = bool(b)
+            return self
+
+        def iterate(self, sentence_iterator):
+            self._iter = sentence_iterator
+            return self
+
+        def tokenizerFactory(self, tok):
+            self._tok = tok
+            return self
+
+        def build(self) -> "Glove":
+            return Glove(self._iter, self._tok or
+                         DefaultTokenizerFactory(), **self._kw)
+
+    def __init__(self, sentence_iterator, tokenizer_factory, **kw):
+        self.sentences = sentence_iterator
+        self.tokenizer = tokenizer_factory
+        self.cfg = kw
+        self.vocab = VocabCache()
+        self.params = None
+        self._step_fn = None
+
+    # -- vocab + co-occurrence (host ETL) -----------------------------------
+    def buildVocab(self):
+        counts: dict[str, int] = {}
+        for sent in self.sentences:
+            for t in self.tokenizer.create(sent).getTokens():
+                counts[t] = counts.get(t, 0) + 1
+        min_f = self.cfg["minWordFrequency"]
+        for w, c in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+            if c >= min_f:
+                self.vocab.add(w, c)
+        if self.vocab.numWords() == 0:
+            raise ValueError(
+                f"empty vocab: no word reaches minWordFrequency={min_f}")
+        return self
+
+    def _cooccurrences(self):
+        """{(i, j): weighted count} with 1/distance weighting (the
+        reference's CoOccurrences pass)."""
+        win = self.cfg["windowSize"]
+        sym = self.cfg["symmetric"]
+        co: dict[tuple, float] = {}
+        for sent in self.sentences:
+            idxs = [self.vocab.indexOf(t)
+                    for t in self.tokenizer.create(sent).getTokens()]
+            idxs = [i for i in idxs if i >= 0]
+            for pos, i in enumerate(idxs):
+                for off in range(1, win + 1):
+                    j_pos = pos + off
+                    if j_pos >= len(idxs):
+                        break
+                    j = idxs[j_pos]
+                    wgt = 1.0 / off
+                    co[(i, j)] = co.get((i, j), 0.0) + wgt
+                    if sym:
+                        co[(j, i)] = co.get((j, i), 0.0) + wgt
+        return co
+
+    # -- device training -----------------------------------------------------
+    def _build_step(self):
+        lr = self.cfg["learningRate"]
+
+        def step(params, grads_sq, rows, cols, logx, weight):
+            loss, g = jax.value_and_grad(_glove_loss)(
+                params, rows, cols, logx, weight)
+            new_p, new_gsq = {}, {}
+            for k in params:
+                gsq = grads_sq[k] + g[k] * g[k]
+                new_p[k] = params[k] - lr * g[k] / jnp.sqrt(gsq + 1e-8)
+                new_gsq[k] = gsq
+            return loss, new_p, new_gsq
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit(self):
+        if self.vocab.numWords() == 0:
+            self.buildVocab()
+        cfg = self.cfg
+        v, d = self.vocab.numWords(), cfg["vectorLength"]
+        rng = np.random.default_rng(cfg["seed"])
+        key = jax.random.key(cfg["seed"])
+        if self.params is None:
+            k1, k2 = jax.random.split(key)
+            init = lambda k: (jax.random.uniform(  # noqa: E731
+                k, (v, d), jnp.float32) - 0.5) / d
+            self.params = {"w": init(k1), "wt": init(k2),
+                           "b": jnp.zeros((v,)), "bt": jnp.zeros((v,))}
+        grads_sq = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        co = self._cooccurrences()
+        if not co:
+            raise ValueError("no co-occurrences (corpus too small?)")
+        pairs = np.asarray(list(co.keys()), np.int32)
+        counts = np.asarray(list(co.values()), np.float32)
+        logx = np.log(counts)
+        weight = np.minimum(
+            1.0, (counts / cfg["xMax"]) ** cfg["alpha"]).astype(np.float32)
+        bsz = min(cfg["batchSize"], len(pairs))
+
+        losses = []
+        for _epoch in range(cfg["epochs"]):
+            order = (rng.permutation(len(pairs)) if cfg["shuffle"]
+                     else np.arange(len(pairs)))
+            total = 0.0
+            for s in range(0, len(order) - bsz + 1, bsz):
+                sel = order[s:s + bsz]
+                loss, self.params, grads_sq = self._step_fn(
+                    self.params, grads_sq, pairs[sel, 0], pairs[sel, 1],
+                    logx[sel], weight[sel])
+                total += float(loss)
+            tail = order[len(order) - (len(order) % bsz):]
+            if len(tail):
+                # pad the ragged tail with zero-weight entries (stable
+                # jit signature, no recompile)
+                pad = bsz - len(tail)
+                sel = np.concatenate([tail, tail[:1].repeat(pad)])
+                wpad = weight[sel].copy()
+                wpad[len(tail):] = 0.0
+                loss, self.params, grads_sq = self._step_fn(
+                    self.params, grads_sq, pairs[sel, 0], pairs[sel, 1],
+                    logx[sel], wpad)
+                total += float(loss)
+            losses.append(total / max(len(pairs), 1))
+        self._loss_curve = losses
+        return self
+
+    # -- lookups (same surface as Word2Vec) ----------------------------------
+    def getWordVectorMatrix(self) -> np.ndarray:
+        # the published GloVe convention: w + w~ as the final embedding
+        return np.asarray(self.params["w"]) + np.asarray(self.params["wt"])
+
+    def getWordVector(self, word) -> np.ndarray:
+        i = self.vocab.indexOf(word)
+        if i < 0:
+            raise KeyError(word)
+        return self.getWordVectorMatrix()[i]
+
+    def hasWord(self, w):
+        return self.vocab.containsWord(w)
+
+    similarity = Word2Vec.similarity
+    wordsNearest = Word2Vec.wordsNearest

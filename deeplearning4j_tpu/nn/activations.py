@@ -67,10 +67,15 @@ class Activation:
 
 
 def resolve_activation(name):
-    """Accept a name string, an Activation constant, or a callable."""
+    """Accept a name string, an Activation constant, or a callable.
+    "leakyrelu:<alpha>" parametrizes the negative slope (serializes as a
+    plain string, like DL4J's ActivationLReLU(alpha))."""
     if callable(name):
         return name
     key = str(name).lower().replace("_", "")
+    if key.startswith("leakyrelu:"):
+        alpha = float(key.split(":", 1)[1])
+        return lambda x: jax.nn.leaky_relu(x, alpha)
     if key not in ACTIVATIONS:
         raise ValueError(f"unknown activation {name!r}")
     return ACTIVATIONS[key]

@@ -142,13 +142,18 @@ class MultiLayerConfiguration:
 
 
 def _wants_conv(layer):
+    """Layers that consume CNN activations directly — no flatten before
+    them. GlobalPooling reduces the spatial axes itself (DL4J semantics:
+    [N,C,H,W] -> [N,C]); Dropout/Activation are shape-preserving."""
     from deeplearning4j_tpu.nn.conf.layers import (
-        BatchNormalization, LocalResponseNormalization, Upsampling2D,
-        ZeroPaddingLayer, Deconvolution2D)
+        ActivationLayer, BatchNormalization, Deconvolution2D, DropoutLayer,
+        GlobalPoolingLayer, LocalResponseNormalization, Upsampling2D,
+        ZeroPaddingLayer)
 
-    return isinstance(layer, (BatchNormalization, LocalResponseNormalization,
-                              Upsampling2D, ZeroPaddingLayer,
-                              Deconvolution2D))
+    return isinstance(layer, (ActivationLayer, BatchNormalization,
+                              Deconvolution2D, DropoutLayer,
+                              GlobalPoolingLayer, LocalResponseNormalization,
+                              Upsampling2D, ZeroPaddingLayer))
 
 
 def _json_defaults(defaults):

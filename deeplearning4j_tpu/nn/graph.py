@@ -77,12 +77,16 @@ class ComputationGraph:
     def _forward(self, params, states, inputs: dict, training, rng,
                  stop_before_output=False):
         # float inputs follow the configured dataType (bf16 nets accept
-        # f32-fed batches); int inputs (embedding ids) pass through
+        # f32-fed batches); int inputs (embedding ids) pass through, and
+        # f64 is left alone — the gradient-check harness runs fp64
         dt = self.conf.dtype
-        env = {k: (v.astype(dt)
-                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
-                   and jnp.asarray(v).dtype != dt else v)
-               for k, v in inputs.items()}
+        env = {}
+        for k, v in inputs.items():
+            v = jnp.asarray(v)
+            if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != dt \
+                    and v.dtype != jnp.float64:
+                v = v.astype(dt)
+            env[k] = v
         new_states = {}
         for i, name in enumerate(self.conf.topo_order):
             node, ins = self.conf.nodes[name]

@@ -38,20 +38,26 @@ _XENT_FAMILY = {LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD}
 
 
 def _flatten_time(labels, pre):
-    """RNN outputs arrive as [N, C, T] (DL4J NCW). Fold time into batch so
-    every loss sees [N*, C]."""
-    if pre.ndim == 3:
-        pre = jnp.reshape(jnp.moveaxis(pre, 2, 1), (-1, pre.shape[1]))
-        labels = jnp.reshape(jnp.moveaxis(labels, 2, 1), (-1, labels.shape[1]))
+    """RNN outputs arrive as [N, C, T] (DL4J NCW) and segmentation
+    outputs as [N, C, H, W]. Fold time/space into batch so every loss
+    sees [N*, C]."""
+    if pre.ndim >= 3:
+        c = pre.shape[1]
+        pre = jnp.reshape(jnp.moveaxis(pre, 1, -1), (-1, c))
+        labels = jnp.reshape(jnp.moveaxis(labels, 1, -1),
+                             (-1, labels.shape[1]))
     return labels, pre
 
 
 def _per_example(loss_fn):
     def wrapped(labels, pre_output, activation, mask=None):
         labels, pre_output = _flatten_time(labels, pre_output)
-        per_ex = loss_fn(labels, pre_output, activation)  # [N]
+        per_ex = loss_fn(labels, pre_output, activation)  # [N*]
         if mask is not None:
             m = jnp.reshape(mask, (-1,)).astype(per_ex.dtype)
+            if m.size != per_ex.size and per_ex.size % m.size == 0:
+                # per-example mask against per-timestep/pixel entries
+                m = jnp.repeat(m, per_ex.size // m.size)
             return jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)
         return jnp.mean(per_ex)
 

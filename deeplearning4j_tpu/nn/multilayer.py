@@ -106,10 +106,13 @@ class MultiLayerNetwork:
     # -- pure forward --------------------------------------------------------
     def _forward(self, params, states, x, training, rng, upto=None):
         # float inputs follow the configured dataType (bf16 nets accept
-        # f32-fed batches); int inputs (embedding ids) pass through
+        # f32-fed batches); int inputs (embedding ids) pass through, and
+        # f64 is left alone — the gradient-check harness runs the whole
+        # net in fp64
         dt = self.conf.dtype
         x = jnp.asarray(x)
-        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt \
+                and x.dtype != jnp.float64:
             x = x.astype(dt)
         new_states = []
         n = len(self.layers) if upto is None else upto

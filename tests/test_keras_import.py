@@ -111,6 +111,44 @@ class TestSequentialCNN:
         np.testing.assert_allclose(got, wc.transpose(3, 2, 0, 1), rtol=1e-6)
 
 
+class TestWidenedLayerCoverage:
+    def test_padding_sepconv_upsampling_globalpool(self, tmp_path):
+        rng = np.random.default_rng(0)
+        dw = rng.normal(size=(3, 3, 2, 1)).astype(np.float32) * 0.3
+        pw = rng.normal(size=(1, 1, 2, 4)).astype(np.float32) * 0.3
+        bs = np.zeros(4, np.float32)
+        wd = rng.normal(size=(4, 3)).astype(np.float32)
+        bd = np.zeros(3, np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "ZeroPadding2D", "config": {
+                "name": "zp", "padding": [[1, 1], [2, 2]],
+                "batch_input_shape": [None, 8, 8, 2]}},
+            {"class_name": "SeparableConv2D", "config": {
+                "name": "sc", "filters": 4, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "same",
+                "activation": "relu", "use_bias": True}},
+            {"class_name": "UpSampling2D", "config": {
+                "name": "up", "size": [2, 2]}},
+            {"class_name": "GlobalAveragePooling2D", "config": {
+                "name": "gap"}},
+            _dense_cfg("out", 3, "softmax"),
+        ]}}
+        p = tmp_path / "wide.h5"
+        _write_h5(p, cfg, {
+            "sc": [("depthwise_kernel:0", dw), ("pointwise_kernel:0", pw),
+                   ("bias:0", bs)],
+            "out": [("kernel:0", wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        x = rng.normal(size=(2, 2, 8, 8)).astype(np.float32)  # NCHW
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+        # depthwise weights installed in (mult, in, kh, kw) layout
+        got = np.asarray(net.getParam(1, "dW"))
+        np.testing.assert_allclose(got, dw.transpose(3, 2, 0, 1),
+                                   rtol=1e-6)
+
+
 class TestFunctionalGraph:
     def test_two_branch_concat(self, tmp_path):
         rng = np.random.default_rng(0)

@@ -111,3 +111,44 @@ class TestBert:
         l1 = [float(t1.train_step(tokens, labels)) for _ in range(3)]
         l2 = [float(t2.train_step(tokens, labels)) for _ in range(3)]
         np.testing.assert_allclose(l1, l2, rtol=2e-3)
+
+
+class TestNewZooModels:
+    """UNet / SqueezeNet / Xception (reference zoo.model.* additions)."""
+
+    def test_unet_shapes_and_training(self):
+        from deeplearning4j_tpu.models.zoo import UNet
+
+        net = UNet(numClasses=1, inputShape=(3, 32, 32), base=8).init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 3, 32, 32)).astype(np.float32)
+        out = net.output(X)[0]
+        assert np.asarray(out).shape == (2, 1, 32, 32)  # mask-sized
+        y = (rng.random((2, 1, 32, 32)) > 0.5).astype(np.float32)
+        s0 = float(net.score((X, y)))
+        net.fit([(X, y)], 3)
+        assert float(net.score((X, y))) < s0
+
+    def test_squeezenet_fire_modules(self):
+        from deeplearning4j_tpu.models.zoo import SqueezeNet
+
+        net = SqueezeNet(numClasses=5, inputShape=(3, 64, 64)).init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 3, 64, 64)).astype(np.float32)
+        out = np.asarray(net.output(X)[0])
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+    def test_xception_separable_residuals(self):
+        from deeplearning4j_tpu.models.zoo import Xception
+
+        net = Xception(numClasses=4, inputShape=(3, 32, 32), blocks=2) \
+            .init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 3, 32, 32)).astype(np.float32)
+        out = np.asarray(net.output(X)[0])
+        assert out.shape == (2, 4)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 2)]
+        s0 = float(net.score((X, y)))
+        net.fit([(X, y)], 3)
+        assert float(net.score((X, y))) < s0

@@ -122,3 +122,43 @@ class TestParagraphVectors:
         labels = pv.nearestLabels("cat dog cow horse cat dog", 4)
         n_animal = sum(1 for l in labels if l.startswith("animal"))
         assert n_animal >= 3, labels
+
+
+class TestGlove:
+    CORPUS = ["the king sits on the throne",
+              "the queen sits on the throne",
+              "the dog runs in the park",
+              "the cat runs in the park",
+              "king and queen rule the land",
+              "dog and cat play in the park"] * 8
+
+    def test_trains_and_loss_decreases(self):
+        from deeplearning4j_tpu.nlp import Glove
+
+        g = (Glove.Builder().minWordFrequency(1).vectorLength(16)
+             .windowSize(3).learningRate(0.05).epochs(12).seed(1)
+             .iterate(self.CORPUS).build())
+        g.fit()
+        assert g._loss_curve[-1] < g._loss_curve[0]
+        vec = g.getWordVector("king")
+        assert vec.shape == (16,) and np.isfinite(vec).all()
+
+    def test_distributional_similarity(self):
+        from deeplearning4j_tpu.nlp import Glove
+
+        g = (Glove.Builder().minWordFrequency(1).vectorLength(24)
+             .windowSize(4).learningRate(0.08).epochs(60).seed(3)
+             .iterate(self.CORPUS).build())
+        g.fit()
+        # king/queen share contexts (sits/throne/rule); park words do not
+        assert g.similarity("king", "queen") > g.similarity("king", "park")
+
+    def test_unknown_word_raises(self):
+        from deeplearning4j_tpu.nlp import Glove
+
+        g = (Glove.Builder().minWordFrequency(1).vectorLength(8)
+             .epochs(1).iterate(["a b c"]).build())
+        g.fit()
+        import pytest as _pytest
+        with _pytest.raises(KeyError):
+            g.getWordVector("zebra")

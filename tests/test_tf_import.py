@@ -82,6 +82,59 @@ class TestMLPImport:
         assert np.isfinite(g).all()
 
 
+class TestFineTuneImported:
+    def test_imported_graph_fine_tunes(self):
+        """The reference's flagship import flow: frozen graph -> SameDiff
+        -> convert weights to variables -> train (SURVEY.md §3.4)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        w1 = (rng.normal(size=(6, 12)) * 0.5).astype(np.float32)
+        b1 = np.zeros(12, np.float32)
+        w2 = (rng.normal(size=(12, 3)) * 0.5).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [16, 6]),
+            const("w1", w1), const("b1", b1), const("w2", w2),
+            NodeDef("mm1", "MatMul", ["x", "w1"], {"T": F32}),
+            NodeDef("h", "BiasAdd", ["mm1", "b1"], {"T": F32}),
+            NodeDef("act", "Relu", ["h"], {"T": F32}),
+            NodeDef("logits", "MatMul", ["act", "w2"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd, trainable=True)
+        # weight consts became variables; scalar/shape consts would not
+        assert {"w1", "b1", "w2"} <= set(sd.variableNames())
+
+        y = sd.placeHolder("y", jnp.float32, 16, 3)
+        sd.loss.softmaxCrossEntropy(sd.getVariable("logits"), y) \
+            .rename("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(5e-2), dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["y"], lossVariables=["loss"]))
+        X = rng.normal(size=(16, 6)).astype(np.float32)
+        Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        hist = sd.fit([(X, Y)], epochs=30)
+        curve = hist.lossCurve
+        assert curve[-1] < curve[0] * 0.8, (curve[0], curve[-1])
+        # the trained weights moved away from the imported values
+        assert not np.allclose(sd.getVariable("w1").getArr().numpy(), w1)
+
+    def test_make_trainable_named_subset(self):
+        gd = GraphDef([
+            placeholder("x", [2, 4]),
+            const("w", np.ones((4, 2), np.float32)),
+            const("scale", np.float32(2.0)),
+            NodeDef("mm", "MatMul", ["x", "w"], {"T": F32}),
+            NodeDef("y", "Mul", ["mm", "scale"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        converted = TFGraphMapper.makeTrainable(sd, names={"w"})
+        assert converted == ["w"]
+        assert "scale" not in sd.variableNames()
+
+
 class TestShapeAndConstFolding:
     def test_shape_pack_reshape_flatten(self):
         """Reshape(x, Pack([StridedSlice(Shape(x)), -1])) — the dynamic
