@@ -65,7 +65,7 @@ def bench_bert():
     # experimental axon tunnel's ~25 ms per-dispatch RPC latency. The
     # tunnel's throughput also varies ~2x between runs, so take the best
     # of several trials (standard peak-throughput reporting).
-    k = 10
+    k = 20  # k=10 -> 62.7 ms/step, k=20 -> 54.6 ms/step (launch amortized)
     stacks = [synthetic_mlm_batch(cfg, batch, seq, seed=s)
               for s in range(k)]
     tokens_k = np.stack([s[0] for s in stacks])
@@ -218,6 +218,13 @@ def bench_word2vec():
            .windowSize(5).negativeSample(5).batchSize(2048)
            .epochs(epochs).seed(1).iterate(sents).build())
     w2v.buildVocab()
+    # one throwaway epoch: compiles the scan executable (the steady-state
+    # number is what BASELINE compares; compile is one-time)
+    try:
+        w2v.cfg["epochs"] = 1
+        w2v.fit()
+    finally:
+        w2v.cfg["epochs"] = epochs
     t0 = time.perf_counter()
     w2v.fit()
     _ = np.asarray(w2v.syn0).sum()  # sync

@@ -179,7 +179,10 @@ class Word2Vec:
         self.syn0 = None     # input vectors [V,D]
         self.syn1 = None     # output vectors [V,D]
         self._neg_table = None
+        self._neg_table_int = None
         self._step_fn = None
+        self._multi_fn = None
+        self._k_bucket = None
 
     # -- vocab ---------------------------------------------------------------
     def buildVocab(self):
@@ -194,10 +197,24 @@ class Word2Vec:
         if self.vocab.numWords() == 0:
             raise ValueError(
                 f"empty vocab: no word reaches minWordFrequency={min_f}")
-        freqs = np.array([w.count for w in self.vocab.words], np.float64)
+        self._build_neg_tables()
+        return self
+
+    def _build_neg_tables(self):
+        """Unigram^0.75 negative-sampling tables from the current vocab —
+        callable lazily too, for models whose vocab was installed by a
+        deserializer rather than buildVocab()."""
+        freqs = np.array([max(w.count, 1) for w in self.vocab.words],
+                         np.float64)
         probs = freqs ** 0.75
         self._neg_table = (probs / probs.sum()).astype(np.float64)
-        return self
+        # quantized unigram table (the original word2vec trick): sampling
+        # becomes a uniform-int gather, ~10x cheaper than choice(p=...)
+        table_size = min(1_000_000, max(10_000, 100 * len(freqs)))
+        counts = np.maximum(
+            1, np.round(self._neg_table * table_size)).astype(np.int64)
+        self._neg_table_int = np.repeat(
+            np.arange(len(freqs), dtype=np.int32), counts)
 
     # -- pair generation (host ETL) -----------------------------------------
     def _encode_corpus(self, rng):
@@ -261,9 +278,34 @@ class Word2Vec:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
+    def _build_multi_step(self):
+        """Whole-epoch SGNS training in ONE device launch: lax.scan over
+        stacked [K, bsz] batches (same dispatch-amortization as
+        MultiLayerNetwork.fitMultiBatch — per-launch RPC latency exceeds
+        a whole SGNS step at default batch sizes)."""
+        lr = self.cfg["learningRate"]
+
+        def many(syn0, syn1, cent_k, ctx_k, negs_k, w_k):
+            def body(carry, xs):
+                syn0, syn1 = carry
+                cent, ctx, negs, w = xs
+                loss, (g0, g1) = jax.value_and_grad(
+                    _sgns_loss, argnums=(0, 1))(syn0, syn1, cent, ctx,
+                                                negs, w)
+                return (syn0 - lr * g0, syn1 - lr * g1), loss
+
+            (syn0, syn1), losses = jax.lax.scan(
+                body, (syn0, syn1), (cent_k, ctx_k, negs_k, w_k))
+            return losses, syn0, syn1
+
+        return jax.jit(many, donate_argnums=(0, 1))
+
     def fit(self):
         if self.vocab.numWords() == 0:
             self.buildVocab()
+        if self._neg_table_int is None:
+            # vocab may have been installed by a deserializer
+            self._build_neg_tables()
         cfg = self.cfg
         v, d = self.vocab.numWords(), cfg["layerSize"]
         rng = np.random.default_rng(cfg["seed"])
@@ -280,16 +322,38 @@ class Word2Vec:
         syn0, syn1 = self.syn0, self.syn1
         for _epoch in range(cfg["epochs"]):
             encoded = self._encode_corpus(rng)
-            if cbow:
-                batches = self._cbow_batches(encoded, rng, bsz)
-            else:
+            if not cbow:
+                # SGNS fast path: stack the epoch's batches and run them
+                # through one scan launch per `iterations` pass
                 centers, contexts = self._make_pairs(encoded, rng)
                 order = rng.permutation(len(centers))
                 centers, contexts = centers[order], contexts[order]
-                batches = [
-                    (centers[i:i + bsz], contexts[i:i + bsz])
-                    for i in range(0, len(centers), bsz)
-                ] or [(centers, contexts)]
+                n = len(centers)
+                k = max(1, (n + bsz - 1) // bsz)
+                # bucket K (rounded up to a multiple of 8) so subsampling-
+                # induced pair-count jitter across epochs reuses ONE
+                # compiled scan (extra batches are zero-weighted)
+                k = -(-k // 8) * 8
+                if self._k_bucket is None or k > self._k_bucket:
+                    self._k_bucket = k
+                k = self._k_bucket
+                full = k * bsz
+                w_flat = np.concatenate(
+                    [np.ones(n, np.float32),
+                     np.zeros(full - n, np.float32)])
+                cent_k = np.resize(centers, full).reshape(k, bsz)
+                ctx_k = np.resize(contexts, full).reshape(k, bsz)
+                w_k = w_flat.reshape(k, bsz)
+                if getattr(self, "_multi_fn", None) is None:
+                    self._multi_fn = self._build_multi_step()
+                for _ in range(cfg["iterations"]):
+                    tbl = self._neg_table_int
+                    negs_k = tbl[rng.integers(0, len(tbl),
+                                              size=(k, bsz, k_neg))]
+                    _losses, syn0, syn1 = self._multi_fn(
+                        syn0, syn1, cent_k, ctx_k, negs_k, w_k)
+                continue
+            batches = self._cbow_batches(encoded, rng, bsz)
             for _ in range(cfg["iterations"]):
                 for batch in batches:
                     b = len(batch[0])

@@ -12,3 +12,5 @@ from deeplearning4j_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention)
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     alternating_dense_specs, replicated_specs)
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401
+    MultiHost, VoidConfiguration)

@@ -180,3 +180,36 @@ class TestRingAttention:
                                    rtol=5e-3, atol=1e-4)
         np.testing.assert_allclose(np.asarray(gv), np.asarray(dv),
                                    rtol=5e-3, atol=1e-4)
+
+
+class TestMultiHost:
+    """Process-group facade (reference: VoidConfiguration + the NCCL/MPI
+    transport tier — here jax.distributed, SURVEY.md §2.6/§5)."""
+
+    def test_single_process_initialize_and_topology(self):
+        from deeplearning4j_tpu.parallel.multihost import (
+            MultiHost, VoidConfiguration)
+
+        topo = MultiHost.initialize(
+            VoidConfiguration(controllerAddress="127.0.0.1:9911"),
+            num_processes=1, process_id=0)
+        try:
+            assert topo["process_count"] == 1
+            assert topo["global_devices"] >= 1
+            # idempotent
+            assert MultiHost.initialize()["process_count"] == 1
+        finally:
+            MultiHost.shutdown()
+
+    def test_void_configuration_builder_and_parity_warning(self):
+        import warnings
+
+        from deeplearning4j_tpu.parallel.multihost import VoidConfiguration
+
+        vc = (VoidConfiguration.builder()
+              .controllerAddress("10.0.0.1:8476").build())
+        assert vc.controllerAddress == "10.0.0.1:8476"
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            VoidConfiguration(networkMask="10.0.0.0/24")
+            assert any("parity" in str(x.message) for x in w)
