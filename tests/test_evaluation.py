@@ -109,3 +109,80 @@ class TestRegressionEvaluation:
         ev.eval(np.array([[1.0]]), np.array([[2.0]]))
         ev.eval(np.array([[3.0]]), np.array([[3.0]]))
         assert abs(ev.meanSquaredError(0) - 0.5) < 1e-9
+
+
+class TestEvaluationCalibration:
+    def test_perfectly_calibrated_predictions(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        rng = np.random.default_rng(0)
+        n = 20000
+        p1 = rng.random(n)
+        labels_idx = (rng.random(n) < p1).astype(int)
+        labels = np.eye(2)[labels_idx]
+        preds = np.stack([1 - p1, p1], axis=1)
+        ec = EvaluationCalibration(reliabilityDiagNumBins=10)
+        ec.eval(labels, preds)
+        rd = ec.getReliabilityDiagram(1)
+        # calibrated: fraction of positives tracks mean predicted prob
+        np.testing.assert_allclose(rd.getFractionPositivesY(),
+                                   rd.getMeanPredictedValueX(), atol=0.05)
+        assert ec.expectedCalibrationError(1) < 0.03
+
+    def test_overconfident_predictions_have_high_ece(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        rng = np.random.default_rng(1)
+        n = 5000
+        # predicts 0.95 but is right half the time
+        preds = np.tile([0.05, 0.95], (n, 1))
+        labels = np.eye(2)[rng.integers(0, 2, n)]
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds)
+        assert ec.expectedCalibrationError(1) > 0.3
+
+    def test_streaming_merge_matches_single_pass(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        rng = np.random.default_rng(2)
+        labels = np.eye(3)[rng.integers(0, 3, 600)]
+        preds = rng.dirichlet([1, 1, 1], 600)
+        whole = EvaluationCalibration().eval(labels, preds)
+        a = EvaluationCalibration().eval(labels[:250], preds[:250])
+        b = EvaluationCalibration().eval(labels[250:], preds[250:])
+        a.merge(b)
+        np.testing.assert_allclose(
+            a.expectedCalibrationError(), whole.expectedCalibrationError())
+        np.testing.assert_array_equal(
+            a.getProbabilityHistogramAllClasses(),
+            whole.getProbabilityHistogramAllClasses())
+
+    def test_shape_errors(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        ec = EvaluationCalibration()
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="2-D"):
+            ec.eval(np.zeros(4), np.zeros(4))
+        ec.eval(np.eye(2), np.eye(2))
+        with _pytest.raises(ValueError, match="class count"):
+            ec.eval(np.eye(3), np.eye(3))
+        with _pytest.raises(ValueError, match="bin configuration"):
+            other = EvaluationCalibration(reliabilityDiagNumBins=5)
+            other.eval(np.eye(2), np.eye(2))
+            ec.merge(other)
+
+    def test_mask_excludes_padding(self):
+        from deeplearning4j_tpu.evaluation import EvaluationCalibration
+
+        labels = np.array([[0, 1], [1, 0], [0, 0], [0, 0]], float)
+        preds = np.array([[0.1, 0.9], [0.8, 0.2],
+                          [0.5, 0.5], [0.5, 0.5]], float)
+        mask = np.array([1.0, 1.0, 0.0, 0.0])
+        a = EvaluationCalibration().eval(labels, preds, mask=mask)
+        b = EvaluationCalibration().eval(labels[:2], preds[:2])
+        np.testing.assert_array_equal(
+            a.getProbabilityHistogramAllClasses(),
+            b.getProbabilityHistogramAllClasses())
+        np.testing.assert_allclose(a.expectedCalibrationError(),
+                                   b.expectedCalibrationError())

@@ -121,6 +121,29 @@ class TestFineTuneImported:
         # the trained weights moved away from the imported values
         assert not np.allclose(sd.getVariable("w1").getArr().numpy(), w1)
 
+    def test_imported_graph_save_load_round_trip(self, tmp_path):
+        """An imported (non-control-flow) graph is a plain SameDiff graph
+        and must survive save/load with identical outputs."""
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=(5, 3)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [4, 5]),
+            const("w", w),
+            NodeDef("mm", "MatMul", ["x", "w"], {"T": F32}),
+            NodeDef("out", "Softmax", ["mm"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(gd, trainable=True)
+        p = tmp_path / "imported.sd"
+        sd.save(str(p))
+        sd2 = SameDiff.load(str(p))
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        a = sd.output({"x": x}, "out")["out"].numpy()
+        b = sd2.output({"x": x}, "out")["out"].numpy()
+        np.testing.assert_allclose(b, a, rtol=1e-6)
+        assert "w" in sd2.variableNames()  # trainability survived
+
     def test_make_trainable_named_subset(self):
         gd = GraphDef([
             placeholder("x", [2, 4]),
