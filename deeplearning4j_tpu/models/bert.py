@@ -49,12 +49,13 @@ class BertConfig:
     dropout: float = 0.1
     compute_dtype: str = "bfloat16"   # activations; params stay f32
     layer_norm_eps: float = 1e-12
-    # "auto" = dense softmax attention: measured on v5e (tools/probe_bert),
-    # XLA's fused dense attention beats the Pallas flash kernel ~2x at
-    # BERT-base shapes (head_dim 64 pads to the 128-wide MXU lane in the
-    # Pallas kernel; XLA's fusion keeps the [B,H,T,T] softmax on-chip
-    # well enough at T=512). "flash" remains available for long-sequence
-    # configs where the score tensor genuinely blows HBM.
+    # "auto" routes by sequence length: dense softmax up to T=1024
+    # (measured on v5e, XLA's fused dense attention beats the Pallas
+    # flash kernel ~2x at BERT-base shapes — head_dim 64 pads to the
+    # kernel's 128-wide MXU lane), and the Pallas flash kernel for
+    # longer 128-divisible T on TPU, where the quadratic [B,H,T,T]
+    # score tensor makes dense untenable. "dense"/"flash"/"dpa" force
+    # a specific implementation.
     attention_impl: str = "auto"
 
     @property
@@ -145,7 +146,17 @@ def _attention(q, k, v, mesh, cfg: BertConfig):
         return ring_attention(q, k, v, mesh)
     impl = cfg.attention_impl
     if impl == "auto":
-        impl = "dense"
+        # measured on v5e (tools/probe_bert): XLA dense attention beats
+        # the Pallas flash kernel ~2x at T=512 (head_dim 64 pads the
+        # kernel's 128-wide MXU lane), but dense materializes the
+        # [B,H,T,T] scores, whose memory grows quadratically — at long T
+        # flash's O(T) memory wins regardless of the lane penalty. The
+        # kernel is TPU-Mosaic-only and needs T divisible by its 128
+        # block; anything else stays dense.
+        t = q.shape[-2]
+        impl = ("flash" if t > 1024 and t % 128 == 0
+                and _pallas_flash is not None
+                and jax.default_backend() == "tpu" else "dense")
     if impl == "dpa":
         # jax.nn.dot_product_attention expects [B,T,H,D]
         qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))

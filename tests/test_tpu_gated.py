@@ -78,6 +78,42 @@ print('OK')
 """)
         assert "OK" in out
 
+    def test_long_sequence_auto_selects_flash(self):
+        """T=2048 on TPU: 'auto' must route to the Pallas flash kernel
+        (asserted by making the dense path raise) and match a dense
+        softmax reference on a query slice. T=1100 (non-128-divisible)
+        must stay dense rather than crash the kernel."""
+        out = _run("""
+import math
+import numpy as np, jax, jax.numpy as jnp
+import deeplearning4j_tpu.models.bert as bert
+cfg = bert.BertConfig(attention_impl='auto')
+k = jax.random.key(0)
+q, kk, v = (jax.random.normal(jax.random.fold_in(k, i),
+            (1, 4, 2048, 64), jnp.bfloat16) for i in range(3))
+_dense = bert._dense_attention
+def _boom(*a):
+    raise AssertionError('auto resolved to dense at T=2048')
+bert._dense_attention = _boom
+try:
+    out = bert._attention(q, kk, v, None, cfg)
+finally:
+    bert._dense_attention = _dense
+assert out.shape == (1, 4, 2048, 64)
+s = jnp.einsum('bhqd,bhkd->bhqk', q[:, :, :256].astype(jnp.float32),
+               kk.astype(jnp.float32)) / math.sqrt(64)
+w = jax.nn.softmax(s, axis=-1)
+ref = jnp.einsum('bhqk,bhkd->bhqd', w, v.astype(jnp.float32))
+np.testing.assert_allclose(np.asarray(out[:, :, :256], np.float32),
+                           np.asarray(ref), rtol=5e-2, atol=5e-2)
+# non-128-divisible long T falls back to dense without crashing
+q2, k2, v2 = (a[:, :, :1100] for a in (q, kk, v))
+out2 = bert._attention(q2, k2, v2, None, cfg)
+assert out2.shape == (1, 4, 1100, 64)
+print('OK')
+""")
+        assert "OK" in out
+
     def test_inference_sync_semantics(self):
         """The axon tunnel's block_until_ready-doesn't-sync quirk
         (bench.py): float() materialization is the reliable sync —
