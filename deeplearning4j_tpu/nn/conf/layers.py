@@ -590,17 +590,29 @@ class BatchNormalization(BaseLayer):
         shape = [1] * x.ndim
         shape[1 if x.ndim > 2 else -1] = -1
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # centered two-pass stats, accumulated in f32 for low-
+            # precision activations (E[x^2]-mean^2 would cancel
+            # catastrophically; bf16 accumulators lose the variance's
+            # low bits). mean/var STAY f32 through the rsqrt — they are
+            # tiny per-channel vectors, and quantizing them to bf16
+            # before adding eps would absorb eps entirely.
+            xf = x.astype(jnp.float32) \
+                if x.dtype in (jnp.bfloat16, jnp.float16) else x
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(
+                jnp.square(xf - mean.reshape(shape)), axis=axes)
+            sdt = state["mean"].dtype
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var,
+                "mean": self.decay * state["mean"]
+                + (1 - self.decay) * mean.astype(sdt),
+                "var": self.decay * state["var"]
+                + (1 - self.decay) * var.astype(sdt),
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xn = (x - mean.reshape(shape)) * lax.rsqrt(
-            var.reshape(shape) + self.eps)
+        xn = (x - mean.reshape(shape).astype(x.dtype)) * lax.rsqrt(
+            var.reshape(shape) + self.eps).astype(x.dtype)
         if not self.lockGammaBeta:
             xn = xn * params["gamma"].reshape(shape) \
                 + params["beta"].reshape(shape)

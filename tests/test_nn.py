@@ -373,3 +373,39 @@ class TestBfloat16DataType:
         s0 = float(net.score((X, y)))
         net.fit([(X, y)], 10)
         assert float(net.score((X, y))) < s0
+
+
+class TestBatchNormNumerics:
+    def test_large_mean_small_variance_f32(self):
+        """Centered stats must survive mean >> std (a one-pass
+        E[x^2]-mean^2 formulation cancels catastrophically here)."""
+        from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+        import jax.numpy as jnp
+
+        lr = BatchNormalization.Builder().nIn(4).build()
+        lr.infer_done = True
+        params = lr.init_params(__import__("jax").random.key(0))
+        state = lr.init_state()
+        rng = np.random.default_rng(0)
+        x = (1000.0 + 0.1 * rng.normal(size=(512, 4))).astype(np.float32)
+        y, _ = lr.apply(params, state, jnp.asarray(x), True, None)
+        y = np.asarray(y)
+        # normalized output: ~zero mean, ~unit std per feature
+        np.testing.assert_allclose(y.mean(0), 0.0, atol=1e-2)
+        np.testing.assert_allclose(y.std(0), 1.0, atol=0.05)
+
+    def test_bf16_activations_f32_stats(self):
+        from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+        import jax.numpy as jnp
+
+        lr = BatchNormalization.Builder().nIn(3).build()
+        params = lr.init_params(__import__("jax").random.key(0),
+                                jnp.bfloat16)
+        state = lr.init_state(jnp.float32)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(256, 3)), jnp.bfloat16)
+        y, st = lr.apply(params, state, x, True, None)
+        assert y.dtype == jnp.bfloat16
+        assert st["mean"].dtype == jnp.float32  # running stats stay f32
+        yn = np.asarray(y, np.float32)
+        np.testing.assert_allclose(yn.mean(0), 0.0, atol=0.05)
