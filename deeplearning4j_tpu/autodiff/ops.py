@@ -947,3 +947,33 @@ def _for_loop(*state, n=None, body_fn=None):
 
 def _as_tuple(v):
     return v if isinstance(v, tuple) else (v,)
+
+
+# ---------------------------------------------------------------------------
+# TF-import support ops (registered statically so graphs holding them
+# execute after save/load in a fresh process)
+# ---------------------------------------------------------------------------
+
+@op("tfEinsum")
+def _tf_einsum(*xs, equation=None):
+    return jnp.einsum(equation, *xs)
+
+
+@op("tfZerosLike")
+def _tf_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@op("tfOnesLike")
+def _tf_ones_like(x):
+    return jnp.ones_like(x)
+
+
+@op("tfStridedSlice")
+def _tf_strided_slice(x, idx=None):
+    import numpy as _np
+
+    return x[tuple(
+        (_np.newaxis if i is None else
+         (slice(*i) if isinstance(i, (list, tuple)) else i))
+        for i in idx)]

@@ -619,18 +619,10 @@ def _h_strided_slice(im, node):
     probe = np.broadcast_to(np.int8(0), in_shape)
     _, idx = _apply_strided_slice(node, probe, begin, end, strides)
 
-    from deeplearning4j_tpu.autodiff.ops import OPS, op as _op_reg  # noqa
-
-    key = "tfStridedSlice"
-    if key not in OPS:
-        OPS[key] = lambda x, idx=None: x[tuple(
-            (np.newaxis if i is None else
-             (slice(*i) if isinstance(i, (list, tuple)) else i))
-            for i in idx)]
     ser = [None if i is None else
            ([i.start, i.stop, i.step] if isinstance(i, slice) else int(i))
            for i in idx]
-    im.emit(node, key, [ins[0]], {"idx": tuple(
+    im.emit(node, "tfStridedSlice", [ins[0]], {"idx": tuple(
         tuple(s) if isinstance(s, list) else s for s in ser)})
 
 
@@ -730,6 +722,31 @@ def _h_pad(im, node):
 @handler("Select", "SelectV2")
 def _h_select(im, node):
     im.emit(node, "where_op", im.data_inputs(node))
+
+
+@handler("Einsum")
+def _h_einsum(im, node):
+    """tf.einsum with a static equation attr — XLA-exported BERT graphs
+    express their projections this way."""
+    eq = node.attrs["equation"].s.decode()
+    im.emit(node, "tfEinsum", im.data_inputs(node), {"equation": eq})
+
+
+@handler("Cumsum")
+def _h_cumsum(im, node):
+    ins = im.data_inputs(node)
+    axis = int(im.need_const(ins[1], "Cumsum axis"))
+    excl = node.attrs.get("exclusive")
+    rev = node.attrs.get("reverse")
+    im.emit(node, "cumsum", [ins[0]],
+            {"axis": axis, "exclusive": bool(excl.b) if excl else False,
+             "reverse": bool(rev.b) if rev else False})
+
+
+@handler("ZerosLike", "OnesLike")
+def _h_fill_like(im, node):
+    key = "tfZerosLike" if node.op == "ZerosLike" else "tfOnesLike"
+    im.emit(node, key, im.data_inputs(node))
 
 
 @handler("Conv2D")

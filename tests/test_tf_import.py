@@ -18,6 +18,12 @@ from deeplearning4j_tpu.modelimport.tensorflow import (
 F32 = attr_type(np.float32)
 
 
+def _repo_root():
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def const(name, arr):
     arr = np.asarray(arr)
     return NodeDef(name, "Const", [], {
@@ -273,6 +279,70 @@ class TestShapeAndConstFolding:
             gd, placeholder_shapes={"x": [3, 4]})
         x = -np.ones((3, 4), np.float32)
         assert sd.output({"x": x}, "y")["y"].numpy().max() == 0.0
+
+    def test_einsum_cumsum_like_ops(self):
+        """XLA-exported BERT graphs use Einsum for projections."""
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(4, 6)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [2, 3, 4]),
+            const("w", w),
+            NodeDef("proj", "Einsum", ["x", "w"],
+                    {"equation": attr_s("abc,cd->abd"), "N": attr_i(2)}),
+            const("cax", np.int32(1)),
+            NodeDef("cum", "Cumsum", ["proj", "cax"],
+                    {"exclusive": attr_b(False),
+                     "reverse": attr_b(False)}),
+            NodeDef("zs", "ZerosLike", ["proj"], {}),
+            NodeDef("os", "OnesLike", ["proj"], {}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        outs = sd.output({"x": x}, "cum", "zs", "os")
+        expect = np.einsum("abc,cd->abd", x, w)
+        np.testing.assert_allclose(outs["cum"].numpy(),
+                                   np.cumsum(expect, axis=1), rtol=1e-4,
+                                   atol=1e-5)
+        assert outs["zs"].numpy().sum() == 0.0
+        np.testing.assert_array_equal(outs["os"].numpy(),
+                                      np.ones_like(expect))
+
+    def test_einsum_graph_loads_in_fresh_process(self, tmp_path):
+        """tfEinsum/tfStridedSlice are STATIC registry ops — a saved
+        graph holding them must execute in a process that never ran the
+        TF importer."""
+        import subprocess
+        import sys
+
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(4, 2)).astype(np.float32)
+        gd = GraphDef([
+            placeholder("x", [3, 4]),
+            const("w", w),
+            NodeDef("y", "Einsum", ["x", "w"],
+                    {"equation": attr_s("ab,bc->ac"), "N": attr_i(2)}),
+            const("b", np.array([0, 0], np.int32)),
+            const("e", np.array([2, 2], np.int32)),
+            const("s", np.array([1, 1], np.int32)),
+            NodeDef("ss", "StridedSlice", ["y", "b", "e", "s"],
+                    {"begin_mask": attr_i(0), "end_mask": attr_i(0),
+                     "shrink_axis_mask": attr_i(0)}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        p = tmp_path / "einsum.sd"
+        sd.save(str(p))
+        script = (
+            "import sys, numpy as np\n"
+            f"sys.path.insert(0, {repr(str(_repo_root()))})\n"
+            "from deeplearning4j_tpu.autodiff import SameDiff\n"
+            f"sd = SameDiff.load({repr(str(p))})\n"
+            "x = np.ones((3, 4), np.float32)\n"
+            "out = sd.output({'x': x}, 'ss')['ss'].numpy()\n"
+            "assert out.shape == (2, 2)\n"
+            "print('FRESH-PROCESS-OK')\n")
+        res = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=180)
+        assert "FRESH-PROCESS-OK" in res.stdout, res.stderr
 
     def test_unsupported_op_raises(self):
         gd = GraphDef([
