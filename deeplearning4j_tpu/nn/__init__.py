@@ -19,6 +19,9 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     OutputLayer, PoolingType, RnnOutputLayer, SeparableConvolution2D,
     SimpleRnn, Subsampling1DLayer, SubsamplingLayer, Upsampling2D,
     ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.variational import (  # noqa: F401
+    AutoEncoder, BernoulliReconstructionDistribution,
+    GaussianReconstructionDistribution, VariationalAutoencoder)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     GradientNormalization, MultiLayerNetwork)
 from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

@@ -79,6 +79,8 @@ class MultiLayerConfiguration:
             from deeplearning4j_tpu.nn.conf.layers import (
                 Convolution1DLayer, DenseLayer, EmbeddingSequenceLayer,
                 LSTM, SimpleRnn)
+            from deeplearning4j_tpu.nn.conf.variational import (
+                AutoEncoder, VariationalAutoencoder)
 
             first = self.layers[0]
             n_in = getattr(first, "nIn", None)
@@ -87,7 +89,9 @@ class MultiLayerConfiguration:
             if isinstance(first, (LSTM, SimpleRnn, Convolution1DLayer,
                                   EmbeddingSequenceLayer)):
                 it = InputType.recurrent(n_in)
-            elif isinstance(first, DenseLayer):  # includes output layers
+            elif isinstance(first, (DenseLayer, AutoEncoder,
+                                    VariationalAutoencoder)):
+                # includes output layers (DenseLayer subclasses)
                 it = InputType.feedForward(n_in)
             else:
                 return

@@ -1,0 +1,360 @@
+"""Unsupervised pretrain layers: AutoEncoder and VariationalAutoencoder.
+
+Reference capability: org.deeplearning4j.nn.conf.layers.AutoEncoder and
+org.deeplearning4j.nn.conf.layers.variational.VariationalAutoencoder
+(+ nn.layers.variational.VariationalAutoencoder runtime and the
+ReconstructionDistribution family) — SURVEY.md §2.5 "Layer impls".
+In the reference these layers carry a layerwise pretrain path
+(MultiLayerNetwork.pretrain / pretrainLayer) driven by per-op dispatch;
+here the pretrain loss is a pure function the network jits into ONE
+compiled unsupervised step (see MultiLayerNetwork.pretrainLayer).
+
+During supervised forward/backprop both layers act as plain feed-forward
+encoders, exactly like the reference (AutoEncoder.activate encodes;
+the VAE outputs the MEAN of q(z|x)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import resolve_activation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, _register
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+# ---------------------------------------------------------------------------
+# reconstruction distributions (reference:
+# nn.conf.layers.variational.{GaussianReconstructionDistribution,
+# BernoulliReconstructionDistribution})
+# ---------------------------------------------------------------------------
+
+class ReconstructionDistribution:
+    """p(x|z): maps decoder pre-activations to a log-probability of the
+    data. distributionInputSize(nIn) gives how many decoder outputs the
+    distribution needs per data dimension."""
+
+    name = "base"
+
+    def distribution_input_size(self, n_in: int) -> int:
+        raise NotImplementedError
+
+    def log_prob(self, x, pre):
+        """Sum over data dims -> per-example log p(x|z), shape [N]."""
+        raise NotImplementedError
+
+    def sample_mean(self, pre):
+        """E[x|z] from decoder pre-activations (for generateAtMeanGivenZ)."""
+        raise NotImplementedError
+
+    def to_json(self):
+        return {"@dist": type(self).__name__, **{
+            k: v for k, v in self.__dict__.items() if not k.startswith("_")}}
+
+    @staticmethod
+    def from_json(d):
+        d = dict(d)
+        cls = _DISTRIBUTIONS[d.pop("@dist")]
+        return cls(**d)
+
+
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """Decoder emits [mean, log(sigma^2)] per data dim; activation is
+    applied to the MEAN half only (reference semantics)."""
+
+    name = "gaussian"
+
+    def __init__(self, activation="identity"):
+        self.activation = activation
+
+    def distribution_input_size(self, n_in):
+        return 2 * n_in
+
+    def _split(self, pre):
+        n = pre.shape[-1] // 2
+        mean = resolve_activation(self.activation)(pre[..., :n])
+        log_var = pre[..., n:]
+        return mean, log_var
+
+    def log_prob(self, x, pre):
+        mean, log_var = self._split(pre)
+        log_var = jnp.clip(log_var, -10.0, 10.0)
+        lp = -0.5 * (jnp.log(2.0 * jnp.pi) + log_var
+                     + jnp.square(x - mean) / jnp.exp(log_var))
+        return jnp.sum(lp, axis=-1)
+
+    def sample_mean(self, pre):
+        return self._split(pre)[0]
+
+
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """Decoder emits one logit per data dim; sigmoid gives p(x=1)."""
+
+    name = "bernoulli"
+
+    def __init__(self, activation="sigmoid"):
+        self.activation = activation
+
+    def distribution_input_size(self, n_in):
+        return n_in
+
+    def log_prob(self, x, pre):
+        if self.activation == "sigmoid":
+            # stable sigmoid cross-entropy straight on the logits
+            lp = -(jnp.maximum(pre, 0.0) - pre * x
+                   + jnp.log1p(jnp.exp(-jnp.abs(pre))))
+            return jnp.sum(lp, axis=-1)
+        p = jnp.clip(resolve_activation(self.activation)(pre), 1e-7,
+                     1.0 - 1e-7)
+        return jnp.sum(x * jnp.log(p) + (1.0 - x) * jnp.log1p(-p), axis=-1)
+
+    def sample_mean(self, pre):
+        return resolve_activation(self.activation)(pre)
+
+
+_DISTRIBUTIONS = {c.__name__: c for c in (
+    GaussianReconstructionDistribution, BernoulliReconstructionDistribution)}
+
+
+def _resolve_distribution(d):
+    if isinstance(d, ReconstructionDistribution):
+        return d
+    if isinstance(d, dict):
+        return ReconstructionDistribution.from_json(d)
+    key = str(d).lower()
+    if key == "bernoulli":
+        return BernoulliReconstructionDistribution()
+    if key == "gaussian":
+        return GaussianReconstructionDistribution()
+    raise ValueError(f"unknown reconstruction distribution {d!r}")
+
+
+# ---------------------------------------------------------------------------
+# AutoEncoder
+# ---------------------------------------------------------------------------
+
+@_register
+class AutoEncoder(BaseLayer):
+    """Denoising autoencoder (reference: conf.layers.AutoEncoder).
+
+    Supervised forward = encode: act(x W + b). Pretrain loss = corrupt the
+    input with masking noise (corruptionLevel), encode, decode through the
+    TIED transpose weight W^T + visible bias, score the reconstruction
+    against the clean input.
+    """
+
+    HAS_PRETRAIN_LOSS = True
+
+    def __init__(self, nIn=None, nOut=None, corruptionLevel=0.3,
+                 sparsity=0.0, lossFunction="mse", **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.corruptionLevel = corruptionLevel
+        self.sparsity = sparsity
+        self.lossFunction = lossFunction
+
+    def apply_defaults(self, defaults):
+        # honor a global .activation(...) default; "sigmoid" is only the
+        # no-default fallback (same propagation rule as BaseOutputLayer)
+        if self.activation is None and defaults.get("activation") is None:
+            self.activation = "sigmoid"
+        super().apply_defaults(defaults)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.arrayElementsPerExample()
+        return InputType.feedForward(self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, _ = jax.random.split(key)
+        return {
+            "W": init_weight(self.weightInit, k1, (self.nIn, self.nOut),
+                             self.nIn, self.nOut, dtype),
+            "b": jnp.full((self.nOut,), float(self.biasInit or 0.0), dtype),
+            "vb": jnp.zeros((self.nIn,), dtype),
+        }
+
+    def apply(self, params, state, x, training, rng):
+        y = self._act(x @ params["W"] + params["b"])
+        return self._dropout(y, training, rng), state
+
+    def _encode(self, params, x):
+        return self._act(x @ params["W"] + params["b"])
+
+    def _decode(self, params, h):
+        return self._act(h @ params["W"].T + params["vb"])
+
+    def pretrain_loss(self, params, x, rng):
+        """Mean reconstruction loss of the denoising pass, per example."""
+        from deeplearning4j_tpu.nn.losses import resolve_loss
+
+        xc = x
+        if self.corruptionLevel and rng is not None:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.corruptionLevel, x.shape)
+            xc = jnp.where(keep, x, jnp.zeros_like(x))
+        recon_pre = self._decode(params, self._encode(params, xc))
+        # reconstruction scored pre-activation-free: the decode already
+        # applied the activation, so use the identity head
+        loss = resolve_loss(self.lossFunction)(x, recon_pre, "identity",
+                                               None)
+        if self.sparsity:
+            # KL sparsity penalty toward the target mean activation
+            rho = self.sparsity
+            h_mean = jnp.clip(jnp.mean(self._encode(params, x), axis=0),
+                              1e-6, 1.0 - 1e-6)
+            loss = loss + jnp.sum(rho * jnp.log(rho / h_mean)
+                                  + (1 - rho) * jnp.log(
+                                      (1 - rho) / (1 - h_mean)))
+        return loss
+
+
+# ---------------------------------------------------------------------------
+# VariationalAutoencoder
+# ---------------------------------------------------------------------------
+
+@_register
+class VariationalAutoencoder(BaseLayer):
+    """VAE layer (reference: conf.layers.variational.VariationalAutoencoder
+    + nn.layers.variational runtime).
+
+    nOut is the LATENT size. encoderLayerSizes / decoderLayerSizes are the
+    hidden MLP widths. Supervised forward outputs the mean of q(z|x).
+    Pretrain loss = -ELBO with the reparameterization trick:
+    KL(q(z|x) || N(0, I)) - (1/S) sum_s log p(x | z_s).
+    """
+
+    HAS_PRETRAIN_LOSS = True
+
+    def __init__(self, nIn=None, nOut=None, encoderLayerSizes=(256,),
+                 decoderLayerSizes=(256,), pzxActivationFunction="identity",
+                 reconstructionDistribution="bernoulli", numSamples=1, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.encoderLayerSizes = tuple(
+            int(s) for s in (encoderLayerSizes if isinstance(
+                encoderLayerSizes, (list, tuple)) else (encoderLayerSizes,)))
+        self.decoderLayerSizes = tuple(
+            int(s) for s in (decoderLayerSizes if isinstance(
+                decoderLayerSizes, (list, tuple)) else (decoderLayerSizes,)))
+        self.pzxActivationFunction = pzxActivationFunction
+        self.reconstructionDistribution = _resolve_distribution(
+            reconstructionDistribution)
+        self.numSamples = int(numSamples)
+
+    def apply_defaults(self, defaults):
+        if self.activation is None and defaults.get("activation") is None:
+            self.activation = "leakyrelu"
+        super().apply_defaults(defaults)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.arrayElementsPerExample()
+        return InputType.feedForward(self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = {}
+        keys = iter(jax.random.split(key, 2 * (
+            len(self.encoderLayerSizes) + len(self.decoderLayerSizes)) + 4))
+
+        def dense(prefix, shapes):
+            last = shapes[0]
+            for i, width in enumerate(shapes[1:]):
+                p[f"{prefix}W{i}"] = init_weight(
+                    self.weightInit, next(keys), (last, width), last, width,
+                    dtype)
+                p[f"{prefix}b{i}"] = jnp.zeros((width,), dtype)
+                last = width
+            return last
+
+        e_last = dense("e", (self.nIn,) + self.encoderLayerSizes)
+        p["meanW"] = init_weight(self.weightInit, next(keys),
+                                 (e_last, self.nOut), e_last, self.nOut,
+                                 dtype)
+        p["meanB"] = jnp.zeros((self.nOut,), dtype)
+        p["logVarW"] = init_weight(self.weightInit, next(keys),
+                                   (e_last, self.nOut), e_last, self.nOut,
+                                   dtype)
+        p["logVarB"] = jnp.zeros((self.nOut,), dtype)
+        d_last = dense("d", (self.nOut,) + self.decoderLayerSizes)
+        out_size = self.reconstructionDistribution.distribution_input_size(
+            self.nIn)
+        p["xW"] = init_weight(self.weightInit, next(keys),
+                              (d_last, out_size), d_last, out_size, dtype)
+        p["xB"] = jnp.zeros((out_size,), dtype)
+        return p
+
+    # -- pure pieces ---------------------------------------------------------
+    def _mlp(self, params, prefix, n, x):
+        act = resolve_activation(self.activation)
+        for i in range(n):
+            x = act(x @ params[f"{prefix}W{i}"] + params[f"{prefix}b{i}"])
+        return x
+
+    def _posterior(self, params, x):
+        h = self._mlp(params, "e", len(self.encoderLayerSizes), x)
+        mean = resolve_activation(self.pzxActivationFunction)(
+            h @ params["meanW"] + params["meanB"])
+        log_var = jnp.clip(h @ params["logVarW"] + params["logVarB"],
+                           -10.0, 10.0)
+        return mean, log_var
+
+    def _decode_pre(self, params, z):
+        h = self._mlp(params, "d", len(self.decoderLayerSizes), z)
+        return h @ params["xW"] + params["xB"]
+
+    def apply(self, params, state, x, training, rng):
+        mean, _ = self._posterior(params, x)
+        return self._dropout(mean, training, rng), state
+
+    def _sample_log_probs(self, params, x, rng, n_samples):
+        """Reparameterized samples z_s ~ q(z|x) with the three per-sample
+        log-densities the ELBO / importance estimates need. Returns
+        (kl, [log p(x|z_s)], [log p(z_s) - log q(z_s|x)])."""
+        mean, log_var = self._posterior(params, x)
+        kl = 0.5 * jnp.sum(
+            jnp.exp(log_var) + jnp.square(mean) - 1.0 - log_var, axis=-1)
+        rng = rng if rng is not None else jax.random.key(0)
+        recon, weight = [], []
+        for s in range(n_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mean.shape,
+                                    mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            recon.append(self.reconstructionDistribution.log_prob(
+                x, self._decode_pre(params, z)))
+            # log N(z; 0, I) - log N(z; mean, var), both diagonal
+            log_p_z = -0.5 * jnp.sum(jnp.square(z) + jnp.log(2 * jnp.pi),
+                                     axis=-1)
+            log_q_z = -0.5 * jnp.sum(
+                jnp.square(eps) + jnp.log(2 * jnp.pi) + log_var, axis=-1)
+            weight.append(log_p_z - log_q_z)
+        return kl, jnp.stack(recon), jnp.stack(weight)
+
+    def pretrain_loss(self, params, x, rng):
+        kl, recon, _ = self._sample_log_probs(params, x, rng,
+                                              self.numSamples)
+        return jnp.mean(kl - jnp.mean(recon, axis=0))
+
+    # -- reference inference APIs -------------------------------------------
+    def reconstruction_log_probability(self, params, x, rng=None,
+                                       num_samples=None):
+        """Per-example importance-sampled estimate of log p(x) (reference:
+        VariationalAutoencoder.reconstructionLogProbability):
+        logsumexp_s[log p(x|z_s) + log p(z_s) - log q(z_s|x)] - log S,
+        which converges to log p(x) as S grows (IWAE bound)."""
+        x = jnp.asarray(x)
+        s_total = num_samples or self.numSamples
+        _, recon, weight = self._sample_log_probs(params, x, rng, s_total)
+        return (jax.scipy.special.logsumexp(recon + weight, axis=0)
+                - jnp.log(float(s_total)))
+
+    def generate_at_mean_given_z(self, params, z):
+        """E[x|z] (reference: generateAtMeanGivenZ)."""
+        return self.reconstructionDistribution.sample_mean(
+            self._decode_pre(params, jnp.asarray(z)))
+
+    def activate_latent(self, params, x):
+        """Mean and log-variance of q(z|x)."""
+        return self._posterior(params, jnp.asarray(x))

@@ -297,6 +297,79 @@ class MultiLayerNetwork:
             self._score = float(last_loss)
         return self
 
+    # -- layerwise unsupervised pretraining (reference:
+    # MultiLayerNetwork.pretrain/pretrainLayer over AutoEncoder / VAE
+    # layers, SURVEY.md §2.5 "Layer impls"; here the unsupervised loss +
+    # updater fuse into one jitted step per layer) ---------------------------
+    def pretrainLayer(self, layer_idx: int, data, epochs: int = 1):
+        """Unsupervised pretraining of ONE layer: inputs forward through
+        layers [0, layer_idx) in inference mode, then the layer's
+        pretrain_loss is minimized with the layer's own updater."""
+        self._check_init()
+        lr = self.layers[layer_idx]
+        if not getattr(lr, "HAS_PRETRAIN_LOSS", False):
+            raise ValueError(
+                f"layer {layer_idx} ({type(lr).__name__}) has no "
+                f"unsupervised pretrain loss")
+        updater = self._layer_updater(layer_idx)
+
+        # the below-stack is FROZEN during this layer's pretraining, so its
+        # forward runs once per batch outside the differentiated step
+        def fwd(below, states, f):
+            h, _ = self._forward(below, states, f, False, None,
+                                 upto=layer_idx)
+            return _apply_preprocessor(self.conf.preprocessors[layer_idx], h)
+
+        def step(lp, opt, h, rng, it):
+            loss, g = jax.value_and_grad(
+                lambda p: lr.pretrain_loss(p, h, rng))(lp)
+            g = _normalize_grads(g, lr.gradientNormalization,
+                                 lr.gradientNormalizationThreshold or 1.0)
+            upd, opt = updater.apply(g, opt, lp, it)
+            lp = jax.tree_util.tree_map(lambda p, u: p - u, lp, upd)
+            return loss, lp, opt
+
+        fkey = ("pretrain_fwd", layer_idx)
+        skey = ("pretrain", layer_idx)
+        if skey not in self._infer_fns:
+            self._infer_fns[fkey] = jax.jit(fwd)
+            self._infer_fns[skey] = jax.jit(step, donate_argnums=(0, 1))
+        jfwd, jstep = self._infer_fns[fkey], self._infer_fns[skey]
+        base_key = jax.random.key(self.conf.seed + 2 + layer_idx)
+        loss = None
+        for epoch_i in range(epochs):
+            batches, data = _prepare_batches(data, epoch_i, epochs)
+            for ds in batches:
+                feats, _, _, _ = _split_dataset_full(ds)
+                f = _host_array(feats[0])
+                # layer 0 included: fwd still applies the dtype cast and
+                # the layer's input preprocessor
+                h = jfwd(self._params[:layer_idx], self._states, f)
+                rng = jax.random.fold_in(base_key, self._iteration)
+                loss, lp, opt = jstep(
+                    self._params[layer_idx], self._opt_states[layer_idx],
+                    h, rng, self._iteration)
+                # rebind immediately: the step DONATED the old buffers
+                self._params[layer_idx] = lp
+                self._opt_states[layer_idx] = opt
+                self._iteration += 1
+        if loss is not None:
+            self._score = float(loss)
+        return self
+
+    def pretrain(self, data, epochs: int = 1):
+        """Pretrain every pretrainable layer in order (reference:
+        MultiLayerNetwork.pretrain(DataSetIterator))."""
+        # materialize one-shot iterables ONCE so the second pretrainable
+        # layer doesn't see an exhausted generator
+        if not hasattr(data, "reset") and not isinstance(
+                data, (list, tuple)):
+            data = list(_as_batches(data))
+        for i, lr in enumerate(self.layers):
+            if getattr(lr, "HAS_PRETRAIN_LOSS", False):
+                self.pretrainLayer(i, data, epochs)
+        return self
+
     # -- TBPTT (reference: MultiLayerNetwork truncated BPTT, SURVEY.md §2.5:
     # tBPTTLength splits each minibatch sequence into segments; hidden state
     # carries ACROSS segments (no gradient flow — states enter the next

@@ -118,3 +118,26 @@ class TestGradientChecks:
         y = rng.integers(0, 2, (3, 3)).astype(np.float32)
         assert GradientCheckUtil.checkGradients(net, f, y, subset=None,
                                                 print_results=True)
+
+    def test_autoencoder_supervised(self):
+        from deeplearning4j_tpu.nn import AutoEncoder
+
+        conf = (_base().list()
+                .layer(AutoEncoder.Builder().nIn(4).nOut(5)
+                       .activation("tanh").build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .build())
+        _check(conf, (3, 4), 3, subset=None)
+
+    def test_vae_supervised(self):
+        from deeplearning4j_tpu.nn import VariationalAutoencoder
+
+        conf = (_base().list()
+                .layer(VariationalAutoencoder.Builder()
+                       .nIn(4).nOut(3).encoderLayerSizes([6])
+                       .decoderLayerSizes([6]).activation("tanh").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .lossFunction("mcxent").build())
+                .build())
+        _check(conf, (3, 4), 2, subset=None)

@@ -1,0 +1,259 @@
+"""AutoEncoder + VariationalAutoencoder layer tests.
+
+Reference capability under test: conf.layers.AutoEncoder and
+conf.layers.variational.VariationalAutoencoder with the
+MultiLayerNetwork.pretrain/pretrainLayer path (SURVEY.md §2.5).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    AutoEncoder, DenseLayer, MultiLayerConfiguration, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.variational import (
+    BernoulliReconstructionDistribution, GaussianReconstructionDistribution)
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _data(n=64, d=12, seed=0):
+    rng = np.random.RandomState(seed)
+    x = (rng.rand(n, d) > 0.5).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), (x.sum(1) > d / 2).astype(int)] = 1.0
+    return x, y
+
+
+def _net(layers, seed=12345):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .updater(Adam(1e-2))
+         .list())
+    for lr in layers:
+        b = b.layer(lr)
+    return MultiLayerNetwork(b.build()).init()
+
+
+class TestAutoEncoder:
+    def test_pretrain_reduces_reconstruction_loss(self):
+        x, _ = _data()
+        net = _net([
+            AutoEncoder.Builder(nIn=12, nOut=6, corruptionLevel=0.2).build(),
+            OutputLayer.Builder(nIn=6, nOut=2).build(),
+        ])
+        lr = net.layers[0]
+        before = float(lr.pretrain_loss(net._params[0], x, None))
+        net.pretrainLayer(0, (x, None))
+        after = float(lr.pretrain_loss(net._params[0], x, None))
+        # one batch, many implicit iterations? one step only: still must drop
+        net.pretrainLayer(0, (x, None), epochs=30)
+        final = float(lr.pretrain_loss(net._params[0], x, None))
+        assert after < before
+        assert final < after
+
+    def test_supervised_forward_shape_and_fit(self):
+        x, y = _data()
+        net = _net([
+            AutoEncoder.Builder(nIn=12, nOut=6).build(),
+            OutputLayer.Builder(nIn=6, nOut=2).build(),
+        ])
+        out = net.output(x).numpy()
+        assert out.shape == (64, 2)
+        net.fit((x, y))
+        s0 = net.score()
+        net.fit([(x, y)] * 20)
+        assert net.score() < s0
+
+    def test_json_round_trip(self):
+        net = _net([
+            AutoEncoder.Builder(nIn=12, nOut=6, corruptionLevel=0.1,
+                                sparsity=0.05,
+                                lossFunction="mse").build(),
+            OutputLayer.Builder(nIn=6, nOut=2).build(),
+        ])
+        js = net.conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        ae = conf2.layers[0]
+        assert isinstance(ae, AutoEncoder)
+        assert ae.corruptionLevel == pytest.approx(0.1)
+        assert ae.sparsity == pytest.approx(0.05)
+
+
+class TestVariationalAutoencoder:
+    def test_pretrain_improves_elbo(self):
+        x, _ = _data(n=128)
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=12, nOut=3, encoderLayerSizes=(16,),
+                decoderLayerSizes=(16,),
+                reconstructionDistribution="bernoulli").build(),
+            OutputLayer.Builder(nIn=3, nOut=2).build(),
+        ])
+        vae = net.layers[0]
+        import jax
+
+        key = jax.random.key(7)
+        before = float(vae.pretrain_loss(net._params[0], x, key))
+        net.pretrain([(x, None)] * 60)
+        after = float(vae.pretrain_loss(net._params[0], x, key))
+        assert after < before
+
+    def test_latent_and_generate_shapes(self):
+        x, _ = _data(n=8)
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=12, nOut=3, encoderLayerSizes=(10,),
+                decoderLayerSizes=(10,)).build(),
+            OutputLayer.Builder(nIn=3, nOut=2).build(),
+        ])
+        vae = net.layers[0]
+        mean, log_var = vae.activate_latent(net._params[0], x)
+        assert mean.shape == (8, 3) and log_var.shape == (8, 3)
+        gen = vae.generate_at_mean_given_z(net._params[0],
+                                           np.zeros((5, 3), np.float32))
+        assert gen.shape == (5, 12)
+        assert np.all(np.asarray(gen) >= 0) and np.all(np.asarray(gen) <= 1)
+
+    def test_reconstruction_log_probability(self):
+        x, _ = _data(n=16)
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=12, nOut=3, encoderLayerSizes=(10,),
+                decoderLayerSizes=(10,)).build(),
+            OutputLayer.Builder(nIn=3, nOut=2).build(),
+        ])
+        vae = net.layers[0]
+        lp = np.asarray(vae.reconstruction_log_probability(
+            net._params[0], x, num_samples=4))
+        assert lp.shape == (16,)
+        assert np.all(np.isfinite(lp))
+        assert np.all(lp <= 0.0 + 1e-6)  # bernoulli log-probs
+
+    def test_gaussian_distribution(self):
+        x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=6, nOut=2, encoderLayerSizes=(8,),
+                decoderLayerSizes=(8,),
+                reconstructionDistribution=GaussianReconstructionDistribution(
+                    "identity")).build(),
+            OutputLayer.Builder(nIn=2, nOut=2, lossFunction="mse",
+                                activation="identity").build(),
+        ])
+        import jax
+
+        key = jax.random.key(3)
+        before = float(net.layers[0].pretrain_loss(net._params[0], x, key))
+        net.pretrainLayer(0, [(x, None)] * 50)
+        after = float(net.layers[0].pretrain_loss(net._params[0], x, key))
+        assert after < before
+
+    def test_json_round_trip_with_distribution(self):
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=12, nOut=3, encoderLayerSizes=(16, 8),
+                decoderLayerSizes=(8, 16),
+                reconstructionDistribution=BernoulliReconstructionDistribution(
+                )).build(),
+            OutputLayer.Builder(nIn=3, nOut=2).build(),
+        ])
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        vae = conf2.layers[0]
+        assert isinstance(vae, VariationalAutoencoder)
+        assert isinstance(vae.reconstructionDistribution,
+                          BernoulliReconstructionDistribution)
+        assert vae.encoderLayerSizes == (16, 8)
+        net2 = MultiLayerNetwork(conf2).init()
+        x, _ = _data(n=4)
+        assert net2.output(x).numpy().shape == (4, 2)
+
+    def test_pretrain_rejects_non_pretrainable(self):
+        net = _net([
+            DenseLayer.Builder(nIn=12, nOut=6).build(),
+            OutputLayer.Builder(nIn=6, nOut=2).build(),
+        ])
+        with pytest.raises(ValueError):
+            net.pretrainLayer(0, (np.zeros((2, 12), np.float32), None))
+
+
+class TestPretrainPlumbing:
+    def test_generator_feeds_every_pretrainable_layer(self):
+        # regression: a one-shot generator must be materialized so the
+        # SECOND pretrainable layer doesn't see an exhausted iterator
+        x, _ = _data(n=32)
+        net = _net([
+            AutoEncoder.Builder(nIn=12, nOut=8).build(),
+            AutoEncoder.Builder(nIn=8, nOut=4).build(),
+            OutputLayer.Builder(nIn=4, nOut=2).build(),
+        ])
+        import jax
+        before1 = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).copy(), net._params[1])
+        net.pretrain(((x, None) for _ in range(5)))
+        after1 = net._params[1]
+        changed = any(
+            not np.allclose(before1[k], np.asarray(after1[k]))
+            for k in before1)
+        assert changed, "layer 1 params untouched: generator was exhausted"
+
+    def test_params_usable_after_each_pretrain_step(self):
+        # regression: donated buffers must be rebound per step, not at the
+        # end, so an interrupted loop can't leave deleted arrays behind
+        x, _ = _data(n=16)
+        net = _net([
+            AutoEncoder.Builder(nIn=12, nOut=4).build(),
+            OutputLayer.Builder(nIn=4, nOut=2).build(),
+        ])
+        net.pretrainLayer(0, (x, None))
+        out = net.output(x).numpy()  # must not raise "Array deleted"
+        assert out.shape == (16, 2)
+
+    def test_iwae_bound_tightens_with_samples(self):
+        # log p(x) estimate: more samples -> estimate must not get worse
+        # (IWAE bound is monotone in S in expectation)
+        x, _ = _data(n=32)
+        net = _net([
+            VariationalAutoencoder.Builder(
+                nIn=12, nOut=3, encoderLayerSizes=(10,),
+                decoderLayerSizes=(10,)).build(),
+            OutputLayer.Builder(nIn=3, nOut=2).build(),
+        ])
+        net.pretrainLayer(0, [(x, None)] * 30)
+        import jax
+        vae = net.layers[0]
+        key = jax.random.key(11)
+        lp1 = float(np.mean(np.asarray(vae.reconstruction_log_probability(
+            net._params[0], x, key, num_samples=1))))
+        lp64 = float(np.mean(np.asarray(vae.reconstruction_log_probability(
+            net._params[0], x, key, num_samples=64))))
+        assert lp64 >= lp1 - 0.5
+
+    def test_global_activation_default_propagates(self):
+        # regression: a builder-level .activation(...) must reach AE/VAE
+        # (fallbacks apply only when NO global default exists)
+        b = (NeuralNetConfiguration.Builder().activation("tanh").list()
+             .layer(AutoEncoder.Builder(nIn=6, nOut=4).build())
+             .layer(VariationalAutoencoder.Builder(
+                 nIn=4, nOut=2, encoderLayerSizes=(5,),
+                 decoderLayerSizes=(5,)).build())
+             .layer(OutputLayer.Builder(nIn=2, nOut=2).build()))
+        conf = b.build()
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[1].activation == "tanh"
+        # and without a global default the layer fallbacks hold
+        conf2 = _net([
+            AutoEncoder.Builder(nIn=6, nOut=4).build(),
+            OutputLayer.Builder(nIn=4, nOut=2).build(),
+        ]).conf
+        assert conf2.layers[0].activation == "sigmoid"
+
+    def test_bernoulli_distribution_honors_activation(self):
+        # identity activation: decoder output IS the probability
+        dist = BernoulliReconstructionDistribution(activation="identity")
+        import jax.numpy as jnp
+        x = jnp.asarray([[1.0, 0.0]])
+        p = jnp.asarray([[0.9, 0.2]])
+        lp = float(dist.log_prob(x, p)[0])
+        assert lp == pytest.approx(np.log(0.9) + np.log(0.8), abs=1e-5)
+        m = np.asarray(dist.sample_mean(p))
+        assert np.allclose(m, np.asarray(p))
