@@ -644,3 +644,152 @@ class Xception(ZooModel):
 
     def init(self) -> ComputationGraph:
         return ComputationGraph(self.conf()).init()
+
+
+class TinyYOLO(ZooModel):
+    """Reference: zoo.model.TinyYOLO (tiny-YOLOv2 on VOC: 5 anchor priors,
+    20 classes, 416x416 input -> 13x13 grid)."""
+
+    PRIORS = [[1.08, 1.19], [3.42, 4.41], [6.63, 11.38], [9.42, 5.11],
+              [16.62, 10.52]]
+
+    def __init__(self, numClasses=20, seed=123, inputShape=(3, 416, 416),
+                 boundingBoxPriors=None, updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.priors = (boundingBoxPriors if boundingBoxPriors is not None
+                       else self.PRIORS)
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+
+        c, h, w = self.inputShape
+        b = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater).weightInit(WeightInit.RELU).list())
+
+        def conv(n, k=3):
+            return (ConvolutionLayer.Builder().nOut(n).kernelSize([k, k])
+                    .convolutionMode(ConvolutionMode.SAME)
+                    .activation("identity").hasBias(False).build())
+
+        def bn():
+            return (BatchNormalization.Builder().activation("leakyrelu")
+                    .build())
+
+        for n in (16, 32, 64, 128, 256):
+            b = (b.layer(conv(n)).layer(bn())
+                 .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                        .stride([2, 2]).build()))
+        # stride-1 SAME pool keeps the 13x13 grid (tiny-YOLOv2 layer 6)
+        b = (b.layer(conv(512)).layer(bn())
+             .layer(SubsamplingLayer.Builder().kernelSize([2, 2])
+                    .stride([1, 1])
+                    .convolutionMode(ConvolutionMode.SAME).build()))
+        for n in (1024, 1024):
+            b = b.layer(conv(n)).layer(bn())
+        n_out = len(self.priors) * (5 + self.numClasses)
+        return (b.layer(ConvolutionLayer.Builder().nOut(n_out)
+                        .kernelSize([1, 1])
+                        .convolutionMode(ConvolutionMode.SAME)
+                        .activation("identity").build())
+                .layer(Yolo2OutputLayer(boundingBoxPriors=self.priors))
+                .setInputType(InputType.convolutional(h, w, c))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class YOLO2(ZooModel):
+    """Reference: zoo.model.YOLO2 — Darknet-19 backbone + the SpaceToDepth
+    'reorg' passthrough merging the 26x26 mid-level features into the
+    13x13 head (built as a ComputationGraph, like the reference)."""
+
+    PRIORS = [[0.57273, 0.677385], [1.87446, 2.06253], [3.33843, 5.47434],
+              [7.88282, 3.52778], [9.77052, 9.16828]]
+
+    def __init__(self, numClasses=80, seed=123, inputShape=(3, 416, 416),
+                 boundingBoxPriors=None, updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.priors = (boundingBoxPriors if boundingBoxPriors is not None
+                       else self.PRIORS)
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn import MergeVertex
+        from deeplearning4j_tpu.nn.conf.layers import SpaceToDepth
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder()
+             .addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        idx = [0]
+
+        def conv(n, k, x):
+            name = f"c{idx[0]}"
+            idx[0] += 1
+            g.addLayer(name, ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([k, k])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("identity").hasBias(False).build(), x)
+            g.addLayer(name + "b", BatchNormalization.Builder()
+                       .activation("leakyrelu").build(), name)
+            return name + "b"
+
+        def pool(x):
+            name = f"p{idx[0]}"
+            idx[0] += 1
+            g.addLayer(name, SubsamplingLayer.Builder().kernelSize([2, 2])
+                       .stride([2, 2]).build(), x)
+            return name
+
+        # darknet-19 trunk
+        x = conv(32, 3, "in")
+        x = pool(x)
+        x = conv(64, 3, x)
+        x = pool(x)
+        for n1, n2 in ((128, 64), (256, 128)):
+            x = conv(n1, 3, x)
+            x = conv(n2, 1, x)
+            x = conv(n1, 3, x)
+            x = pool(x)
+        x = conv(512, 3, x)
+        x = conv(256, 1, x)
+        x = conv(512, 3, x)
+        x = conv(256, 1, x)
+        x = conv(512, 3, x)
+        passthrough = x                     # 26x26x512 mid-level features
+        x = pool(x)
+        x = conv(1024, 3, x)
+        x = conv(512, 1, x)
+        x = conv(1024, 3, x)
+        x = conv(512, 1, x)
+        x = conv(1024, 3, x)
+        x = conv(1024, 3, x)
+        x = conv(1024, 3, x)
+
+        # reorg passthrough: 1x1 conv to 64ch, then 26x26x64 -> 13x13x256,
+        # concat with the 13x13x1024 head (YOLOv2 layout)
+        p = conv(64, 1, passthrough)
+        g.addLayer("reorg", SpaceToDepth.Builder().blockSize(2).build(), p)
+        g.addVertex("cat", MergeVertex(), "reorg", x)
+        x = conv(1024, 3, "cat")
+        n_out = len(self.priors) * (5 + self.numClasses)
+        g.addLayer("head", ConvolutionLayer.Builder().nOut(n_out)
+                   .kernelSize([1, 1]).convolutionMode(ConvolutionMode.SAME)
+                   .activation("identity").build(), x)
+        g.addLayer("out", Yolo2OutputLayer(boundingBoxPriors=self.priors),
+                   "head")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

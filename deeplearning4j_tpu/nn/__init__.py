@@ -16,9 +16,13 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     ConvolutionLayer, ConvolutionMode, Deconvolution2D, DenseLayer,
     DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
     GravesLSTM, LastTimeStep, LocalResponseNormalization, LossLayer, LSTM,
-    OutputLayer, PoolingType, RnnOutputLayer, SeparableConvolution2D,
-    SimpleRnn, Subsampling1DLayer, SubsamplingLayer, Upsampling2D,
-    ZeroPaddingLayer)
+    DepthToSpace, OutputLayer, PoolingType, RnnOutputLayer,
+    SeparableConvolution2D, SimpleRnn, SpaceToDepth, Subsampling1DLayer,
+    SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.objdetect import (  # noqa: F401
+    Yolo2OutputLayer)
+from deeplearning4j_tpu.nn.objdetect import (  # noqa: F401
+    DetectedObject, YoloUtils)
 from deeplearning4j_tpu.nn.conf.variational import (  # noqa: F401
     AutoEncoder, BernoulliReconstructionDistribution,
     GaussianReconstructionDistribution, VariationalAutoencoder)

@@ -2,3 +2,4 @@ from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
     MultiLayerConfiguration, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import variational  # noqa: F401  (registers)
+from deeplearning4j_tpu.nn.conf import objdetect  # noqa: F401  (registers)

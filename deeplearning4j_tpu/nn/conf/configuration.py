@@ -150,14 +150,16 @@ def _wants_conv(layer):
     them. GlobalPooling reduces the spatial axes itself (DL4J semantics:
     [N,C,H,W] -> [N,C]); Dropout/Activation are shape-preserving."""
     from deeplearning4j_tpu.nn.conf.layers import (
-        ActivationLayer, BatchNormalization, Deconvolution2D, DropoutLayer,
-        GlobalPoolingLayer, LocalResponseNormalization, Upsampling2D,
-        ZeroPaddingLayer)
+        ActivationLayer, BatchNormalization, Deconvolution2D, DepthToSpace,
+        DropoutLayer, GlobalPoolingLayer, LocalResponseNormalization,
+        SpaceToDepth, Upsampling2D, ZeroPaddingLayer)
+    from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
 
     return isinstance(layer, (ActivationLayer, BatchNormalization,
-                              Deconvolution2D, DropoutLayer,
+                              Deconvolution2D, DepthToSpace, DropoutLayer,
                               GlobalPoolingLayer, LocalResponseNormalization,
-                              Upsampling2D, ZeroPaddingLayer))
+                              SpaceToDepth, Upsampling2D, ZeroPaddingLayer,
+                              Yolo2OutputLayer))
 
 
 def _json_defaults(defaults):

@@ -676,6 +676,61 @@ class Upsampling2D(BaseLayer):
 
 
 @_register
+class SpaceToDepth(BaseLayer):
+    """[N,C,H,W] -> [N, C*b*b, H/b, W/b] (reference:
+    conf.layers.SpaceToDepthLayer — the YOLO2 'reorg' passthrough)."""
+
+    def __init__(self, blockSize=2, **kw):
+        super().__init__(**kw)
+        self.blockSize = int(blockSize)
+
+    def infer(self, input_type):
+        bsz = self.blockSize
+        if input_type.height % bsz or input_type.width % bsz:
+            raise ValueError(
+                f"SpaceToDepth(blockSize={bsz}) needs spatial dims "
+                f"divisible by the block, got "
+                f"{input_type.height}x{input_type.width}")
+        return InputType.convolutional(input_type.height // bsz,
+                                       input_type.width // bsz,
+                                       input_type.channels * bsz * bsz)
+
+    def apply(self, params, state, x, training, rng):
+        n, c, h, w = x.shape
+        bsz = self.blockSize
+        x = x.reshape(n, c, h // bsz, bsz, w // bsz, bsz)
+        x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+        return x.reshape(n, c * bsz * bsz, h // bsz, w // bsz), state
+
+
+@_register
+class DepthToSpace(BaseLayer):
+    """[N, C*b*b, H, W] -> [N, C, H*b, W*b] (inverse of SpaceToDepth)."""
+
+    def __init__(self, blockSize=2, **kw):
+        super().__init__(**kw)
+        self.blockSize = int(blockSize)
+
+    def infer(self, input_type):
+        bsz = self.blockSize
+        if input_type.channels % (bsz * bsz):
+            raise ValueError(
+                f"DepthToSpace(blockSize={bsz}) needs channels divisible "
+                f"by block^2, got {input_type.channels}")
+        return InputType.convolutional(input_type.height * bsz,
+                                       input_type.width * bsz,
+                                       input_type.channels // (bsz * bsz))
+
+    def apply(self, params, state, x, training, rng):
+        n, c, h, w = x.shape
+        bsz = self.blockSize
+        cout = c // (bsz * bsz)
+        x = x.reshape(n, bsz, bsz, cout, h, w)
+        x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+        return x.reshape(n, cout, h * bsz, w * bsz), state
+
+
+@_register
 class GlobalPoolingLayer(BaseLayer):
     """[N,C,H,W] -> [N,C] or [N,C,T] -> [N,C]."""
 

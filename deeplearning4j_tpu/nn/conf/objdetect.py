@@ -1,0 +1,171 @@
+"""Object detection: the YOLOv2 output layer.
+
+Reference capability: org.deeplearning4j.nn.conf.layers.objdetect
+.Yolo2OutputLayer + nn.layers.objdetect.Yolo2OutputLayer (SURVEY.md §2.5
+layer impls; used by the TinyYOLO / YOLO2 zoo models, §2.7). The
+reference computes the YOLOv2 loss with per-op dispatch over [N,B*(5+C),
+H,W] activations; here the whole loss is one pure jit-able function —
+anchor assignment (argmax IoU vs priors) is computed with vectorized
+one-hot masks so there is no data-dependent control flow.
+
+Layout contracts (identical to the reference):
+  network output: [N, B*(5+C), H, W]   B anchors, C classes,
+                  per-anchor channels = (tx, ty, tw, th, to, c_0..c_{C-1})
+  labels:         [N, 4+C, H, W]       channels = (x1, y1, x2, y2) in GRID
+                  units + one-hot class, zero everywhere for empty cells.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, LossLayer, _register)
+
+
+def _anchor_iou(wh_gt, priors):
+    """IoU of centered boxes: wh_gt [..., 2] vs priors [B, 2] -> [..., B]."""
+    gw, gh = wh_gt[..., 0:1], wh_gt[..., 1:2]            # [..., 1]
+    pw, ph = priors[:, 0], priors[:, 1]                  # [B]
+    inter = jnp.minimum(gw, pw) * jnp.minimum(gh, ph)
+    union = gw * gh + pw * ph - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@_register
+class Yolo2OutputLayer(LossLayer):
+    """YOLOv2 detection loss (reference: conf.layers.objdetect
+    .Yolo2OutputLayer.Builder with lambdaCoord/lambdaNoObj and the three
+    component losses; defaults follow the reference: L2 position/class
+    losses, lambdaCoord=5, lambdaNoObj=0.5).
+
+    boundingBoxPriors: [B, 2] anchor (width, height) pairs in grid units.
+    """
+
+    def __init__(self, boundingBoxPriors=None, lambdaCoord=5.0,
+                 lambdaNoObj=0.5, lossPositionScale="l2",
+                 lossClassPredictions="l2", **kw):
+        kw.setdefault("lossFunction", "mse")
+        super().__init__(**kw)
+        if boundingBoxPriors is None:
+            raise ValueError("Yolo2OutputLayer requires boundingBoxPriors")
+        self.boundingBoxPriors = [[float(v) for v in p]
+                                  for p in np.asarray(boundingBoxPriors)]
+        self.lambdaCoord = float(lambdaCoord)
+        self.lambdaNoObj = float(lambdaNoObj)
+        self.lossPositionScale = lossPositionScale
+        self.lossClassPredictions = lossClassPredictions
+        self.activation = "identity"
+
+    # -- geometry ------------------------------------------------------------
+    @property
+    def n_anchors(self):
+        return len(self.boundingBoxPriors)
+
+    def _split(self, x):
+        """[N, B*(5+C), H, W] -> (txy, twh, to, logits) with anchor axis:
+        txy [N,B,2,H,W], twh [N,B,2,H,W], to [N,B,H,W],
+        logits [N,B,C,H,W]."""
+        n, ch, h, w = x.shape
+        b = self.n_anchors
+        per = ch // b
+        x = x.reshape(n, b, per, h, w)
+        return (x[:, :, 0:2], x[:, :, 2:4], x[:, :, 4],
+                x[:, :, 5:])
+
+    def _decode(self, x):
+        """Decoded predictions [N, B, 5+C, H, W]: xy = cell-relative
+        sigmoid, wh = prior * exp(twh) (grid units), confidence sigmoid,
+        class softmax (reference: nn.layers.objdetect.Yolo2OutputLayer
+        .activate)."""
+        txy, twh, to, logits = self._split(x)
+        priors = jnp.asarray(self.boundingBoxPriors, x.dtype)  # [B, 2]
+        xy = jax.nn.sigmoid(txy)
+        wh = priors[None, :, :, None, None] * jnp.exp(
+            jnp.clip(twh, -10.0, 10.0))
+        conf = jax.nn.sigmoid(to)[:, :, None]
+        cls = jax.nn.softmax(logits, axis=2)
+        return jnp.concatenate([xy, wh, conf, cls], axis=2)
+
+    def apply(self, params, state, x, training, rng):
+        return self._decode(x), state
+
+    # -- loss ----------------------------------------------------------------
+    def compute_loss(self, params, x, labels, mask=None):
+        """YOLOv2 composite loss; labels [N, 4+C, H, W] (grid units)."""
+        labels = jnp.asarray(labels, x.dtype)
+        n, _, h, w = x.shape
+        b = self.n_anchors
+        priors = jnp.asarray(self.boundingBoxPriors, x.dtype)  # [B,2]
+
+        txy, twh, to, logits = self._split(x)
+        cls_gt = labels[:, 4:]                      # [N, C, H, W]
+        obj = (jnp.sum(cls_gt, axis=1) > 0).astype(x.dtype)  # [N, H, W]
+
+        x1, y1, x2, y2 = (labels[:, 0], labels[:, 1], labels[:, 2],
+                          labels[:, 3])             # [N, H, W] grid units
+        cx, cy = (x1 + x2) * 0.5, (y1 + y2) * 0.5
+        gw, gh = x2 - x1, y2 - y1
+
+        # anchor responsibility: argmax IoU(prior, gt wh), one-hot masked
+        wh_gt = jnp.stack([gw, gh], axis=-1)        # [N, H, W, 2]
+        iou_a = _anchor_iou(wh_gt, priors)          # [N, H, W, B]
+        resp = jax.nn.one_hot(jnp.argmax(iou_a, axis=-1), b,
+                              dtype=x.dtype)        # [N, H, W, B]
+        resp = jnp.moveaxis(resp, -1, 1) * obj[:, None]      # [N, B, H, W]
+
+        # position: sigmoid(txy) vs cell-relative gt center; sqrt wh
+        tx_gt = jnp.clip(cx - jnp.floor(cx), 0.0, 1.0)
+        ty_gt = jnp.clip(cy - jnp.floor(cy), 0.0, 1.0)
+        pxy = jax.nn.sigmoid(txy)                   # [N, B, 2, H, W]
+        pos = (jnp.square(pxy[:, :, 0] - tx_gt[:, None])
+               + jnp.square(pxy[:, :, 1] - ty_gt[:, None]))
+        pwh = priors[None, :, :, None, None] * jnp.exp(
+            jnp.clip(twh, -10.0, 10.0))             # [N, B, 2, H, W]
+        eps = 1e-9
+        size = (jnp.square(jnp.sqrt(pwh[:, :, 0] + eps)
+                           - jnp.sqrt(jnp.maximum(gw, 0.0) + eps)[:, None])
+                + jnp.square(jnp.sqrt(pwh[:, :, 1] + eps)
+                             - jnp.sqrt(jnp.maximum(gh, 0.0)
+                                        + eps)[:, None]))
+        loss_pos = self.lambdaCoord * jnp.sum(resp * (pos + size))
+
+        # confidence: responsible anchors target IoU(pred, gt); the rest 0
+        cell_x = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+        cell_y = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+        pcx = pxy[:, :, 0] + cell_x                 # [N, B, H, W]
+        pcy = pxy[:, :, 1] + cell_y
+        inter_w = jnp.maximum(0.0, jnp.minimum(pcx + pwh[:, :, 0] / 2,
+                                               (cx + gw / 2)[:, None])
+                              - jnp.maximum(pcx - pwh[:, :, 0] / 2,
+                                            (cx - gw / 2)[:, None]))
+        inter_h = jnp.maximum(0.0, jnp.minimum(pcy + pwh[:, :, 1] / 2,
+                                               (cy + gh / 2)[:, None])
+                              - jnp.maximum(pcy - pwh[:, :, 1] / 2,
+                                            (cy - gh / 2)[:, None]))
+        inter = inter_w * inter_h
+        union = (pwh[:, :, 0] * pwh[:, :, 1]
+                 + (gw * gh)[:, None] - inter)
+        iou = inter / jnp.maximum(union, 1e-9)      # [N, B, H, W]
+        conf = jax.nn.sigmoid(to)
+        loss_conf = (jnp.sum(resp * jnp.square(
+            conf - jax.lax.stop_gradient(iou)))
+            + self.lambdaNoObj * jnp.sum((1.0 - resp)
+                                         * jnp.square(conf)))
+
+        # class predictions on responsible anchors
+        probs = jax.nn.softmax(logits, axis=2)      # [N, B, C, H, W]
+        if str(self.lossClassPredictions).lower() in ("mcxent",
+                                                      "negativeloglikelihood"):
+            cls_term = -jnp.sum(
+                cls_gt[:, None] * jnp.log(jnp.maximum(probs, 1e-9)), axis=2)
+        else:  # L2 on the softmax outputs (reference default)
+            cls_term = jnp.sum(jnp.square(probs - cls_gt[:, None]), axis=2)
+        loss_cls = jnp.sum(resp * cls_term)
+
+        return (loss_pos + loss_conf + loss_cls) / n
+
+    def infer(self, input_type):
+        return input_type

@@ -177,8 +177,7 @@ class AutoEncoder(BaseLayer):
         }
 
     def apply(self, params, state, x, training, rng):
-        y = self._act(x @ params["W"] + params["b"])
-        return self._dropout(y, training, rng), state
+        return self._dropout(self._encode(params, x), training, rng), state
 
     def _encode(self, params, x):
         return self._act(x @ params["W"] + params["b"])

@@ -1,0 +1,100 @@
+"""Host-side object-detection post-processing.
+
+Reference capability: org.deeplearning4j.nn.layers.objdetect.{YoloUtils,
+DetectedObject} (SURVEY.md §2.5/§2.7 — used by TinyYOLO/YOLO2 zoo
+models). Decode runs on device inside the net's compiled forward (the
+Yolo2OutputLayer.apply decode); thresholding + per-class non-max
+suppression are a small host loop over the few surviving boxes, exactly
+where the reference keeps them (they are O(detections²), not O(pixels)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DetectedObject:
+    """One detection (reference: nn.layers.objdetect.DetectedObject);
+    coordinates are grid units with (cx, cy) the box center."""
+
+    def __init__(self, example, cx, cy, w, h, predicted_class, confidence,
+                 class_predictions):
+        self.exampleNumber = int(example)
+        self.centerX = float(cx)
+        self.centerY = float(cy)
+        self.width = float(w)
+        self.height = float(h)
+        self.predictedClass = int(predicted_class)
+        self.confidence = float(confidence)
+        self.classPredictions = np.asarray(class_predictions)
+
+    def getTopLeftXY(self):
+        return (self.centerX - self.width / 2,
+                self.centerY - self.height / 2)
+
+    def getBottomRightXY(self):
+        return (self.centerX + self.width / 2,
+                self.centerY + self.height / 2)
+
+    def __repr__(self):
+        return (f"DetectedObject(example={self.exampleNumber}, "
+                f"class={self.predictedClass}, conf={self.confidence:.3f}, "
+                f"cx={self.centerX:.2f}, cy={self.centerY:.2f}, "
+                f"w={self.width:.2f}, h={self.height:.2f})")
+
+
+def _iou(a, b):
+    ax1, ay1 = a.getTopLeftXY()
+    ax2, ay2 = a.getBottomRightXY()
+    bx1, by1 = b.getTopLeftXY()
+    bx2, by2 = b.getBottomRightXY()
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = ((ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter)
+    return inter / union if union > 0 else 0.0
+
+
+class YoloUtils:
+    @staticmethod
+    def getPredictedObjects(decoded, threshold=0.5,
+                            nms_threshold=0.4) -> list:
+        """decoded: the Yolo2OutputLayer forward output
+        [N, B, 5+C, H, W] (xy cell-relative, wh grid units, conf,
+        class probs). Returns DetectedObjects above `threshold`
+        object-confidence, NMS-suppressed per class at `nms_threshold`
+        IoU (reference: YoloUtils.getPredictedObjects + nonMaxSuppression).
+        """
+        d = np.asarray(decoded)
+        n, b, per, h, w = d.shape
+        out = []
+        conf = d[:, :, 4]                       # [N, B, H, W]
+        keep = np.argwhere(conf > threshold)
+        for ex, a, gy, gx in keep:
+            vec = d[ex, a, :, gy, gx]
+            cx, cy = vec[0] + gx, vec[1] + gy
+            bw, bh = vec[2], vec[3]
+            cls = vec[5:]
+            out.append(DetectedObject(ex, cx, cy, bw, bh,
+                                      int(np.argmax(cls)),
+                                      vec[4] * cls.max(), cls))
+        return YoloUtils.nonMaxSuppression(out, nms_threshold)
+
+    @staticmethod
+    def nonMaxSuppression(objects, iou_threshold=0.4) -> list:
+        """Greedy per-example, per-class NMS keeping highest-confidence
+        boxes."""
+        kept = []
+        by_key: dict = {}
+        for o in objects:
+            by_key.setdefault((o.exampleNumber, o.predictedClass),
+                              []).append(o)
+        for group in by_key.values():
+            group.sort(key=lambda o: -o.confidence)
+            chosen: list = []
+            for o in group:
+                if all(_iou(o, c) <= iou_threshold for c in chosen):
+                    chosen.append(o)
+            kept.extend(chosen)
+        kept.sort(key=lambda o: (o.exampleNumber, -o.confidence))
+        return kept

@@ -1,0 +1,166 @@
+"""Object-detection tests: Yolo2OutputLayer loss/decode, YoloUtils NMS,
+TinyYOLO/YOLO2 zoo models (reference: nn.layers.objdetect +
+zoo.model.{TinyYOLO, YOLO2}, SURVEY.md §2.5/§2.7)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    ConvolutionLayer, ConvolutionMode, DepthToSpace, DetectedObject,
+    InputType, MultiLayerNetwork, NeuralNetConfiguration, SpaceToDepth,
+    Yolo2OutputLayer, YoloUtils)
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+PRIORS = [[1.0, 1.0], [3.0, 3.0]]  # B=2 anchors
+C = 3                              # classes
+GRID = 4
+
+
+def _labels(n=2):
+    """[N, 4+C, H, W]: one object per example."""
+    y = np.zeros((n, 4 + C, GRID, GRID), np.float32)
+    for ex in range(n):
+        # object centered in cell (1, 2): box from (2.1, 1.2) to (3.3, 1.9)
+        y[ex, 0, 1, 2] = 2.1   # x1
+        y[ex, 1, 1, 2] = 1.2   # y1
+        y[ex, 2, 1, 2] = 3.3   # x2
+        y[ex, 3, 1, 2] = 1.9   # y2
+        y[ex, 4 + (ex % C), 1, 2] = 1.0
+    return y
+
+
+def _tiny_det_net(seed=7):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-3))
+         .list()
+         .layer(ConvolutionLayer.Builder().nOut(8).kernelSize([3, 3])
+                .convolutionMode(ConvolutionMode.SAME)
+                .activation("leakyrelu").build())
+         .layer(ConvolutionLayer.Builder()
+                .nOut(len(PRIORS) * (5 + C)).kernelSize([1, 1])
+                .convolutionMode(ConvolutionMode.SAME)
+                .activation("identity").build())
+         .layer(Yolo2OutputLayer(boundingBoxPriors=PRIORS))
+         .setInputType(InputType.convolutional(GRID, GRID, 2)))
+    return MultiLayerNetwork(b.build()).init()
+
+
+class TestYolo2Loss:
+    def test_loss_finite_and_decreases(self):
+        net = _tiny_det_net()
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, GRID, GRID).astype(np.float32)
+        y = _labels(2)
+        s0 = net.score((x, y))
+        assert np.isfinite(s0)
+        net.fit([(x, y)] * 60)
+        assert net.score((x, y)) < s0 * 0.7
+
+    def test_decode_shapes_and_ranges(self):
+        net = _tiny_det_net()
+        x = np.random.RandomState(1).randn(2, 2, GRID, GRID).astype(
+            np.float32)
+        out = net.output(x).numpy()
+        assert out.shape == (2, len(PRIORS), 5 + C, GRID, GRID)
+        xy = out[:, :, 0:2]
+        conf = out[:, :, 4]
+        cls = out[:, :, 5:]
+        assert np.all(xy >= 0) and np.all(xy <= 1)
+        assert np.all(conf >= 0) and np.all(conf <= 1)
+        assert np.allclose(cls.sum(axis=2), 1.0, atol=1e-5)
+        assert np.all(out[:, :, 2:4] > 0)  # wh positive
+
+    def test_trained_net_detects_the_object(self):
+        net = _tiny_det_net()
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, GRID, GRID).astype(np.float32)
+        y = _labels(1)
+        net.fit([(x, y)] * 250)
+        objs = YoloUtils.getPredictedObjects(net.output(x).numpy(),
+                                             threshold=0.35)
+        assert len(objs) >= 1
+        top = objs[0]
+        # object center is (2.7, 1.55) in grid units
+        assert abs(top.centerX - 2.7) < 1.0
+        assert abs(top.centerY - 1.55) < 1.0
+        assert top.predictedClass == 0
+
+    def test_json_round_trip(self):
+        from deeplearning4j_tpu.nn import MultiLayerConfiguration
+
+        net = _tiny_det_net()
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        out = conf2.layers[-1]
+        assert isinstance(out, Yolo2OutputLayer)
+        assert np.allclose(out.boundingBoxPriors, PRIORS)
+        assert out.lambdaCoord == pytest.approx(5.0)
+
+
+class TestYoloUtils:
+    def _obj(self, ex, cx, cy, w, h, cls, conf):
+        probs = np.zeros(C)
+        probs[cls] = 1.0
+        return DetectedObject(ex, cx, cy, w, h, cls, conf, probs)
+
+    def test_nms_suppresses_overlap_keeps_distinct(self):
+        a = self._obj(0, 2.0, 2.0, 2.0, 2.0, 1, 0.9)
+        b = self._obj(0, 2.1, 2.1, 2.0, 2.0, 1, 0.6)   # overlaps a
+        c = self._obj(0, 8.0, 8.0, 2.0, 2.0, 1, 0.7)   # far away
+        d = self._obj(0, 2.0, 2.0, 2.0, 2.0, 2, 0.5)   # other class
+        e = self._obj(1, 2.0, 2.0, 2.0, 2.0, 1, 0.4)   # other example
+        kept = YoloUtils.nonMaxSuppression([a, b, c, d, e], 0.4)
+        assert a in kept and c in kept and d in kept and e in kept
+        assert b not in kept
+
+    def test_corner_helpers(self):
+        o = self._obj(0, 3.0, 4.0, 2.0, 1.0, 0, 0.8)
+        assert o.getTopLeftXY() == (2.0, 3.5)
+        assert o.getBottomRightXY() == (4.0, 4.5)
+
+
+class TestSpaceToDepth:
+    def test_round_trip_with_depth_to_space(self):
+        from deeplearning4j_tpu.nn import OutputLayer
+
+        x = np.arange(2 * 4 * 4 * 4, dtype=np.float32).reshape(2, 4, 4, 4)
+        s2d = SpaceToDepth(blockSize=2)
+        d2s = DepthToSpace(blockSize=2)
+        y, _ = s2d.apply({}, {}, x, False, None)
+        assert y.shape == (2, 16, 2, 2)
+        z, _ = d2s.apply({}, {}, np.asarray(y), False, None)
+        assert np.array_equal(np.asarray(z), x)
+
+    def test_shape_inference(self):
+        t = InputType.convolutional(26, 26, 64)
+        out = SpaceToDepth(blockSize=2).infer(t)
+        assert (out.height, out.width, out.channels) == (13, 13, 256)
+
+
+class TestZooDetectionModels:
+    def test_tiny_yolo_builds_and_steps(self):
+        from deeplearning4j_tpu.models import TinyYOLO
+
+        # scaled-down input keeps the test fast; grid = 128/32 = 4
+        net = TinyYOLO(numClasses=3, inputShape=(3, 128, 128),
+                       boundingBoxPriors=PRIORS).init()
+        x = np.random.RandomState(0).randn(1, 3, 128, 128).astype(
+            np.float32)
+        y = _labels(1)
+        out = net.output(x).numpy()
+        assert out.shape == (1, 2, 5 + 3, 4, 4)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 3)
+        assert np.isfinite(net.score((x, y)))
+        assert np.isfinite(s0)
+
+    def test_yolo2_builds_and_steps(self):
+        from deeplearning4j_tpu.models import YOLO2
+
+        net = YOLO2(numClasses=3, inputShape=(3, 128, 128),
+                    boundingBoxPriors=PRIORS).init()
+        x = np.random.RandomState(0).randn(1, 3, 128, 128).astype(
+            np.float32)
+        y = _labels(1)
+        out = net.outputSingle(x).numpy()
+        assert out.shape == (1, 2, 5 + 3, 4, 4)
+        net.fit([(x, y)] * 2)
+        assert np.isfinite(net.score((x, y)))
