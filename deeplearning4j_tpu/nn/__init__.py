@@ -21,6 +21,14 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.objdetect import (  # noqa: F401
     Yolo2OutputLayer)
+from deeplearning4j_tpu.nn.conf.attention import (  # noqa: F401
+    AttentionVertex, LearnedSelfAttentionLayer, RecurrentAttentionLayer,
+    SelfAttentionLayer)
+from deeplearning4j_tpu.nn.conf.layers_extra import (  # noqa: F401
+    CenterLossOutputLayer, Convolution3D, Cropping1D, Cropping2D,
+    Cropping3D, ElementWiseMultiplicationLayer, FrozenLayer,
+    LocallyConnected1D, LocallyConnected2D, MaskZeroLayer, PReLULayer,
+    RepeatVector, Subsampling3DLayer, Upsampling1D, Upsampling3D)
 from deeplearning4j_tpu.nn.objdetect import (  # noqa: F401
     DetectedObject, YoloUtils)
 from deeplearning4j_tpu.nn.conf.variational import (  # noqa: F401

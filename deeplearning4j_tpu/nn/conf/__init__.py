@@ -3,3 +3,5 @@ from deeplearning4j_tpu.nn.conf.configuration import (  # noqa: F401
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import variational  # noqa: F401  (registers)
 from deeplearning4j_tpu.nn.conf import objdetect  # noqa: F401  (registers)
+from deeplearning4j_tpu.nn.conf import layers_extra  # noqa: F401 (registers)
+from deeplearning4j_tpu.nn.conf import attention  # noqa: F401  (registers)

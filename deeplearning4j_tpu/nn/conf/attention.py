@@ -1,0 +1,285 @@
+"""Attention layers over recurrent activations.
+
+Reference capability: the DL4J attention layer family added in 1.0.0-beta4
+(org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} and
+org.deeplearning4j.nn.conf.graph.AttentionVertex), all built on the
+nd4j `multiHeadDotProductAttention` declarable op (SURVEY.md §5
+"long-context" row). Layout contract matches the reference: activations
+are DL4J time-series [N, C, T]; attention math runs in [N, T, C] and
+maps onto the registered OPS (one fused XLA softmax-matmul chain instead
+of the reference's per-op dispatch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.ops import OPS
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseLayer, _register
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+def _mh_params(key, n_in, n_heads, head_size, n_out, weight_init, dtype):
+    ks = jax.random.split(key, 4)
+    proj = n_heads * head_size
+    return {
+        "Wq": init_weight(weight_init, ks[0], (n_in, proj), n_in, proj,
+                          dtype),
+        "Wk": init_weight(weight_init, ks[1], (n_in, proj), n_in, proj,
+                          dtype),
+        "Wv": init_weight(weight_init, ks[2], (n_in, proj), n_in, proj,
+                          dtype),
+        "Wo": init_weight(weight_init, ks[3], (proj, n_out), proj, n_out,
+                          dtype),
+    }
+
+
+@_register
+class SelfAttentionLayer(BaseLayer):
+    """Multi-head dot-product SELF attention: every timestep attends over
+    the whole sequence (reference: conf.layers.SelfAttentionLayer).
+    projectInput=False runs raw single-head attention (nOut == nIn)."""
+
+    def __init__(self, nIn=None, nOut=None, nHeads=1, headSize=None,
+                 projectInput=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.projectInput = projectInput
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError("projectInput=False requires nHeads=1")
+            self.nOut = self.nIn
+        elif self.nOut is None:
+            raise ValueError("SelfAttentionLayer needs nOut when "
+                             "projectInput=True")
+        if self.headSize is None:
+            if self.projectInput and self.nOut % self.nHeads:
+                raise ValueError(
+                    f"nOut={self.nOut} not divisible by nHeads="
+                    f"{self.nHeads}: set headSize explicitly")
+            self.headSize = (self.nOut // self.nHeads if self.projectInput
+                             else self.nIn)
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        if not self.projectInput:
+            return {}
+        return _mh_params(key, self.nIn, self.nHeads, self.headSize,
+                          self.nOut, self.weightInit, dtype)
+
+    def apply(self, params, state, x, training, rng):
+        xt = jnp.swapaxes(x, 1, 2)               # [N, T, C]
+        if self.projectInput:
+            y = OPS["multiHeadDotProductAttention"](
+                xt, xt, xt, params["Wq"], params["Wk"], params["Wv"],
+                params["Wo"], numHeads=self.nHeads)
+        else:
+            y = OPS["dotProductAttention"](xt, xt, xt)
+        # activation AFTER the swap back: _act's softmax path assumes the
+        # DL4J [N, C, T] layout (class axis = 1)
+        return self._act(jnp.swapaxes(y, 1, 2)), state
+
+
+@_register
+class LearnedSelfAttentionLayer(BaseLayer):
+    """Attention with LEARNED query vectors: pools a variable-length
+    sequence into a fixed nQueries-step output (reference:
+    conf.layers.LearnedSelfAttentionLayer)."""
+
+    def __init__(self, nIn=None, nOut=None, nHeads=1, headSize=None,
+                 nQueries=1, projectInput=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.nQueries = int(nQueries)
+        self.projectInput = projectInput
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError("projectInput=False requires nHeads=1")
+            self.nOut = self.nIn
+        elif self.nOut is None:
+            raise ValueError("LearnedSelfAttentionLayer needs nOut when "
+                             "projectInput=True")
+        if self.headSize is None:
+            if self.projectInput and self.nOut % self.nHeads:
+                raise ValueError(
+                    f"nOut={self.nOut} not divisible by nHeads="
+                    f"{self.nHeads}: set headSize explicitly")
+            self.headSize = (self.nOut // self.nHeads if self.projectInput
+                             else self.nIn)
+        return InputType.recurrent(self.nOut, self.nQueries)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kq, kp = jax.random.split(key)
+        p = {} if not self.projectInput else _mh_params(
+            kq, self.nIn, self.nHeads, self.headSize, self.nOut,
+            self.weightInit, dtype)
+        p["Q"] = init_weight(self.weightInit, kp,
+                             (self.nQueries, self.nIn), self.nIn,
+                             self.nQueries, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        xt = jnp.swapaxes(x, 1, 2)               # [N, T, C]
+        q = jnp.broadcast_to(params["Q"],
+                             (xt.shape[0],) + params["Q"].shape)
+        if self.projectInput:
+            y = OPS["multiHeadDotProductAttention"](
+                q, xt, xt, params["Wq"], params["Wk"], params["Wv"],
+                params["Wo"], numHeads=self.nHeads)
+        else:
+            y = OPS["dotProductAttention"](q, xt, xt)
+        return self._act(jnp.swapaxes(y, 1, 2)), state
+
+
+@_register
+class RecurrentAttentionLayer(BaseLayer):
+    """Recurrent cell with per-timestep attention over the FULL input
+    sequence (reference: conf.layers.RecurrentAttentionLayer — an RNN
+    whose step input is augmented with an attention readout queried by
+    the previous hidden state). Lowered to one lax.scan, the XLA
+    analogue of the reference's per-step while loop."""
+
+    IS_RECURRENT = True
+
+    def __init__(self, nIn=None, nOut=None, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 4)
+        return {
+            "W": init_weight(self.weightInit, ks[0],
+                             (self.nIn, self.nOut), self.nIn, self.nOut,
+                             dtype),
+            "R": init_weight(self.weightInit, ks[1],
+                             (self.nOut, self.nOut), self.nOut, self.nOut,
+                             dtype),
+            "A": init_weight(self.weightInit, ks[2],
+                             (self.nIn, self.nOut), self.nIn, self.nOut,
+                             dtype),
+            "Wq": init_weight(self.weightInit, ks[3],
+                              (self.nOut, self.nIn), self.nOut, self.nIn,
+                              dtype),
+            "b": jnp.zeros((self.nOut,), dtype),
+        }
+
+    def apply(self, params, state, x, training, rng):
+        from deeplearning4j_tpu.nn.activations import resolve_activation
+
+        act = resolve_activation(self.activation)
+        xt = jnp.swapaxes(x, 1, 2)               # [N, T, C]
+        n = xt.shape[0]
+        h0 = state.get("h") if isinstance(state, dict) and state else None
+        if h0 is None:
+            h0 = jnp.zeros((n, self.nOut), xt.dtype)
+
+        def step(h, x_t):
+            q = (h @ params["Wq"])[:, None, :]   # [N, 1, C]
+            a = OPS["dotProductAttention"](q, xt, xt)[:, 0]  # [N, C]
+            h_new = act(x_t @ params["W"] + a @ params["A"]
+                        + h @ params["R"] + params["b"])
+            return h_new, h_new
+
+        hT, hs = lax.scan(step, h0, jnp.swapaxes(xt, 0, 1))
+        y = jnp.transpose(hs, (1, 2, 0))         # [N, nOut, T]
+        if isinstance(state, dict) and state:
+            return y, {"h": hT}
+        return y, state
+
+    def streaming_state(self, batch_size, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch_size, self.nOut), dtype)}
+
+
+@_register
+class AttentionVertex(BaseLayer):
+    """Graph vertex: multi-head attention over separate (queries, keys,
+    values) inputs (reference: conf.graph.AttentionVertex). A
+    parameterized MULTI-input graph node — the graph runtime feeds it the
+    full input list."""
+
+    MULTI_INPUT = True
+
+    def __init__(self, nInQueries=None, nInKeys=None, nInValues=None,
+                 nOut=None, nHeads=1, headSize=None, projectInput=True,
+                 **kw):
+        super().__init__(**kw)
+        self.nInQueries = nInQueries
+        self.nInKeys = nInKeys
+        self.nInValues = nInValues
+        self.nOut = nOut
+        self.nHeads = int(nHeads)
+        self.headSize = headSize
+        self.projectInput = projectInput
+
+    def infer(self, *input_types):
+        tq = input_types[0]
+        self.nInQueries = self.nInQueries or tq.size
+        if len(input_types) > 1:
+            self.nInKeys = self.nInKeys or input_types[1].size
+            self.nInValues = self.nInValues or input_types[-1].size
+        else:
+            self.nInKeys = self.nInKeys or self.nInQueries
+            self.nInValues = self.nInValues or self.nInQueries
+        if not self.projectInput:
+            self.nOut = self.nInValues
+        if self.headSize is None:
+            self.headSize = (self.nOut // self.nHeads if self.projectInput
+                             else self.nInKeys)
+        return InputType.recurrent(
+            self.nOut, getattr(tq, "timeSeriesLength", None))
+
+    def init_params(self, key, dtype=jnp.float32):
+        if not self.projectInput:
+            return {}
+        ks = jax.random.split(key, 4)
+        proj = self.nHeads * self.headSize
+        wi = self.weightInit
+        return {
+            "Wq": init_weight(wi, ks[0], (self.nInQueries, proj),
+                              self.nInQueries, proj, dtype),
+            "Wk": init_weight(wi, ks[1], (self.nInKeys, proj),
+                              self.nInKeys, proj, dtype),
+            "Wv": init_weight(wi, ks[2], (self.nInValues, proj),
+                              self.nInValues, proj, dtype),
+            "Wo": init_weight(wi, ks[3], (proj, self.nOut), proj,
+                              self.nOut, dtype),
+        }
+
+    def apply(self, params, state, xs, training, rng):
+        if not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        q = jnp.swapaxes(xs[0], 1, 2)
+        k = jnp.swapaxes(xs[1], 1, 2) if len(xs) > 1 else q
+        v = jnp.swapaxes(xs[2], 1, 2) if len(xs) > 2 else k
+        if self.projectInput:
+            y = OPS["multiHeadDotProductAttention"](
+                q, k, v, params["Wq"], params["Wk"], params["Wv"],
+                params["Wo"], numHeads=self.nHeads)
+        else:
+            y = OPS["dotProductAttention"](q, k, v)
+        return self._act(jnp.swapaxes(y, 1, 2)), state

@@ -82,7 +82,14 @@ class MultiLayerConfiguration:
             from deeplearning4j_tpu.nn.conf.variational import (
                 AutoEncoder, VariationalAutoencoder)
 
+            from deeplearning4j_tpu.nn.conf.layers_extra import (
+                FrozenLayer, MaskZeroLayer)
+
             first = self.layers[0]
+            # unwrap wrapper layers: the inner layer declares the kind/nIn
+            while isinstance(first, (FrozenLayer, MaskZeroLayer)):
+                first = (first.layer if isinstance(first, FrozenLayer)
+                         else first.underlying)
             n_in = getattr(first, "nIn", None)
             if n_in is None:
                 return
@@ -153,12 +160,18 @@ def _wants_conv(layer):
         ActivationLayer, BatchNormalization, Deconvolution2D, DepthToSpace,
         DropoutLayer, GlobalPoolingLayer, LocalResponseNormalization,
         SpaceToDepth, Upsampling2D, ZeroPaddingLayer)
+    from deeplearning4j_tpu.nn.conf.layers_extra import (
+        Cropping2D, FrozenLayer, LocallyConnected2D, PReLULayer)
     from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
 
+    if isinstance(layer, FrozenLayer):
+        return _wants_conv(layer.layer)
     return isinstance(layer, (ActivationLayer, BatchNormalization,
-                              Deconvolution2D, DepthToSpace, DropoutLayer,
-                              GlobalPoolingLayer, LocalResponseNormalization,
-                              SpaceToDepth, Upsampling2D, ZeroPaddingLayer,
+                              Cropping2D, Deconvolution2D, DepthToSpace,
+                              DropoutLayer, GlobalPoolingLayer,
+                              LocalResponseNormalization,
+                              LocallyConnected2D, PReLULayer, SpaceToDepth,
+                              Upsampling2D, ZeroPaddingLayer,
                               Yolo2OutputLayer))
 
 

@@ -211,8 +211,10 @@ class ComputationGraphConfiguration:
                 in_types = [types[i] for i in ins if i in types]
                 if len(in_types) != len(ins):
                     continue
-                types[name] = node.infer(*in_types) if isinstance(
-                    node, GraphVertex) else node.infer(in_types[0])
+                multi = (isinstance(node, GraphVertex)
+                         or getattr(node, "MULTI_INPUT", False))
+                types[name] = (node.infer(*in_types) if multi
+                               else node.infer(in_types[0]))
 
     @property
     def dtype(self):

@@ -29,6 +29,11 @@ class InputType:
         return ConvolutionalFlatType(int(height), int(width), int(channels))
 
     @staticmethod
+    def convolutional3D(depth, height, width, channels):
+        return Convolutional3DType(int(depth), int(height), int(width),
+                                   int(channels))
+
+    @staticmethod
     def from_json(d):
         kinds = {
             "feedforward": lambda: FeedForwardType(d["size"]),
@@ -38,6 +43,8 @@ class InputType:
                 d["height"], d["width"], d["channels"]),
             "convolutionalflat": lambda: ConvolutionalFlatType(
                 d["height"], d["width"], d["channels"]),
+            "convolutional3d": lambda: Convolutional3DType(
+                d["depth"], d["height"], d["width"], d["channels"]),
         }
         return kinds[d["kind"]]()
 
@@ -91,6 +98,29 @@ class ConvolutionalType:
     def to_json(self):
         return {"kind": self.kind, "height": self.height,
                 "width": self.width, "channels": self.channels}
+
+
+@dataclass
+class Convolutional3DType:
+    """Volumetric input, NCDHW layout (reference: InputType.convolutional3D
+    with DataFormat.NCDHW)."""
+
+    depth: int
+    height: int
+    width: int
+    channels: int
+    kind: str = "convolutional3d"
+
+    def arrayElementsPerExample(self):
+        return self.depth * self.height * self.width * self.channels
+
+    def batch_shape(self, n=1):
+        return (n, self.channels, self.depth, self.height, self.width)
+
+    def to_json(self):
+        return {"kind": self.kind, "depth": self.depth,
+                "height": self.height, "width": self.width,
+                "channels": self.channels}
 
 
 @dataclass

@@ -1,0 +1,578 @@
+"""Structural / specialty layers rounding out the DL4J layer registry.
+
+Reference capability (SURVEY.md §2.5 "Layer impls" — conf.layers.*):
+Cropping1D/2D/3D, Upsampling1D/3D, Convolution3D, Subsampling3D,
+LocallyConnected1D/2D, PReLULayer, RepeatVector, MaskZeroLayer,
+FrozenLayer, ElementWiseMultiplicationLayer, CenterLossOutputLayer.
+All are pure-function emitters lowered into the net's single compiled
+step like every other layer; 3-D convolution maps straight onto
+lax.conv_general_dilated with NCDHW dimension numbers (one XLA op where
+the reference has a vol2col + gemm helper chain).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    Convolutional3DType, InputType)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, BaseOutputLayer, ConvolutionMode, PoolingType, _pair,
+    _register)
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+# ---------------------------------------------------------------------------
+# cropping
+# ---------------------------------------------------------------------------
+
+@_register
+class Cropping1D(BaseLayer):
+    """[N, C, T] -> crop (head, tail) timesteps (reference:
+    conf.layers.convolutional.Cropping1D)."""
+
+    def __init__(self, cropping=(0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping if isinstance(cropping, (list, tuple)) else (cropping,
+                                                                  cropping)
+        self.cropping = tuple(int(v) for v in c)
+
+    def infer(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        if t is not None:
+            t = t - self.cropping[0] - self.cropping[1]
+            if t <= 0:
+                raise ValueError(
+                    f"Cropping1D{self.cropping} consumes the whole "
+                    f"{input_type.timeSeriesLength}-step sequence")
+        return InputType.recurrent(input_type.size, t)
+
+    def apply(self, params, state, x, training, rng):
+        a, bz = self.cropping
+        return x[:, :, a: x.shape[2] - bz], state
+
+
+@_register
+class Cropping2D(BaseLayer):
+    """[N, C, H, W] -> crop (top, bottom, left, right) (reference:
+    conf.layers.convolutional.Cropping2D)."""
+
+    def __init__(self, cropping=(0, 0, 0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c, c, c, c)
+        elif len(c) == 2:
+            c = (c[0], c[0], c[1], c[1])
+        self.cropping = tuple(int(v) for v in c)
+
+    def infer(self, input_type):
+        t, b, l, r = self.cropping
+        oh = input_type.height - t - b
+        ow = input_type.width - l - r
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"Cropping2D{self.cropping} leaves a {oh}x{ow} output for "
+                f"{input_type.height}x{input_type.width} input")
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+    def apply(self, params, state, x, training, rng):
+        t, b, l, r = self.cropping
+        return x[:, :, t: x.shape[2] - b, l: x.shape[3] - r], state
+
+
+@_register
+class Cropping3D(BaseLayer):
+    """[N, C, D, H, W] crop; cropping = (d1, d2, h1, h2, w1, w2)."""
+
+    def __init__(self, cropping=(0, 0, 0, 0, 0, 0), **kw):
+        super().__init__(**kw)
+        c = cropping
+        if isinstance(c, int):
+            c = (c,) * 6
+        elif len(c) == 3:
+            c = (c[0], c[0], c[1], c[1], c[2], c[2])
+        self.cropping = tuple(int(v) for v in c)
+
+    def infer(self, input_type):
+        d1, d2, h1, h2, w1, w2 = self.cropping
+        od = input_type.depth - d1 - d2
+        oh = input_type.height - h1 - h2
+        ow = input_type.width - w1 - w2
+        if od <= 0 or oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"Cropping3D{self.cropping} leaves a {od}x{oh}x{ow} output")
+        return InputType.convolutional3D(od, oh, ow, input_type.channels)
+
+    def apply(self, params, state, x, training, rng):
+        d1, d2, h1, h2, w1, w2 = self.cropping
+        return x[:, :, d1: x.shape[2] - d2, h1: x.shape[3] - h2,
+                 w1: x.shape[4] - w2], state
+
+
+# ---------------------------------------------------------------------------
+# upsampling
+# ---------------------------------------------------------------------------
+
+@_register
+class Upsampling1D(BaseLayer):
+    """[N, C, T] -> repeat each timestep `size` times."""
+
+    def __init__(self, size=2, **kw):
+        super().__init__(**kw)
+        self.size = int(size)
+
+    def infer(self, input_type):
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(input_type.size,
+                                   t * self.size if t else None)
+
+    def apply(self, params, state, x, training, rng):
+        return jnp.repeat(x, self.size, axis=2), state
+
+
+@_register
+class Upsampling3D(BaseLayer):
+    """[N, C, D, H, W] nearest-neighbor upsampling."""
+
+    def __init__(self, size=(2, 2, 2), **kw):
+        super().__init__(**kw)
+        self.size = _triple(size)
+
+    def infer(self, input_type):
+        sd, sh, sw = self.size
+        return InputType.convolutional3D(input_type.depth * sd,
+                                         input_type.height * sh,
+                                         input_type.width * sw,
+                                         input_type.channels)
+
+    def apply(self, params, state, x, training, rng):
+        sd, sh, sw = self.size
+        x = jnp.repeat(x, sd, axis=2)
+        x = jnp.repeat(x, sh, axis=3)
+        return jnp.repeat(x, sw, axis=4), state
+
+
+# ---------------------------------------------------------------------------
+# 3-D convolution / pooling (NCDHW)
+# ---------------------------------------------------------------------------
+
+@_register
+class Convolution3D(BaseLayer):
+    """Reference: conf.layers.Convolution3D (NCDHW). One
+    lax.conv_general_dilated call replaces the reference's vol2col + gemm
+    helper chain."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(3, 3, 3),
+                 stride=(1, 1, 1), padding=(0, 0, 0), dilation=(1, 1, 1),
+                 convolutionMode=None, hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.kernelSize = _triple(kernelSize)
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        self.dilation = _triple(dilation)
+        self.convolutionMode = convolutionMode or ConvolutionMode.TRUNCATE
+        self.hasBias = hasBias
+
+    def _same(self):
+        return self.convolutionMode == ConvolutionMode.SAME
+
+    def infer(self, input_type):
+        if not isinstance(input_type, Convolutional3DType):
+            raise ValueError(
+                f"Convolution3D needs convolutional3D input, "
+                f"got {input_type}")
+        self.nIn = self.nIn or input_type.channels
+        dims = (input_type.depth, input_type.height, input_type.width)
+        out = []
+        for i in range(3):
+            k = (self.kernelSize[i] - 1) * self.dilation[i] + 1
+            if self._same():
+                out.append(-(-dims[i] // self.stride[i]))
+            else:
+                out.append((dims[i] + 2 * self.padding[i] - k)
+                           // self.stride[i] + 1)
+        return InputType.convolutional3D(out[0], out[1], out[2], self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kd, kh, kw = self.kernelSize
+        fan_in = self.nIn * kd * kh * kw
+        fan_out = self.nOut * kd * kh * kw
+        k1, _ = jax.random.split(key)
+        p = {"W": init_weight(self.weightInit, k1,
+                              (self.nOut, self.nIn, kd, kh, kw),
+                              fan_in, fan_out, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        x = self._dropout(x, training, rng)
+        if self._same():
+            pad = "SAME"
+        else:
+            pad = [(p, p) for p in self.padding]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if "b" in params:
+            y = y + params["b"].reshape(1, -1, 1, 1, 1)
+        return self._act(y), state
+
+
+@_register
+class Subsampling3DLayer(BaseLayer):
+    """Reference: conf.layers.Subsampling3DLayer (max/avg, NCDHW)."""
+
+    def __init__(self, poolingType=PoolingType.MAX, kernelSize=(2, 2, 2),
+                 stride=(2, 2, 2), padding=(0, 0, 0), convolutionMode=None,
+                 **kw):
+        super().__init__(**kw)
+        self.poolingType = poolingType
+        self.kernelSize = _triple(kernelSize)
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        self.convolutionMode = convolutionMode or ConvolutionMode.TRUNCATE
+
+    def infer(self, input_type):
+        dims = (input_type.depth, input_type.height, input_type.width)
+        out = []
+        for i in range(3):
+            if self.convolutionMode == ConvolutionMode.SAME:
+                out.append(-(-dims[i] // self.stride[i]))
+            else:
+                out.append((dims[i] + 2 * self.padding[i]
+                            - self.kernelSize[i]) // self.stride[i] + 1)
+        return InputType.convolutional3D(out[0], out[1], out[2],
+                                         input_type.channels)
+
+    def apply(self, params, state, x, training, rng):
+        window = (1, 1) + self.kernelSize
+        strides = (1, 1) + self.stride
+        if self.convolutionMode == ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = ((0, 0), (0, 0)) + tuple(
+                (p, p) for p in self.padding)
+        if self.poolingType == PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  pad)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                  strides, pad)
+            y = s / c
+        return y, state
+
+
+# ---------------------------------------------------------------------------
+# locally connected (unshared conv weights)
+# ---------------------------------------------------------------------------
+
+@_register
+class LocallyConnected2D(BaseLayer):
+    """Convolution with UNSHARED per-position weights (reference:
+    conf.layers.LocallyConnected2D). Patches come from one
+    conv_general_dilated_patches call; the per-position contraction is a
+    single batched einsum on the MXU instead of the reference's unrolled
+    per-window gemms."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=(2, 2),
+                 stride=(1, 1), padding=(0, 0), hasBias=True,
+                 inputSize=None, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.kernelSize = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.hasBias = hasBias
+        self.inputSize = tuple(inputSize) if inputSize else None  # (H, W)
+
+    def _out_hw(self):
+        h, w = self.inputSize
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        ph, pw = self.padding
+        return ((h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.channels
+        self.inputSize = (input_type.height, input_type.width)
+        oh, ow = self._out_hw()
+        return InputType.convolutional(oh, ow, self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.inputSize is None:
+            raise ValueError("LocallyConnected2D needs inputSize (H, W) "
+                             "or setInputType on the config")
+        kh, kw = self.kernelSize
+        oh, ow = self._out_hw()
+        k = self.nIn * kh * kw
+        k1, _ = jax.random.split(key)
+        p = {"W": init_weight(self.weightInit, k1,
+                              (oh * ow, k, self.nOut), k, self.nOut,
+                              dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        ph, pw = self.padding
+        patches = lax.conv_general_dilated_patches(
+            x, self.kernelSize, self.stride,
+            [(ph, ph), (pw, pw)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, k, oh, ow = patches.shape
+        patches = patches.reshape(n, k, oh * ow)
+        y = jnp.einsum("nkp,pko->nop", patches, params["W"])
+        y = y.reshape(n, -1, oh, ow)
+        if "b" in params:
+            y = y + params["b"].reshape(1, -1, 1, 1)
+        return self._act(y), state
+
+
+@_register
+class LocallyConnected1D(BaseLayer):
+    """[N, C, T] unshared 1-D convolution."""
+
+    def __init__(self, nIn=None, nOut=None, kernelSize=2, stride=1,
+                 padding=0, hasBias=True, inputSize=None, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.kernelSize = int(kernelSize)
+        self.stride = int(stride)
+        self.padding = int(padding)
+        self.hasBias = hasBias
+        self.inputSize = int(inputSize) if inputSize else None  # T
+
+    def _out_t(self):
+        return ((self.inputSize + 2 * self.padding - self.kernelSize)
+                // self.stride + 1)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        t = getattr(input_type, "timeSeriesLength", None)
+        if t:
+            self.inputSize = t
+        return InputType.recurrent(self.nOut,
+                                   self._out_t() if self.inputSize else None)
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.inputSize is None:
+            raise ValueError("LocallyConnected1D needs inputSize (T) or a "
+                             "recurrent input type with a declared length")
+        k = self.nIn * self.kernelSize
+        k1, _ = jax.random.split(key)
+        p = {"W": init_weight(self.weightInit, k1,
+                              (self._out_t(), k, self.nOut), k, self.nOut,
+                              dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        p = self.padding
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernelSize,), (self.stride,), [(p, p)],
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        n, k, ot = patches.shape
+        y = jnp.einsum("nkp,pko->nop", patches, params["W"])
+        y = y.reshape(n, -1, ot)
+        if "b" in params:
+            y = y + params["b"].reshape(1, -1, 1)
+        return self._act(y), state
+
+
+# ---------------------------------------------------------------------------
+# small parametric / shaping layers
+# ---------------------------------------------------------------------------
+
+@_register
+class PReLULayer(BaseLayer):
+    """Parametric ReLU with a learned per-channel slope (reference:
+    conf.layers.PReLULayer)."""
+
+    def __init__(self, nIn=None, alphaInit=0.0, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.alphaInit = float(alphaInit)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or getattr(
+            input_type, "channels", getattr(input_type, "size", None))
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"alpha": jnp.full((self.nIn,), self.alphaInit, dtype)}
+
+    def apply(self, params, state, x, training, rng):
+        shape = [1] * x.ndim
+        shape[1 if x.ndim > 2 else -1] = -1
+        a = params["alpha"].reshape(shape)
+        return jnp.where(x >= 0, x, a * x), state
+
+
+@_register
+class RepeatVector(BaseLayer):
+    """[N, C] -> [N, C, n] (reference: conf.layers.misc.RepeatVector)."""
+
+    def __init__(self, repetitionFactor=2, **kw):
+        super().__init__(**kw)
+        self.repetitionFactor = int(repetitionFactor)
+
+    def infer(self, input_type):
+        return InputType.recurrent(input_type.size, self.repetitionFactor)
+
+    def apply(self, params, state, x, training, rng):
+        return jnp.repeat(x[:, :, None], self.repetitionFactor, axis=2), \
+            state
+
+
+@_register
+class ElementWiseMultiplicationLayer(BaseLayer):
+    """out = act(x * w + b) with learned per-feature w, b (reference:
+    conf.layers.misc.ElementWiseMultiplicationLayer)."""
+
+    def __init__(self, nIn=None, nOut=None, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        self.nOut = self.nIn
+        return InputType.feedForward(self.nIn)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"w": jnp.ones((self.nIn,), dtype),
+                "b": jnp.full((self.nIn,), float(self.biasInit or 0.0),
+                              dtype)}
+
+    def apply(self, params, state, x, training, rng):
+        return self._act(x * params["w"] + params["b"]), state
+
+
+@_register
+class MaskZeroLayer(BaseLayer):
+    """Wrapper deriving a timestep mask from the INPUT (timesteps where
+    every feature equals maskingValue) and zeroing the wrapped layer's
+    output there (reference: conf.layers.util.MaskZeroLayer — the
+    keras-import masking idiom)."""
+
+    def __init__(self, underlying=None, maskingValue=0.0, **kw):
+        super().__init__(**kw)
+        self.underlying = underlying
+        self.maskingValue = float(maskingValue)
+
+    def apply_defaults(self, defaults):
+        super().apply_defaults(defaults)
+        if self.underlying is not None:
+            self.underlying.apply_defaults(defaults)
+
+    def infer(self, input_type):
+        return self.underlying.infer(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.underlying.init_params(key, dtype)
+
+    def init_state(self, dtype=jnp.float32):
+        return self.underlying.init_state(dtype)
+
+    def apply(self, params, state, x, training, rng):
+        keep = jnp.any(x != self.maskingValue, axis=1, keepdims=True)
+        y, state = self.underlying.apply(params, state, x, training, rng)
+        return y * keep.astype(y.dtype), state
+
+
+@_register
+class FrozenLayer(BaseLayer):
+    """Wrapper excluding the inner layer from training (reference:
+    conf.layers.misc.FrozenLayer; freezing = the NoOp updater, same
+    mechanism as TransferLearning.setFeatureExtractor)."""
+
+    def __init__(self, layer=None, **kw):
+        super().__init__(**kw)
+        self.layer = layer
+        from deeplearning4j_tpu.optimize.updaters import NoOp
+
+        self.updater = NoOp()
+
+    def apply_defaults(self, defaults):
+        d = dict(defaults)
+        d.pop("updater", None)   # keep NoOp regardless of the global
+        super().apply_defaults(d)
+        if self.layer is not None:
+            self.layer.apply_defaults(d)
+
+    def infer(self, input_type):
+        return self.layer.infer(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.layer.init_params(key, dtype)
+
+    def init_state(self, dtype=jnp.float32):
+        return self.layer.init_state(dtype)
+
+    def apply(self, params, state, x, training, rng):
+        # frozen = inference behavior even during fit: no dropout, no
+        # batch-norm running-stat updates (state is returned unchanged)
+        y, _ = self.layer.apply(params, state, x, False, None)
+        return y, state
+
+
+@_register
+class CenterLossOutputLayer(BaseOutputLayer):
+    """Classification output with an added center-loss pull toward learned
+    per-class feature centers (reference:
+    conf.layers.CenterLossOutputLayer, used by FaceNet-style zoo models).
+
+    Centers here are PARAMETERS optimized jointly by the layer's updater
+    (gradient lambda*(c_y - h)) rather than the reference's separate
+    alpha-EMA update — same fixed point, one compiled step, and the loss
+    stays exactly differentiable (numeric gradient checks pass). `alpha`
+    (the reference's EMA rate) is therefore accepted-and-IGNORED config
+    parity: the centers' effective learning rate is the optimizer's. A
+    one-time warning makes the divergence visible.
+    """
+
+    _warned_alpha = False
+
+    def __init__(self, alpha=0.05, lambdaCoeff=2e-4, **kw):
+        super().__init__(**kw)
+        self.alpha = float(alpha)
+        self.lambdaCoeff = float(lambdaCoeff)
+        if alpha != 0.05 and not CenterLossOutputLayer._warned_alpha:
+            import warnings
+
+            warnings.warn(
+                "CenterLossOutputLayer.alpha is accepted for DL4J config "
+                "parity but ignored: centers train with the layer's "
+                "updater, not an alpha-EMA", stacklevel=2)
+            CenterLossOutputLayer._warned_alpha = True
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = super().init_params(key, dtype)
+        p["centers"] = jnp.zeros((self.nOut, self.nIn), dtype)
+        return p
+
+    def compute_loss(self, params, x, labels, mask=None):
+        base = super().compute_loss(params, x, labels, mask)
+        # labels one-hot [N, numClasses] -> each example's class center
+        c = labels @ params["centers"]                 # [N, nIn]
+        pull = jnp.sum(jnp.square(x - c), axis=-1)
+        if mask is not None and mask.ndim == 1:
+            pull = pull * mask
+        return base + 0.5 * self.lambdaCoeff * jnp.mean(pull)

@@ -116,20 +116,22 @@ class Yolo2OutputLayer(LossLayer):
                               dtype=x.dtype)        # [N, H, W, B]
         resp = jnp.moveaxis(resp, -1, 1) * obj[:, None]      # [N, B, H, W]
 
-        # position: sigmoid(txy) vs cell-relative gt center; sqrt wh
+        # position: sigmoid(txy) vs cell-relative gt center; sqrt wh.
+        # lossPositionScale selects the penalty ("l2" default, "l1")
+        pen = (jnp.abs if str(self.lossPositionScale).lower() == "l1"
+               else jnp.square)
         tx_gt = jnp.clip(cx - jnp.floor(cx), 0.0, 1.0)
         ty_gt = jnp.clip(cy - jnp.floor(cy), 0.0, 1.0)
         pxy = jax.nn.sigmoid(txy)                   # [N, B, 2, H, W]
-        pos = (jnp.square(pxy[:, :, 0] - tx_gt[:, None])
-               + jnp.square(pxy[:, :, 1] - ty_gt[:, None]))
+        pos = (pen(pxy[:, :, 0] - tx_gt[:, None])
+               + pen(pxy[:, :, 1] - ty_gt[:, None]))
         pwh = priors[None, :, :, None, None] * jnp.exp(
             jnp.clip(twh, -10.0, 10.0))             # [N, B, 2, H, W]
         eps = 1e-9
-        size = (jnp.square(jnp.sqrt(pwh[:, :, 0] + eps)
-                           - jnp.sqrt(jnp.maximum(gw, 0.0) + eps)[:, None])
-                + jnp.square(jnp.sqrt(pwh[:, :, 1] + eps)
-                             - jnp.sqrt(jnp.maximum(gh, 0.0)
-                                        + eps)[:, None]))
+        size = (pen(jnp.sqrt(pwh[:, :, 0] + eps)
+                    - jnp.sqrt(jnp.maximum(gw, 0.0) + eps)[:, None])
+                + pen(jnp.sqrt(pwh[:, :, 1] + eps)
+                      - jnp.sqrt(jnp.maximum(gh, 0.0) + eps)[:, None]))
         loss_pos = self.lambdaCoord * jnp.sum(resp * (pos + size))
 
         # confidence: responsible anchors target IoU(pred, gt); the rest 0

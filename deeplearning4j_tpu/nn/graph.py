@@ -100,7 +100,8 @@ class ComputationGraph:
                 new_states[name] = states[name]
             else:
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
-                y, st = node.apply(params[name], states[name], xs[0],
+                arg = xs if getattr(node, "MULTI_INPUT", False) else xs[0]
+                y, st = node.apply(params[name], states[name], arg,
                                    training, lrng)
                 env[name] = y
                 new_states[name] = st

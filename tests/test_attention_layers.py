@@ -1,0 +1,162 @@
+"""Attention layer family tests (reference: conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} +
+conf.graph.AttentionVertex, SURVEY.md §5 long-context row)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    AttentionVertex, GlobalPoolingLayer, InputType,
+    LearnedSelfAttentionLayer, LSTM, MultiLayerConfiguration,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
+    RecurrentAttentionLayer, RnnOutputLayer, SelfAttentionLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.utils.gradient_check import GradientCheckUtil
+
+
+def _build(layers, input_type=None, seed=5):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+         .list())
+    for lr in layers:
+        b = b.layer(lr)
+    if input_type is not None:
+        b = b.setInputType(input_type)
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _seq_data(n=6, c=3, t=5, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, c, t).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[(x.sum((1, 2)) > 0).astype(int)]
+    return x, y
+
+
+class TestSelfAttention:
+    def test_shapes_and_training(self):
+        x, y = _seq_data()
+        net = _build([
+            SelfAttentionLayer.Builder(nOut=6, nHeads=2,
+                                       activation="identity").build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(3, 5))
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (6, 6, 5)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 25)
+        assert net.score((x, y)) < s0
+
+    def test_unprojected(self):
+        x, _ = _seq_data()
+        net = _build([
+            SelfAttentionLayer.Builder(projectInput=False,
+                                       activation="identity").build(),
+            RnnOutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(3, 5))
+        assert net._params[0] == {}
+        assert net.output(x).shape() == (6, 2, 5)
+
+    def test_gradient_check(self):
+        net = _build([
+            SelfAttentionLayer.Builder(nOut=4, nHeads=2,
+                                       activation="tanh").build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(2, 4))
+        rng = np.random.default_rng(0)
+        f = rng.normal(size=(2, 2, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=25)
+
+    def test_json_round_trip(self):
+        net = _build([
+            SelfAttentionLayer.Builder(nOut=6, nHeads=3).build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(3, 5))
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        sa = conf2.layers[0]
+        assert isinstance(sa, SelfAttentionLayer)
+        assert sa.nHeads == 3 and sa.headSize == 2
+
+
+class TestLearnedSelfAttention:
+    def test_pools_to_fixed_queries(self):
+        x, y = _seq_data(t=7)
+        net = _build([
+            LearnedSelfAttentionLayer.Builder(
+                nOut=4, nHeads=2, nQueries=3,
+                activation="identity").build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(3, 7))
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (6, 4, 3)   # T collapsed to nQueries
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 25)
+        assert net.score((x, y)) < s0
+
+    def test_gradient_check(self):
+        net = _build([
+            LearnedSelfAttentionLayer.Builder(
+                nOut=4, nHeads=2, nQueries=2, activation="tanh").build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(2, 4))
+        rng = np.random.default_rng(1)
+        f = rng.normal(size=(2, 2, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[1, 0]]
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=25)
+
+
+class TestRecurrentAttention:
+    def test_shapes_states_and_training(self):
+        x, y = _seq_data()
+        net = _build([
+            RecurrentAttentionLayer.Builder(nOut=5).build(),
+            RnnOutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(3, 5))
+        out = net.output(x)
+        assert out.shape() == (6, 2, 5)
+        yr = np.eye(2, dtype=np.float32)[
+            np.random.RandomState(0).randint(0, 2, (6, 5))].transpose(
+                0, 2, 1)
+        s0 = net.score((x, yr))
+        net.fit([(x, yr)] * 20)
+        assert net.score((x, yr)) < s0
+
+    def test_gradient_check(self):
+        net = _build([
+            RecurrentAttentionLayer.Builder(nOut=3).build(),
+            RnnOutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(2, 3))
+        rng = np.random.default_rng(2)
+        f = rng.normal(size=(2, 2, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, (2, 3))].transpose(0, 2, 1)
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=25)
+
+
+class TestAttentionVertex:
+    def test_graph_attention_qkv(self):
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        g = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2))
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.recurrent(3, 5))
+        g.addLayer("enc", LSTM.Builder(nOut=4,
+                                       activation="tanh").build(), "in")
+        g.addLayer("att", AttentionVertex(nOut=4, nHeads=2,
+                                          activation="identity"),
+                   "enc", "enc", "enc")
+        g.addLayer("pool", GlobalPoolingLayer.Builder().build(), "att")
+        g.addLayer("out", OutputLayer.Builder().nOut(2).build(), "pool")
+        g.setOutputs("out")
+        net = ComputationGraph(g.build()).init()
+        x = np.random.RandomState(0).randn(4, 3, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        out = net.outputSingle(x)
+        assert out.shape() == (4, 2)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 25)
+        assert net.score((x, y)) < s0
+        assert net._params["att"]["Wq"].shape == (4, 4)

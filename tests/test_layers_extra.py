@@ -1,0 +1,255 @@
+"""Structural / specialty layer tests (reference: conf.layers.* —
+Cropping, Upsampling1D/3D, Convolution3D, Subsampling3D, LocallyConnected,
+PReLU, RepeatVector, MaskZero, Frozen, ElementWiseMultiplication,
+CenterLossOutputLayer; SURVEY.md §2.5)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    CenterLossOutputLayer, Convolution3D, ConvolutionMode, Cropping1D,
+    Cropping2D, Cropping3D, DenseLayer, ElementWiseMultiplicationLayer,
+    FrozenLayer, GlobalPoolingLayer, InputType, LocallyConnected1D,
+    LocallyConnected2D, LSTM, MaskZeroLayer, MultiLayerConfiguration,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer, PReLULayer,
+    RepeatVector, RnnOutputLayer, Subsampling3DLayer, Upsampling1D,
+    Upsampling3D)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.utils.gradient_check import GradientCheckUtil
+
+
+def _build(layers, input_type=None, seed=3):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+         .list())
+    for lr in layers:
+        b = b.layer(lr)
+    if input_type is not None:
+        b = b.setInputType(input_type)
+    return MultiLayerNetwork(b.build()).init()
+
+
+class TestCroppingAndUpsampling:
+    def test_cropping2d(self):
+        net = _build([Cropping2D(cropping=(1, 1, 2, 2)),
+                      GlobalPoolingLayer.Builder().build(),
+                      OutputLayer.Builder().nOut(2).build()],
+                     InputType.convolutional(8, 10, 3))
+        x = np.random.RandomState(0).randn(2, 3, 8, 10).astype(np.float32)
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (2, 3, 6, 6)
+
+    def test_cropping1d_and_upsampling1d(self):
+        lr = Cropping1D(cropping=(1, 2))
+        x = np.arange(2 * 3 * 8, dtype=np.float32).reshape(2, 3, 8)
+        y, _ = lr.apply({}, {}, x, False, None)
+        assert y.shape == (2, 3, 5)
+        up = Upsampling1D(size=3)
+        z, _ = up.apply({}, {}, np.asarray(y), False, None)
+        assert z.shape == (2, 3, 15)
+        assert np.all(np.asarray(z)[:, :, 0] == np.asarray(z)[:, :, 2])
+
+    def test_cropping3d_and_upsampling3d(self):
+        x = np.random.RandomState(0).randn(1, 2, 6, 6, 6).astype(np.float32)
+        y, _ = Cropping3D(cropping=(1, 1, 1, 1, 1, 1)).apply(
+            {}, {}, x, False, None)
+        assert y.shape == (1, 2, 4, 4, 4)
+        z, _ = Upsampling3D(size=2).apply({}, {}, np.asarray(y), False,
+                                          None)
+        assert z.shape == (1, 2, 8, 8, 8)
+
+
+class TestConv3D:
+    def test_forward_shapes_and_training(self):
+        net = _build([
+            Convolution3D.Builder(nOut=4, kernelSize=[2, 2, 2],
+                                  convolutionMode=ConvolutionMode.SAME,
+                                  activation="relu").build(),
+            Subsampling3DLayer.Builder(kernelSize=[2, 2, 2],
+                                       stride=[2, 2, 2]).build(),
+            DenseLayer.Builder(nOut=8, activation="tanh").build(),
+            OutputLayer.Builder(nOut=2).build(),
+        ], InputType.convolutional3D(4, 4, 4, 2))
+        x = np.random.RandomState(0).randn(3, 2, 4, 4, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0]]
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (3, 4, 4, 4, 4)
+        assert acts[2].shape() == (3, 4, 2, 2, 2)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 20)
+        assert net.score((x, y)) < s0
+
+    def test_gradient_check(self):
+        net = _build([
+            Convolution3D.Builder(nOut=2, kernelSize=[2, 2, 2],
+                                  activation="tanh").build(),
+            DenseLayer.Builder(nOut=4, activation="tanh").build(),
+            OutputLayer.Builder(nOut=2).build(),
+        ], InputType.convolutional3D(3, 3, 3, 1))
+        rng = np.random.default_rng(0)
+        f = rng.normal(size=(2, 1, 3, 3, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=20)
+
+    def test_json_round_trip(self):
+        net = _build([
+            Convolution3D.Builder(nOut=2, kernelSize=[2, 2, 2]).build(),
+            DenseLayer.Builder(nOut=4).build(),
+            OutputLayer.Builder(nOut=2).build(),
+        ], InputType.convolutional3D(3, 3, 3, 1))
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert isinstance(conf2.layers[0], Convolution3D)
+        assert conf2.layers[0].kernelSize == (2, 2, 2)
+
+
+class TestLocallyConnected:
+    def test_2d_unshared_weights_shapes(self):
+        net = _build([
+            LocallyConnected2D.Builder(nOut=3, kernelSize=[2, 2],
+                                       activation="tanh").build(),
+            GlobalPoolingLayer.Builder().build(),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.convolutional(5, 5, 2))
+        x = np.random.RandomState(0).randn(2, 2, 5, 5).astype(np.float32)
+        acts = net.feedForward(x)
+        assert acts[1].shape() == (2, 3, 4, 4)
+        # unshared: W has a leading per-position axis
+        assert net._params[0]["W"].shape == (16, 2 * 2 * 2, 3)
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 25)
+        assert net.score((x, y)) < s0
+
+    def test_1d_gradient_check(self):
+        net = _build([
+            LocallyConnected1D.Builder(nOut=3, kernelSize=2,
+                                       activation="tanh").build(),
+            RnnOutputLayer.Builder().nOut(2).build(),
+        ], InputType.recurrent(2, 5))
+        rng = np.random.default_rng(1)
+        f = rng.normal(size=(2, 2, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, (2, 4))].transpose(0, 2, 1)
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=20)
+
+
+class TestSmallLayers:
+    def test_prelu_learns_slope(self):
+        net = _build([
+            PReLULayer(alphaInit=0.0),
+            OutputLayer.Builder().nOut(2).lossFunction("mse")
+            .activation("identity").build(),
+        ], InputType.feedForward(4))
+        x = -np.abs(np.random.RandomState(0).randn(8, 4)).astype(np.float32)
+        y = (x * -0.5)[:, :2].astype(np.float32)
+        net.fit([(x, y)] * 60)
+        alpha = np.asarray(net._params[0]["alpha"])
+        assert not np.allclose(alpha, 0.0)  # slope moved
+
+    def test_repeat_vector(self):
+        y, _ = RepeatVector(repetitionFactor=4).apply(
+            {}, {}, np.ones((2, 3), np.float32), False, None)
+        assert y.shape == (2, 3, 4)
+
+    def test_elementwise_multiplication(self):
+        net = _build([
+            ElementWiseMultiplicationLayer(activation="identity"),
+            OutputLayer.Builder().nOut(2).build(),
+        ], InputType.feedForward(4))
+        x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        assert net._params[0]["w"].shape == (4,)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 30)
+        assert net.score((x, y)) < s0
+
+    def test_mask_zero_layer(self):
+        lstm = LSTM.Builder(nIn=3, nOut=4, activation="tanh").build()
+        wrap = MaskZeroLayer(underlying=lstm, maskingValue=0.0)
+        net = _build([wrap, RnnOutputLayer.Builder().nOut(2).build()],
+                     InputType.recurrent(3, 6))
+        x = np.random.RandomState(0).randn(2, 3, 6).astype(np.float32)
+        x[:, :, 4:] = 0.0  # padded timesteps
+        acts = net.feedForward(x)
+        h = acts[1].numpy()
+        assert np.all(h[:, :, 4:] == 0.0)
+        assert np.any(h[:, :, :4] != 0.0)
+
+    def test_frozen_layer_params_do_not_move(self):
+        inner = DenseLayer.Builder(nIn=4, nOut=5,
+                                   activation="tanh").build()
+        net = _build([FrozenLayer(layer=inner),
+                      OutputLayer.Builder().nOut(2).build()])
+        w0 = np.asarray(net._params[0]["W"]).copy()
+        out_w0 = np.asarray(net._params[1]["W"]).copy()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        net.fit([(x, y)] * 10)
+        assert np.allclose(np.asarray(net._params[0]["W"]), w0)
+        assert not np.allclose(np.asarray(net._params[1]["W"]), out_w0)
+
+    def test_center_loss_output_layer(self):
+        net = _build([
+            DenseLayer.Builder(nIn=6, nOut=4, activation="tanh").build(),
+            CenterLossOutputLayer.Builder(nOut=3, lambdaCoeff=0.01).build(),
+        ])
+        assert net._params[1]["centers"].shape == (3, 4)
+        rng = np.random.RandomState(0)
+        x = rng.randn(12, 6).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)]
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 40)
+        assert net.score((x, y)) < s0
+        assert not np.allclose(np.asarray(net._params[1]["centers"]), 0.0)
+
+    def test_center_loss_gradient_check(self):
+        net = _build([
+            DenseLayer.Builder(nIn=4, nOut=3, activation="tanh").build(),
+            CenterLossOutputLayer.Builder(nOut=2, lambdaCoeff=0.1).build(),
+        ])
+        rng = np.random.default_rng(0)
+        f = rng.normal(size=(3, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=None)
+
+    def test_serde_round_trip_wrappers(self):
+        inner = DenseLayer.Builder(nIn=4, nOut=5).build()
+        net = _build([FrozenLayer(layer=inner),
+                      OutputLayer.Builder().nOut(2).build()])
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        fl = conf2.layers[0]
+        assert isinstance(fl, FrozenLayer)
+        assert isinstance(fl.layer, DenseLayer)
+        from deeplearning4j_tpu.optimize.updaters import NoOp
+        assert isinstance(fl.updater, NoOp)
+
+    def test_frozen_batchnorm_state_untouched(self):
+        # regression: a frozen BN must not update running stats during fit
+        from deeplearning4j_tpu.nn import BatchNormalization
+        net = _build([
+            DenseLayer.Builder(nIn=4, nOut=5, activation="tanh").build(),
+            FrozenLayer(layer=BatchNormalization.Builder().nIn(5).build()),
+            OutputLayer.Builder(nIn=5, nOut=2).build()])
+        m0 = np.asarray(net._states[1]["mean"]).copy()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32) + 3.0
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        net.fit([(x, y)] * 5)
+        assert np.allclose(np.asarray(net._states[1]["mean"]), m0)
+
+    def test_oversized_crop_raises(self):
+        with pytest.raises(ValueError):
+            Cropping2D(cropping=(5, 4, 0, 0)).infer(
+                InputType.convolutional(8, 8, 1))
+        with pytest.raises(ValueError):
+            Cropping1D(cropping=(3, 3)).infer(InputType.recurrent(2, 5))
+
+    def test_center_loss_alpha_warns_once(self):
+        import warnings
+        CenterLossOutputLayer._warned_alpha = False
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            CenterLossOutputLayer.Builder(nIn=3, nOut=2,
+                                          alpha=0.25).build()
+            CenterLossOutputLayer.Builder(nIn=3, nOut=2,
+                                          alpha=0.25).build()
+        msgs = [w for w in rec if "alpha" in str(w.message)]
+        assert len(msgs) == 1
