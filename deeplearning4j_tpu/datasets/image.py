@@ -42,6 +42,25 @@ class ParentPathLabelGenerator(PathLabelGenerator):
         return os.path.basename(os.path.dirname(os.path.abspath(path)))
 
 
+def _bilinear_resize_chw(src_hwc_u8: np.ndarray, oh: int,
+                         ow: int) -> np.ndarray:
+    """numpy twin of the native resize_hwc_to_chw kernel: half-pixel-center
+    classic bilinear (no antialiasing), [H,W,C]u8 -> [C,oh,ow]f32."""
+    h, w, _ = src_hwc_u8.shape
+    fy = np.clip((np.arange(oh) + 0.5) * h / oh - 0.5, 0, None)
+    fx = np.clip((np.arange(ow) + 0.5) * w / ow - 0.5, 0, None)
+    y0 = np.minimum(fy.astype(np.int64), h - 1)
+    x0 = np.minimum(fx.astype(np.int64), w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0)[:, None, None].astype(np.float32)
+    wx = (fx - x0)[None, :, None].astype(np.float32)
+    s = src_hwc_u8.astype(np.float32)
+    top = s[y0][:, x0] * (1 - wx) + s[y0][:, x1] * wx
+    bot = s[y1][:, x0] * (1 - wx) + s[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy).transpose(2, 0, 1)
+
+
 class NativeImageLoader:
     """Decode one image file -> [C,H,W] float32 (reference:
     org.datavec.image.loader.NativeImageLoader, minus OpenCV)."""
@@ -50,26 +69,49 @@ class NativeImageLoader:
         self.height, self.width, self.channels = height, width, channels
 
     def asMatrix(self, path_or_image) -> np.ndarray:
-        Image = _require_pil()
-        img = path_or_image
-        if not hasattr(img, "convert"):
-            img = Image.open(path_or_image)
-        img = img.convert("L" if self.channels == 1 else "RGB")
-        if img.size != (self.width, self.height):
-            img = img.resize((self.width, self.height),
-                             Image.Resampling.BILINEAR)
+        """Resize semantics are classic half-pixel-center bilinear (OpenCV
+        INTER_LINEAR — what the reference's NativeImageLoader does), NOT
+        PIL's antialiased downscale. The native kernel and the numpy
+        fallback implement the SAME math, so pixel values do not depend
+        on whether the g++ toolchain was present. PIL is used only to
+        decode files and convert color modes."""
         from deeplearning4j_tpu import native
 
+        img = path_or_image
+        if isinstance(img, np.ndarray):
+            if img.dtype != np.uint8:
+                raise ValueError(
+                    f"asMatrix ndarray input must be uint8 [H,W,C] "
+                    f"(got dtype {img.dtype}); normalize AFTER loading "
+                    f"with a DataNormalization, not before")
+            hwc = img[:, :, None] if img.ndim == 2 else img
+        else:
+            if not hasattr(img, "convert"):
+                Image = _require_pil()
+                img = Image.open(path_or_image)
+            img = img.convert("L" if self.channels == 1 else "RGB")
+            hwc = np.asarray(img, np.uint8)
+            if hwc.ndim == 2:
+                hwc = hwc[:, :, None]
+        if hwc.shape[2] != self.channels:
+            if self.channels == 1:
+                # luma conversion, same coefficients as PIL convert("L")
+                hwc = (hwc[:, :, :3].astype(np.float32)
+                       @ np.asarray([0.299, 0.587, 0.114], np.float32))
+                hwc = hwc.astype(np.uint8)[:, :, None]
+            elif self.channels == 3 and hwc.shape[2] == 1:
+                hwc = np.repeat(hwc, 3, axis=2)
+            else:
+                raise ValueError(
+                    f"cannot convert {hwc.shape[2]}-channel image to "
+                    f"{self.channels} channels")
+        if hwc.shape[0] == 0 or hwc.shape[1] == 0:
+            raise ValueError(f"empty image {hwc.shape}")
         if native.available():
-            chw = native.hwc_to_chw(np.asarray(img, np.uint8))
+            chw = native.resize_hwc_to_chw(hwc, self.height, self.width)
             if chw is not None:
                 return chw
-        arr = np.asarray(img, np.float32)
-        if arr.ndim == 2:
-            arr = arr[None, :, :]
-        else:
-            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
-        return arr
+        return _bilinear_resize_chw(hwc, self.height, self.width)
 
 
 # ---------------------------------------------------------------------------

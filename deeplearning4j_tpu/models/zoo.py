@@ -221,7 +221,10 @@ class AlexNet(ZooModel):
 
 
 class VGG16(ZooModel):
-    """Reference: zoo.model.VGG16."""
+    """Reference: zoo.model.VGG16. BLOCKS = (channels, conv-repeats) per
+    pooled stage; VGG19 overrides it."""
+
+    BLOCKS = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
 
     def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224)):
         self.numClasses = numClasses
@@ -243,7 +246,7 @@ class VGG16(ZooModel):
             return (SubsamplingLayer.Builder().kernelSize([2, 2])
                     .stride([2, 2]).build())
 
-        for n, reps in ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3)):
+        for n, reps in self.BLOCKS:
             for _ in range(reps):
                 b = b.layer(conv(n))
             b = b.layer(pool())
@@ -788,6 +791,97 @@ class YOLO2(ZooModel):
                    .activation("identity").build(), x)
         g.addLayer("out", Yolo2OutputLayer(boundingBoxPriors=self.priors),
                    "head")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+class VGG19(VGG16):
+    """Reference: zoo.model.VGG19 — VGG16 with a 4th conv in the last
+    three blocks (same builder, different BLOCKS)."""
+
+    BLOCKS = ((64, 2), (128, 2), (256, 4), (512, 4), (512, 4))
+
+
+class FaceNetNN4Small2(ZooModel):
+    """Reference: zoo.model.FaceNetNN4Small2 — the face-embedding model
+    trained with CenterLossOutputLayer. Inception-style graph: stem convs,
+    mixed 1x1/3x3/5x5/pool towers merged on the channel axis, embedding
+    dense layer, center-loss softmax head."""
+
+    def __init__(self, numClasses=10, seed=123, inputShape=(3, 96, 96),
+                 embeddingSize=128, lambdaCoeff=2e-4, updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.embeddingSize = embeddingSize
+        self.lambdaCoeff = lambdaCoeff
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn import (
+            CenterLossOutputLayer, L2NormalizeVertex, MergeVertex)
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def conv(name, src, n, k, s=1):
+            g.addLayer(name, ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([k, k]).stride([s, s])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("identity").hasBias(False).build(), src)
+            g.addLayer(name + "_bn", BatchNormalization.Builder()
+                       .activation("relu").build(), name)
+            return name + "_bn"
+
+        # stem
+        x = conv("stem1", "in", 64, 7, 2)
+        g.addLayer("stem_pool", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), x)
+        x = conv("stem2", "stem_pool", 64, 1)
+        x = conv("stem3", x, 192, 3)
+        g.addLayer("stem_pool2", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), x)
+        x = "stem_pool2"
+
+        # inception blocks: (1x1, 3x3 reduce->3x3, 5x5 reduce->5x5, pool->1x1)
+        def inception(tag, src, n1, r3, n3, r5, n5, np_):
+            t1 = conv(f"{tag}_1x1", src, n1, 1)
+            t3 = conv(f"{tag}_3r", src, r3, 1)
+            t3 = conv(f"{tag}_3x3", t3, n3, 3)
+            t5 = conv(f"{tag}_5r", src, r5, 1)
+            t5 = conv(f"{tag}_5x5", t5, n5, 5)
+            g.addLayer(f"{tag}_pool", SubsamplingLayer.Builder()
+                       .kernelSize([3, 3]).stride([1, 1])
+                       .convolutionMode(ConvolutionMode.SAME).build(), src)
+            tp = conv(f"{tag}_poolproj", f"{tag}_pool", np_, 1)
+            g.addVertex(f"{tag}_cat", MergeVertex(), t1, t3, t5, tp)
+            return f"{tag}_cat"
+
+        x = inception("inc1", x, 64, 96, 128, 16, 32, 32)
+        x = inception("inc2", x, 64, 96, 128, 32, 64, 64)
+        g.addLayer("red_pool", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), x)
+        x = inception("inc3", "red_pool", 128, 96, 192, 32, 64, 64)
+
+        # embedding + center-loss head
+        g.addLayer("gap", GlobalPoolingLayer.Builder().build(), x)
+        g.addLayer("embedding", DenseLayer.Builder()
+                   .nOut(self.embeddingSize).activation("identity").build(),
+                   "gap")
+        g.addVertex("l2norm", L2NormalizeVertex(), "embedding")
+        g.addLayer("out", CenterLossOutputLayer.Builder()
+                   .nOut(self.numClasses).lambdaCoeff(self.lambdaCoeff)
+                   .activation("softmax").lossFunction("mcxent").build(),
+                   "l2norm")
         g.setOutputs("out")
         return g.build()
 

@@ -64,6 +64,11 @@ def _load():
             lib.hwc_to_chw.argtypes = [
                 u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_int, ctypes.c_float, ctypes.c_float, f32p]
+            lib.resize_hwc_to_chw.restype = None
+            lib.resize_hwc_to_chw.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_float, ctypes.c_float, f32p]
             _LIB = lib
         except Exception:  # toolchain missing/failed -> numpy fallback
             _LIB = None
@@ -114,6 +119,26 @@ def csv_parse(text: bytes, delimiter=",") -> np.ndarray | None:
     if rows < 0 or cols.value == 0:
         return None
     return out[:rows * cols.value].reshape(rows, cols.value).copy()
+
+
+def resize_hwc_to_chw(img_u8: np.ndarray, out_h: int, out_w: int,
+                      flip_h=False, scale=1.0, shift=0.0):
+    """Fused bilinear resize + [H,W,C]u8 -> [C,oh,ow]f32 + affine
+    normalize in one native pass, or None when the lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    img_u8 = np.ascontiguousarray(img_u8, dtype=np.uint8)
+    if img_u8.ndim == 2:
+        img_u8 = img_u8[:, :, None]
+    h, w, c = img_u8.shape
+    if h == 0 or w == 0 or out_h <= 0 or out_w <= 0:
+        return None  # callers fall back to the Python path's clear error
+    dst = np.empty((c, int(out_h), int(out_w)), np.float32)
+    lib.resize_hwc_to_chw(img_u8, h, w, c, int(out_h), int(out_w),
+                          int(bool(flip_h)), float(scale), float(shift),
+                          dst)
+    return dst
 
 
 def hwc_to_chw(img_u8: np.ndarray, flip_h=False, scale=1.0, shift=0.0):

@@ -106,4 +106,48 @@ void hwc_to_chw(const uint8_t* src, int64_t h, int64_t w, int64_t c,
     }
 }
 
+// Fused bilinear resize + HWC->CHW + affine normalize (+optional h-flip):
+// the whole NativeImageLoader.asMatrix hot path in ONE pass over the
+// output (reference: NativeImageLoader wraps C++ OpenCV resize/convert,
+// SURVEY.md §2.4). src is [h,w,c] uint8, dst is [c,oh,ow] float32.
+void resize_hwc_to_chw(const uint8_t* src, int64_t h, int64_t w, int64_t c,
+                       int64_t oh, int64_t ow, int flip_h, float scale,
+                       float shift, float* dst) {
+    if (h <= 0 || w <= 0 || oh <= 0 || ow <= 0 || c <= 0) return;
+    // half-pixel centers, classic bilinear (OpenCV INTER_LINEAR
+    // semantics — NO antialiasing; PIL's antialiased downscale differs).
+    // The numpy fallback (_bilinear_resize_chw) implements the same math.
+    const float sy = (float)h / (float)oh;
+    const float sx = (float)w / (float)ow;
+    for (int64_t y = 0; y < oh; ++y) {
+        float fy = ((float)y + 0.5f) * sy - 0.5f;
+        if (fy < 0) fy = 0;
+        int64_t y0 = (int64_t)fy;
+        if (y0 > h - 1) y0 = h - 1;
+        int64_t y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+        const float wy = fy - (float)y0;
+        for (int64_t x = 0; x < ow; ++x) {
+            const int64_t xo = flip_h ? ow - 1 - x : x;
+            float fx = ((float)x + 0.5f) * sx - 0.5f;
+            if (fx < 0) fx = 0;
+            int64_t x0 = (int64_t)fx;
+            if (x0 > w - 1) x0 = w - 1;
+            int64_t x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+            const float wx = fx - (float)x0;
+            const uint8_t* p00 = src + (y0 * w + x0) * c;
+            const uint8_t* p01 = src + (y0 * w + x1) * c;
+            const uint8_t* p10 = src + (y1 * w + x0) * c;
+            const uint8_t* p11 = src + (y1 * w + x1) * c;
+            for (int64_t ch = 0; ch < c; ++ch) {
+                const float top = (float)p00[ch] * (1.0f - wx)
+                                  + (float)p01[ch] * wx;
+                const float bot = (float)p10[ch] * (1.0f - wx)
+                                  + (float)p11[ch] * wx;
+                dst[ch * oh * ow + y * ow + xo] =
+                    (top * (1.0f - wy) + bot * wy) * scale + shift;
+            }
+        }
+    }
+}
+
 }  // extern "C"

@@ -152,3 +152,40 @@ class TestNewZooModels:
         s0 = float(net.score((X, y)))
         net.fit([(X, y)], 3)
         assert float(net.score((X, y))) < s0
+
+
+class TestZooRound2Additions:
+    """VGG19 / FaceNetNN4Small2 (reference zoo.model.* additions)."""
+
+    def test_vgg19_builds_and_trains(self):
+        from deeplearning4j_tpu.models import VGG19
+
+        net = VGG19(numClasses=4, inputShape=(3, 32, 32)).init()
+        x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[[0, 2]]
+        assert net.output(x).shape() == (2, 4)
+        # 16 conv + 5 pool + 2 dense + output
+        from deeplearning4j_tpu.nn import ConvolutionLayer
+        n_conv = sum(isinstance(lr, ConvolutionLayer) for lr in net.layers)
+        assert n_conv == 16
+        net.fit([(x, y)], 2)
+        assert np.isfinite(net.score((x, y)))
+
+    def test_facenet_center_loss_graph(self):
+        from deeplearning4j_tpu.models import FaceNetNN4Small2
+
+        net = FaceNetNN4Small2(numClasses=5, inputShape=(3, 32, 32),
+                               embeddingSize=16).init()
+        x = np.random.RandomState(0).randn(4, 3, 32, 32).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+        emb_w = net._params["embedding"]["W"]
+        assert emb_w.shape[1] == 16
+        assert net._params["out"]["centers"].shape == (5, 16)
+        out = net.outputSingle(x).numpy()
+        assert out.shape == (4, 5)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 6)
+        assert net.score((x, y)) < s0
+        # centers moved toward the embeddings
+        assert not np.allclose(
+            np.asarray(net._params["out"]["centers"]), 0.0)

@@ -135,3 +135,88 @@ class TestHwcToChw:
         out = NativeImageLoader(9, 11, 3).asMatrix(str(p))
         np.testing.assert_allclose(
             out, arr.transpose(2, 0, 1).astype(np.float32))
+
+
+class TestResizeFused:
+    def test_matches_reference_bilinear(self):
+        from deeplearning4j_tpu import native
+
+        if not native.available():
+            import pytest
+            pytest.skip("native lib unavailable")
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 256, (16, 24, 3), np.uint8)
+        out = native.resize_hwc_to_chw(img, 8, 12)
+        assert out.shape == (3, 8, 12)
+        # half-pixel-center bilinear reference in numpy
+        def ref_resize(src, oh, ow):
+            h, w, c = src.shape
+            fy = (np.arange(oh) + 0.5) * h / oh - 0.5
+            fx = (np.arange(ow) + 0.5) * w / ow - 0.5
+            fy = np.clip(fy, 0, None); fx = np.clip(fx, 0, None)
+            y0 = np.minimum(fy.astype(int), h - 1)
+            x0 = np.minimum(fx.astype(int), w - 1)
+            y1 = np.minimum(y0 + 1, h - 1); x1 = np.minimum(x0 + 1, w - 1)
+            wy = (fy - y0)[:, None, None]; wx = (fx - x0)[None, :, None]
+            s = src.astype(np.float32)
+            top = s[y0][:, x0] * (1 - wx) + s[y0][:, x1] * wx
+            bot = s[y1][:, x0] * (1 - wx) + s[y1][:, x1] * wx
+            return (top * (1 - wy) + bot * wy).transpose(2, 0, 1)
+        expect = ref_resize(img, 8, 12)
+        assert np.allclose(out, expect, atol=1e-3)
+
+    def test_identity_resize_scale_shift_flip(self):
+        from deeplearning4j_tpu import native
+
+        if not native.available():
+            import pytest
+            pytest.skip("native lib unavailable")
+        img = np.arange(2 * 3 * 1, dtype=np.uint8).reshape(2, 3, 1)
+        same = native.resize_hwc_to_chw(img, 2, 3, scale=2.0, shift=1.0)
+        assert np.allclose(same[0], img[:, :, 0] * 2.0 + 1.0)
+        flipped = native.resize_hwc_to_chw(img, 2, 3, flip_h=True)
+        assert np.allclose(flipped[0], img[:, ::-1, 0])
+
+    def test_loader_uses_native_without_pil(self):
+        from deeplearning4j_tpu.datasets.image import NativeImageLoader
+        from deeplearning4j_tpu import native
+
+        if not native.available():
+            import pytest
+            pytest.skip("native lib unavailable")
+        img = np.random.RandomState(1).randint(0, 256, (20, 20, 3),
+                                               np.uint8)
+        loader = NativeImageLoader(10, 10, 3)
+        out = loader.asMatrix(img)
+        assert out.shape == (3, 10, 10)
+        assert out.dtype == np.float32
+
+    def test_native_and_numpy_fallback_agree(self):
+        # regression: pixel values must not depend on toolchain presence
+        from deeplearning4j_tpu import native
+        from deeplearning4j_tpu.datasets.image import _bilinear_resize_chw
+
+        if not native.available():
+            import pytest
+            pytest.skip("native lib unavailable")
+        img = np.random.RandomState(3).randint(0, 256, (16, 16, 3),
+                                               np.uint8)
+        a = native.resize_hwc_to_chw(img, 8, 8)
+        b = _bilinear_resize_chw(img, 8, 8)
+        assert np.allclose(a, b, atol=1e-3)
+
+    def test_float_ndarray_rejected(self):
+        from deeplearning4j_tpu.datasets.image import NativeImageLoader
+        import pytest
+        with pytest.raises(ValueError):
+            NativeImageLoader(8, 8, 3).asMatrix(
+                np.random.rand(16, 16, 3).astype(np.float32))
+
+    def test_channel_conversion_on_native_path(self):
+        from deeplearning4j_tpu.datasets.image import NativeImageLoader
+        rgb = np.random.RandomState(0).randint(0, 256, (12, 12, 3),
+                                               np.uint8)
+        gray = NativeImageLoader(6, 6, 1).asMatrix(rgb)
+        assert gray.shape == (1, 6, 6)
+        up = NativeImageLoader(6, 6, 3).asMatrix(rgb[:, :, 0])
+        assert up.shape == (3, 6, 6)
