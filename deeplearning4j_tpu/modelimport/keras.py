@@ -113,6 +113,9 @@ def _input_type_from_shape(shape):
     """batch_input_shape (without batch dim) -> InputType. Keras NHWC conv
     input -> convolutional(h, w, c); [T, C] -> recurrent(C, T)."""
     shape = [s for s in shape if s is not None]
+    if len(shape) == 4:
+        d, h, w, c = shape   # Keras NDHWC -> our NCDHW
+        return InputType.convolutional3D(d, h, w, c)
     if len(shape) == 3:
         h, w, c = shape
         return InputType.convolutional(h, w, c)
@@ -214,6 +217,90 @@ def _convert_layer(class_name, kc, is_last, prev_returns_sequences):
         alpha = kc.get("alpha", 0.3)  # Keras default slope
         return ActivationLayer.Builder() \
             .activation(f"leakyrelu:{alpha}").build()
+    if class_name == "Conv1D":
+        from deeplearning4j_tpu.nn import Convolution1DLayer
+
+        if kc.get("padding") == "causal":
+            raise ValueError(
+                "Conv1D padding='causal' is not supported by the importer")
+        b = (Convolution1DLayer.Builder().nOut(kc["filters"])
+             .kernelSize(kc["kernel_size"][0])
+             .stride(kc.get("strides", (1,))[0])
+             .activation(_act(kc.get("activation")))
+             .hasBias(kc.get("use_bias", True)))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
+    if class_name == "Conv3D":
+        from deeplearning4j_tpu.nn import Convolution3D
+
+        b = (Convolution3D.Builder().nOut(kc["filters"])
+             .kernelSize(list(kc["kernel_size"]))
+             .stride(list(kc.get("strides", (1, 1, 1))))
+             .activation(_act(kc.get("activation")))
+             .hasBias(kc.get("use_bias", True)))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
+    if class_name in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn import Subsampling3DLayer
+
+        pt = (PoolingType.MAX if class_name == "MaxPooling3D"
+              else PoolingType.AVG)
+        ps = kc.get("pool_size", (2, 2, 2))
+        st = kc.get("strides") or ps
+        b = Subsampling3DLayer.Builder(poolingType=pt) \
+            .kernelSize(list(ps)).stride(list(st))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
+    if class_name == "Cropping1D":
+        from deeplearning4j_tpu.nn import Cropping1D
+
+        crop = kc.get("cropping", (1, 1))
+        if isinstance(crop, int):
+            crop = (crop, crop)
+        return Cropping1D.Builder().cropping(list(crop)).build()
+    if class_name == "Cropping2D":
+        from deeplearning4j_tpu.nn import Cropping2D
+
+        crop = kc.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(crop, int):
+            pads = [crop] * 4
+        elif crop and isinstance(crop[0], (list, tuple)):
+            pads = [crop[0][0], crop[0][1], crop[1][0], crop[1][1]]
+        else:
+            pads = [crop[0], crop[0], crop[1], crop[1]]
+        return Cropping2D.Builder().cropping(pads).build()
+    if class_name == "UpSampling1D":
+        from deeplearning4j_tpu.nn import Upsampling1D
+
+        return Upsampling1D.Builder().size(kc.get("size", 2)).build()
+    if class_name == "UpSampling3D":
+        from deeplearning4j_tpu.nn import Upsampling3D
+
+        return Upsampling3D.Builder() \
+            .size(list(kc.get("size", (2, 2, 2)))).build()
+    if class_name == "RepeatVector":
+        from deeplearning4j_tpu.nn import RepeatVector
+
+        return RepeatVector.Builder().repetitionFactor(kc["n"]).build()
+    if class_name == "PReLU":
+        from deeplearning4j_tpu.nn import PReLULayer
+
+        return PReLULayer.Builder().build()
+    if class_name in ("GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        from deeplearning4j_tpu.nn import GlobalPoolingLayer
+
+        pt = (PoolingType.AVG if "Average" in class_name
+              else PoolingType.MAX)
+        return GlobalPoolingLayer.Builder().poolingType(pt).build()
+    if class_name == "ELU":
+        return ActivationLayer.Builder() \
+            .activation(f"elu:{kc.get('alpha', 1.0)}").build()
+    if class_name == "ThresholdedReLU":
+        return ActivationLayer.Builder() \
+            .activation(f"thresholdedrelu:{kc.get('theta', 1.0)}").build()
     raise ValueError(f"unsupported Keras layer: {class_name}")
 
 
@@ -336,6 +423,35 @@ def _convert_weights(layer, arrs):
         if len(arrs) > 2:
             out["b"] = arrs[2]
         return out
+    from deeplearning4j_tpu.nn import (
+        Convolution1DLayer, Convolution3D, PReLULayer)
+
+    if isinstance(layer, Convolution3D):
+        w = np.transpose(arrs[0], (4, 3, 0, 1, 2))  # DHWIO -> OIDHW
+        out = {"W": w}
+        if len(arrs) > 1:
+            out["b"] = arrs[1]
+        return out
+    if isinstance(layer, Convolution1DLayer):
+        w = np.transpose(arrs[0], (2, 1, 0))        # KIO -> OIK
+        out = {"W": w}
+        if len(arrs) > 1:
+            out["b"] = arrs[1]
+        return out
+    if isinstance(layer, PReLULayer):
+        # Keras alpha carries the input shape (often with shared spatial
+        # axes); ours is per-channel/per-feature
+        a = np.asarray(arrs[0], np.float32)
+        if a.ndim == 1:
+            return {"alpha": a}
+        if a.size == a.shape[-1]:
+            return {"alpha": a.reshape(a.shape[-1])}
+        import warnings
+
+        warnings.warn(
+            f"PReLU alpha of shape {a.shape} has unshared spatial axes; "
+            f"importing the per-channel mean", stacklevel=2)
+        return {"alpha": a.mean(axis=tuple(range(a.ndim - 1)))}
     if isinstance(layer, ConvolutionLayer):
         w = np.transpose(arrs[0], (3, 2, 0, 1))  # HWIO -> OIHW
         out = {"W": w}

@@ -76,6 +76,12 @@ def resolve_activation(name):
     if key.startswith("leakyrelu:"):
         alpha = float(key.split(":", 1)[1])
         return lambda x: jax.nn.leaky_relu(x, alpha)
+    if key.startswith("elu:"):
+        alpha = float(key.split(":", 1)[1])
+        return lambda x: jax.nn.elu(x, alpha)
+    if key.startswith("thresholdedrelu:"):
+        theta = float(key.split(":", 1)[1])
+        return lambda x: jnp.where(x > theta, x, 0.0)
     if key not in ACTIVATIONS:
         raise ValueError(f"unknown activation {name!r}")
     return ACTIVATIONS[key]

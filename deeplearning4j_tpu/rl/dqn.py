@@ -32,6 +32,11 @@ class QLearningConfiguration:
     epsilonDecay: float = 0.995
     learningRate: float = 1e-3
     hidden: tuple = (64, 64)
+    # reference: QLearning.QLConfiguration.doubleDQN — decouple action
+    # selection (online net) from evaluation (target net)
+    doubleDQN: bool = False
+    # reference: rl4j dueling DQN factory — Q = V + A - mean(A)
+    dueling: bool = False
 
     @staticmethod
     def builder():
@@ -53,18 +58,36 @@ class _QConfBuilder:
         return QLearningConfiguration(**self._kw)
 
 
-def _init_mlp(key, sizes):
+def _init_mlp(key, sizes, dueling=False):
     params = []
-    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+    trunk = sizes[:-1] if dueling else sizes
+    for i, (a, b) in enumerate(zip(trunk[:-1], trunk[1:])):
         k = jax.random.fold_in(key, i)
         params.append({
             "W": jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a),
             "b": jnp.zeros((b,)),
         })
+    if dueling:
+        h, n_act = sizes[-2], sizes[-1]
+        kv = jax.random.fold_in(key, 101)
+        ka = jax.random.fold_in(key, 102)
+        params.append({
+            "Wv": jax.random.normal(kv, (h, 1)) * np.sqrt(2.0 / h),
+            "bv": jnp.zeros((1,)),
+            "Wa": jax.random.normal(ka, (h, n_act)) * np.sqrt(2.0 / h),
+            "ba": jnp.zeros((n_act,)),
+        })
     return params
 
 
 def _mlp(params, x):
+    head = params[-1]
+    if "Wv" in head:        # dueling: shared trunk -> V and A streams
+        for p in params[:-1]:
+            x = jax.nn.relu(x @ p["W"] + p["b"])
+        v = x @ head["Wv"] + head["bv"]                    # [N, 1]
+        a = x @ head["Wa"] + head["ba"]                    # [N, n_act]
+        return v + a - jnp.mean(a, axis=1, keepdims=True)
     for i, p in enumerate(params):
         x = x @ p["W"] + p["b"]
         if i < len(params) - 1:
@@ -103,7 +126,7 @@ class QLearningDiscreteDense:
         n_act = mdp.actionSpaceSize()
         sizes = (obs_dim,) + tuple(conf.hidden) + (n_act,)
         key = jax.random.key(conf.seed)
-        self.params = _init_mlp(key, sizes)
+        self.params = _init_mlp(key, sizes, dueling=conf.dueling)
         # real copy: params is donated each step, so the target must not
         # alias its buffers (f(donate(a), a) is invalid)
         self.target = jax.tree_util.tree_map(
@@ -127,7 +150,14 @@ class QLearningDiscreteDense:
             def loss_fn(p):
                 q = _mlp(p, obs)
                 q_sa = jnp.take_along_axis(q, act[:, None], axis=1)[:, 0]
-                q_next = jnp.max(_mlp(target, nxt), axis=1)
+                if self.conf.doubleDQN:
+                    # double DQN: online net picks, target net evaluates
+                    a_star = jnp.argmax(_mlp(p, nxt), axis=1)
+                    q_next = jnp.take_along_axis(
+                        _mlp(target, nxt), a_star[:, None], axis=1)[:, 0]
+                    q_next = jax.lax.stop_gradient(q_next)
+                else:
+                    q_next = jnp.max(_mlp(target, nxt), axis=1)
                 y = rew + gamma * q_next * (1.0 - done)
                 err = q_sa - jax.lax.stop_gradient(y)
                 # Huber with errorClamp delta

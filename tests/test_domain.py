@@ -184,3 +184,38 @@ class TestStats:
         loaded = FileStatsStorage.load(p)
         assert len(loaded.records) == 5
         assert loaded.records[-1]["iteration"] == 5
+
+
+class TestDQNVariants:
+    """doubleDQN flag + dueling architecture (reference: rl4j
+    QLConfiguration.doubleDQN, dueling DQN factory)."""
+
+    def _solve(self, **kw):
+        conf = QLearningConfiguration(
+            seed=1, maxStep=6000, batchSize=64, gamma=0.9,
+            targetDqnUpdateFreq=50, updateStart=200, epsilonDecay=0.98,
+            hidden=(32, 32), **kw)
+        ql = QLearningDiscreteDense(SimpleGridWorld(4), conf)
+        ql.train()
+        return ql.getPolicy().play(SimpleGridWorld(4))
+
+    def test_double_dqn_solves_chain(self):
+        assert self._solve(doubleDQN=True) > 0.5
+
+    def test_dueling_dqn_solves_chain(self):
+        assert self._solve(dueling=True) > 0.5
+
+    def test_dueling_param_shapes(self):
+        import jax
+        from deeplearning4j_tpu.rl.dqn import _init_mlp, _mlp
+        import numpy as np
+        import jax.numpy as jnp
+
+        p = _init_mlp(jax.random.key(0), (4, 8, 3), dueling=True)
+        assert "Wv" in p[-1] and p[-1]["Wa"].shape == (8, 3)
+        q = _mlp(p, jnp.ones((2, 4)))
+        assert q.shape == (2, 3)
+        # dueling identity: mean-advantage subtraction leaves Q centered
+        a = jnp.asarray(np.random.RandomState(0).randn(2, 4), jnp.float32)
+        q = np.asarray(_mlp(p, a))
+        assert np.isfinite(q).all()

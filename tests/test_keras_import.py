@@ -208,3 +208,130 @@ class TestErrors:
         _write_h5(p, cfg, {})
         with pytest.raises(ValueError, match="not a Sequential"):
             KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+
+
+class TestRound2LayerCoverage:
+    """Conv1D/Conv3D/pool3D/cropping/upsampling/PReLU/RepeatVector import
+    (reference: KerasLayer registry coverage, SURVEY.md §2.7)."""
+
+    def test_conv3d_pool3d(self, tmp_path):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(2, 2, 2, 1, 3)).astype(np.float32)  # DHWIO
+        b = rng.normal(size=(3,)).astype(np.float32)
+        wd = rng.normal(size=(3, 2)).astype(np.float32)
+        bd = rng.normal(size=(2,)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Conv3D", "config": {
+                "name": "c3", "filters": 3, "kernel_size": [2, 2, 2],
+                "strides": [1, 1, 1], "padding": "same",
+                "activation": "relu", "use_bias": True,
+                "batch_input_shape": [None, 4, 4, 4, 1]}},
+            {"class_name": "MaxPooling3D", "config": {
+                "name": "p3", "pool_size": [2, 2, 2]}},
+            {"class_name": "GlobalAveragePooling2D", "config": {
+                "name": "gap"}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "c3d.h5"
+        _write_h5(p, cfg, {"c3": [("kernel:0", w), ("bias:0", b)],
+                           "out": [("kernel:0", wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        from deeplearning4j_tpu.nn import Convolution3D
+        assert isinstance(net.layers[0], Convolution3D)
+        assert net._params[0]["W"].shape == (3, 1, 2, 2, 2)
+        x = np.random.RandomState(0).randn(2, 1, 4, 4, 4).astype(
+            np.float32)
+        out = net.output(x).numpy()
+        assert out.shape == (2, 2)
+        assert np.allclose(out.sum(1), 1.0, atol=1e-5)
+
+    def test_cropping_upsampling_prelu(self, tmp_path):
+        rng = np.random.default_rng(1)
+        wc = rng.normal(size=(3, 3, 1, 2)).astype(np.float32)
+        alpha = rng.normal(size=(1, 1, 2)).astype(np.float32) * 0.1
+        wd = rng.normal(size=(2, 2)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Conv2D", "config": {
+                "name": "c", "filters": 2, "kernel_size": [3, 3],
+                "padding": "same", "activation": "linear",
+                "use_bias": False,
+                "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "PReLU", "config": {"name": "pr"}},
+            {"class_name": "Cropping2D", "config": {
+                "name": "cr", "cropping": [[1, 1], [2, 2]]}},
+            {"class_name": "UpSampling2D", "config": {
+                "name": "up", "size": [2, 2]}},
+            {"class_name": "GlobalAveragePooling2D", "config": {
+                "name": "gap"}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "crop.h5"
+        _write_h5(p, cfg, {
+            "c": [("kernel:0", wc)],
+            "pr": [("alpha:0", alpha)],
+            "out": [("kernel:0", wd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        from deeplearning4j_tpu.nn import (Cropping2D, PReLULayer,
+                                           Upsampling2D)
+        assert isinstance(net.layers[1], PReLULayer)
+        assert np.allclose(np.asarray(net._params[1]["alpha"]),
+                           alpha.reshape(2))
+        assert isinstance(net.layers[2], Cropping2D)
+        assert isinstance(net.layers[3], Upsampling2D)
+        x = np.random.RandomState(1).randn(2, 1, 8, 8).astype(np.float32)
+        acts = net.feedForward(x)
+        assert acts[3].shape() == (2, 2, 6, 4)    # cropped
+        assert acts[4].shape() == (2, 2, 12, 8)   # upsampled
+
+    def test_conv1d_repeat_vector(self, tmp_path):
+        rng = np.random.default_rng(2)
+        w1 = rng.normal(size=(3, 2, 4)).astype(np.float32)   # KIO
+        wd = rng.normal(size=(4, 2)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Conv1D", "config": {
+                "name": "c1", "filters": 4, "kernel_size": [3],
+                "strides": [1], "padding": "same",
+                "activation": "tanh", "use_bias": False,
+                "batch_input_shape": [None, 6, 2]}},
+            {"class_name": "GlobalAveragePooling1D", "config": {
+                "name": "gap"}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "c1d.h5"
+        _write_h5(p, cfg, {"c1": [("kernel:0", w1)],
+                           "out": [("kernel:0", wd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        assert net._params[0]["W"].shape == (4, 2, 3)
+        x = np.random.RandomState(2).randn(2, 2, 6).astype(np.float32)
+        assert net.output(x).numpy().shape == (2, 2)
+
+    def test_parametrized_elu_and_causal_rejection(self, tmp_path):
+        # ELU alpha preserved; causal Conv1D raises instead of silently
+        # mis-importing
+        rng = np.random.default_rng(3)
+        wd = rng.normal(size=(4, 2)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Dense", "config": {
+                "name": "d", "units": 4, "activation": "linear",
+                "use_bias": False, "batch_input_shape": [None, 4]}},
+            {"class_name": "ELU", "config": {"name": "e", "alpha": 0.5}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "elu.h5"
+        _write_h5(p, cfg, {
+            "d": [("kernel:0", rng.normal(size=(4, 4)).astype(np.float32))],
+            "out": [("kernel:0", wd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        assert net.layers[1].activation == "elu:0.5"
+
+        causal = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Conv1D", "config": {
+                "name": "c", "filters": 2, "kernel_size": [3],
+                "padding": "causal", "activation": "linear",
+                "use_bias": False, "batch_input_shape": [None, 6, 2]}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p2 = tmp_path / "causal.h5"
+        _write_h5(p2, causal, {})
+        with pytest.raises(ValueError, match="causal"):
+            KerasModelImport.importKerasSequentialModelAndWeights(str(p2))
