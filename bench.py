@@ -163,7 +163,7 @@ def bench_resnet50():
     # 595 imgs/s f32/b64 -> 1467 imgs/s bf16/b256)
     net = ResNet50(numClasses=1000, dataType="bfloat16").init()
     rng = np.random.default_rng(0)
-    bsz, k = 256, 3
+    bsz, k = 256, 8  # k=3 -> 1334 img/s, k=8 amortizes the tunnel RTT further
     X_k = rng.normal(size=(k, bsz, 3, 224, 224)).astype(np.float32)
     y_k = np.stack([np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, bsz)] for _ in range(k)])

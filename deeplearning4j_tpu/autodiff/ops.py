@@ -977,3 +977,249 @@ def _tf_strided_slice(x, idx=None):
         (_np.newaxis if i is None else
          (slice(*i) if isinstance(i, (list, tuple)) else i))
         for i in idx)]
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (reference: nd4j SDLinalg / libnd4j blas parity ops —
+# cholesky, solve, matrix_inverse, svd, qr, lu, matrix_band_part, ...)
+# ---------------------------------------------------------------------------
+
+OPS["cholesky"] = jnp.linalg.cholesky
+OPS["matrixInverse"] = jnp.linalg.inv
+OPS["matrixDeterminant"] = jnp.linalg.det
+OPS["logdet"] = lambda x: jnp.linalg.slogdet(x)[1]
+OPS["trace"] = lambda x: jnp.trace(x, axis1=-2, axis2=-1)
+
+
+@op("solve")
+def _solve(a, b, adjoint=False):
+    if adjoint:
+        a = jnp.swapaxes(a, -1, -2).conj()
+    return jnp.linalg.solve(a, b)
+
+
+@op("triangularSolve")
+def _triangular_solve(a, b, lower=True, adjoint=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(a, b, lower=lower,
+                                trans=2 if adjoint else 0)
+
+
+@op("svd")
+def _svd(x, fullUV=False, computeUV=True):
+    # computeUV accepted for parity; U/V are always produced so the op's
+    # graph arity stays fixed at 3 (XLA drops unused outputs anyway)
+    u, s, vh = jnp.linalg.svd(x, full_matrices=fullUV)
+    return s, u, jnp.swapaxes(vh, -1, -2)  # DL4J returns (s, u, v)
+
+
+@op("qr")
+def _qr(x, fullMatrices=False):
+    return jnp.linalg.qr(x, mode="complete" if fullMatrices else "reduced")
+
+
+@op("lu")
+def _lu(x):
+    import jax.scipy.linalg as jsl
+
+    lu, piv = jsl.lu_factor(x)
+    return lu, piv
+
+
+@op("lstsq")
+def _lstsq(a, b, fast=True):
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("matrixBandPart")
+def _matrix_band_part(x, minLower=-1, maxUpper=-1):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if minLower >= 0:
+        keep = keep & (i - j <= minLower)
+    if maxUpper >= 0:
+        keep = keep & (j - i <= maxUpper)
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+OPS["triu"] = lambda x, diag=0: jnp.triu(x, k=diag)
+OPS["tril"] = lambda x, diag=0: jnp.tril(x, k=diag)
+OPS["diagPart"] = lambda x: jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (reference: libnd4j parity_ops segment_* /
+# unsorted_segment_*) — num_segments must be static under jit
+# ---------------------------------------------------------------------------
+
+def _num_segments(ids, numSegments):
+    if numSegments is not None:
+        return int(numSegments)
+    try:
+        return int(jnp.max(ids)) + 1
+    except jax.errors.ConcretizationTypeError as e:
+        raise ValueError(
+            "segment ops need numSegments when the ids are traced "
+            "(static output shape under jit); pass numSegments "
+            "explicitly") from e
+
+
+def _segment(reducer):
+    def f(data, ids, numSegments=None):
+        ids = jnp.asarray(ids, jnp.int32)
+        return reducer(data, ids,
+                       num_segments=_num_segments(ids, numSegments))
+    return f
+
+
+OPS["segmentSum"] = OPS["unsortedSegmentSum"] = _segment(jax.ops.segment_sum)
+OPS["segmentMax"] = OPS["unsortedSegmentMax"] = _segment(jax.ops.segment_max)
+OPS["segmentMin"] = OPS["unsortedSegmentMin"] = _segment(jax.ops.segment_min)
+OPS["segmentProd"] = OPS["unsortedSegmentProd"] = _segment(
+    jax.ops.segment_prod)
+
+
+@op("segmentMean")
+def _segment_mean(data, ids, numSegments=None):
+    ids = jnp.asarray(ids, jnp.int32)
+    n = _num_segments(ids, numSegments)
+    s = jax.ops.segment_sum(data, ids, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(data), ids, num_segments=n)
+    return s / jnp.maximum(c, 1)
+
+
+OPS["unsortedSegmentMean"] = _segment_mean
+
+
+# ---------------------------------------------------------------------------
+# topK / misc (reference: parity ops top_k, in_top_k, confusion_matrix,
+# bincount, zero_fraction)
+# ---------------------------------------------------------------------------
+
+@op("topK")
+def _top_k(x, k=1, sorted=True):  # noqa: A002
+    return lax.top_k(x, int(k))
+
+
+@op("inTopK")
+def _in_top_k(predictions, targets, k=1):
+    _, idx = lax.top_k(predictions, int(k))
+    return jnp.any(idx == targets[..., None], axis=-1)
+
+
+@op("confusionMatrix")
+def _confusion_matrix(labels, pred, numClasses):
+    n = int(numClasses)
+    idx = jnp.asarray(labels, jnp.int32) * n + jnp.asarray(pred, jnp.int32)
+    return jnp.bincount(idx, length=n * n).reshape(n, n)
+
+
+@op("bincount")
+def _bincount(x, weights=None, minLength=0, maxLength=None):
+    """DL4J bincount(values, weights, minLength, maxLength). With
+    maxLength the output length is static (values >= it are dropped,
+    TF maxlength semantics — required under jit); otherwise the length
+    is max(values)+1 extended to minLength, which needs concrete
+    values."""
+    x = jnp.asarray(x, jnp.int32)
+    if maxLength is not None:
+        n = max(int(minLength), int(maxLength))
+        return jnp.bincount(x, weights, length=n)
+    try:
+        m = int(jnp.max(x)) + 1
+    except jax.errors.ConcretizationTypeError as e:
+        raise ValueError(
+            "bincount without maxLength needs concrete values; inside a "
+            "jitted graph pass maxLength for a static output size") from e
+    return jnp.bincount(x, weights, length=max(m, int(minLength)))
+
+
+OPS["zeroFraction"] = lambda x: jnp.mean((x == 0).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# image / spatial ops (reference: libnd4j parity image ops — resize,
+# extract_image_patches, space_to_batch, batch_to_space, s2d/d2s; the
+# reference routes these through custom kernels, here jax.image / lax)
+# ---------------------------------------------------------------------------
+
+@op("imageResize")
+def _image_resize(x, height, width, method="bilinear", antialias=False):
+    """x: [N,C,H,W] (DL4J layout); method: bilinear|nearest|cubic.
+    antialias defaults OFF to match the TF/DL4J resize ops this mirrors
+    (jax.image.resize's own default is antialias=True)."""
+    meth = {"bilinear": "bilinear", "nearest": "nearest",
+            "cubic": "cubic", "bicubic": "cubic"}[str(method).lower()]
+    n, c = x.shape[0], x.shape[1]
+    return jax.image.resize(x, (n, c, int(height), int(width)), meth,
+                            antialias=antialias)
+
+
+@op("extractImagePatches")
+def _extract_image_patches(x, kH, kW, sH=1, sW=1, sameMode=False):
+    pad = "SAME" if sameMode else "VALID"
+    return lax.conv_general_dilated_patches(
+        x, (int(kH), int(kW)), (int(sH), int(sW)), pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+@op("spaceToDepth")
+def _space_to_depth(x, blockSize=2):
+    n, c, h, w = x.shape
+    b = int(blockSize)
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    return jnp.transpose(x, (0, 3, 5, 1, 2, 4)).reshape(
+        n, c * b * b, h // b, w // b)
+
+
+@op("depthToSpace")
+def _depth_to_space(x, blockSize=2):
+    n, c, h, w = x.shape
+    b = int(blockSize)
+    cout = c // (b * b)
+    x = x.reshape(n, b, b, cout, h, w)
+    return jnp.transpose(x, (0, 3, 4, 1, 5, 2)).reshape(
+        n, cout, h * b, w * b)
+
+
+@op("spaceToBatch")
+def _space_to_batch(x, blockSize=2, padding=((0, 0), (0, 0))):
+    n, c, h, w = x.shape
+    b = int(blockSize)
+    x = jnp.pad(x, ((0, 0), (0, 0)) + tuple(tuple(p) for p in padding))
+    h2, w2 = x.shape[2], x.shape[3]
+    x = x.reshape(n, c, h2 // b, b, w2 // b, b)
+    return jnp.transpose(x, (3, 5, 0, 1, 2, 4)).reshape(
+        n * b * b, c, h2 // b, w2 // b)
+
+
+@op("batchToSpace")
+def _batch_to_space(x, blockSize=2, crop=((0, 0), (0, 0))):
+    nb, c, h, w = x.shape
+    b = int(blockSize)
+    n = nb // (b * b)
+    x = x.reshape(b, b, n, c, h, w)
+    x = jnp.transpose(x, (2, 3, 4, 0, 5, 1)).reshape(n, c, h * b, w * b)
+    (ct, cb), (cl, cr) = crop
+    return x[:, :, ct: x.shape[2] - cb, cl: x.shape[3] - cr]
+
+
+# ---------------------------------------------------------------------------
+# special functions (reference: libnd4j transforms — lgamma, digamma,
+# igamma, betainc, erfc, zeta)
+# ---------------------------------------------------------------------------
+
+OPS["erfc"] = jax.scipy.special.erfc
+OPS["lgamma"] = jax.scipy.special.gammaln
+OPS["digamma"] = jax.scipy.special.digamma
+OPS["igamma"] = jax.scipy.special.gammainc
+OPS["igammac"] = jax.scipy.special.gammaincc
+OPS["betainc"] = jax.scipy.special.betainc
+OPS["atan2"] = jnp.arctan2
+OPS["expm1"] = jnp.expm1
+OPS["asinh"] = jnp.arcsinh
+OPS["acosh"] = jnp.arccosh
+OPS["atanh"] = jnp.arctanh

@@ -251,7 +251,13 @@ class SDMath(_Namespace):
         "gte", "lt", "lte", "and_op", "or_op", "not_op", "xor_op",
         "maximum", "minimum", "clipByValue", "clipByNorm", "standardize",
         "squaredDifference", "floordiv", "mod", "diag", "invertPermutation",
-        "reverse", "argmax", "argmin",
+        "reverse", "argmax", "argmin", "atan2", "expm1", "asinh", "acosh",
+        "atanh", "erfc", "lgamma", "digamma", "igamma", "igammac",
+        "betainc", "segmentSum", "segmentMax", "segmentMin", "segmentMean",
+        "segmentProd", "unsortedSegmentSum", "unsortedSegmentMax",
+        "unsortedSegmentMin", "unsortedSegmentMean", "unsortedSegmentProd",
+        "topK", "inTopK", "confusionMatrix", "bincount", "zeroFraction",
+        "trace",
     )
 
 
@@ -303,6 +309,27 @@ class SDLoss(_Namespace):
             return v
 
         return g
+
+
+class SDLinalg(_Namespace):
+    """Reference: org.nd4j.autodiff.samediff.ops.SDLinalg (cholesky,
+    solve, svd, qr, lu, matrix inverse/determinant, band part)."""
+
+    _passthrough = (
+        "cholesky", "solve", "triangularSolve", "matrixInverse",
+        "matrixDeterminant", "logdet", "svd", "qr", "lu", "lstsq",
+        "matrixBandPart", "triu", "tril", "diagPart", "trace", "matmul",
+    )
+
+
+class SDImage(_Namespace):
+    """Reference: org.nd4j.autodiff.samediff.ops.SDImage (resize ops,
+    extract patches, space/batch/depth rearrangements; NCHW layout)."""
+
+    _passthrough = (
+        "imageResize", "extractImagePatches", "spaceToDepth",
+        "depthToSpace", "spaceToBatch", "batchToSpace",
+    )
 
 
 class SDRandom(_Namespace):
@@ -366,7 +393,8 @@ class History:
 
 class SameDiff:
     MULTI_OUTPUT_OPS = {"moments": 2, "lstmCell": 2, "lstmLayer": 3,
-                        "gruLayer": 2, "simpleRnnLayer": 2}
+                        "gruLayer": 2, "simpleRnnLayer": 2, "svd": 3,
+                        "qr": 2, "lu": 2, "topK": 2}
 
     def __init__(self):
         self._ops: list[Op] = []
@@ -389,6 +417,8 @@ class SameDiff:
         self.rnn = SDRNN(self)
         self.loss = SDLoss(self)
         self.random = SDRandom(self)
+        self.linalg = SDLinalg(self)
+        self.image = SDImage(self)
 
     @staticmethod
     def create() -> "SameDiff":

@@ -433,6 +433,11 @@ _UNARY = {
     "Tan": "tan", "Asin": "asin", "Acos": "acos", "Atan": "atan",
     "Sinh": "sinh", "Cosh": "cosh", "Reciprocal": "reciprocal",
     "IsNan": "isnan", "IsInf": "isinf", "LogicalNot": "not_op",
+    "Erfc": "erfc", "Lgamma": "lgamma", "Digamma": "digamma",
+    "Expm1": "expm1", "Asinh": "asinh", "Acosh": "acosh",
+    "Atanh": "atanh", "Cholesky": "cholesky",
+    "MatrixInverse": "matrixInverse",
+    "MatrixDeterminant": "matrixDeterminant",
 }
 
 
@@ -448,7 +453,8 @@ _BINARY = {
     "SquaredDifference": "squaredDifference", "FloorMod": "mod",
     "Equal": "eq", "NotEqual": "neq", "Greater": "gt",
     "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
-    "LogicalAnd": "and_op", "LogicalOr": "or_op",
+    "LogicalAnd": "and_op", "LogicalOr": "or_op", "Atan2": "atan2",
+    "Igamma": "igamma", "Igammac": "igammac",
 }
 
 
@@ -730,6 +736,29 @@ def _h_einsum(im, node):
     express their projections this way."""
     eq = node.attrs["equation"].s.decode()
     im.emit(node, "tfEinsum", im.data_inputs(node), {"equation": eq})
+
+
+@handler("SpaceToDepth", "DepthToSpace")
+def _h_space_depth(im, node):
+    """NOTE: emitted against our NCHW ops. TF's DEFAULT data_format for
+    these ops is NHWC, so an absent attr is NHWC and must be rejected —
+    only graphs declaring NCHW import exactly."""
+    fmt = node.attrs.get("data_format")
+    fmt_s = fmt.s.decode() if fmt is not None else "NHWC"
+    if fmt_s != "NCHW":
+        raise ValueError(
+            f"{node.op} data_format {fmt_s!r} unsupported (NCHW only)")
+    bs = int(node.attrs["block_size"].i)
+    opname = ("spaceToDepth" if node.op == "SpaceToDepth"
+              else "depthToSpace")
+    im.emit(node, opname, im.data_inputs(node), {"blockSize": bs})
+
+
+@handler("TopKV2")
+def _h_topk(im, node):
+    ins = im.data_inputs(node)
+    k = int(im.need_const(ins[1], "TopKV2 k"))
+    im.emit(node, "topK", [ins[0]], {"k": k})
 
 
 @handler("Cumsum")

@@ -1,0 +1,243 @@
+"""Op validation for the round-2 registry additions (reference: the
+nd4j opvalidation framework, SURVEY.md §4 — expected outputs per op vs
+scipy/numpy, plus gradient checks where the op is differentiable)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.ops import OPS
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+
+class TestLinalgOps:
+    def setup_method(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32)
+        self.spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        self.b = rng.randn(4, 2).astype(np.float32)
+
+    def test_cholesky_solve_inverse_det(self):
+        L = np.asarray(OPS["cholesky"](self.spd))
+        assert np.allclose(L @ L.T, self.spd, atol=1e-3)
+        x = np.asarray(OPS["solve"](self.spd, self.b))
+        assert np.allclose(self.spd @ x, self.b, atol=1e-3)
+        inv = np.asarray(OPS["matrixInverse"](self.spd))
+        assert np.allclose(inv @ self.spd, np.eye(4), atol=1e-3)
+        det = float(OPS["matrixDeterminant"](self.spd))
+        assert det == pytest.approx(float(np.linalg.det(self.spd)),
+                                    rel=1e-3)
+        assert float(OPS["logdet"](self.spd)) == pytest.approx(
+            np.log(det), rel=1e-3)
+
+    def test_svd_qr_reconstruct(self):
+        m = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        s, u, v = OPS["svd"](m)
+        assert np.allclose(np.asarray(u) * np.asarray(s)
+                           @ np.asarray(v).T, m, atol=1e-3)
+        q, r = OPS["qr"](m)
+        assert np.allclose(np.asarray(q) @ np.asarray(r), m, atol=1e-3)
+        assert np.allclose(np.asarray(q).T @ np.asarray(q), np.eye(3),
+                           atol=1e-3)
+
+    def test_triangular_and_band(self):
+        m = np.arange(16, dtype=np.float32).reshape(4, 4)
+        assert np.allclose(np.asarray(OPS["triu"](m)), np.triu(m))
+        assert np.allclose(np.asarray(OPS["tril"](m, diag=-1)),
+                           np.tril(m, -1))
+        band = np.asarray(OPS["matrixBandPart"](m, 1, 1))
+        expect = np.triu(np.tril(m, 1), -1)
+        assert np.allclose(band, expect)
+        assert np.allclose(np.asarray(OPS["diagPart"](m)), np.diag(m))
+        assert float(OPS["trace"](m)) == np.trace(m)
+
+    def test_triangular_solve(self):
+        L = np.tril(np.random.RandomState(2).rand(4, 4) + 1).astype(
+            np.float32)
+        x = np.asarray(OPS["triangularSolve"](L, self.b, lower=True))
+        assert np.allclose(L @ x, self.b, atol=1e-3)
+
+    def test_solve_gradient(self):
+        # linalg ops are differentiable through jax
+        def f(a):
+            return jnp.sum(jnp.square(OPS["solve"](a, self.b)))
+
+        g = jax.grad(f)(jnp.asarray(self.spd))
+        eps = 1e-2
+        d = np.zeros((4, 4), np.float32)
+        d[0, 0] = eps
+        num = (f(jnp.asarray(self.spd + d))
+               - f(jnp.asarray(self.spd - d))) / (2 * eps)
+        assert float(g[0, 0]) == pytest.approx(float(num), rel=2e-2)
+
+
+class TestSegmentOps:
+    def test_all_reducers(self):
+        data = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+        ids = np.asarray([0, 0, 1, 1, 1], np.int32)
+        assert np.allclose(OPS["segmentSum"](data, ids, 2), [3, 12])
+        assert np.allclose(OPS["segmentMax"](data, ids, 2), [2, 5])
+        assert np.allclose(OPS["segmentMin"](data, ids, 2), [1, 3])
+        assert np.allclose(OPS["segmentMean"](data, ids, 2), [1.5, 4])
+        assert np.allclose(OPS["segmentProd"](data, ids, 2), [2, 60])
+
+    def test_unsorted_and_empty_segment(self):
+        data = np.asarray([1.0, 2.0, 3.0], np.float32)
+        ids = np.asarray([2, 0, 2], np.int32)
+        out = np.asarray(OPS["unsortedSegmentSum"](data, ids, 4))
+        assert np.allclose(out, [2, 0, 4, 0])
+        mean = np.asarray(OPS["unsortedSegmentMean"](data, ids, 4))
+        assert np.allclose(mean, [2, 0, 2, 0])  # empty segments -> 0
+
+
+class TestTopKMisc:
+    def test_topk_and_in_topk(self):
+        x = np.asarray([[1.0, 5.0, 3.0, 2.0]], np.float32)
+        vals, idx = OPS["topK"](x, k=2)
+        assert np.allclose(np.asarray(vals), [[5.0, 3.0]])
+        assert np.asarray(idx).tolist() == [[1, 2]]
+        hit = OPS["inTopK"](x, np.asarray([2], np.int32), k=2)
+        miss = OPS["inTopK"](x, np.asarray([0], np.int32), k=2)
+        assert bool(np.asarray(hit)[0]) and not bool(np.asarray(miss)[0])
+
+    def test_confusion_bincount_zerofraction(self):
+        cm = np.asarray(OPS["confusionMatrix"](
+            np.asarray([0, 1, 1, 2]), np.asarray([0, 1, 2, 2]), 3))
+        assert cm[1, 1] == 1 and cm[1, 2] == 1 and cm[2, 2] == 1
+        assert np.asarray(OPS["bincount"](
+            np.asarray([0, 1, 1, 3]), minLength=5)).tolist() == \
+            [1, 2, 0, 1, 0]
+        assert float(OPS["zeroFraction"](
+            np.asarray([0.0, 1.0, 0.0, 2.0]))) == 0.5
+
+
+class TestImageOps:
+    def test_resize_bilinear_and_nearest(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = np.asarray(OPS["imageResize"](x, 2, 2, method="nearest"))
+        assert y.shape == (1, 1, 2, 2)
+        yb = np.asarray(OPS["imageResize"](x, 8, 8, method="bilinear"))
+        assert yb.shape == (1, 1, 8, 8)
+        assert yb.min() >= 0 and yb.max() <= 15
+
+    def test_space_depth_round_trips(self):
+        x = np.random.RandomState(0).randn(2, 4, 4, 4).astype(np.float32)
+        s2d = np.asarray(OPS["spaceToDepth"](x, 2))
+        assert s2d.shape == (2, 16, 2, 2)
+        back = np.asarray(OPS["depthToSpace"](s2d, 2))
+        assert np.allclose(back, x)
+        s2b = np.asarray(OPS["spaceToBatch"](x, 2))
+        assert s2b.shape == (8, 4, 2, 2)
+        b2s = np.asarray(OPS["batchToSpace"](s2b, 2))
+        assert np.allclose(b2s, x)
+
+    def test_extract_patches(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        p = np.asarray(OPS["extractImagePatches"](x, 2, 2, 2, 2))
+        assert p.shape == (1, 4, 2, 2)
+
+
+class TestSpecialFns:
+    def test_against_scipy_values(self):
+        # fixed golden values (scipy.special on CPU)
+        assert float(OPS["lgamma"](jnp.asarray(5.0))) == pytest.approx(
+            3.1780538, abs=1e-4)
+        assert float(OPS["digamma"](jnp.asarray(2.0))) == pytest.approx(
+            0.4227843, abs=1e-4)
+        assert float(OPS["erfc"](jnp.asarray(0.5))) == pytest.approx(
+            0.4795001, abs=1e-4)
+        assert float(OPS["igamma"](jnp.asarray(2.0),
+                                   jnp.asarray(1.0))) == pytest.approx(
+            0.2642411, abs=1e-4)
+        assert float(OPS["betainc"](jnp.asarray(2.0), jnp.asarray(3.0),
+                                    jnp.asarray(0.5))) == pytest.approx(
+            0.6875, abs=1e-4)
+        assert float(OPS["atan2"](jnp.asarray(1.0),
+                                  jnp.asarray(1.0))) == pytest.approx(
+            np.pi / 4, abs=1e-5)
+
+
+class TestSameDiffNamespaces:
+    def test_linalg_namespace_in_graph(self):
+        sd = SameDiff()
+        a = sd.constant("a", np.asarray([[4.0, 1.0], [1.0, 3.0]],
+                                        np.float32))
+        chol = sd.linalg.cholesky(a)
+        L = np.asarray(chol.eval().numpy())
+        assert np.allclose(L @ L.T, [[4, 1], [1, 3]], atol=1e-4)
+
+    def test_topk_multi_output_in_graph(self):
+        sd = SameDiff()
+        x = sd.constant("x", np.asarray([[3.0, 1.0, 2.0]], np.float32))
+        vals, idx = sd.math.topK(x, k=2)
+        assert np.allclose(vals.eval().numpy(), [[3.0, 2.0]])
+        assert idx.eval().numpy().tolist() == [[0, 2]]
+
+    def test_image_namespace(self):
+        sd = SameDiff()
+        x = sd.constant("x", np.arange(16, dtype=np.float32)
+                        .reshape(1, 1, 4, 4))
+        y = sd.image.imageResize(x, height=2, width=2, method="nearest")
+        assert y.eval().numpy().shape == (1, 1, 2, 2)
+
+    def test_segment_in_graph_trains(self):
+        # segment ops must be jit/grad compatible inside a graph
+        sd = SameDiff()
+        data = sd.var("d", np.asarray([1.0, 2.0, 3.0], np.float32))
+        ids = sd.constant("i", np.asarray([0, 1, 0], np.int32))
+        s = sd.math.segmentSum(data, ids, numSegments=2)
+        loss = sd.math.sum(sd.math.square(s))
+        sd.setLossVariables(loss)
+        grads = sd.calculateGradients({}, "d")
+        g = np.asarray(grads["d"])
+        # d/dd of (d0+d2)^2 + d1^2 = [2*4, 2*2, 2*4]
+        assert np.allclose(g, [8.0, 4.0, 8.0])
+
+
+class TestReviewRegressions:
+    def test_bincount_extends_beyond_minlength(self):
+        # TF/np minlength semantics: out-of-range values EXTEND the output
+        out = np.asarray(OPS["bincount"](np.asarray([0, 7]), minLength=3))
+        assert out.tolist() == [1, 0, 0, 0, 0, 0, 0, 1]
+        # maxLength gives the static-size TF maxlength behavior
+        out = np.asarray(OPS["bincount"](np.asarray([0, 7]), maxLength=3))
+        assert out.tolist() == [1, 0, 0]
+
+    def test_bincount_in_jit_needs_maxlength(self):
+        with pytest.raises(ValueError, match="maxLength"):
+            jax.jit(lambda v: OPS["bincount"](v))(np.asarray([0, 1]))
+        out = jax.jit(lambda v: OPS["bincount"](v, maxLength=4))(
+            np.asarray([0, 1, 1]))
+        assert np.asarray(out).tolist() == [1, 2, 0, 0]
+
+    def test_segment_infers_num_segments_eagerly(self):
+        data = np.asarray([1.0, 2.0, 3.0], np.float32)
+        ids = np.asarray([0, 1, 1], np.int32)
+        assert np.allclose(OPS["segmentSum"](data, ids), [1, 5])
+        with pytest.raises(ValueError, match="numSegments"):
+            jax.jit(lambda d, i: OPS["segmentSum"](d, i))(data, ids)
+
+    def test_image_resize_no_antialias_matches_classic(self):
+        # downscale by 2 with antialias off: nearest-of-bilinear at exact
+        # half-pixel centers averages each 2x2 block
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = np.asarray(OPS["imageResize"](x, 2, 2, method="bilinear"))
+        expect = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        assert np.allclose(y, expect, atol=1e-4)
+
+    def test_tf_space_to_depth_default_format_rejected(self):
+        from deeplearning4j_tpu.modelimport.protobuf import (
+            GraphDef, NodeDef, attr_i)
+        from deeplearning4j_tpu.modelimport.tensorflow import (
+            TFGraphMapper, TFImportError)
+        from tests.test_tf_import import placeholder
+
+        gd = GraphDef([
+            placeholder("x", [1, 4, 4, 4]),
+            NodeDef("s2d", "SpaceToDepth", ["x"],
+                    {"block_size": attr_i(2)}),  # no data_format = NHWC
+        ])
+        with pytest.raises((ValueError, TFImportError)):
+            TFGraphMapper.importGraph(gd)

@@ -542,3 +542,65 @@ class TestConvImport:
         out = sd.output({"x": x}, "bn")["bn"].numpy()
         expect = (x - mean) / np.sqrt(var + 1e-3) * scale + offset
         np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestRound2Ops:
+    """SpaceToDepth/DepthToSpace/TopKV2 + new unary/binary mappings."""
+
+    def test_space_to_depth_import(self):
+        x = np.arange(2 * 4 * 4 * 4, dtype=np.float32).reshape(2, 4, 4, 4)
+        gd = GraphDef([
+            placeholder("x", [2, 4, 4, 4]),
+            NodeDef("s2d", "SpaceToDepth", ["x"], {
+                "block_size": attr_i(2),
+                "data_format": attr_s(b"NCHW")}),
+            NodeDef("d2s", "DepthToSpace", ["s2d"], {
+                "block_size": attr_i(2),
+                "data_format": attr_s(b"NCHW")}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        out = sd.output({"x": x}, "s2d", "d2s")
+        assert np.asarray(out["s2d"]).shape == (2, 16, 2, 2)
+        assert np.allclose(np.asarray(out["d2s"]), x)
+
+    def test_nhwc_space_to_depth_rejected(self):
+        gd = GraphDef([
+            placeholder("x", [1, 4, 4, 4]),
+            NodeDef("s2d", "SpaceToDepth", ["x"], {
+                "block_size": attr_i(2),
+                "data_format": attr_s(b"NHWC")}),
+        ])
+        with pytest.raises((ValueError, TFImportError)):
+            TFGraphMapper.importGraph(gd)
+
+    def test_topk_import(self):
+        gd = GraphDef([
+            placeholder("x", [2, 5]),
+            const("k", np.asarray(3, np.int32)),
+            NodeDef("tk", "TopKV2", ["x", "k"], {}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.asarray([[5.0, 1.0, 4.0, 2.0, 3.0],
+                        [0.0, 9.0, 8.0, 7.0, 1.0]], np.float32)
+        out = sd.output({"x": x}, "tk", "tk:1")
+        assert np.allclose(np.asarray(out["tk"]),
+                           [[5, 4, 3], [9, 8, 7]])
+        assert np.asarray(out["tk:1"]).tolist() == [[0, 2, 4], [1, 2, 3]]
+
+    def test_new_unary_binary_mappings(self):
+        gd = GraphDef([
+            placeholder("x", [3]),
+            placeholder("y", [3]),
+            NodeDef("a2", "Atan2", ["x", "y"], {}),
+            NodeDef("lg", "Lgamma", ["y"], {}),
+            NodeDef("em", "Expm1", ["x"], {}),
+        ])
+        sd = TFGraphMapper.importGraph(gd)
+        x = np.asarray([1.0, 2.0, 0.5], np.float32)
+        y = np.asarray([1.0, 3.0, 5.0], np.float32)
+        out = sd.output({"x": x, "y": y}, "a2", "lg", "em")
+        assert np.allclose(np.asarray(out["a2"]), np.arctan2(x, y),
+                           atol=1e-5)
+        import scipy.special as sp
+        assert np.allclose(np.asarray(out["lg"]), sp.gammaln(y), atol=1e-4)
+        assert np.allclose(np.asarray(out["em"]), np.expm1(x), atol=1e-5)
