@@ -1,5 +1,5 @@
 from deeplearning4j_tpu.evaluation.classification import (  # noqa: F401
-    Evaluation, EvaluationBinary, ROC, ROCMultiClass)
+    Evaluation, EvaluationBinary, ROC, ROCBinary, ROCMultiClass)
 from deeplearning4j_tpu.evaluation.regression import (  # noqa: F401
     RegressionEvaluation)
 from deeplearning4j_tpu.evaluation.calibration import (  # noqa: F401

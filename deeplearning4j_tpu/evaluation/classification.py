@@ -252,3 +252,53 @@ class ROCMultiClass:
 
     def calculateAverageAUC(self):
         return float(np.mean([r.calculateAUC() for r in self._rocs.values()]))
+
+
+class ROCBinary:
+    """Per-output ROC for MULTI-LABEL binary outputs [N, nOut] (reference:
+    org.nd4j.evaluation.classification.ROCBinary — one ROC per sigmoid
+    output, vs ROC's single binary problem)."""
+
+    def __init__(self, thresholdSteps=0):
+        self.thresholdSteps = thresholdSteps
+        self._rocs: dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        lab = _to_np(labels)
+        pred = _to_np(predictions)
+        if lab.ndim == 1:
+            lab = lab[:, None]
+            pred = pred[:, None]
+        m = None if mask is None else _to_np(mask)
+        for i in range(lab.shape[-1]):
+            li, pi = lab[..., i].reshape(-1), pred[..., i].reshape(-1)
+            if m is not None:
+                # per-output mask [N, nOut] selects its column; a
+                # per-example mask [N] applies to every output
+                mi = m[..., i] if m.ndim == lab.ndim else m
+                keep = mi.reshape(-1) > 0
+                li, pi = li[keep], pi[keep]
+            self._rocs.setdefault(i, ROC(self.thresholdSteps)).eval(li, pi)
+        return self
+
+    def numLabels(self):
+        return len(self._rocs)
+
+    def calculateAUC(self, outputNum):
+        return self._rocs[outputNum].calculateAUC()
+
+    def calculateAUCPR(self, outputNum):
+        return self._rocs[outputNum].calculateAUCPR()
+
+    def calculateAverageAUC(self):
+        if not self._rocs:
+            return 0.0
+        return float(np.mean([r.calculateAUC()
+                              for r in self._rocs.values()]))
+
+    def stats(self):
+        lines = ["ROCBinary (per-output AUC / AUCPR)"]
+        for i, r in sorted(self._rocs.items()):
+            lines.append(f"  out {i}: AUC {r.calculateAUC():.4f}  "
+                         f"AUCPR {r.calculateAUCPR():.4f}")
+        return "\n".join(lines)

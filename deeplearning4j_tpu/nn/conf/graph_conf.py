@@ -165,7 +165,8 @@ class ReshapeVertex(GraphVertex):
 
 class ComputationGraphConfiguration:
     def __init__(self, inputs, nodes, outputs, defaults=None, seed=12345,
-                 dataType="float32", input_types=None):
+                 dataType="float32", input_types=None,
+                 backpropType="Standard", tbpttLength=None):
         self.inputs = list(inputs)            # input names
         self.nodes = nodes                    # name -> (layer|vertex, [input names])
         self.outputs = list(outputs)          # output layer names
@@ -173,6 +174,8 @@ class ComputationGraphConfiguration:
         self.seed = seed
         self.dataType = dataType
         self.input_types = input_types or {}
+        self.backpropType = backpropType
+        self.tbpttLength = tbpttLength
         self.topo_order: list[str] = []
         self._finalize()
 
@@ -237,6 +240,8 @@ class ComputationGraphConfiguration:
             "dataType": self.dataType,
             "inputTypes": {k: v.to_json()
                            for k, v in self.input_types.items()},
+            "backpropType": self.backpropType,
+            "tbpttLength": self.tbpttLength,
         }, indent=1)
 
     toJson = to_json
@@ -258,7 +263,8 @@ class ComputationGraphConfiguration:
                        for k, v in (d.get("inputTypes") or {}).items()}
         return ComputationGraphConfiguration(
             d["inputs"], nodes, d["outputs"], defaults, d.get("seed", 12345),
-            d.get("dataType", "float32"), input_types)
+            d.get("dataType", "float32"), input_types,
+            d.get("backpropType", "Standard"), d.get("tbpttLength"))
 
     fromJson = from_json
 
@@ -294,7 +300,22 @@ class GraphBuilder:
         self._outputs = list(names)
         return self
 
+    def backpropType(self, bt, tbpttLength=None):
+        """Reference: GraphBuilder.backpropType(TruncatedBPTT) +
+        tBPTTForwardLength/tBPTTBackwardLength (one symmetric length)."""
+        self._backprop_type = bt
+        if tbpttLength is not None:
+            self._tbptt_length = int(tbpttLength)
+        return self
+
+    def tBPTTLength(self, n):
+        self._backprop_type = "TruncatedBPTT"
+        self._tbptt_length = int(n)
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         return ComputationGraphConfiguration(
             self._inputs, self._nodes, self._outputs, dict(self._defaults),
-            self._seed, self._dataType, self._input_types)
+            self._seed, self._dataType, self._input_types,
+            getattr(self, "_backprop_type", "Standard"),
+            getattr(self, "_tbptt_length", None))

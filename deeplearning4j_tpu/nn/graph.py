@@ -228,6 +228,119 @@ class ComputationGraph:
                 masks[n] = _ones_mask(lab[n])
         return inputs, lab, masks
 
+    # -- TBPTT + streaming state (reference: ComputationGraph truncated
+    # BPTT + rnnTimeStep; same chunked-segment scheme as
+    # MultiLayerNetwork._fit_tbptt, over the DAG's recurrent nodes) ---------
+    def _recurrent_nodes(self, forbid_bidirectional=False):
+        from deeplearning4j_tpu.nn.conf.layers import Bidirectional
+
+        out = []
+        for name, (node, _ins) in self.conf.nodes.items():
+            if isinstance(node, Bidirectional):
+                if forbid_bidirectional:
+                    raise ValueError(
+                        f"node {name!r} is Bidirectional: streaming "
+                        f"rnnTimeStep/TBPTT cannot carry state through a "
+                        f"layer that consumes the whole sequence")
+                continue
+            if getattr(node, "IS_RECURRENT", False) or getattr(
+                    getattr(node, "rnn", None), "IS_RECURRENT", False):
+                out.append(name)
+        return out
+
+    def _seed_rnn_states(self, states, batch_size):
+        dtype = self.conf.dtype
+        out = dict(states)
+        for name in self._recurrent_nodes():
+            node, _ = self.conf.nodes[name]
+            target = node.rnn if hasattr(node, "rnn") and getattr(
+                node.rnn, "IS_RECURRENT", False) and not getattr(
+                node, "IS_RECURRENT", False) else node
+            out[name] = target.streaming_state(batch_size, dtype)
+        return out
+
+    def _strip_rnn_states(self, states):
+        out = dict(states)
+        for name in self._recurrent_nodes():
+            out[name] = {}
+        return out
+
+    def _fit_tbptt(self, params, states, opts, inputs, labels, masks,
+                   base_key):
+        from deeplearning4j_tpu.nn.conf.configuration import BackpropType
+
+        assert self.conf.backpropType == BackpropType.TruncatedBPTT
+        L = self.conf.tbpttLength
+        T = max(v.shape[2] for v in inputs.values() if v.ndim == 3)
+        n = next(iter(inputs.values())).shape[0]
+        self._recurrent_nodes(forbid_bidirectional=True)
+        states = self._seed_rnn_states(states, n)
+        loss = None
+        for t0 in range(0, T, L):
+            def chunk(v, is_mask=False):
+                if is_mask:
+                    return v[:, t0:t0 + L] if v.ndim == 2 else v
+                return v[:, :, t0:t0 + L] if v.ndim == 3 else v
+
+            ic = {k: chunk(v) for k, v in inputs.items()}
+            lc = {k: chunk(v) for k, v in labels.items()}
+            mc = {k: chunk(v, is_mask=True) for k, v in masks.items()}
+            seg = min(L, T - t0)
+            if seg < L:
+                # zero-pad the tail segment to the fixed tbptt shape and
+                # mask the padded timesteps out of the loss
+                pad = L - seg
+                ic = {k: (np.concatenate(
+                    [v, np.zeros(v.shape[:2] + (pad,), v.dtype)], axis=2)
+                    if v.ndim == 3 else v) for k, v in ic.items()}
+                lc = {k: (np.concatenate(
+                    [v, np.zeros(v.shape[:2] + (pad,), v.dtype)], axis=2)
+                    if v.ndim == 3 else v) for k, v in lc.items()}
+                mc = {k: (np.concatenate(
+                    [v, np.zeros((v.shape[0], pad), v.dtype)], axis=1)
+                    if v.ndim == 2 else v) for k, v in mc.items()}
+            rng = jax.random.fold_in(base_key, self._iteration)
+            loss, params, states, opts = self._train_step(
+                params, states, opts, ic, lc, mc, rng, self._iteration)
+            self._iteration += 1
+        return loss, params, self._strip_rnn_states(states), opts
+
+    def rnnTimeStep(self, *xs):
+        """Streaming inference with carried recurrent state; each x is
+        [N, C] (one timestep) or [N, C, T] (a chunk)."""
+        self._check_init()
+        arrs = [_unwrap(x) for x in xs]
+        single = arrs[0].ndim == 2
+        if single:
+            arrs = [a[:, :, None] for a in arrs]
+        n = arrs[0].shape[0]
+        self._recurrent_nodes(forbid_bidirectional=True)
+        if getattr(self, "_stream_states", None) is None or \
+                getattr(self, "_stream_batch", None) != n:
+            self._stream_states = self._seed_rnn_states(self._states, n)
+            self._stream_batch = n
+        inputs = {k: v for k, v in zip(self.conf.inputs, arrs)}
+        key = "stream"
+        if key not in self._infer_fn_cache:
+            def fn(params, states, inputs):
+                env, ns = self._forward(params, states, inputs, False, None)
+                return [env[o] for o in self.conf.outputs], ns
+
+            self._infer_fn_cache[key] = jax.jit(fn)
+        ys, new_states = self._infer_fn_cache[key](
+            self._params, self._stream_states, inputs)
+        rec = set(self._recurrent_nodes())
+        self._stream_states = {
+            k: (ns if k in rec else self._stream_states[k])
+            for k, ns in new_states.items()}
+        outs = [INDArray(y[:, :, 0]) if single and y.ndim == 3
+                else INDArray(y) for y in ys]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnnClearPreviousState(self):
+        self._stream_states = None
+        self._stream_batch = None
+
     def fit(self, data, epochs: int = 1):
         self._check_init()
         if self._train_step is None:
@@ -252,13 +365,26 @@ class ComputationGraph:
                     for k in labels:
                         (labels[k],), masks[k], _ = _pad_to_bucket(
                             [labels[k]], masks[k], self._bucket)
-                rng = jax.random.fold_in(base_key, self._iteration)
-                loss, params, states, opts = self._train_step(
-                    params, states, opts, inputs, labels, masks, rng,
-                    self._iteration)
+                from deeplearning4j_tpu.nn.conf.configuration import (
+                    BackpropType)
+
+                tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
+                         and self.conf.tbpttLength
+                         and any(v.ndim == 3
+                                 and v.shape[2] > self.conf.tbpttLength
+                                 for v in inputs.values()))
+                if tbptt:
+                    loss, params, states, opts = self._fit_tbptt(
+                        params, states, opts, inputs, labels, masks,
+                        base_key)
+                else:
+                    rng = jax.random.fold_in(base_key, self._iteration)
+                    loss, params, states, opts = self._train_step(
+                        params, states, opts, inputs, labels, masks, rng,
+                        self._iteration)
+                    self._iteration += 1
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
-                self._iteration += 1
                 last = loss
                 if self._listeners:
                     self._score = float(loss)

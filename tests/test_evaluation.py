@@ -186,3 +186,47 @@ class TestEvaluationCalibration:
             b.getProbabilityHistogramAllClasses())
         np.testing.assert_allclose(a.expectedCalibrationError(),
                                    b.expectedCalibrationError())
+
+
+class TestROCBinary:
+    def test_per_output_auc(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+
+        roc = ROCBinary()
+        # output 0: perfectly separable; output 1: anti-correlated
+        labels = np.asarray([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+        preds = np.asarray([[0.9, 0.8], [0.8, 0.1], [0.2, 0.9],
+                            [0.1, 0.2]], np.float32)
+        roc.eval(labels, preds)
+        assert roc.numLabels() == 2
+        assert roc.calculateAUC(0) == 1.0
+        assert roc.calculateAUC(1) < 0.5
+        avg = roc.calculateAverageAUC()
+        assert avg == (roc.calculateAUC(0) + roc.calculateAUC(1)) / 2
+        assert "out 0" in roc.stats()
+
+    def test_mask_and_accumulation(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+
+        roc = ROCBinary()
+        labels = np.asarray([[1], [0], [1]], np.float32)
+        preds = np.asarray([[0.9], [0.8], [0.1]], np.float32)
+        mask = np.asarray([1, 1, 0], np.float32)   # drop the bad example
+        roc.eval(labels, preds, mask=mask)
+        assert roc.calculateAUC(0) == 1.0
+        roc.eval(np.asarray([[1]], np.float32),
+                 np.asarray([[0.05]], np.float32))
+        assert roc.calculateAUC(0) < 1.0
+
+    def test_per_output_mask(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+
+        roc = ROCBinary()
+        labels = np.asarray([[1, 1], [0, 0], [1, 0]], np.float32)
+        preds = np.asarray([[0.9, 0.2], [0.1, 0.8], [0.2, 0.9]],
+                           np.float32)
+        mask = np.asarray([[1, 0], [1, 1], [0, 1]], np.float32)
+        roc.eval(labels, preds, mask=mask)
+        # output 0 keeps examples 0,1 (separable); output 1 keeps 1,2
+        assert roc.calculateAUC(0) == 1.0
+        assert roc.calculateAUC(1) == 0.0

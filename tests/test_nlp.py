@@ -162,3 +162,77 @@ class TestGlove:
         import pytest as _pytest
         with _pytest.raises(KeyError):
             g.getWordVector("zebra")
+
+
+class TestWord2VecBinaryFormat:
+    def _tiny_model(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        import jax.numpy as jnp
+        import numpy as np
+
+        m = Word2Vec(None, None, minWordFrequency=1, layerSize=4,
+                     windowSize=2, negative=2, learningRate=0.025,
+                     epochs=1, iterations=1, seed=0, batchSize=8,
+                     sampling=0, algorithm="skipgram")
+        for w in ("alpha", "beta", "gamma"):
+            m.vocab.add(w, 1)
+        m.syn0 = jnp.asarray(
+            np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0)
+        m.syn1 = jnp.zeros_like(m.syn0)
+        return m
+
+    def test_binary_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        import numpy as np
+
+        m = self._tiny_model()
+        p = str(tmp_path / "vec.bin")
+        WordVectorSerializer.writeWord2VecBinary(m, p)
+        r = WordVectorSerializer.readWord2VecBinary(p)
+        assert r.vocab.wordAtIndex(1) == "beta"
+        assert np.allclose(np.asarray(r.getWordVectorMatrix()),
+                           np.asarray(m.getWordVectorMatrix()))
+
+    def test_load_static_model_autodetects(self, tmp_path):
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        import numpy as np
+
+        m = self._tiny_model()
+        pb = str(tmp_path / "vec.bin")
+        pt = str(tmp_path / "vec.txt")
+        WordVectorSerializer.writeWord2VecBinary(m, pb)
+        WordVectorSerializer.writeWord2VecModel(m, pt)
+        for p in (pb, pt):
+            r = WordVectorSerializer.loadStaticModel(p)
+            assert np.allclose(np.asarray(r.getWordVectorMatrix()),
+                               np.asarray(m.getWordVectorMatrix()),
+                               atol=1e-5)
+
+    def test_load_static_model_hard_cases(self, tmp_path):
+        # binary zero vectors decode as valid utf-8 (NUL bytes) and text
+        # models with multibyte words must both route correctly
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        import numpy as np
+        import jax.numpy as jnp
+
+        m = self._tiny_model()
+        m.syn0 = jnp.zeros_like(m.syn0)          # all-zero binary payload
+        pb = str(tmp_path / "zeros.bin")
+        WordVectorSerializer.writeWord2VecBinary(m, pb)
+        r = WordVectorSerializer.loadStaticModel(pb)
+        assert np.allclose(np.asarray(r.getWordVectorMatrix()), 0.0)
+
+        m2 = self._tiny_model()
+        pt = str(tmp_path / "uni.txt")
+        # long multibyte words so a fixed-window probe would cut one
+        import io
+        mat = np.asarray(m2.getWordVectorMatrix())
+        with io.open(pt, "w", encoding="utf-8") as f:
+            f.write(f"{mat.shape[0]} {mat.shape[1]}\n")
+            for i in range(mat.shape[0]):
+                word = "日本語テスト" * 12 + str(i)
+                f.write(word + " "
+                        + " ".join(f"{x:.6f}" for x in mat[i]) + "\n")
+        r2 = WordVectorSerializer.loadStaticModel(pt)
+        assert np.allclose(np.asarray(r2.getWordVectorMatrix()), mat,
+                           atol=1e-5)

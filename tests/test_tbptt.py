@@ -152,3 +152,68 @@ class TestRnnTimeStep:
         net2 = MultiLayerNetwork(conf2).init()
         net2.rnnTimeStep(X[:, :, 0])
         assert set(net2.rnnGetPreviousState(0)) == {"h"}
+
+
+class TestGraphTBPTT:
+    """TBPTT + rnnTimeStep on ComputationGraph (reference:
+    ComputationGraph truncated BPTT, SURVEY.md §2.5 TBPTT row)."""
+
+    def _graph(self, tbptt=None):
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, InputType, LSTM, NeuralNetConfiguration,
+            RnnOutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        g = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.recurrent(3, 12))
+        g.addLayer("lstm", LSTM.Builder(nOut=5, activation="tanh").build(),
+                   "in")
+        g.addLayer("out", RnnOutputLayer.Builder().nOut(2).build(), "lstm")
+        g.setOutputs("out")
+        if tbptt:
+            g.tBPTTLength(tbptt)
+        return ComputationGraph(g.build()).init()
+
+    def _data(self, n=4, t=12):
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, 3, t).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rng.randint(0, 2, (n, t))].transpose(0, 2, 1)
+        return x, y
+
+    def test_graph_tbptt_trains_and_carries_state(self):
+        net = self._graph(tbptt=4)
+        x, y = self._data()
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 15)
+        assert net.score((x, y)) < s0
+        # 12 timesteps / tbptt 4 = 3 compiled steps per batch
+        assert net._iteration == 15 * 3
+
+    def test_graph_tbptt_matches_standard_on_short_seqs(self):
+        # sequences shorter than tbpttLength take the standard path
+        net = self._graph(tbptt=30)
+        x, y = self._data(t=12)
+        net.fit([(x, y)] * 2)
+        assert net._iteration == 2
+
+    def test_graph_rnn_time_step_matches_full_sequence(self):
+        net = self._graph()
+        x, y = self._data(n=2, t=6)
+        full = net.outputSingle(x).numpy()
+        net.rnnClearPreviousState()
+        outs = []
+        for t in range(6):
+            outs.append(net.rnnTimeStep(x[:, :, t]).numpy())
+        stream = np.stack(outs, axis=2)
+        assert np.allclose(stream, full, atol=1e-4)
+
+    def test_graph_json_round_trip_keeps_tbptt(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+
+        net = self._graph(tbptt=4)
+        conf2 = ComputationGraphConfiguration.from_json(net.conf.to_json())
+        assert conf2.backpropType == "TruncatedBPTT"
+        assert conf2.tbpttLength == 4
