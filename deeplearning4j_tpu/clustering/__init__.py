@@ -1,2 +1,3 @@
 from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401,E501
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne  # noqa: F401,E501

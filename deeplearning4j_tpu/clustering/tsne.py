@@ -1,0 +1,177 @@
+"""t-SNE embedding.
+
+Reference capability: org.deeplearning4j.plot.BarnesHutTsne
+(deeplearning4j-manifold; SURVEY.md §2.7 domain libs). The reference
+approximates the repulsive forces with a Barnes-Hut quad/sp-tree on the
+HOST because exact t-SNE is O(N²) per iteration in scalar code; on TPU
+the O(N²) pairwise kernels are two dense matmul-shaped reductions that
+the MXU eats directly, so this implementation keeps EXACT gradients and
+jits the entire gradient-descent loop (lax.scan) into one executable —
+idiomatic XLA rather than a tree-code translation. The BarnesHutTsne
+builder surface (theta, perplexity, learningRate, momentum, maxIter) is
+kept; theta is accepted for config parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pairwise_sq_dists(x):
+    s = jnp.sum(jnp.square(x), axis=1)
+    d = s[:, None] + s[None, :] - 2.0 * (x @ x.T)
+    return jnp.maximum(d, 0.0)
+
+
+def _binary_search_betas(d, perplexity, iters=40):
+    """Per-point precision (beta = 1/2sigma²) matching the target
+    perplexity, fully vectorized: 40 bisection steps over all rows at
+    once (reference: the per-row host loop in BarnesHutTsne.computeGaussianPerplexity)."""
+    n = d.shape[0]
+    log_u = jnp.log(perplexity)
+    eye = jnp.eye(n, dtype=bool)
+
+    def entropy(beta):
+        p = jnp.where(eye, 0.0, jnp.exp(-d * beta[:, None]))
+        sum_p = jnp.maximum(jnp.sum(p, axis=1), 1e-12)
+        h = jnp.log(sum_p) + beta * jnp.sum(d * p, axis=1) / sum_p
+        return h, p / sum_p[:, None]
+
+    def body(carry, _):
+        beta, lo, hi = carry
+        h, _ = entropy(beta)
+        too_high = h > log_u          # entropy too high -> raise beta
+        new_lo = jnp.where(too_high, beta, lo)
+        new_hi = jnp.where(too_high, hi, beta)
+        new_beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(new_hi), beta * 2.0,
+                      (beta + new_hi) / 2.0),
+            (new_lo + beta) / 2.0)
+        return (new_beta, new_lo, new_hi), 0.0
+
+    beta0 = jnp.ones((n,))
+    lo0 = jnp.zeros((n,))
+    hi0 = jnp.full((n,), jnp.inf)
+    (beta, _, _), _ = lax.scan(body, (beta0, lo0, hi0), None, length=iters)
+    _, p = entropy(beta)
+    return p
+
+
+class BarnesHutTsne:
+    """Builder-compatible t-SNE (exact gradients, whole loop jitted)."""
+
+    class Builder:
+        # setter name -> constructor kwarg; typos fail loudly
+        _KEYS = {k: k for k in (
+            "numDimension", "perplexity", "theta", "learningRate",
+            "momentum", "finalMomentum", "maxIter", "stopLyingIteration",
+            "seed", "usePca")}
+        _KEYS["setMaxIter"] = "maxIter"
+
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, item):
+            if item.startswith("_"):
+                raise AttributeError(item)
+            if item not in self._KEYS:
+                raise AttributeError(
+                    f"unknown BarnesHutTsne setting {item!r} "
+                    f"(known: {sorted(self._KEYS)})")
+
+            def setter(v):
+                self._kw[self._KEYS[item]] = v
+                return self
+
+            return setter
+
+        def build(self):
+            return BarnesHutTsne(**self._kw)
+
+    def __init__(self, numDimension=2, perplexity=30.0, theta=0.5,
+                 learningRate=200.0, momentum=0.5, finalMomentum=0.8,
+                 maxIter=1000, stopLyingIteration=100, seed=42,
+                 usePca=False):
+        self.numDimension = int(numDimension)
+        self.perplexity = float(perplexity)
+        self.theta = theta                  # parity knob (exact gradients)
+        self.learningRate = float(learningRate)
+        self.momentum = float(momentum)
+        self.finalMomentum = float(finalMomentum)
+        self.maxIter = int(maxIter)
+        self.stopLyingIteration = int(stopLyingIteration)
+        self.seed = int(seed)
+        self.usePca = usePca
+        self._embedding = None
+
+    def fit(self, x):
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        if self.usePca and x.shape[1] > 50:
+            xc = x - x.mean(0)
+            _, _, vt = np.linalg.svd(xc, full_matrices=False)
+            x = xc @ vt[:50].T
+        perp = min(self.perplexity, (n - 1) / 3.0)
+
+        d = _pairwise_sq_dists(jnp.asarray(x))
+        p_cond = _binary_search_betas(d, perp)
+        p = (p_cond + p_cond.T) / (2.0 * n)
+        p = jnp.maximum(p, 1e-12)
+
+        key = jax.random.key(self.seed)
+        y0 = 1e-4 * jax.random.normal(key, (n, self.numDimension))
+        eye = jnp.eye(n, dtype=bool)
+
+        lying, lr = 12.0, self.learningRate
+        m0, m1 = self.momentum, self.finalMomentum
+        switch = self.stopLyingIteration
+
+        def grad_kl(y, p_eff):
+            dy = _pairwise_sq_dists(y)
+            num = jnp.where(eye, 0.0, 1.0 / (1.0 + dy))   # student-t
+            q = jnp.maximum(num / jnp.sum(num), 1e-12)
+            w = (p_eff - q) * num                         # [N, N]
+            # grad_i = 4 * sum_j w_ij (y_i - y_j)
+            return 4.0 * (jnp.sum(w, axis=1, keepdims=True) * y - w @ y)
+
+        def body(carry, it):
+            y, vel, gains = carry
+            p_eff = jnp.where(it < switch, p * lying, p)
+            g = grad_kl(y, p_eff)
+            # DL4J/van-der-Maaten gain adaptation
+            gains = jnp.where(jnp.sign(g) != jnp.sign(vel),
+                              gains + 0.2, gains * 0.8)
+            gains = jnp.maximum(gains, 0.01)
+            mom = jnp.where(it < switch, m0, m1)
+            vel = mom * vel - lr * gains * g
+            y = y + vel
+            y = y - jnp.mean(y, axis=0)
+            return (y, vel, gains), 0.0
+
+        @jax.jit
+        def run(y0):
+            init = (y0, jnp.zeros_like(y0), jnp.ones_like(y0))
+            (y, _, _), _ = lax.scan(body, init,
+                                    jnp.arange(self.maxIter))
+            return y
+
+        self._embedding = np.asarray(run(y0))
+        return self
+
+    def getData(self) -> np.ndarray:
+        if self._embedding is None:
+            raise RuntimeError("call fit() first")
+        return self._embedding
+
+    def saveAsFile(self, labels, path):
+        """Reference: BarnesHutTsne.saveAsFile(labels, path) — one
+        'x y ... label' line per point."""
+        emb = self.getData()
+        with open(path, "w") as f:
+            for i in range(emb.shape[0]):
+                coords = " ".join(f"{v:.6f}" for v in emb[i])
+                f.write(f"{coords} {labels[i]}\n")

@@ -10,7 +10,8 @@ from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
     MnistDataSetIterator, synthesize_mnist)
 from deeplearning4j_tpu.datasets.records import (  # noqa: F401
     CSVRecordReader, FileSplit, InputSplit, LineRecordReader,
-    ListStringSplit, RecordReader, RecordReaderDataSetIterator)
+    ListStringSplit, RecordReader, RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator)
 from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler, Normalizer, NormalizerMinMaxScaler,
     NormalizerStandardize)

@@ -223,3 +223,101 @@ class RecordReaderDataSetIterator(DataSetIterator):
             idx = np.asarray(labels, np.int64).reshape(-1)
             l = np.eye(self.numPossibleLabels, dtype=np.float32)[idx]
         return DataSet(f, l)
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Joins NAMED record readers into MultiDataSets for multi-input /
+    multi-output ComputationGraphs (reference:
+    org.deeplearning4j.datasets.datavec.RecordReaderMultiDataSetIterator
+    + its Builder: addReader / addInput(column ranges) /
+    addOutputOneHot / addOutput).
+
+    Readers advance in lockstep; each input/output takes a column range
+    of one reader's records per example.
+    """
+
+    class Builder:
+        def __init__(self, batchSize=32):
+            self._batch = batchSize
+            self._readers: dict[str, RecordReader] = {}
+            self._inputs: list = []    # (reader, colFrom, colTo)
+            self._outputs: list = []   # (reader, colFrom, colTo, oneHotN)
+
+        def addReader(self, name, reader):
+            self._readers[name] = reader
+            return self
+
+        def addInput(self, readerName, columnFrom=0, columnTo=None):
+            self._inputs.append((readerName, columnFrom, columnTo))
+            return self
+
+        def addOutput(self, readerName, columnFrom=0, columnTo=None):
+            self._outputs.append((readerName, columnFrom, columnTo, None))
+            return self
+
+        def addOutputOneHot(self, readerName, column, numClasses):
+            self._outputs.append((readerName, column, column,
+                                  int(numClasses)))
+            return self
+
+        def build(self):
+            if not self._inputs or not self._outputs:
+                raise ValueError("declare at least one input and output")
+            missing = {r for r, *_ in self._inputs + self._outputs
+                       } - set(self._readers)
+            if missing:
+                raise ValueError(f"undeclared readers: {sorted(missing)}")
+            return RecordReaderMultiDataSetIterator(self)
+
+    def __init__(self, builder: "RecordReaderMultiDataSetIterator.Builder"):
+        super().__init__(builder._batch)
+        self._readers = builder._readers
+        self._inputs = builder._inputs
+        self._outputs = builder._outputs
+
+    def reset(self):
+        for r in self._readers.values():
+            r.reset()
+        self._peek = None
+
+    def _records_row(self):
+        """One aligned row of floats per reader, or None when exhausted."""
+        row = {}
+        for name, r in self._readers.items():
+            if not r.hasNext():
+                return None
+            row[name] = [float(v) for v in r.next()]
+        return row
+
+    @staticmethod
+    def _cols(rec, c0, c1):
+        c1 = len(rec) - 1 if c1 is None else (c1 if c1 >= 0
+                                              else len(rec) + c1)
+        c0 = c0 if c0 >= 0 else len(rec) + c0
+        return rec[c0:c1 + 1]
+
+    def _next_batch(self):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        rows = []
+        while len(rows) < self._batch:
+            row = self._records_row()
+            if row is None:
+                break
+            rows.append(row)
+        if not rows:
+            return None
+        feats = []
+        for name, c0, c1 in self._inputs:
+            feats.append(np.asarray(
+                [self._cols(r[name], c0, c1) for r in rows], np.float32))
+        labels = []
+        for name, c0, c1, onehot in self._outputs:
+            vals = [self._cols(r[name], c0, c1) for r in rows]
+            if onehot is not None:
+                idx = np.asarray([int(v[0]) for v in vals])
+                labels.append(np.eye(onehot, dtype=np.float32)[idx])
+            else:
+                labels.append(np.asarray(vals, np.float32))
+        # preprocessing happens once, in the base next()
+        return MultiDataSet(feats, labels)

@@ -270,6 +270,13 @@ class ROCBinary:
             lab = lab[:, None]
             pred = pred[:, None]
         m = None if mask is None else _to_np(mask)
+        if lab.ndim == 3:
+            # DL4J time series [N, nOut, T]: fold time into the batch so
+            # the per-OUTPUT axis stays axis -1 (mask arrives as [N, T])
+            lab = lab.transpose(0, 2, 1).reshape(-1, lab.shape[1])
+            pred = pred.transpose(0, 2, 1).reshape(-1, pred.shape[1])
+            if m is not None and m.ndim == 2:
+                m = m.reshape(-1)
         for i in range(lab.shape[-1]):
             li, pi = lab[..., i].reshape(-1), pred[..., i].reshape(-1)
             if m is not None:

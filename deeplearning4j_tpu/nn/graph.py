@@ -314,11 +314,18 @@ class ComputationGraph:
         if single:
             arrs = [a[:, :, None] for a in arrs]
         n = arrs[0].shape[0]
-        self._recurrent_nodes(forbid_bidirectional=True)
+        rec = set(self._recurrent_nodes(forbid_bidirectional=True))
         if getattr(self, "_stream_states", None) is None or \
                 getattr(self, "_stream_batch", None) != n:
-            self._stream_states = self._seed_rnn_states(self._states, n)
+            seeded = self._seed_rnn_states(self._states, n)
+            self._stream_states = {k: seeded[k] for k in rec}
             self._stream_batch = n
+        # only the recurrent carry is cached; everything else (BN running
+        # stats, ...) comes fresh from self._states so an interleaved
+        # fit() (which rebinds self._states after donation) can't leave
+        # stale or deleted buffers behind
+        states = {k: (self._stream_states[k] if k in rec else v)
+                  for k, v in self._states.items()}
         inputs = {k: v for k, v in zip(self.conf.inputs, arrs)}
         key = "stream"
         if key not in self._infer_fn_cache:
@@ -328,11 +335,8 @@ class ComputationGraph:
 
             self._infer_fn_cache[key] = jax.jit(fn)
         ys, new_states = self._infer_fn_cache[key](
-            self._params, self._stream_states, inputs)
-        rec = set(self._recurrent_nodes())
-        self._stream_states = {
-            k: (ns if k in rec else self._stream_states[k])
-            for k, ns in new_states.items()}
+            self._params, states, inputs)
+        self._stream_states = {k: new_states[k] for k in rec}
         outs = [INDArray(y[:, :, 0]) if single and y.ndim == 3
                 else INDArray(y) for y in ys]
         return outs[0] if len(outs) == 1 else outs

@@ -451,23 +451,25 @@ class MultiLayerNetwork:
         if single:
             x = x[:, :, None]
         n = x.shape[0]
-        self._recurrent_indices(forbid_bidirectional=True)
+        rec = set(self._recurrent_indices(forbid_bidirectional=True))
         if self._stream_states is None or self._stream_batch != n:
-            self._stream_states = self._seed_rnn_states(self._states, n)
+            seeded = self._seed_rnn_states(self._states, n)
+            self._stream_states = {i: seeded[i] for i in rec}
             self._stream_batch = n
+        # only the recurrent carry is cached; BN running stats etc. come
+        # fresh from self._states so an interleaved fit() (which rebinds
+        # self._states after donating the old buffers) can't leave stale
+        # or deleted arrays behind
+        states = [self._stream_states[i] if i in rec else s
+                  for i, s in enumerate(self._states)]
         key = "stream"
         if key not in self._infer_fns:
             def fn(params, states, x):
                 return self._forward(params, states, x, False, None)
 
             self._infer_fns[key] = jax.jit(fn)
-        y, new_states = self._infer_fns[key](self._params,
-                                             self._stream_states, x)
-        # keep only the recurrent carry; BN etc. stay at their trained state
-        rec = set(self._recurrent_indices())
-        self._stream_states = [
-            ns if i in rec else self._stream_states[i]
-            for i, ns in enumerate(new_states)]
+        y, new_states = self._infer_fns[key](self._params, states, x)
+        self._stream_states = {i: new_states[i] for i in rec}
         y = INDArray(y[:, :, 0]) if single and y.ndim == 3 else INDArray(y)
         return y
 
@@ -479,7 +481,7 @@ class MultiLayerNetwork:
         if self._stream_states is None:
             return {}
         return {k: INDArray(v)
-                for k, v in self._stream_states[layer_idx].items()}
+                for k, v in self._stream_states.get(layer_idx, {}).items()}
 
     def rnnSetPreviousState(self, layer_idx: int, state: dict):
         """Install carried state (e.g. restoring a saved streaming session).
@@ -490,7 +492,9 @@ class MultiLayerNetwork:
             if not vals:
                 raise ValueError("cannot infer batch size from empty state")
             n = next(iter(vals.values())).shape[0]
-            self._stream_states = self._seed_rnn_states(self._states, n)
+            rec = set(self._recurrent_indices())
+            seeded = self._seed_rnn_states(self._states, n)
+            self._stream_states = {i: seeded[i] for i in rec}
             self._stream_batch = n
         self._stream_states[layer_idx] = vals
 

@@ -219,3 +219,106 @@ class TestDQNVariants:
         a = jnp.asarray(np.random.RandomState(0).randn(2, 4), jnp.float32)
         q = np.asarray(_mlp(p, a))
         assert np.isfinite(q).all()
+
+
+class TestTsne:
+    """BarnesHutTsne capability (reference: deeplearning4j-manifold
+    org.deeplearning4j.plot.BarnesHutTsne; exact MXU-friendly gradients
+    here, see clustering/tsne.py)."""
+
+    def test_separates_two_clusters(self, tmp_path):
+        from deeplearning4j_tpu.clustering import BarnesHutTsne
+
+        rng = np.random.RandomState(0)
+        a = rng.randn(30, 10).astype(np.float32)
+        b = rng.randn(30, 10).astype(np.float32) + 8.0
+        x = np.concatenate([a, b])
+        tsne = (BarnesHutTsne.Builder()
+                .numDimension(2).perplexity(10.0)
+                .learningRate(100.0).setMaxIter(300).build())
+        tsne.fit(x)
+        emb = tsne.getData()
+        assert emb.shape == (60, 2)
+        # clusters stay separated in the embedding: centroid distance
+        # well above mean intra-cluster spread
+        ca, cb = emb[:30].mean(0), emb[30:].mean(0)
+        spread = (emb[:30].std() + emb[30:].std()) / 2
+        assert np.linalg.norm(ca - cb) > 2.0 * spread
+        # saveAsFile round-trip
+        p = str(tmp_path / "tsne.txt")
+        tsne.saveAsFile([str(i // 30) for i in range(60)], p)
+        lines = open(p).read().strip().splitlines()
+        assert len(lines) == 60 and lines[0].endswith(" 0")
+
+
+class TestMultiDataSetIterator:
+    def test_two_readers_feed_two_input_graph(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (
+            CSVRecordReader, FileSplit, RecordReaderMultiDataSetIterator)
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, DenseLayer, MergeVertex,
+            NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        rng = np.random.RandomState(0)
+        fa = tmp_path / "a.csv"
+        fb = tmp_path / "b.csv"
+        n = 40
+        xa = rng.randn(n, 3)
+        xb = rng.randn(n, 2)
+        ycls = ((xa.sum(1) + xb.sum(1)) > 0).astype(int)
+        fa.write_text("\n".join(
+            ",".join(f"{v:.5f}" for v in row) for row in xa))
+        fb.write_text("\n".join(
+            ",".join(f"{v:.5f}" for v in list(row) + [float(c)])
+            for row, c in zip(xb, ycls)))
+
+        ra = CSVRecordReader()
+        ra.initialize(FileSplit(str(fa)))
+        rb = CSVRecordReader()
+        rb.initialize(FileSplit(str(fb)))
+        it = (RecordReaderMultiDataSetIterator.Builder(batchSize=20)
+              .addReader("a", ra).addReader("b", rb)
+              .addInput("a", 0, 2)
+              .addInput("b", 0, 1)
+              .addOutputOneHot("b", 2, 2)
+              .build())
+
+        mds = it.next()
+        assert mds.numFeatureArrays() == 2
+        assert mds.getFeatures(0).shape == (20, 3)
+        assert mds.getFeatures(1).shape == (20, 2)
+        assert mds.getLabels(0).shape == (20, 2)
+        it.reset()
+
+        g = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+             .graphBuilder().addInputs("inA", "inB"))
+        g.addLayer("da", DenseLayer.Builder(nIn=3, nOut=8,
+                                            activation="tanh").build(),
+                   "inA")
+        g.addLayer("db", DenseLayer.Builder(nIn=2, nOut=8,
+                                            activation="tanh").build(),
+                   "inB")
+        g.addVertex("cat", MergeVertex(), "da", "db")
+        g.addLayer("out", OutputLayer.Builder(nIn=16, nOut=2).build(),
+                   "cat")
+        g.setOutputs("out")
+        net = ComputationGraph(g.build()).init()
+        net.fit(it, 20)
+        it.reset()
+        ev_correct = 0
+        total = 0
+        while it.hasNext():
+            mds = it.next()
+            out = net.outputSingle(*mds.getFeatures()).numpy()
+            ev_correct += int((np.argmax(out, 1)
+                               == np.argmax(mds.getLabels(0), 1)).sum())
+            total += out.shape[0]
+        assert ev_correct / total > 0.8
+
+    def test_builder_rejects_typos(self):
+        from deeplearning4j_tpu.clustering import BarnesHutTsne
+        import pytest
+
+        with pytest.raises(AttributeError, match="perplexityy"):
+            BarnesHutTsne.Builder().perplexityy(5.0)

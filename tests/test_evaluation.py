@@ -230,3 +230,17 @@ class TestROCBinary:
         # output 0 keeps examples 0,1 (separable); output 1 keeps 1,2
         assert roc.calculateAUC(0) == 1.0
         assert roc.calculateAUC(1) == 0.0
+
+    def test_time_series_layout(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+
+        rng = np.random.RandomState(0)
+        # [N, nOut, T] with output 0 perfectly predicted
+        n, t = 4, 5
+        lab = rng.randint(0, 2, (n, 2, t)).astype(np.float32)
+        pred = rng.rand(n, 2, t).astype(np.float32)
+        pred[:, 0] = lab[:, 0] * 0.8 + 0.1
+        roc = ROCBinary()
+        roc.eval(lab, pred)
+        assert roc.numLabels() == 2       # outputs, not timesteps
+        assert roc.calculateAUC(0) == 1.0

@@ -217,3 +217,13 @@ class TestGraphTBPTT:
         conf2 = ComputationGraphConfiguration.from_json(net.conf.to_json())
         assert conf2.backpropType == "TruncatedBPTT"
         assert conf2.tbpttLength == 4
+
+    def test_streaming_survives_interleaved_fit(self):
+        # regression: rnnTimeStep caches must not alias donated state
+        # buffers; only the recurrent carry is cached
+        net = self._graph()
+        x, y = self._data(n=2, t=6)
+        net.rnnTimeStep(x[:, :, 0][:, :, None])
+        net.fit([(x, y)] * 2)          # donates + rebinds states
+        out = net.rnnTimeStep(x[:, :, 1][:, :, None])  # must not raise
+        assert np.isfinite(out.numpy()).all()
