@@ -21,3 +21,5 @@ Capability map (reference layer -> this package):
 __version__ = "0.1.0"
 
 from deeplearning4j_tpu.ndarray import Nd4j, INDArray  # noqa: F401
+from deeplearning4j_tpu.backend import Nd4jBackend  # noqa: F401
+from deeplearning4j_tpu.runtime import RuntimeConfig  # noqa: F401

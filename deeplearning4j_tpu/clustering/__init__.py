@@ -1,3 +1,4 @@
 from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401,E501
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne  # noqa: F401,E501
+from deeplearning4j_tpu.clustering.server import NearestNeighborsServer  # noqa: F401,E501

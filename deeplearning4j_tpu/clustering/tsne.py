@@ -69,7 +69,7 @@ class BarnesHutTsne:
         _KEYS = {k: k for k in (
             "numDimension", "perplexity", "theta", "learningRate",
             "momentum", "finalMomentum", "maxIter", "stopLyingIteration",
-            "seed", "usePca")}
+            "switchMomentumIteration", "seed", "usePca")}
         _KEYS["setMaxIter"] = "maxIter"
 
         def __init__(self):
@@ -94,8 +94,8 @@ class BarnesHutTsne:
 
     def __init__(self, numDimension=2, perplexity=30.0, theta=0.5,
                  learningRate=200.0, momentum=0.5, finalMomentum=0.8,
-                 maxIter=1000, stopLyingIteration=100, seed=42,
-                 usePca=False):
+                 maxIter=1000, stopLyingIteration=100,
+                 switchMomentumIteration=250, seed=42, usePca=False):
         self.numDimension = int(numDimension)
         self.perplexity = float(perplexity)
         self.theta = theta                  # parity knob (exact gradients)
@@ -104,6 +104,7 @@ class BarnesHutTsne:
         self.finalMomentum = float(finalMomentum)
         self.maxIter = int(maxIter)
         self.stopLyingIteration = int(stopLyingIteration)
+        self.switchMomentumIteration = int(switchMomentumIteration)
         self.seed = int(seed)
         self.usePca = usePca
         self._embedding = None
@@ -128,7 +129,8 @@ class BarnesHutTsne:
 
         lying, lr = 12.0, self.learningRate
         m0, m1 = self.momentum, self.finalMomentum
-        switch = self.stopLyingIteration
+        stop_lying = self.stopLyingIteration
+        switch_mom = self.switchMomentumIteration
 
         def grad_kl(y, p_eff):
             dy = _pairwise_sq_dists(y)
@@ -140,13 +142,13 @@ class BarnesHutTsne:
 
         def body(carry, it):
             y, vel, gains = carry
-            p_eff = jnp.where(it < switch, p * lying, p)
+            p_eff = jnp.where(it < stop_lying, p * lying, p)
             g = grad_kl(y, p_eff)
             # DL4J/van-der-Maaten gain adaptation
             gains = jnp.where(jnp.sign(g) != jnp.sign(vel),
                               gains + 0.2, gains * 0.8)
             gains = jnp.maximum(gains, 0.01)
-            mom = jnp.where(it < switch, m0, m1)
+            mom = jnp.where(it < switch_mom, m0, m1)
             vel = mom * vel - lr * gains * g
             y = y + vel
             y = y - jnp.mean(y, axis=0)

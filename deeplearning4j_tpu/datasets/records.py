@@ -316,6 +316,12 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
             vals = [self._cols(r[name], c0, c1) for r in rows]
             if onehot is not None:
                 idx = np.asarray([int(v[0]) for v in vals])
+                if idx.min() < 0 or idx.max() >= onehot:
+                    bad = idx[(idx < 0) | (idx >= onehot)][0]
+                    raise ValueError(
+                        f"one-hot output from reader {name!r} column "
+                        f"{c0}: class index {bad} outside "
+                        f"[0, {onehot})")
                 labels.append(np.eye(onehot, dtype=np.float32)[idx])
             else:
                 labels.append(np.asarray(vals, np.float32))

@@ -272,11 +272,13 @@ class ROCBinary:
         m = None if mask is None else _to_np(mask)
         if lab.ndim == 3:
             # DL4J time series [N, nOut, T]: fold time into the batch so
-            # the per-OUTPUT axis stays axis -1 (mask arrives as [N, T])
+            # the per-OUTPUT axis stays axis -1. A [N, T] mask folds to
+            # per-example; a [N, nOut, T] mask folds to per-output.
             lab = lab.transpose(0, 2, 1).reshape(-1, lab.shape[1])
             pred = pred.transpose(0, 2, 1).reshape(-1, pred.shape[1])
-            if m is not None and m.ndim == 2:
-                m = m.reshape(-1)
+            if m is not None:
+                m = (m.transpose(0, 2, 1).reshape(-1, m.shape[1])
+                     if m.ndim == 3 else m.reshape(-1))
         for i in range(lab.shape[-1]):
             li, pi = lab[..., i].reshape(-1), pred[..., i].reshape(-1)
             if m is not None:

@@ -322,3 +322,73 @@ class TestMultiDataSetIterator:
 
         with pytest.raises(AttributeError, match="perplexityy"):
             BarnesHutTsne.Builder().perplexityy(5.0)
+
+
+class TestNearestNeighborsServer:
+    """REST k-NN module (reference: nearestneighbor-server, SURVEY §2.7)."""
+
+    def test_knn_over_http(self):
+        import json
+        import urllib.request
+        from deeplearning4j_tpu.clustering import NearestNeighborsServer
+
+        pts = np.asarray([[0, 0], [1, 0], [5, 5], [5, 6]], np.float32)
+        srv = NearestNeighborsServer(pts, labels=["a", "b", "c", "d"])
+        srv.start(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(base + "/status") as r:
+                st = json.loads(r.read())
+            assert st["numPoints"] == 4 and st["dim"] == 2
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"ndarray": [5.0, 5.2], "k": 2}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req) as r:
+                res = json.loads(r.read())["results"]
+            assert [x["label"] for x in res] == ["c", "d"]
+            # malformed request -> JSON error, not a crash
+            bad = urllib.request.Request(base + "/knn", data=b"notjson",
+                                         method="POST")
+            try:
+                urllib.request.urlopen(bad)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
+
+
+class TestBackendSeam:
+    def test_discovery_and_forcing(self):
+        from deeplearning4j_tpu.backend import Nd4jBackend
+
+        Nd4jBackend.reset()
+        backends = Nd4jBackend.availableBackends()
+        assert any(b.name == "cpu" for b in backends)
+        b = Nd4jBackend.load()
+        assert b.isAvailable()
+        # load memoizes
+        assert Nd4jBackend.load() is b
+        cpu = Nd4jBackend.load(force="cpu")
+        assert cpu.name == "cpu" and cpu.platform == "cpu"
+        assert len(Nd4jBackend.devices(force="cpu")) >= 1
+        import pytest
+        with pytest.raises(RuntimeError, match="not available"):
+            Nd4jBackend.load(force="rocm")
+        Nd4jBackend.reset()
+
+    def test_one_hot_out_of_range_raises(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (
+            CSVRecordReader, FileSplit, RecordReaderMultiDataSetIterator)
+
+        f = tmp_path / "bad.csv"
+        f.write_text("0.5,1\n0.2,-1\n")
+        r = CSVRecordReader()
+        r.initialize(FileSplit(str(f)))
+        it = (RecordReaderMultiDataSetIterator.Builder(batchSize=4)
+              .addReader("r", r).addInput("r", 0, 0)
+              .addOutputOneHot("r", 1, 2).build())
+        import pytest
+        with pytest.raises(ValueError, match="class index -1"):
+            it.next()

@@ -244,3 +244,13 @@ class TestROCBinary:
         roc.eval(lab, pred)
         assert roc.numLabels() == 2       # outputs, not timesteps
         assert roc.calculateAUC(0) == 1.0
+
+    def test_time_series_per_output_mask(self):
+        from deeplearning4j_tpu.evaluation import ROCBinary
+
+        rng = np.random.RandomState(1)
+        lab = rng.randint(0, 2, (4, 2, 5)).astype(np.float32)
+        pred = rng.rand(4, 2, 5).astype(np.float32)
+        roc = ROCBinary()
+        roc.eval(lab, pred, mask=np.ones((4, 2, 5), np.float32))
+        assert roc.numLabels() == 2
