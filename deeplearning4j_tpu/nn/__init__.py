@@ -24,6 +24,8 @@ from deeplearning4j_tpu.nn.conf.objdetect import (  # noqa: F401
 from deeplearning4j_tpu.nn.conf.attention import (  # noqa: F401
     AttentionVertex, LearnedSelfAttentionLayer, RecurrentAttentionLayer,
     SelfAttentionLayer)
+from deeplearning4j_tpu.nn.conf.capsnet import (  # noqa: F401
+    CapsuleLayer, CapsuleStrengthLayer, PrimaryCapsules)
 from deeplearning4j_tpu.nn.conf.layers_extra import (  # noqa: F401
     CenterLossOutputLayer, Convolution3D, Cropping1D, Cropping2D,
     Cropping3D, ElementWiseMultiplicationLayer, FrozenLayer,

@@ -5,3 +5,4 @@ from deeplearning4j_tpu.nn.conf import variational  # noqa: F401  (registers)
 from deeplearning4j_tpu.nn.conf import objdetect  # noqa: F401  (registers)
 from deeplearning4j_tpu.nn.conf import layers_extra  # noqa: F401 (registers)
 from deeplearning4j_tpu.nn.conf import attention  # noqa: F401  (registers)
+from deeplearning4j_tpu.nn.conf import capsnet  # noqa: F401  (registers)

@@ -1,0 +1,149 @@
+"""CapsNet layers (dynamic routing between capsules).
+
+Reference capability: org.deeplearning4j.nn.conf.layers.{PrimaryCapsules,
+CapsuleLayer, CapsuleStrengthLayer} (added to DL4J in 1.0.0-beta4;
+SURVEY.md §2.5 layer impls). The reference runs the routing iterations
+as per-op dispatch; here the whole routing loop is a lax.fori_loop
+inside the net's single compiled step, and the per-capsule prediction
+tensor u_hat is ONE batched einsum on the MXU.
+
+Tensor convention follows the reference's mapping of capsule activations
+onto the recurrent input type: [N, capsules, capsuleDimensions] =
+InputType.recurrent(capsules, capsuleDimensions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    ConvolutionalType, InputType)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseLayer, _pair, _register)
+from deeplearning4j_tpu.autodiff.ops import OPS
+from deeplearning4j_tpu.nn.weights import init_weight
+
+
+def _squash(s, axis=-1, eps=1e-7):
+    """v = |s|^2/(1+|s|^2) * s/|s| (the capsule non-linearity)."""
+    sq = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * s / jnp.sqrt(sq + eps)
+
+
+@_register
+class PrimaryCapsules(BaseLayer):
+    """Conv feature maps -> primary capsule vectors (reference:
+    conf.layers.PrimaryCapsules). A conv with channels*capsuleDimensions
+    filters, reshaped to [N, caps, capsDim] and squashed."""
+
+    def __init__(self, nIn=None, capsuleDimensions=8, channels=32,
+                 kernelSize=(9, 9), stride=(2, 2), hasBias=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.channels = int(channels)
+        self.kernelSize = _pair(kernelSize)
+        self.stride = _pair(stride)
+        self.hasBias = hasBias
+
+    def infer(self, input_type):
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"PrimaryCapsules needs convolutional input, "
+                f"got {input_type}")
+        self.nIn = self.nIn or input_type.channels
+        kh, kw = self.kernelSize
+        sh, sw = self.stride
+        oh = (input_type.height - kh) // sh + 1
+        ow = (input_type.width - kw) // sw + 1
+        caps = self.channels * oh * ow
+        return InputType.recurrent(caps, self.capsuleDimensions)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        n_out = self.channels * self.capsuleDimensions
+        fan_in = self.nIn * kh * kw
+        k1, _ = jax.random.split(key)
+        p = {"W": init_weight(self.weightInit, k1,
+                              (n_out, self.nIn, kh, kw), fan_in,
+                              n_out * kh * kw, dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((n_out,), self.biasInit, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        y = OPS["conv2d"](x, params["W"], params.get("b"),
+                          strides=self.stride, padding=(0, 0))
+        n = y.shape[0]
+        # [N, channels*capsDim, H, W] -> [N, channels*H*W, capsDim]
+        y = y.reshape(n, self.channels, self.capsuleDimensions, -1)
+        y = jnp.transpose(y, (0, 1, 3, 2)).reshape(
+            n, -1, self.capsuleDimensions)
+        return _squash(y), state
+
+
+@_register
+class CapsuleLayer(BaseLayer):
+    """Fully-connected capsules with dynamic routing (reference:
+    conf.layers.CapsuleLayer: capsules, capsuleDimensions, routings)."""
+
+    def __init__(self, nIn=None, inputCapsuleDimensions=None, capsules=10,
+                 capsuleDimensions=16, routings=3, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn                       # input capsule COUNT
+        self.inputCapsuleDimensions = inputCapsuleDimensions
+        self.capsules = int(capsules)
+        self.capsuleDimensions = int(capsuleDimensions)
+        self.routings = int(routings)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        t = getattr(input_type, "timeSeriesLength", None)
+        self.inputCapsuleDimensions = self.inputCapsuleDimensions or t
+        if self.inputCapsuleDimensions is None:
+            raise ValueError(
+                "CapsuleLayer needs inputCapsuleDimensions (the input "
+                "type's capsule dimension was undeclared)")
+        return InputType.recurrent(self.capsules, self.capsuleDimensions)
+
+    def init_params(self, key, dtype=jnp.float32):
+        i, d_in = self.nIn, self.inputCapsuleDimensions
+        j, d_out = self.capsules, self.capsuleDimensions
+        k1, _ = jax.random.split(key)
+        return {"W": init_weight(self.weightInit, k1, (i, j, d_out, d_in),
+                                 d_in, d_out, dtype)}
+
+    def apply(self, params, state, x, training, rng):
+        # x: [N, inCaps, inDim]; u_hat[n,i,j,:] = W[i,j] @ x[n,i]
+        u_hat = jnp.einsum("ijdk,nik->nijd", params["W"], x)
+        n, i, j, _ = u_hat.shape
+        b0 = jnp.zeros((n, i, j), u_hat.dtype)
+
+        # fully differentiable routing (routings is small, so the
+        # unrolled-through-grad cost is negligible and analytic gradients
+        # match numeric ones exactly)
+        def routing_iter(it, b):
+            c = jax.nn.softmax(b, axis=2)[..., None]      # over out caps
+            s = jnp.sum(c * u_hat, axis=1)                # [N, j, d]
+            v = _squash(s)
+            return b + jnp.einsum("nijd,njd->nij", u_hat, v)
+
+        b = lax.fori_loop(0, self.routings - 1, routing_iter, b0)
+        c = jax.nn.softmax(b, axis=2)[..., None]
+        v = _squash(jnp.sum(c * u_hat, axis=1))
+        return v, state
+
+
+@_register
+class CapsuleStrengthLayer(BaseLayer):
+    """[N, caps, capsDim] -> per-capsule L2 norms [N, caps] (reference:
+    conf.layers.CapsuleStrengthLayer — class probabilities are capsule
+    lengths)."""
+
+    def infer(self, input_type):
+        return InputType.feedForward(input_type.size)
+
+    def apply(self, params, state, x, training, rng):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-9), state

@@ -102,9 +102,12 @@ class MultiLayerConfiguration:
                 it = InputType.feedForward(n_in)
             else:
                 return
+        from deeplearning4j_tpu.nn.conf.capsnet import PrimaryCapsules
+
         for i, lr in enumerate(self.layers):
             if isinstance(it, ConvolutionalFlatType) and isinstance(
-                    lr, (ConvolutionLayer, SubsamplingLayer)):
+                    lr, (ConvolutionLayer, PrimaryCapsules,
+                         SubsamplingLayer)):
                 self.preprocessors[i] = (
                     _PP_TO_CNN, (it.channels, it.height, it.width))
                 it = InputType.convolutional(it.height, it.width, it.channels)
@@ -160,6 +163,7 @@ def _wants_conv(layer):
         ActivationLayer, BatchNormalization, Deconvolution2D, DepthToSpace,
         DropoutLayer, GlobalPoolingLayer, LocalResponseNormalization,
         SpaceToDepth, Upsampling2D, ZeroPaddingLayer)
+    from deeplearning4j_tpu.nn.conf.capsnet import PrimaryCapsules
     from deeplearning4j_tpu.nn.conf.layers_extra import (
         Cropping2D, FrozenLayer, LocallyConnected2D, PReLULayer)
     from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
@@ -170,7 +174,8 @@ def _wants_conv(layer):
                               Cropping2D, Deconvolution2D, DepthToSpace,
                               DropoutLayer, GlobalPoolingLayer,
                               LocalResponseNormalization,
-                              LocallyConnected2D, PReLULayer, SpaceToDepth,
+                              LocallyConnected2D, PReLULayer,
+                              PrimaryCapsules, SpaceToDepth,
                               Upsampling2D, ZeroPaddingLayer,
                               Yolo2OutputLayer))
 

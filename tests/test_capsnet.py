@@ -1,0 +1,115 @@
+"""CapsNet layer tests (reference: conf.layers.{PrimaryCapsules,
+CapsuleLayer, CapsuleStrengthLayer}, SURVEY.md §2.5)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, CapsuleLayer, CapsuleStrengthLayer, ConvolutionLayer,
+    InputType, LossLayer, MultiLayerConfiguration, MultiLayerNetwork,
+    NeuralNetConfiguration, PrimaryCapsules)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.utils.gradient_check import GradientCheckUtil
+
+
+def _capsnet(seed=3):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-3))
+         .list()
+         .layer(ConvolutionLayer.Builder().nOut(8).kernelSize([3, 3])
+                .activation("relu").build())
+         .layer(PrimaryCapsules.Builder(capsuleDimensions=4, channels=2,
+                                        kernelSize=[3, 3],
+                                        stride=[2, 2]).build())
+         .layer(CapsuleLayer.Builder(capsules=3, capsuleDimensions=6,
+                                     routings=3).build())
+         .layer(CapsuleStrengthLayer.Builder().build())
+         .layer(ActivationLayer.Builder().activation("softmax").build())
+         .layer(LossLayer(lossFunction="mcxent", activation="identity"))
+         .setInputType(InputType.convolutional(12, 12, 1)))
+    return MultiLayerNetwork(b.build()).init()
+
+
+class TestCapsNet:
+    def test_shapes_through_stack(self):
+        net = _capsnet()
+        x = np.random.RandomState(0).randn(2, 1, 12, 12).astype(np.float32)
+        acts = net.feedForward(x)
+        # conv 12->10, primarycaps conv 10->4 => caps = 2*4*4 = 32
+        assert acts[2].shape() == (2, 32, 4)
+        assert acts[3].shape() == (2, 3, 6)
+        assert acts[4].shape() == (2, 3)
+        probs = acts[5].numpy()
+        assert np.allclose(probs.sum(1), 1.0, atol=1e-5)
+
+    def test_capsule_lengths_bounded(self):
+        net = _capsnet()
+        x = np.random.RandomState(1).randn(4, 1, 12, 12).astype(np.float32)
+        caps = net.feedForward(x)[3].numpy()
+        norms = np.linalg.norm(caps, axis=-1)
+        assert np.all(norms < 1.0)   # squash bounds lengths to [0, 1)
+
+    def test_trains(self):
+        net = _capsnet()
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 1, 12, 12).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 30)
+        assert net.score((x, y)) < s0
+
+    def test_gradient_check(self):
+        b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-3))
+             .list()
+             .layer(PrimaryCapsules.Builder(capsuleDimensions=3, channels=2,
+                                            kernelSize=[2, 2],
+                                            stride=[1, 1]).build())
+             .layer(CapsuleLayer.Builder(capsules=2, capsuleDimensions=4,
+                                         routings=2).build())
+             .layer(CapsuleStrengthLayer.Builder().build())
+             .layer(LossLayer(lossFunction="mse",
+                              activation="identity"))
+             .setInputType(InputType.convolutional(4, 4, 1)))
+        net = MultiLayerNetwork(b.build()).init()
+        rng = np.random.default_rng(0)
+        f = rng.normal(size=(2, 1, 4, 4)).astype(np.float32)
+        y = np.abs(rng.normal(size=(2, 2))).astype(np.float32)
+        assert GradientCheckUtil.checkGradients(net, f, y, subset=25)
+
+    def test_json_round_trip(self):
+        net = _capsnet()
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        pc = conf2.layers[1]
+        cl = conf2.layers[2]
+        assert isinstance(pc, PrimaryCapsules)
+        assert pc.capsuleDimensions == 4 and pc.channels == 2
+        assert isinstance(cl, CapsuleLayer)
+        assert cl.routings == 3
+        net2 = MultiLayerNetwork(conf2).init()
+        x = np.random.RandomState(2).randn(1, 1, 12, 12).astype(np.float32)
+        assert net2.output(x).numpy().shape == (1, 3)
+
+
+class TestCapsNetConfigEdges:
+    def test_flat_input_gets_reshape_preprocessor(self):
+        b = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+             .list()
+             .layer(PrimaryCapsules.Builder(capsuleDimensions=3, channels=2,
+                                            kernelSize=[3, 3],
+                                            stride=[2, 2]).build())
+             .layer(CapsuleStrengthLayer.Builder().build())
+             .layer(LossLayer(lossFunction="mse",
+                              activation="identity"))
+             .setInputType(InputType.convolutionalFlat(8, 8, 1)))
+        net = MultiLayerNetwork(b.build()).init()
+        x = np.random.RandomState(0).randn(2, 64).astype(np.float32)
+        out = net.output(x).numpy()   # flat input must reshape to NCHW
+        assert out.ndim == 2
+
+    def test_feedforward_input_rejected_clearly(self):
+        import pytest
+        with pytest.raises(ValueError, match="convolutional input"):
+            (NeuralNetConfiguration.Builder().list()
+             .layer(PrimaryCapsules.Builder(capsuleDimensions=3,
+                                            channels=2).build())
+             .layer(LossLayer(lossFunction="mse"))
+             .setInputType(InputType.feedForward(10))
+             .build())
