@@ -19,8 +19,8 @@ from jax import lax
 from deeplearning4j_tpu.nn.conf.inputs import (
     Convolutional3DType, InputType)
 from deeplearning4j_tpu.nn.conf.layers import (
-    BaseLayer, BaseOutputLayer, ConvolutionMode, PoolingType, _pair,
-    _register)
+    BaseLayer, BaseOutputLayer, ConvolutionMode, LossLayer, PoolingType,
+    _pair, _register)
 from deeplearning4j_tpu.nn.weights import init_weight
 
 
@@ -576,3 +576,81 @@ class CenterLossOutputLayer(BaseOutputLayer):
         if mask is not None and mask.ndim == 1:
             pull = pull * mask
         return base + 0.5 * self.lambdaCoeff * jnp.mean(pull)
+
+
+@_register
+class OCNNOutputLayer(LossLayer):
+    """One-class neural network output for anomaly detection (reference:
+    org.deeplearning4j.nn.conf.ocnn.OCNNOutputLayer — hiddenSize, nu,
+    windowSize, rUpdate schedule).
+
+    Score y = w . sigmoid(V x); training minimizes
+      0.5(||V||^2 + ||w||^2) + mean(relu(r - y)) / nu - r
+    with r tracked in the compiled step (like batch-norm statistics) as
+    an exponentially-smoothed nu-quantile of the batch scores; the
+    smoothing horizon is windowSize EXAMPLES, the analog of the
+    reference's every-windowSize r refresh. At inference, examples with
+    y < r are anomalies.
+    """
+
+    LOSS_UPDATES_STATE = True
+
+    def __init__(self, nIn=None, hiddenSize=10, nu=0.04, windowSize=10000,
+                 activation=None, lossFunction="ocnn", **kw):
+        super().__init__(lossFunction=lossFunction,
+                         activation=activation or "sigmoid", **kw)
+        self.nIn = nIn
+        self.hiddenSize = int(hiddenSize)
+        self.nu = float(nu)
+        self.windowSize = int(windowSize)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        return InputType.feedForward(1)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        return {"V": init_weight(self.weightInit, k1,
+                                 (self.nIn, self.hiddenSize), self.nIn,
+                                 self.hiddenSize, dtype),
+                "w": init_weight(self.weightInit, k2, (self.hiddenSize,),
+                                 self.hiddenSize, 1, dtype)}
+
+    def _score(self, params, x):
+        from deeplearning4j_tpu.nn.activations import resolve_activation
+
+        h = resolve_activation(self.activation)(x @ params["V"])
+        return h @ params["w"]
+
+    def apply(self, params, state, x, training, rng):
+        return self._score(params, x)[:, None], state
+
+    def _smoothed_r(self, y, state):
+        q = jnp.quantile(jax.lax.stop_gradient(y), self.nu)
+        n = y.shape[0]
+        alpha = min(1.0, n / max(self.windowSize, 1))
+        seen = state.get("seen", jnp.zeros((), jnp.int32))
+        r = jnp.where(seen > 0,
+                      (1.0 - alpha) * state["r"] + alpha * q, q)
+        return r, {"r": r.astype(state["r"].dtype), "seen": seen + 1}
+
+    def init_state(self, dtype=jnp.float32):
+        return {"r": jnp.zeros((), dtype),
+                "seen": jnp.zeros((), jnp.int32)}
+
+    def compute_loss_with_state(self, params, x, labels, mask=None,
+                                state=None):
+        """labels are IGNORED (one-class training trains on normal data
+        only, reference semantics)."""
+        y = self._score(params, x)
+        r, new_state = self._smoothed_r(y, state or self.init_state())
+        reg = 0.5 * (jnp.sum(jnp.square(params["V"]))
+                     + jnp.sum(jnp.square(params["w"])))
+        hinge = jnp.maximum(0.0, r - y)
+        if mask is not None and mask.ndim == 1:
+            hinge = hinge * mask
+        return reg + jnp.mean(hinge) / self.nu - r, new_state
+
+    def compute_loss(self, params, x, labels, mask=None):
+        loss, _ = self.compute_loss_with_state(params, x, labels, mask)
+        return loss

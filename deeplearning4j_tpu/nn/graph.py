@@ -115,8 +115,14 @@ class ComputationGraph:
         for out in self.conf.outputs:
             node, _ = self.conf.nodes[out]
             mask = None if masks is None else masks.get(out)
-            loss = loss + node.compute_loss(params[out], env[out],
-                                            labels[out], mask)
+            if training and getattr(node, "LOSS_UPDATES_STATE", False):
+                # loss-state channel (see MultiLayerNetwork._loss_from)
+                term, new_states[out] = node.compute_loss_with_state(
+                    params[out], env[out], labels[out], mask, states[out])
+                loss = loss + term
+            else:
+                loss = loss + node.compute_loss(params[out], env[out],
+                                                labels[out], mask)
         # regularization
         for name, (node, _) in self.conf.nodes.items():
             p = params.get(name)

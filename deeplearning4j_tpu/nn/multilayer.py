@@ -133,7 +133,14 @@ class MultiLayerNetwork:
                                       upto=out_idx)
         h = _apply_preprocessor(self.conf.preprocessors[out_idx], h)
         out_layer = self.layers[out_idx]
-        loss = out_layer.compute_loss(params[out_idx], h, l, mask)
+        if training and getattr(out_layer, "LOSS_UPDATES_STATE", False):
+            # loss-state channel (e.g. OCNN's r threshold): the output
+            # layer's apply() never runs during training, so its state
+            # updates ride along with the loss
+            loss, new_states[out_idx] = out_layer.compute_loss_with_state(
+                params[out_idx], h, l, mask, states[out_idx])
+        else:
+            loss = out_layer.compute_loss(params[out_idx], h, l, mask)
         # L1/L2 regularization per layer (reference: BaseLayer.calcRegularizationScore)
         reg = 0.0
         for i, lr in enumerate(self.layers):

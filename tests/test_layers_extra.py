@@ -253,3 +253,48 @@ class TestSmallLayers:
                                           alpha=0.25).build()
         msgs = [w for w in rec if "alpha" in str(w.message)]
         assert len(msgs) == 1
+
+
+class TestOCNN:
+    """One-class NN output (reference: conf.ocnn.OCNNOutputLayer)."""
+
+    def test_learns_normal_manifold(self):
+        from deeplearning4j_tpu.nn import OCNNOutputLayer
+
+        rng = np.random.RandomState(0)
+        # normal data lives on a line (x2 = x1); anomalies break it
+        t = rng.randn(128, 1).astype(np.float32)
+        normal = np.concatenate([t, t + 0.05 * rng.randn(128, 1)
+                                 .astype(np.float32)], axis=1)
+        net = _build([
+            OCNNOutputLayer.Builder(nIn=2, hiddenSize=8, nu=0.1).build(),
+        ])
+        # one-class: labels unused, train on normal data only
+        dummy = np.zeros((128, 1), np.float32)
+        net.fit([(normal, dummy)] * 150)
+        r = float(np.asarray(net._states[0]["r"]))
+        assert r != 0.0          # r state actually updated during fit
+        scores_norm = net.output(normal).numpy()[:, 0]
+        anti = rng.randn(64, 1).astype(np.float32)
+        anomalies = np.concatenate([anti, -anti], axis=1)  # x2 = -x1
+        scores_anom = net.output(anomalies).numpy()[:, 0]
+        # quantile property: ~1-nu of normal scores at or above r
+        assert (scores_norm >= r).mean() > 0.8
+        # smoothed r sits near the nu-quantile of the trained scores
+        q = float(np.quantile(scores_norm, 0.1))
+        assert abs(r - q) < max(0.5, abs(q))
+        # normal scores separate from off-manifold scores
+        assert scores_norm.mean() > scores_anom.mean()
+
+    def test_json_round_trip(self):
+        from deeplearning4j_tpu.nn import OCNNOutputLayer
+
+        net = _build([
+            DenseLayer.Builder(nIn=4, nOut=8, activation="tanh").build(),
+            OCNNOutputLayer.Builder(hiddenSize=6, nu=0.05,
+                                    windowSize=500).build(),
+        ])
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        oc = conf2.layers[1]
+        assert isinstance(oc, OCNNOutputLayer)
+        assert oc.nu == 0.05 and oc.hiddenSize == 6
