@@ -887,3 +887,91 @@ class FaceNetNN4Small2(ZooModel):
 
     def init(self) -> ComputationGraph:
         return ComputationGraph(self.conf()).init()
+
+
+class InceptionResNetV1(ZooModel):
+    """Reference: zoo.model.InceptionResNetV1 (the FaceNet-class
+    inception-resnet: stem + residual inception blocks with a scale on
+    the residual branch, embedding + center-loss head like
+    FaceNetNN4Small2)."""
+
+    def __init__(self, numClasses=10, seed=123, inputShape=(3, 96, 96),
+                 embeddingSize=128, blocksA=2, blocksB=2, lambdaCoeff=2e-4,
+                 updater=None):
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.embeddingSize = embeddingSize
+        self.blocksA = blocksA
+        self.blocksB = blocksB
+        self.lambdaCoeff = lambdaCoeff
+        self.updater = updater or Adam(1e-3)
+
+    def conf(self):
+        from deeplearning4j_tpu.nn import (
+            CenterLossOutputLayer, L2NormalizeVertex, MergeVertex,
+            ScaleVertex)
+
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+
+        def conv(name, src, n, k, s=1, act="relu"):
+            g.addLayer(name, ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([k, k]).stride([s, s])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("identity").hasBias(False).build(), src)
+            g.addLayer(name + "_bn", BatchNormalization.Builder()
+                       .activation(act).build(), name)
+            return name + "_bn"
+
+        # stem: conv s2, conv, conv, pool -> width 64
+        x = conv("stem1", "in", 32, 3, 2)
+        x = conv("stem2", x, 32, 3)
+        x = conv("stem3", x, 64, 3)
+        g.addLayer("stem_pool", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), x)
+        x = conv("stem4", "stem_pool", 128, 1)
+
+        def block(tag, src, width, mid, scale=0.17):
+            """Inception-resnet block: two towers -> 1x1 up-proj,
+            residual-added with a scale (the V1 stabilization)."""
+            t1 = conv(f"{tag}_1x1", src, mid, 1)
+            t2 = conv(f"{tag}_3a", src, mid, 1)
+            t2 = conv(f"{tag}_3b", t2, mid, 3)
+            g.addVertex(f"{tag}_cat", MergeVertex(), t1, t2)
+            up = conv(f"{tag}_up", f"{tag}_cat", width, 1, act="identity")
+            g.addVertex(f"{tag}_scale", ScaleVertex(scale), up)
+            g.addVertex(f"{tag}_add", ElementWiseVertex("Add"), src,
+                        f"{tag}_scale")
+            g.addLayer(f"{tag}_act", ActivationLayer.Builder()
+                       .activation("relu").build(), f"{tag}_add")
+            return f"{tag}_act"
+
+        for i in range(self.blocksA):
+            x = block(f"ira{i}", x, 128, 32)
+        # reduction: stride-2 pool + channel up-projection
+        g.addLayer("redA_pool", SubsamplingLayer.Builder()
+                   .kernelSize([3, 3]).stride([2, 2])
+                   .convolutionMode(ConvolutionMode.SAME).build(), x)
+        x = conv("redA_proj", "redA_pool", 256, 1)
+        for i in range(self.blocksB):
+            x = block(f"irb{i}", x, 256, 64, scale=0.1)
+
+        g.addLayer("gap", GlobalPoolingLayer.Builder().build(), x)
+        g.addLayer("embedding", DenseLayer.Builder()
+                   .nOut(self.embeddingSize).activation("identity").build(),
+                   "gap")
+        g.addVertex("l2norm", L2NormalizeVertex(), "embedding")
+        g.addLayer("out", CenterLossOutputLayer.Builder()
+                   .nOut(self.numClasses).lambdaCoeff(self.lambdaCoeff)
+                   .activation("softmax").lossFunction("mcxent").build(),
+                   "l2norm")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

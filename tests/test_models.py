@@ -189,3 +189,18 @@ class TestZooRound2Additions:
         # centers moved toward the embeddings
         assert not np.allclose(
             np.asarray(net._params["out"]["centers"]), 0.0)
+
+    def test_inception_resnet_v1(self):
+        from deeplearning4j_tpu.models import InceptionResNetV1
+
+        net = InceptionResNetV1(numClasses=4, inputShape=(3, 32, 32),
+                                embeddingSize=16, blocksA=1,
+                                blocksB=1).init()
+        x = np.random.RandomState(0).randn(4, 3, 32, 32).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+        out = net.outputSingle(x).numpy()
+        assert out.shape == (4, 4)
+        assert np.allclose(out.sum(1), 1.0, atol=1e-4)
+        s0 = net.score((x, y))
+        net.fit([(x, y)] * 5)
+        assert net.score((x, y)) < s0
