@@ -127,3 +127,79 @@ assert abs(v - 256**3) < 1e-3, v
 print('OK')
 """)
         assert "OK" in out
+
+
+@gated
+class TestRealChipRound2:
+    """Round-2 session features on the real chip."""
+
+    def test_yolo_detects_on_chip(self):
+        _run("""
+import numpy as np
+from deeplearning4j_tpu.models import TinyYOLO
+from deeplearning4j_tpu.nn import YoloUtils
+net = TinyYOLO(numClasses=3, inputShape=(3, 128, 128),
+               boundingBoxPriors=[[1.0, 1.0], [3.0, 3.0]]).init()
+rng = np.random.RandomState(0)
+xs, ys = [], []
+for k in range(8):
+    img = rng.rand(3, 128, 128).astype(np.float32) * 0.1
+    ci, cj = k % 4, (k * 2 + 1) % 4
+    img[:, ci * 32 + 8:ci * 32 + 24, cj * 32 + 8:cj * 32 + 24] = 1.0
+    lab = np.zeros((7, 4, 4), np.float32)
+    cx, cy = cj + 0.5, ci + 0.5
+    lab[0, ci, cj] = cx - 0.5; lab[1, ci, cj] = cy - 0.5
+    lab[2, ci, cj] = cx + 0.5; lab[3, ci, cj] = cy + 0.5
+    lab[4, ci, cj] = 1.0
+    xs.append(img); ys.append(lab)
+x, y = np.stack(xs), np.stack(ys)
+net.fit([(x, y)] * 200)
+objs = YoloUtils.getPredictedObjects(net.output(x).numpy(),
+                                     threshold=0.3)
+assert len(objs) >= 4, len(objs)
+print("OK")
+""", timeout=540)
+
+    def test_vae_pretrain_on_chip(self):
+        _run("""
+import numpy as np
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, VariationalAutoencoder)
+from deeplearning4j_tpu.optimize.updaters import Adam
+rng = np.random.RandomState(0)
+x = (rng.rand(128, 16) > 0.5).astype(np.float32)
+b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2)).list()
+     .layer(VariationalAutoencoder.Builder().nIn(16).nOut(4)
+            .encoderLayerSizes([24]).decoderLayerSizes([24]).build())
+     .layer(OutputLayer.Builder().nOut(2).build()))
+net = MultiLayerNetwork(b.build()).init()
+import jax
+key = jax.random.key(0)
+e0 = float(net.layers[0].pretrain_loss(net._params[0], x, key))
+net.pretrain([(x, None)] * 50)
+e1 = float(net.layers[0].pretrain_loss(net._params[0], x, key))
+assert e1 < e0, (e0, e1)
+print("OK")
+""")
+
+    def test_attention_classifier_on_chip(self):
+        _run("""
+import numpy as np
+from deeplearning4j_tpu.nn import (GlobalPoolingLayer, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, SelfAttentionLayer, InputType)
+from deeplearning4j_tpu.optimize.updaters import Adam
+rng = np.random.RandomState(0)
+x = rng.randn(32, 4, 10).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x.sum((1, 2)) > 0).astype(int)]
+b = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2)).list()
+     .layer(SelfAttentionLayer.Builder(nOut=8, nHeads=2,
+                                       activation="tanh").build())
+     .layer(GlobalPoolingLayer.Builder().build())
+     .layer(OutputLayer.Builder().nOut(2).build())
+     .setInputType(InputType.recurrent(4, 10)))
+net = MultiLayerNetwork(b.build()).init()
+s0 = net.score((x, y))
+net.fit([(x, y)] * 40)
+assert net.score((x, y)) < s0
+print("OK")
+""")
