@@ -1160,10 +1160,20 @@ def _image_resize(x, height, width, method="bilinear", antialias=False):
 
 @op("extractImagePatches")
 def _extract_image_patches(x, kH, kW, sH=1, sW=1, sameMode=False):
+    """TF/DL4J extract_image_patches orders the patch feature dim
+    patch-position-major with depth fastest — (kh, kw, c) — while
+    lax.conv_general_dilated_patches emits channel-major (c, kh, kw);
+    permute to match the reference op's ordering."""
     pad = "SAME" if sameMode else "VALID"
-    return lax.conv_general_dilated_patches(
-        x, (int(kH), int(kW)), (int(sH), int(sW)), pad,
+    kH, kW = int(kH), int(kW)
+    c = x.shape[1]
+    p = lax.conv_general_dilated_patches(
+        x, (kH, kW), (int(sH), int(sW)), pad,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, _, oh, ow = p.shape
+    p = p.reshape(n, c, kH, kW, oh, ow)
+    return jnp.transpose(p, (0, 2, 3, 1, 4, 5)).reshape(
+        n, kH * kW * c, oh, ow)
 
 
 @op("spaceToDepth")

@@ -281,19 +281,33 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
         self._peek = None
 
     def _records_row(self):
-        """One aligned row of floats per reader, or None when exhausted."""
-        row = {}
-        for name, r in self._readers.items():
-            if not r.hasNext():
-                return None
-            row[name] = [float(v) for v in r.next()]
-        return row
+        """One aligned row of floats per reader, or None when exhausted.
+
+        All readers are checked for exhaustion before any is consumed, so
+        mismatched-length readers raise instead of silently dropping the
+        records already pulled from the longer ones.
+        """
+        state = {name: r.hasNext() for name, r in self._readers.items()}
+        if not any(state.values()):
+            return None
+        if not all(state.values()):
+            done = sorted(n for n, h in state.items() if not h)
+            live = sorted(n for n, h in state.items() if h)
+            raise ValueError(
+                f"readers out of alignment: {done} exhausted while "
+                f"{live} still have records")
+        return {name: [float(v) for v in r.next()]
+                for name, r in self._readers.items()}
 
     @staticmethod
     def _cols(rec, c0, c1):
-        c1 = len(rec) - 1 if c1 is None else (c1 if c1 >= 0
-                                              else len(rec) + c1)
-        c0 = c0 if c0 >= 0 else len(rec) + c0
+        n = len(rec)
+        c1 = n - 1 if c1 is None else (c1 if c1 >= 0 else n + c1)
+        c0 = c0 if c0 >= 0 else n + c0
+        if not (0 <= c0 <= c1 < n):
+            raise ValueError(
+                f"column range [{c0}, {c1}] out of bounds for a record "
+                f"of width {n}")
         return rec[c0:c1 + 1]
 
     def _next_batch(self):

@@ -246,6 +246,12 @@ class AttentionVertex(BaseLayer):
             self.nInKeys = self.nInKeys or self.nInQueries
             self.nInValues = self.nInValues or self.nInQueries
         if not self.projectInput:
+            if self.nHeads != 1:
+                raise ValueError(
+                    "AttentionVertex: projectInput=False requires "
+                    f"nHeads=1 (got nHeads={self.nHeads}); without "
+                    "projections the single-head dotProductAttention "
+                    "path is used")
             self.nOut = self.nInValues
         if self.headSize is None:
             self.headSize = (self.nOut // self.nHeads if self.projectInput

@@ -468,9 +468,10 @@ class ElementWiseMultiplicationLayer(BaseLayer):
 @_register
 class MaskZeroLayer(BaseLayer):
     """Wrapper deriving a timestep mask from the INPUT (timesteps where
-    every feature equals maskingValue) and zeroing the wrapped layer's
-    output there (reference: conf.layers.util.MaskZeroLayer — the
-    keras-import masking idiom)."""
+    every feature equals maskingValue), zeroing the wrapped layer's input
+    AND output at masked steps so a recurrent underlying layer's carried
+    state never sees the masking sentinel (reference:
+    conf.layers.util.MaskZeroLayer — the keras-import masking idiom)."""
 
     def __init__(self, underlying=None, maskingValue=0.0, **kw):
         super().__init__(**kw)
@@ -492,8 +493,14 @@ class MaskZeroLayer(BaseLayer):
         return self.underlying.init_state(dtype)
 
     def apply(self, params, state, x, training, rng):
+        # Zero the INPUT at masked timesteps (not just the output): a
+        # recurrent underlying layer must not carry hidden state polluted
+        # by interior masked steps — reference zeroes the input and the
+        # RNN honors the mask.
         keep = jnp.any(x != self.maskingValue, axis=1, keepdims=True)
-        y, state = self.underlying.apply(params, state, x, training, rng)
+        keep = keep.astype(x.dtype)
+        y, state = self.underlying.apply(params, state, x * keep,
+                                         training, rng)
         return y * keep.astype(y.dtype), state
 
 

@@ -143,3 +143,116 @@ class TestWord2VecTailBatch:
         # init; any vector must now differ from its init
         v = w2v.getWordVector("alpha")
         assert v is not None and np.abs(v).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# Round-2 advisor findings
+# ---------------------------------------------------------------------------
+
+
+class TestExtractImagePatchesOrdering:
+    """ADVICE r2 low: patch feature dim must be (kh, kw, c) like
+    TF/DL4J extract_image_patches, not channel-major."""
+
+    def test_matches_naive_tf_ordering(self):
+        from deeplearning4j_tpu.autodiff.ops import OPS
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(1, 2, 3, 3)).astype(np.float32)
+        kH = kW = 2
+        out = np.asarray(OPS["extractImagePatches"](x, kH, kW, 1, 1))
+        assert out.shape == (1, kH * kW * 2, 2, 2)
+        for oy in range(2):
+            for ox in range(2):
+                # TF: patch-position-major, depth fastest
+                expect = x[0, :, oy:oy + kH, ox:ox + kW].transpose(
+                    1, 2, 0).reshape(-1)
+                np.testing.assert_allclose(out[0, :, oy, ox], expect)
+
+
+class TestMultiReaderValidation:
+    """ADVICE r2 low x2: out-of-range columns and misaligned readers must
+    raise, not silently truncate / drop records."""
+
+    @staticmethod
+    def _csv(tmp_path, name, rows):
+        p = tmp_path / name
+        p.write_text("\n".join(",".join(str(v) for v in r) for r in rows))
+        from deeplearning4j_tpu.datasets import CSVRecordReader, FileSplit
+
+        r = CSVRecordReader()
+        r.initialize(FileSplit(str(p)))
+        return r
+
+    def test_out_of_range_column_raises(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (
+            RecordReaderMultiDataSetIterator)
+
+        ra = self._csv(tmp_path, "a.csv", [[1, 2, 3]] * 4)
+        it = (RecordReaderMultiDataSetIterator.Builder(batchSize=2)
+              .addReader("a", ra).addInput("a", 0, 7)
+              .addOutput("a", 2, 2).build())
+        with pytest.raises(ValueError, match="out of bounds"):
+            it.next()
+
+    def test_misaligned_readers_raise(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (
+            RecordReaderMultiDataSetIterator)
+
+        ra = self._csv(tmp_path, "a.csv", [[1, 2]] * 5)
+        rb = self._csv(tmp_path, "b.csv", [[3, 0]] * 3)
+        it = (RecordReaderMultiDataSetIterator.Builder(batchSize=10)
+              .addReader("a", ra).addReader("b", rb)
+              .addInput("a", 0, 1).addOutputOneHot("b", 1, 2).build())
+        with pytest.raises(ValueError, match="out of alignment"):
+            it.next()
+
+
+class TestMaskZeroInputZeroing:
+    """ADVICE r2 low: masked-step INPUTS must not pollute recurrent state
+    carried past an interior masked timestep."""
+
+    def test_interior_masked_step_feeds_zeros_not_sentinel(self):
+        from deeplearning4j_tpu.nn import (
+            InputType, LSTM, MaskZeroLayer, MultiLayerNetwork,
+            NeuralNetConfiguration, RnnOutputLayer)
+
+        def build(wrap):
+            lstm = LSTM.Builder(nIn=3, nOut=4, activation="tanh").build()
+            layer0 = (MaskZeroLayer(underlying=lstm, maskingValue=-1.0)
+                      if wrap else lstm)
+            conf = (NeuralNetConfiguration.Builder().seed(5)
+                    .list()
+                    .layer(layer0)
+                    .layer(RnnOutputLayer.Builder().nOut(2)
+                           .activation("softmax").build())
+                    .setInputType(InputType.recurrent(3, 6))
+                    .build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            return net
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 6)).astype(np.float32)
+        x_masked = x.copy()
+        x_masked[:, :, 2] = -1.0      # interior masked step (sentinel)
+        x_zeroed = x.copy()
+        x_zeroed[:, :, 2] = 0.0       # what the wrapped RNN must see
+
+        y_wrap = build(True).output(x_masked)
+        y_ref = build(False).output(x_zeroed)
+        # downstream of the masked step the carried state must match the
+        # zero-input run (pre-fix, the -1 sentinel flowed into the carry)
+        np.testing.assert_allclose(y_wrap[:, :, 3:], y_ref[:, :, 3:],
+                                   atol=1e-5)
+
+
+class TestAttentionVertexHeadValidation:
+    """ADVICE r2 low: projectInput=False with nHeads>1 must raise."""
+
+    def test_raises(self):
+        from deeplearning4j_tpu.nn import AttentionVertex, InputType
+
+        v = AttentionVertex(nHeads=2, projectInput=False)
+        with pytest.raises(ValueError, match="projectInput=False"):
+            v.infer(InputType.recurrent(4, 5))
