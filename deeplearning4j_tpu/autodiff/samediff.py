@@ -48,6 +48,107 @@ class Op:
     attrs: dict
 
 
+class _TraceUnsupported(Exception):
+    """Raised when a control-flow body cannot be traced into a child
+    SameDiff graph (e.g. it calls jnp functions directly instead of the
+    SDVariable op surface). The builder then falls back to storing the
+    raw callable: the graph still runs, but cannot be save()d."""
+
+
+class _CaptureError(_TraceUnsupported):
+    """A body captured an outer variable with no build-time value (a
+    placeholder or op output). Unlike other trace failures this cannot
+    work at runtime either — the raw-callable fallback would leak an
+    SDVariable into jnp tracing — so it is a hard build-time error."""
+
+
+class SubGraph:
+    """A control-flow body as a named child SameDiff graph: the
+    serializable representation of whileLoop/ifCond/scan/forLoop bodies
+    (reference analog: FlatBuffers function defs in libnd4j's graph
+    scheme, SURVEY.md §2.1). arg_names are the child placeholders fed
+    positionally; out_names the child variables returned."""
+
+    def __init__(self, graph: "SameDiff", arg_names: list,
+                 out_names: list):
+        self.graph = graph
+        self.arg_names = list(arg_names)
+        self.out_names = list(out_names)
+
+    def callable(self, squeeze: bool = False):
+        """Compile the child graph into a plain jnp-arrays callable with
+        the signature control-flow op kernels expect."""
+        fn = self.graph._make_fn(tuple(self.out_names), training=False)
+        params, consts = self.graph._split_values()
+        arg_names, out_names = self.arg_names, self.out_names
+        import jax as _jax
+
+        rng = _jax.random.key(0)
+
+        def call(*args):
+            feeds = dict(zip(arg_names, args))
+            outs = fn(feeds, params, consts, rng)
+            res = tuple(outs[n] for n in out_names)
+            return res[0] if (squeeze and len(res) == 1) else res
+
+        return call
+
+    def to_dict(self) -> dict:
+        d = self.graph._graph_dict()
+        d["values"] = {
+            k: {"dtype": str(np.dtype(v.dtype)),
+                "data": np.asarray(v).tolist()}
+            for k, v in self.graph._values.items()
+        }
+        return {"args": self.arg_names, "outs": self.out_names,
+                "graph": d}
+
+    @staticmethod
+    def from_dict(d: dict) -> "SubGraph":
+        child = SameDiff._from_graph_dict(d["graph"])
+        for k, spec in d["graph"]["values"].items():
+            child._values[k] = jnp.asarray(
+                np.asarray(spec["data"], np.dtype(spec["dtype"])))
+        return SubGraph(child, d["args"], d["outs"])
+
+
+def _trace_subgraph(fn, n_args) -> SubGraph:
+    """Trace a Python body callable into a child SameDiff graph by calling
+    it with child placeholders. Raises _TraceUnsupported when the body
+    escapes the SDVariable op surface."""
+    child = SameDiff()
+    child._tracing = True
+    arg_names = [f"__arg{i}" for i in range(n_args)]
+    phs = [child.placeHolder(n) for n in arg_names]
+    try:
+        outs = fn(*phs)
+    except _TraceUnsupported:
+        raise
+    except Exception as e:
+        raise _TraceUnsupported(
+            f"body is not traceable over SDVariables ({type(e).__name__}: "
+            f"{e}); it will be stored as a raw callable and the graph "
+            f"will not be serializable") from e
+    finally:
+        child._tracing = False
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    if not all(isinstance(o, SDVariable) and o.sd is child for o in outs):
+        raise _TraceUnsupported(
+            "body returned non-SDVariable outputs during tracing")
+    return SubGraph(child, arg_names, [o.name() for o in outs])
+
+
+# which op attrs hold sub-graph bodies, and the callable attr + squeeze
+# behavior each one feeds (squeeze: whileLoop's cond must return a scalar,
+# not a 1-tuple)
+_SUBGRAPH_ATTRS = {
+    "cond_graph": ("cond_fn", True),
+    "body_graph": ("body_fn", False),
+    "true_graph": ("true_fn", False),
+    "false_graph": ("false_fn", False),
+}
+
+
 def _unwrap_value(v):
     if isinstance(v, INDArray):
         return v.jax()
@@ -71,9 +172,21 @@ class SDVariable:
 
     # -- graph-building arithmetic -----------------------------------------
     def _bin(self, opname, other, rev=False):
-        other = self.sd._as_var(other)
-        a, b = (other, self) if rev else (self, other)
-        return self.sd._op(opname, [a, b])
+        # when operands come from different graphs (control-flow body
+        # tracing mixes child placeholders with captured parent vars),
+        # build the op on the graph BEING TRACED regardless of operand
+        # order — `outer_const + loop_var` must behave like
+        # `loop_var + outer_const`
+        sd = self.sd
+        if (isinstance(other, SDVariable) and other.sd is not sd
+                and getattr(other.sd, "_tracing", False)
+                and not getattr(sd, "_tracing", False)):
+            sd = other.sd
+        a = sd._as_var(self)
+        b = sd._as_var(other)
+        if rev:
+            a, b = b, a
+        return sd._op(opname, [a, b])
 
     def add(self, o):
         return self._bin("add", o)
@@ -114,6 +227,36 @@ class SDVariable:
 
     def __matmul__(self, o):
         return self.mmul(o)
+
+    # comparisons (reference: SDVariable.gt/lt/gte/lte/eq/neq)
+    def gt(self, o):
+        return self._bin("gt", o)
+
+    def lt(self, o):
+        return self._bin("lt", o)
+
+    def gte(self, o):
+        return self._bin("gte", o)
+
+    def lte(self, o):
+        return self._bin("lte", o)
+
+    def eq(self, o):
+        return self._bin("eq", o)
+
+    def neq(self, o):
+        return self._bin("neq", o)
+
+    __gt__ = gt
+    __lt__ = lt
+    __ge__ = gte
+    __le__ = lte
+
+    def all(self, *dims, keepDims=False):
+        return self._red("all", dims, keepDims)
+
+    def any(self, *dims, keepDims=False):
+        return self._red("any", dims, keepDims)
 
     def neg(self):
         return self.sd._op("neg", [self])
@@ -485,8 +628,38 @@ class SameDiff:
 
     def _as_var(self, x) -> SDVariable:
         if isinstance(x, SDVariable):
+            if x.sd is not self:
+                return self._capture_foreign(x)
             return x
         return self.constant(x)
+
+    def _capture_foreign(self, var: SDVariable) -> SDVariable:
+        """A body closure referenced a variable of ANOTHER graph (the
+        parent, during control-flow body tracing): snapshot its current
+        value into this graph as a captured constant — the captured-
+        constant table that makes control-flow bodies serializable."""
+        name = f"__cap_{var.name()}"
+        if name in self._vars:
+            return self._vars[name]
+        src = var.sd
+        if var.name() not in src._values:
+            raise _CaptureError(
+                f"control-flow body captures {var.name()!r}, which has no "
+                f"value at build time (placeholders/op outputs cannot be "
+                f"captured; pass them as explicit loop variables)")
+        if var.variableType == VariableType.VARIABLE:
+            # a snapshot would silently FREEZE the trainable param inside
+            # the body (updates and gradients would never reach it)
+            raise _CaptureError(
+                f"control-flow body captures trainable variable "
+                f"{var.name()!r}; a build-time snapshot would freeze it — "
+                f"pass it as an explicit loop variable instead")
+        val = src._values[var.name()]
+        v = SDVariable(self, name, VariableType.CONSTANT,
+                       tuple(val.shape), val.dtype)
+        self._vars[name] = v
+        self._values[name] = val
+        return v
 
     def convertToConstant(self, var: SDVariable):
         var.variableType = VariableType.CONSTANT
@@ -587,34 +760,53 @@ class SameDiff:
 
     # -- control flow (reference: SDBaseOps.whileLoop/ifCond; TF
     # Enter/Exit/Merge/Switch interpreted as whole loops, SURVEY.md §3.4).
-    # Bodies are Python callables over jnp arrays; compiled into ONE
-    # lax.while_loop/cond/scan XLA op — graphs holding them run and (for
-    # ifCond/scan) differentiate, but cannot be save()d.
+    # Bodies are Python callables; at build time each body is TRACED over
+    # child-graph placeholder SDVariables into a named sub-SameDiff graph
+    # (closure-captured outer constants become a captured-constant table),
+    # so graphs holding control flow serialize like any other op — the
+    # analog of the reference's FlatBuffers function defs. Bodies that
+    # escape the SDVariable surface (raw jnp calls) fall back to the
+    # callable itself: they run and differentiate but cannot be save()d.
+    def _body_attrs(self, graph_key: str, fn, n_args: int) -> dict:
+        fn_key, squeeze = _SUBGRAPH_ATTRS[graph_key]
+        try:
+            sub = _trace_subgraph(fn, n_args)
+            return {graph_key: sub, fn_key: sub.callable(squeeze=squeeze)}
+        except _CaptureError as e:
+            raise ValueError(str(e)) from e
+        except _TraceUnsupported:
+            return {fn_key: fn}
+
     def whileLoop(self, condBody, loopBody, *loopVars, name=None):
         """loopVars -> final vars after `while condBody(*v): v =
         loopBody(*v)`. Forward-only (XLA while has no reverse-mode)."""
         vs = [self._as_var(v) for v in loopVars]
-        return self._op("whileLoop", vs,
-                        {"cond_fn": condBody, "body_fn": loopBody},
+        attrs = self._body_attrs("cond_graph", condBody, len(vs))
+        attrs.update(self._body_attrs("body_graph", loopBody, len(vs)))
+        return self._op("whileLoop", vs, attrs,
                         name, n_out=len(vs) if len(vs) > 1 else 1)
 
     def ifCond(self, predicate, trueBody, falseBody, *operands, name=None,
                n_out=1):
         ops_ = [self._as_var(v) for v in operands]
+        attrs = self._body_attrs("true_graph", trueBody, len(ops_))
+        attrs.update(self._body_attrs("false_graph", falseBody, len(ops_)))
         return self._op("ifCond", [self._as_var(predicate)] + ops_,
-                        {"true_fn": trueBody, "false_fn": falseBody},
-                        name, n_out=n_out)
+                        attrs, name, n_out=n_out)
 
     def scan(self, body, init, xs, name=None):
         """lax.scan: body(carry, x) -> (carry, y). Returns
         (final_carry, stacked_ys); reverse-mode differentiable."""
         return self._op("scanOp", [self._as_var(init), self._as_var(xs)],
-                        {"body_fn": body}, name, n_out=2)
+                        self._body_attrs("body_graph", body, 2),
+                        name, n_out=2)
 
     def forLoop(self, n, body, *loopVars, name=None):
         """n fixed iterations of body(i, *vars) (lax.fori_loop)."""
         vs = [self._as_var(v) for v in loopVars]
-        return self._op("forLoop", vs, {"n": int(n), "body_fn": body},
+        attrs = {"n": int(n)}
+        attrs.update(self._body_attrs("body_graph", body, 1 + len(vs)))
+        return self._op("forLoop", vs, attrs,
                         name, n_out=len(vs) if len(vs) > 1 else 1)
 
     def getVariable(self, name: str) -> SDVariable:
@@ -662,7 +854,10 @@ class SameDiff:
             env.update(placeholders)
             for idx in op_indices:
                 o = self._ops[idx]
-                kwargs = dict(o.attrs)
+                # *_graph attrs are the serializable sub-graph bodies; the
+                # kernels consume only the compiled *_fn callables
+                kwargs = {k: v for k, v in o.attrs.items()
+                          if not k.endswith("_graph")}
                 fn_name = o.fn_name
                 if fn_name in RANDOM_OPS:
                     kwargs["key"] = jax.random.fold_in(rng, idx)
@@ -891,9 +1086,10 @@ class SameDiff:
         return history
 
     # -- serde (reference: SameDiff.save/load flatbuffers .fb; here a zip of
-    # graph JSON + npz values, same round-trip capability, SURVEY.md §5) ----
-    def save(self, path: str, saveUpdaterState: bool = False):
-        graph = {
+    # graph JSON + npz values, same round-trip capability, SURVEY.md §5;
+    # control-flow bodies serialize as nested sub-graph dicts) ------------
+    def _graph_dict(self) -> dict:
+        return {
             "variables": [
                 {
                     "name": v.name(),
@@ -909,10 +1105,33 @@ class SameDiff:
                 for o in self._ops
             ],
             "lossVariables": self._loss_vars,
+        }
+
+    @staticmethod
+    def _from_graph_dict(graph: dict) -> "SameDiff":
+        sd = SameDiff()
+        for vd in graph["variables"]:
+            v = SDVariable(
+                sd, vd["name"], VariableType(vd["type"]),
+                tuple(vd["shape"]) if vd["shape"] else None,
+                np.dtype(vd["dtype"]),
+            )
+            sd._vars[vd["name"]] = v
+        for i, od in enumerate(graph["ops"]):
+            sd._ops.append(Op(od["fn"], od["inputs"], od["outputs"],
+                              _attrs_from_json(od["attrs"])))
+            for on in od["outputs"]:
+                sd._producer[on] = i
+        sd._loss_vars = graph.get("lossVariables", [])
+        return sd
+
+    def save(self, path: str, saveUpdaterState: bool = False):
+        graph = self._graph_dict()
+        graph.update({
             "trainingConfig": (self.trainingConfig.to_json()
                                if self.trainingConfig else None),
             "step": self._step,
-        }
+        })
         import io
 
         with zipfile.ZipFile(path, "w") as zf:
@@ -931,25 +1150,12 @@ class SameDiff:
     def load(path: str, loadUpdaterState: bool = False) -> "SameDiff":
         import io
 
-        sd = SameDiff()
         with zipfile.ZipFile(path) as zf:
             graph = json.loads(zf.read("graph.json"))
             values = np.load(io.BytesIO(zf.read("values.npz")))
-            for vd in graph["variables"]:
-                v = SDVariable(
-                    sd, vd["name"], VariableType(vd["type"]),
-                    tuple(vd["shape"]) if vd["shape"] else None,
-                    np.dtype(vd["dtype"]),
-                )
-                sd._vars[vd["name"]] = v
-            for i, od in enumerate(graph["ops"]):
-                sd._ops.append(Op(od["fn"], od["inputs"], od["outputs"],
-                                  od["attrs"]))
-                for on in od["outputs"]:
-                    sd._producer[on] = i
+            sd = SameDiff._from_graph_dict(graph)
             for k in values.files:
                 sd._values[k] = jnp.asarray(values[k])
-            sd._loss_vars = graph["lossVariables"]
             sd._step = graph.get("step", 0)
             if graph.get("trainingConfig"):
                 sd.trainingConfig = TrainingConfig.from_json(
@@ -1004,13 +1210,26 @@ class _BatchOutputBuilder:
 
 
 def _json_attrs(attrs: dict) -> dict:
+    # callables whose sub-graph representation exists serialize as the
+    # graph; a callable WITHOUT one is a non-traceable body -> still a
+    # hard error (same boundary the reference draws at FlatBuffers
+    # function defs)
+    graph_backed = {_SUBGRAPH_ATTRS[k][0] for k in attrs
+                    if k in _SUBGRAPH_ATTRS}
     out = {}
     for k, v in attrs.items():
+        if k in graph_backed:
+            continue  # rebuilt from the sub-graph on load
+        if isinstance(v, SubGraph):
+            out[k] = {"__subgraph__": v.to_dict()}
+            continue
         if callable(v):
             raise ValueError(
-                "graphs holding control-flow ops (whileLoop/ifCond/scan/"
-                "forLoop) cannot be serialized: the loop body is a Python "
-                "callable, not graph data")
+                "graph holds a control-flow op whose body could not be "
+                "traced into a sub-graph (it escapes the SDVariable op "
+                "surface, e.g. by calling jnp functions directly); such "
+                "graphs run but cannot be serialized — rewrite the body "
+                "over SDVariable ops to make it saveable")
         if isinstance(v, tuple):
             v = list(v)
         elif hasattr(v, "dtype") and hasattr(v, "tolist"):
@@ -1023,6 +1242,21 @@ def _json_attrs(attrs: dict) -> dict:
             except TypeError:
                 v = str(np.dtype(v))  # dtypes and dtype-like objects
         out[k] = v
+    return out
+
+
+def _attrs_from_json(attrs: dict) -> dict:
+    """Inverse of _json_attrs: rebuild SubGraph bodies and their runtime
+    callables from nested sub-graph dicts."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__subgraph__" in v:
+            sub = SubGraph.from_dict(v["__subgraph__"])
+            out[k] = sub
+            fn_key, squeeze = _SUBGRAPH_ATTRS[k]
+            out[fn_key] = sub.callable(squeeze=squeeze)
+        else:
+            out[k] = v
     return out
 
 

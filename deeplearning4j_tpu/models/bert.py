@@ -49,6 +49,13 @@ class BertConfig:
     dropout: float = 0.1
     compute_dtype: str = "bfloat16"   # activations; params stay f32
     layer_norm_eps: float = 1e-12
+    # MoE variant: n_experts > 0 replaces every layer's dense FFN with a
+    # GShard/Switch top-k MoE block whose experts shard over the `expert`
+    # mesh axis (dp x ep training through the same BertTrainer)
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity: float = 1.5
+    moe_aux_weight: float = 1e-2
     # "auto" routes by sequence length: dense softmax up to T=1024
     # (measured on v5e, XLA's fused dense attention beats the Pallas
     # flash kernel ~2x at BERT-base shapes — head_dim 64 pads to the
@@ -81,18 +88,26 @@ def init_params(cfg: BertConfig, key) -> dict:
     }
     for i in range(cfg.num_layers):
         k = jax.random.split(keys[6 + i], 6)
-        params["layers"].append({
+        layer = {
             "qkv_w": norm(k[0], (h, 3 * h)),
             "qkv_b": jnp.zeros((3 * h,)),
             "out_w": norm(k[1], (h, h)),
             "out_b": jnp.zeros((h,)),
             "ln1": {"g": jnp.ones((h,)), "b": jnp.zeros((h,))},
-            "ffn_in_w": norm(k[2], (h, f)),
-            "ffn_in_b": jnp.zeros((f,)),
-            "ffn_out_w": norm(k[3], (f, h)),
-            "ffn_out_b": jnp.zeros((h,)),
             "ln2": {"g": jnp.ones((h,)), "b": jnp.zeros((h,))},
-        })
+        }
+        if cfg.n_experts > 0:
+            from deeplearning4j_tpu.parallel.moe import moe_init
+
+            layer["moe"] = moe_init(k[2], h, f, cfg.n_experts)
+        else:
+            layer.update({
+                "ffn_in_w": norm(k[2], (h, f)),
+                "ffn_in_b": jnp.zeros((f,)),
+                "ffn_out_w": norm(k[3], (f, h)),
+                "ffn_out_b": jnp.zeros((h,)),
+            })
+        params["layers"].append(layer)
     return params
 
 
@@ -102,10 +117,17 @@ def param_specs(cfg: BertConfig) -> dict:
         "qkv_w": P(None, MODEL_AXIS), "qkv_b": P(MODEL_AXIS),
         "out_w": P(MODEL_AXIS, None), "out_b": P(),
         "ln1": {"g": P(), "b": P()},
-        "ffn_in_w": P(None, MODEL_AXIS), "ffn_in_b": P(MODEL_AXIS),
-        "ffn_out_w": P(MODEL_AXIS, None), "ffn_out_b": P(),
         "ln2": {"g": P(), "b": P()},
     }
+    if cfg.n_experts > 0:
+        from deeplearning4j_tpu.parallel.moe import moe_param_specs
+
+        layer["moe"] = moe_param_specs()
+    else:
+        layer.update({
+            "ffn_in_w": P(None, MODEL_AXIS), "ffn_in_b": P(MODEL_AXIS),
+            "ffn_out_w": P(MODEL_AXIS, None), "ffn_out_b": P(),
+        })
     return {
         "tok_emb": P(None, MODEL_AXIS),
         "pos_emb": P(),
@@ -184,53 +206,91 @@ def _attention(q, k, v, mesh, cfg: BertConfig):
     return fn(q, k, v)
 
 
-def forward(params, cfg: BertConfig, tokens, type_ids=None, mesh=None,
-            deterministic=True, rng=None):
-    """tokens: [B, T] int32 -> hidden states [B, T, H]."""
-    dtype = jnp.dtype(cfg.compute_dtype)
-    b, t = tokens.shape
+def encoder_layer(lp, x, cfg: BertConfig, mesh=None, li=0,
+                  deterministic=True, rng=None):
+    """One transformer encoder block (post-LN like original BERT).
+    x: [B, T, H] in compute dtype -> ([B, T, H], aux_loss scalar).
+    aux_loss is the MoE load-balancing loss (0.0 for dense FFN layers)."""
+    dtype = x.dtype
+    b, t = x.shape[0], x.shape[1]
+    nh, hd = cfg.num_heads, cfg.head_dim
+    qkv = x @ lp["qkv_w"].astype(dtype) + lp["qkv_b"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda a: jnp.transpose(  # noqa: E731
+        a.reshape(b, t, nh, hd), (0, 2, 1, 3))
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    att = _attention(q, k, v, mesh, cfg)
+    att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, t, nh * hd)
+    att = att @ lp["out_w"].astype(dtype) + lp["out_b"].astype(dtype)
+    if not deterministic and cfg.dropout > 0 and rng is not None:
+        att = _dropout(att, cfg.dropout, jax.random.fold_in(rng, 2 * li))
+    x = _layer_norm((x + att).astype(jnp.float32), lp["ln1"]["g"],
+                    lp["ln1"]["b"], cfg.layer_norm_eps).astype(dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in lp:
+        from deeplearning4j_tpu.parallel.moe import moe_apply
+
+        # gate_w stays f32: moe_apply's gating math runs in f32 and
+        # pre-truncating the gate weights to bf16 would move routing
+        # decisions near ties
+        mp = {k: (v if k == "gate_w" else v.astype(dtype))
+              for k, v in lp["moe"].items()}
+        hdn, aux = moe_apply(mp, x.reshape(b * t, -1), k=cfg.moe_k,
+                             capacity_factor=cfg.moe_capacity)
+        hdn = hdn.reshape(b, t, -1)
+        aux = aux.astype(jnp.float32)
+    else:
+        hdn = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dtype)
+                          + lp["ffn_in_b"].astype(dtype))
+        hdn = hdn @ lp["ffn_out_w"].astype(dtype) \
+            + lp["ffn_out_b"].astype(dtype)
+    if not deterministic and cfg.dropout > 0 and rng is not None:
+        hdn = _dropout(hdn, cfg.dropout,
+                       jax.random.fold_in(rng, 2 * li + 1))
+    x = _layer_norm((x + hdn).astype(jnp.float32), lp["ln2"]["g"],
+                    lp["ln2"]["b"], cfg.layer_norm_eps).astype(dtype)
+    return x, aux
+
+
+def embed(params, cfg: BertConfig, tokens, type_ids=None):
+    """tokens [B, T] -> embedded+LN'd activations [B, T, H] in compute
+    dtype."""
+    t = tokens.shape[1]
     x = params["tok_emb"][tokens]                       # [B,T,H] f32 gather
     x = x + params["pos_emb"][None, :t, :]
     if type_ids is not None:
         x = x + params["type_emb"][type_ids]
     x = _layer_norm(x, params["emb_ln"]["g"], params["emb_ln"]["b"],
                     cfg.layer_norm_eps)
-    x = x.astype(dtype)
-    nh, hd = cfg.num_heads, cfg.head_dim
+    return x.astype(jnp.dtype(cfg.compute_dtype))
 
+
+def forward_with_aux(params, cfg: BertConfig, tokens, type_ids=None,
+                     mesh=None, deterministic=True, rng=None):
+    """tokens: [B, T] int32 -> (hidden states [B, T, H], total MoE aux
+    loss)."""
+    x = embed(params, cfg, tokens, type_ids)
+    aux_total = jnp.zeros((), jnp.float32)
     for li, lp in enumerate(params["layers"]):
-        # attention (post-LN like original BERT)
-        qkv = x @ lp["qkv_w"].astype(dtype) + lp["qkv_b"].astype(dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda a: jnp.transpose(  # noqa: E731
-            a.reshape(b, t, nh, hd), (0, 2, 1, 3))
-        q, k, v = to_heads(q), to_heads(k), to_heads(v)
-        att = _attention(q, k, v, mesh, cfg)
-        att = jnp.transpose(att, (0, 2, 1, 3)).reshape(b, t, nh * hd)
-        att = att @ lp["out_w"].astype(dtype) + lp["out_b"].astype(dtype)
-        if not deterministic and cfg.dropout > 0 and rng is not None:
-            att = _dropout(att, cfg.dropout, jax.random.fold_in(rng, 2 * li))
-        x = _layer_norm((x + att).astype(jnp.float32), lp["ln1"]["g"],
-                        lp["ln1"]["b"], cfg.layer_norm_eps).astype(dtype)
-        # FFN
-        hdn = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dtype)
-                          + lp["ffn_in_b"].astype(dtype))
-        hdn = hdn @ lp["ffn_out_w"].astype(dtype) \
-            + lp["ffn_out_b"].astype(dtype)
-        if not deterministic and cfg.dropout > 0 and rng is not None:
-            hdn = _dropout(hdn, cfg.dropout,
-                           jax.random.fold_in(rng, 2 * li + 1))
-        x = _layer_norm((x + hdn).astype(jnp.float32), lp["ln2"]["g"],
-                        lp["ln2"]["b"], cfg.layer_norm_eps).astype(dtype)
-    return x
+        x, aux = encoder_layer(lp, x, cfg, mesh=mesh, li=li,
+                               deterministic=deterministic, rng=rng)
+        aux_total = aux_total + aux
+    return x, aux_total
+
+
+def forward(params, cfg: BertConfig, tokens, type_ids=None, mesh=None,
+            deterministic=True, rng=None):
+    """tokens: [B, T] int32 -> hidden states [B, T, H]."""
+    return forward_with_aux(params, cfg, tokens, type_ids, mesh,
+                            deterministic, rng)[0]
 
 
 def mlm_loss(params, cfg: BertConfig, tokens, labels, mesh=None,
              deterministic=False, rng=None):
     """Masked-LM loss; labels = -100 for unmasked positions (ignored).
     LM head ties tok_emb."""
-    hs = forward(params, cfg, tokens, mesh=mesh,
-                 deterministic=deterministic, rng=rng)
+    hs, aux = forward_with_aux(params, cfg, tokens, mesh=mesh,
+                               deterministic=deterministic, rng=rng)
     logits = (hs.astype(jnp.float32) @ params["tok_emb"].T
               + params["mlm_bias"])
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -238,7 +298,8 @@ def mlm_loss(params, cfg: BertConfig, tokens, labels, mesh=None,
     safe = jnp.where(valid, labels, 0)
     tok_lp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     n = jnp.maximum(jnp.sum(valid), 1)
-    return -jnp.sum(jnp.where(valid, tok_lp, 0.0)) / n
+    loss = -jnp.sum(jnp.where(valid, tok_lp, 0.0)) / n
+    return loss + cfg.moe_aux_weight * aux
 
 
 def mlm_loss_masked(params, cfg: BertConfig, tokens, positions, mlm_labels,
@@ -251,8 +312,8 @@ def mlm_loss_masked(params, cfg: BertConfig, tokens, positions, mlm_labels,
 
     positions [B,M] int32, mlm_labels [B,M] int32, weights [B,M] f32
     (0 = padding when a row has fewer than M masked tokens)."""
-    hs = forward(params, cfg, tokens, mesh=mesh,
-                 deterministic=deterministic, rng=rng)
+    hs, aux = forward_with_aux(params, cfg, tokens, mesh=mesh,
+                               deterministic=deterministic, rng=rng)
     gathered = jnp.take_along_axis(hs, positions[..., None], axis=1)
     # bf16 x bf16 MXU matmul with f32 accumulation
     logits = jnp.einsum(
@@ -262,7 +323,16 @@ def mlm_loss_masked(params, cfg: BertConfig, tokens, positions, mlm_labels,
     tok_lp = jnp.take_along_axis(logp, mlm_labels[..., None],
                                  axis=-1)[..., 0]
     n = jnp.maximum(jnp.sum(weights), 1.0)
-    return -jnp.sum(tok_lp * weights) / n
+    loss = -jnp.sum(tok_lp * weights) / n
+    return loss + cfg.moe_aux_weight * aux
+
+
+def mlm_max_preds(seq_len):
+    """Stable masked-slot count (like TF BERT max_predictions_per_seq) so
+    the executable shape never depends on the random mask draw. Shared by
+    BertTrainer and BertPipelineTrainer — their step-for-step parity
+    depends on the identical formula."""
+    return max(1, int(0.15 * seq_len) + 1)
 
 
 def mlm_gather(labels, max_preds=None):
@@ -422,9 +492,7 @@ class BertTrainer:
         return loss
 
     def _max_preds(self, seq_len):
-        """Stable masked-slot count (like TF BERT max_predictions_per_seq)
-        so the executable shape never depends on the random mask draw."""
-        return max(1, int(0.15 * seq_len) + 1)
+        return mlm_max_preds(seq_len)
 
 
 def synthetic_mlm_batch(cfg: BertConfig, batch, seq_len, seed=0,

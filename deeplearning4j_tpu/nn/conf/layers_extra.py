@@ -661,3 +661,65 @@ class OCNNOutputLayer(LossLayer):
     def compute_loss(self, params, x, labels, mask=None):
         loss, _ = self.compute_loss_with_state(params, x, labels, mask)
         return loss
+
+
+# ---------------------------------------------------------------------------
+# mixture-of-experts
+# ---------------------------------------------------------------------------
+
+@_register
+class MoELayer(BaseLayer):
+    """Mixture-of-Experts FFN block usable anywhere in a
+    MultiLayerNetwork: GShard/Switch top-k gating over nExperts expert
+    FFNs [nIn -> ffnSize -> nOut], capacity-bounded with overflow drop.
+
+    The load-balancing aux loss (Switch Transformer eq. 4, scaled by
+    auxWeight) rides the layer-state channel: apply() stores it under
+    "_aux_loss" and MultiLayerNetwork adds every layer's _aux_loss to the
+    training objective. No reference analog (SURVEY.md §2.6 marks expert
+    parallel "NO") — additive capability; the expert axis shards over an
+    `expert` mesh when trained under ShardedTrainer/BertTrainer-style
+    GSPMD jits, and runs as E batched einsums on one device otherwise.
+    """
+
+    def __init__(self, nIn=None, nOut=None, ffnSize=None, nExperts=4,
+                 topK=2, capacityFactor=1.5, auxWeight=1e-2, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.ffnSize = ffnSize
+        self.nExperts = int(nExperts)
+        self.topK = int(topK)
+        self.capacityFactor = float(capacityFactor)
+        self.auxWeight = float(auxWeight)
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        self.nOut = self.nOut or self.nIn
+        self.ffnSize = self.ffnSize or 4 * self.nIn
+        from deeplearning4j_tpu.nn.conf.inputs import InputType as _IT
+
+        return _IT.feedForward(self.nOut)
+
+    def init_params(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 3)
+        e, h, f, o = self.nExperts, self.nIn, self.ffnSize, self.nOut
+        wi = self.weightInit
+        return {
+            "gate_w": init_weight(wi, ks[0], (h, e), h, e, dtype),
+            "w1": init_weight(wi, ks[1], (e, h, f), h, f, dtype),
+            "b1": jnp.zeros((e, f), dtype),
+            "w2": init_weight(wi, ks[2], (e, f, o), f, o, dtype),
+            "b2": jnp.zeros((e, o), dtype),
+        }
+
+    def init_state(self, dtype=jnp.float32):
+        return {"_aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, x, training, rng):
+        from deeplearning4j_tpu.parallel.moe import moe_apply
+
+        y, aux = moe_apply(params, x, k=self.topK,
+                           capacity_factor=self.capacityFactor)
+        return self._act(y), {
+            "_aux_loss": self.auxWeight * aux.astype(jnp.float32)}

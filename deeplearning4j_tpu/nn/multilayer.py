@@ -141,6 +141,14 @@ class MultiLayerNetwork:
                 params[out_idx], h, l, mask, states[out_idx])
         else:
             loss = out_layer.compute_loss(params[out_idx], h, l, mask)
+        # hidden-layer aux-loss channel: any layer may store a scalar under
+        # "_aux_loss" in its state (e.g. MoELayer's load-balancing loss);
+        # summed into the training objective so gradients flow through the
+        # layer's forward computation
+        if training:
+            for st in new_states:
+                if isinstance(st, dict) and "_aux_loss" in st:
+                    loss = loss + st["_aux_loss"]
         # L1/L2 regularization per layer (reference: BaseLayer.calcRegularizationScore)
         reg = 0.0
         for i, lr in enumerate(self.layers):

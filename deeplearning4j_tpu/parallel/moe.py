@@ -55,8 +55,10 @@ def moe_apply(params, x, k: int = 2, capacity_factor: float = 1.5):
     e = params["gate_w"].shape[1]
     c = int(math.ceil(k * n / e * capacity_factor))
 
-    logits = x @ params["gate_w"]                     # [N, E]
-    probs = jax.nn.softmax(logits, axis=-1)
+    # gating math in f32 regardless of activation dtype: routing decisions
+    # and the aux loss are tiny tensors but precision-sensitive
+    logits = x.astype(jnp.float32) @ params["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)           # [N, E] f32
 
     # load-balancing aux loss: E * sum_e (frac tokens to e * mean prob e)
     top1 = jnp.argmax(probs, axis=-1)
@@ -76,10 +78,13 @@ def moe_apply(params, x, k: int = 2, capacity_factor: float = 1.5):
     pos = jnp.sum(pos_flat.reshape(n, k, e) * oh, axis=-1)  # [N, k]
     keep = pos < c                                    # capacity mask
 
-    # dense dispatch/combine tensors [N, E, C]
-    pos_oh = jax.nn.one_hot(pos, c, dtype=x.dtype) * keep[..., None]
-    disp = jnp.einsum("nke,nkc->nec", oh.astype(x.dtype), pos_oh)
-    comb = jnp.einsum("nk,nke,nkc->nec", topk_p, oh.astype(x.dtype), pos_oh)
+    # dense dispatch/combine tensors [N, E, C] in the activation dtype
+    # (these feed the big MXU einsums)
+    pos_oh = jax.nn.one_hot(pos, c, dtype=jnp.float32) * keep[..., None]
+    disp = jnp.einsum("nke,nkc->nec", oh.astype(jnp.float32),
+                      pos_oh).astype(x.dtype)
+    comb = jnp.einsum("nk,nke,nkc->nec", topk_p, oh.astype(jnp.float32),
+                      pos_oh).astype(x.dtype)
 
     # to experts, through the FFN, back — XLA turns the sharded-E einsums
     # into all-to-alls over the expert axis

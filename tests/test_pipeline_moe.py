@@ -109,3 +109,188 @@ class TestMoE:
         collapsed["gate_w"] = jnp.asarray(gw)
         _, aux_collapsed = moe_apply(collapsed, x)
         assert float(aux_collapsed) > float(aux_uniform)
+
+
+# ---------------------------------------------------------------------------
+# Round 3: pipeline + MoE on the FLAGSHIP (VERDICT round-2 item 2)
+# ---------------------------------------------------------------------------
+
+
+class TestBertPipeline:
+    """BERT trained through the GPipe pipeline must match the single-
+    device BertTrainer loss curve step for step."""
+
+    def _cfg(self, n_layers=4):
+        from deeplearning4j_tpu.models.bert import BertConfig
+
+        return BertConfig(vocab_size=64, hidden=16, num_layers=n_layers,
+                          num_heads=2, ffn=32, max_len=32, dropout=0.0,
+                          compute_dtype="float32")
+
+    def test_loss_curve_matches_single_device(self):
+        from deeplearning4j_tpu.models.bert import (
+            BertTrainer, synthetic_mlm_batch)
+        from deeplearning4j_tpu.models.bert_pipeline import (
+            BertPipelineTrainer)
+
+        cfg = self._cfg()
+        mesh_pp = MeshConfig(data=2, pipe=2, devices=jax.devices()[:4]).build()
+        mesh_1 = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+        pp = BertPipelineTrainer(cfg, mesh_pp, microbatches=2, lr=1e-3,
+                                 seed=7)
+        single = BertTrainer(cfg, mesh_1, lr=1e-3, seed=7)
+        toks, labs = synthetic_mlm_batch(cfg, 8, 16, seed=0)
+        for step in range(3):
+            l_pp = float(pp.train_step(toks, labs))
+            l_1 = float(single.train_step(toks, labs))
+            assert l_pp == pytest.approx(l_1, rel=2e-4), (step, l_pp, l_1)
+
+    def test_stack_round_trip(self):
+        from deeplearning4j_tpu.models.bert import BertConfig, init_params
+        from deeplearning4j_tpu.models.bert_pipeline import (
+            stack_layer_params, unstack_layer_params)
+
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.key(0))
+        _, stacked = stack_layer_params(cfg, params, 2)
+        layers = unstack_layer_params(stacked)
+        assert len(layers) == cfg.num_layers
+        for orig, rt in zip(params["layers"], layers):
+            for k in orig:
+                np.testing.assert_allclose(
+                    np.asarray(jax.tree_util.tree_leaves(orig[k])[0]),
+                    np.asarray(jax.tree_util.tree_leaves(rt[k])[0]))
+
+    def test_indivisible_layers_raise(self):
+        from deeplearning4j_tpu.models.bert import BertConfig, init_params
+        from deeplearning4j_tpu.models.bert_pipeline import (
+            stack_layer_params)
+
+        cfg = self._cfg(n_layers=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            stack_layer_params(cfg, init_params(cfg, jax.random.key(0)), 2)
+
+
+class TestBertMoE:
+    """MoE-FFN BERT variant trains through the unchanged BertTrainer with
+    experts sharded over the expert axis."""
+
+    def _cfg(self, n_experts):
+        from deeplearning4j_tpu.models.bert import BertConfig
+
+        return BertConfig(vocab_size=64, hidden=16, num_layers=2,
+                          num_heads=2, ffn=32, max_len=32, dropout=0.0,
+                          compute_dtype="float32", n_experts=n_experts)
+
+    def test_dp_ep_matches_single_device(self):
+        from deeplearning4j_tpu.models.bert import (
+            BertTrainer, synthetic_mlm_batch)
+
+        cfg = self._cfg(4)
+        mesh_ep = MeshConfig(data=2, expert=2,
+                             devices=jax.devices()[:4]).build()
+        mesh_1 = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+        ep = BertTrainer(cfg, mesh_ep, lr=1e-3, seed=3)
+        single = BertTrainer(cfg, mesh_1, lr=1e-3, seed=3)
+        toks, labs = synthetic_mlm_batch(cfg, 8, 16, seed=0)
+        for step in range(3):
+            l_ep = float(ep.train_step(toks, labs))
+            l_1 = float(single.train_step(toks, labs))
+            assert l_ep == pytest.approx(l_1, rel=2e-4), (step, l_ep, l_1)
+
+    def test_loss_includes_aux(self):
+        from deeplearning4j_tpu.models.bert import (
+            init_params, mlm_gather, mlm_loss_masked, synthetic_mlm_batch)
+        import dataclasses
+
+        cfg = self._cfg(4)
+        params = init_params(cfg, jax.random.key(0))
+        toks, labs = synthetic_mlm_batch(cfg, 4, 16, seed=0)
+        pos, lab, w = mlm_gather(labs)
+        base = float(mlm_loss_masked(params, cfg, toks, pos, lab, w,
+                                     deterministic=True))
+        noaux = dataclasses.replace(cfg, moe_aux_weight=0.0)
+        off = float(mlm_loss_masked(params, noaux, toks, pos, lab, w,
+                                    deterministic=True))
+        assert base != pytest.approx(off, abs=1e-9)
+
+    def test_gate_params_train(self):
+        from deeplearning4j_tpu.models.bert import (
+            BertTrainer, synthetic_mlm_batch)
+
+        cfg = self._cfg(4)
+        mesh = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+        tr = BertTrainer(cfg, mesh, lr=1e-2, seed=0)
+        g0 = np.asarray(jax.device_get(
+            tr.params["layers"][0]["moe"]["gate_w"])).copy()
+        toks, labs = synthetic_mlm_batch(cfg, 4, 16, seed=0)
+        for _ in range(3):
+            tr.train_step(toks, labs)
+        g1 = np.asarray(jax.device_get(
+            tr.params["layers"][0]["moe"]["gate_w"]))
+        assert np.abs(g1 - g0).max() > 0
+
+
+class TestMoELayerDSL:
+    """MoELayer as a conf-DSL layer inside MultiLayerNetwork, aux loss via
+    the layer-state channel."""
+
+    def _net(self, aux_weight=1e-2):
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, InputType, MoELayer, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(5e-3))
+                .list()
+                .layer(DenseLayer.Builder(nOut=16, activation="relu").build())
+                .layer(MoELayer.Builder().nOut(16).ffnSize(32).nExperts(4)
+                       .topK(2).auxWeight(aux_weight).build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .build())
+                .setInputType(InputType.feedForward(8))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    def test_trains(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net = self._net()
+        s0 = net.score((X, y))
+        net.fit([(X, y)] * 40)
+        assert net.score((X, y)) < s0
+
+    def test_aux_loss_in_objective(self):
+        """Gate weights must receive gradient through the aux loss: with
+        top-k routing the combine path also feeds the gate, so instead
+        compare the training objective with aux on vs off."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net_on = self._net(aux_weight=0.5)
+        net_off = self._net(aux_weight=0.0)
+        # identical params (same seed); objectives must differ by the aux
+        # term during TRAINING (score() is eval-mode and excludes it)
+        from deeplearning4j_tpu.datasets import DataSet
+
+        ds = DataSet(X, y)
+        net_on.fit([ds])
+        net_off.fit([ds])
+        w_on = np.asarray(jax.device_get(net_on._params[1]["gate_w"]))
+        w_off = np.asarray(jax.device_get(net_off._params[1]["gate_w"]))
+        assert np.abs(w_on - w_off).max() > 0
+
+    def test_serialization_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.utils import ModelSerializer
+
+        net = self._net()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(8, 8)).astype(np.float32)
+        y_before = net.output(X)
+        p = str(tmp_path / "moe_net.zip")
+        ModelSerializer.writeModel(net, p, True)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p)
+        np.testing.assert_allclose(net2.output(X), y_before, rtol=1e-5)
