@@ -495,6 +495,30 @@ def _random_bernoulli(shape=None, p=0.5, key=None):
     return jax.random.bernoulli(key, p, tuple(shape)).astype(jnp.float32)
 
 
+@op("randomGamma", random=True)
+def _random_gamma(shape=None, alpha=1.0, beta=1.0, key=None):
+    """Gamma(alpha, rate beta) (reference: random ops gamma declarable)."""
+    return jax.random.gamma(key, alpha, tuple(shape)) / beta
+
+
+@op("randomPoisson", random=True)
+def _random_poisson(shape=None, lam=1.0, key=None):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(jnp.float32)
+
+
+@op("randomExponential", random=True)
+def _random_exponential(shape=None, lam=1.0, key=None):
+    return jax.random.exponential(key, tuple(shape)) / lam
+
+
+@op("truncatedNormal", random=True)
+def _truncated_normal(shape=None, mean=0.0, stddev=1.0, key=None):
+    """Normal truncated to +/-2 sigma (TF/DL4J truncated_normal
+    semantics)."""
+    return mean + stddev * jax.random.truncated_normal(
+        key, -2.0, 2.0, tuple(shape))
+
+
 # ---------------------------------------------------------------------------
 # conv / pool (NCHW, weights [out, in, kH, kW] like libnd4j conv2d)
 # ---------------------------------------------------------------------------
@@ -622,6 +646,62 @@ def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding=(0, 0),
         s = _pool(x, kernel, strides, padding, sameMode, 0.0, lax.add)
         return s / (k[0] * k[1])
     return _pool(x, kernel, strides, padding, sameMode, 0.0, lax.add, norm=True)
+
+
+def _triple_(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(a) for a in v)
+    return (int(v),) * 3
+
+
+@op("conv3d")
+def _conv3d_op(x, w, b=None, strides=(1, 1, 1), padding=(0, 0, 0),
+               dilation=(1, 1, 1), sameMode=False):
+    """x: [N,C,D,H,W]; w: [outC, inC, kD, kH, kW] (op-level conv3d —
+    reference: libnd4j conv3dnew declarable; the Convolution3D LAYER
+    wraps the same lowering)."""
+    strides = _triple_(strides)
+    dilation = _triple_(dilation)
+    if sameMode:
+        pad = "SAME"
+    else:
+        p = _triple_(padding)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1, 1)
+    return y
+
+
+def _pool3d(x, kernel, strides, padding, sameMode, init, fn, norm=False):
+    k = _triple_(kernel)
+    s = _triple_(strides)
+    p = _triple_(padding)
+    pad = "SAME" if sameMode else (
+        (0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    window = (1, 1) + k
+    strides_full = (1, 1) + s
+    y = lax.reduce_window(x, init, fn, window, strides_full, pad)
+    if norm:
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                strides_full, pad)
+        y = y / cnt
+    return y
+
+
+@op("maxPooling3d")
+def _max_pool3d(x, kernel=(2, 2, 2), strides=(2, 2, 2), padding=(0, 0, 0),
+                sameMode=False):
+    return _pool3d(x, kernel, strides, padding, sameMode, -jnp.inf, lax.max)
+
+
+@op("avgPooling3d")
+def _avg_pool3d(x, kernel=(2, 2, 2), strides=(2, 2, 2), padding=(0, 0, 0),
+                sameMode=False):
+    return _pool3d(x, kernel, strides, padding, sameMode, 0.0, lax.add,
+                   norm=True)
 
 
 @op("globalAvgPooling")
@@ -1148,13 +1228,25 @@ OPS["zeroFraction"] = lambda x: jnp.mean((x == 0).astype(jnp.float32))
 
 @op("imageResize")
 def _image_resize(x, height, width, method="bilinear", antialias=False):
-    """x: [N,C,H,W] (DL4J layout); method: bilinear|nearest|cubic.
-    antialias defaults OFF to match the TF/DL4J resize ops this mirrors
-    (jax.image.resize's own default is antialias=True)."""
+    """x: [N,C,H,W] (DL4J layout); method: bilinear|nearest|cubic|
+    lanczos3|lanczos5|area. antialias defaults OFF to match the TF/DL4J
+    resize ops this mirrors (jax.image.resize's own default is
+    antialias=True). `area` averages exact input regions and requires
+    integer downscale factors (the TF ResizeArea fast path)."""
+    height, width = int(height), int(width)
+    n, c, h, w = x.shape
+    m = str(method).lower()
+    if m == "area":
+        if h % height or w % width:
+            raise ValueError(
+                f"imageResize method='area' needs integer downscale "
+                f"factors, got {h}x{w} -> {height}x{width}")
+        fh, fw = h // height, w // width
+        return x.reshape(n, c, height, fh, width, fw).mean(axis=(3, 5))
     meth = {"bilinear": "bilinear", "nearest": "nearest",
-            "cubic": "cubic", "bicubic": "cubic"}[str(method).lower()]
-    n, c = x.shape[0], x.shape[1]
-    return jax.image.resize(x, (n, c, int(height), int(width)), meth,
+            "cubic": "cubic", "bicubic": "cubic",
+            "lanczos3": "lanczos3", "lanczos5": "lanczos5"}[m]
+    return jax.image.resize(x, (n, c, height, width), meth,
                             antialias=antialias)
 
 
@@ -1233,3 +1325,120 @@ OPS["expm1"] = jnp.expm1
 OPS["asinh"] = jnp.arcsinh
 OPS["acosh"] = jnp.arccosh
 OPS["atanh"] = jnp.arctanh
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: libnd4j ctc_loss declarable / SameDiff ctcLoss).
+# TPU-first design: the forward (alpha) recursion in log space as ONE
+# lax.scan over time — no per-timestep host dispatch, fully batched,
+# differentiable by jax.grad (the reference ships a hand-written
+# ctcLossGrad; reverse-mode through the scan supplies it here).
+# ---------------------------------------------------------------------------
+
+@op("ctcLoss")
+def _ctc_loss(targetLabels, logitInput, targetLabelLengths=None,
+              logitInputLengths=None, blankIndex=0):
+    """targetLabels: [B, U] int labels (padded); logitInput: [B, T, C]
+    UNNORMALIZED logits; lengths: [B] ints. Returns per-example negative
+    log likelihood [B]."""
+    labels = jnp.asarray(targetLabels, jnp.int32)
+    logits = logitInput
+    b, u = labels.shape
+    t_max, c = logits.shape[1], logits.shape[2]
+    if targetLabelLengths is None:
+        targetLabelLengths = jnp.full((b,), u, jnp.int32)
+    if logitInputLengths is None:
+        logitInputLengths = jnp.full((b,), t_max, jnp.int32)
+    lab_len = jnp.asarray(targetLabelLengths, jnp.int32)
+    log_len = jnp.asarray(logitInputLengths, jnp.int32)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    s = 2 * u + 1
+    neg_inf = jnp.float32(-1e30)
+    # extended sequence [blank, l1, blank, ..., lU, blank]
+    ext = jnp.full((b, s), blankIndex, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    is_lab = jnp.arange(s) % 2 == 1
+    ext_m2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    allow_skip = is_lab[None, :] & (ext != ext_m2)
+
+    def lp_ext(t_lp):
+        return jnp.take_along_axis(t_lp, ext, axis=1)  # [B, S]
+
+    alpha0 = jnp.full((b, s), neg_inf)
+    first = lp_ext(lp[:, 0])
+    alpha0 = alpha0.at[:, 0].set(first[:, 0])
+    if s > 1:
+        alpha0 = alpha0.at[:, 1].set(first[:, 1])
+
+    def step(alpha, inputs):
+        t_lp, t_idx = inputs
+        a1 = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate(
+            [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(allow_skip, a2, neg_inf)
+        stacked = jnp.stack([alpha, a1, a2])
+        new = jax.scipy.special.logsumexp(stacked, axis=0) + lp_ext(t_lp)
+        # freeze past each example's input length
+        live = (t_idx < log_len)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    alpha, _ = lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(lp[:, 1:], 1, 0), jnp.arange(1, t_max)))
+
+    end = 2 * lab_len  # index of final blank state
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_last = jnp.take_along_axis(
+        alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    a_last = jnp.where(lab_len > 0, a_last, neg_inf)
+    return -jax.scipy.special.logsumexp(
+        jnp.stack([a_end, a_last]), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# non-max suppression as a REGISTERED op (reference: libnd4j
+# non_max_suppression declarable; the host-side YoloUtils path remains
+# for detection post-processing, this one is jittable in-graph)
+# ---------------------------------------------------------------------------
+
+@op("nonMaxSuppression")
+def _non_max_suppression(boxes, scores, maxOutputSize=10,
+                         iouThreshold=0.5, scoreThreshold=None):
+    """boxes [N,4] (y1,x1,y2,x2), scores [N] -> selected indices
+    [maxOutputSize] int32, padded with -1 (static shape for jit)."""
+    n = boxes.shape[0]
+    k = int(maxOutputSize)
+    y1, x1, y2, x2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    inter = (jnp.maximum(iy2 - iy1, 0) * jnp.maximum(ix2 - ix1, 0))
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+
+    live = jnp.ones((n,), bool)
+    if scoreThreshold is not None:
+        live = live & (scores >= scoreThreshold)
+
+    def body(i, carry):
+        live, out = carry
+        masked = jnp.where(live, scores, -jnp.inf)
+        idx = jnp.argmax(masked)
+        ok = masked[idx] > -jnp.inf
+        out = out.at[i].set(jnp.where(ok, idx.astype(jnp.int32), -1))
+        # drop the pick and everything overlapping it — STRICTLY above
+        # the threshold (TF/libnd4j semantics: iou > threshold
+        # suppresses; boundary-equal survives)
+        suppress = iou[idx] > iouThreshold
+        live = live & ~suppress & ok
+        live = live.at[idx].set(False)
+        return live, out
+
+    _, out = lax.fori_loop(0, k, body,
+                           (live, jnp.full((k,), -1, jnp.int32)))
+    return out

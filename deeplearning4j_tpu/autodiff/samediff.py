@@ -93,22 +93,37 @@ class SubGraph:
 
         return call
 
-    def to_dict(self) -> dict:
+    def to_dict(self, value_sink=None, prefix="") -> dict:
+        """Serializable dict. Child-graph VALUES (captured constants can
+        be weight-matrix sized) go into `value_sink` — the parent's npz
+        dict — under prefixed keys, not into the JSON; the tiny scalar
+        fallback inlines them when no sink is provided (in-memory use)."""
         d = self.graph._graph_dict()
-        d["values"] = {
-            k: {"dtype": str(np.dtype(v.dtype)),
-                "data": np.asarray(v).tolist()}
-            for k, v in self.graph._values.items()
-        }
+        if value_sink is not None:
+            d["value_keys"] = {}
+            for k, v in self.graph._values.items():
+                sk = f"{prefix}{k}"
+                value_sink[sk] = np.asarray(v)
+                d["value_keys"][k] = sk
+        else:
+            d["values"] = {
+                k: {"dtype": str(np.dtype(v.dtype)),
+                    "data": np.asarray(v).tolist()}
+                for k, v in self.graph._values.items()
+            }
         return {"args": self.arg_names, "outs": self.out_names,
                 "graph": d}
 
     @staticmethod
-    def from_dict(d: dict) -> "SubGraph":
+    def from_dict(d: dict, value_source=None) -> "SubGraph":
         child = SameDiff._from_graph_dict(d["graph"])
-        for k, spec in d["graph"]["values"].items():
-            child._values[k] = jnp.asarray(
-                np.asarray(spec["data"], np.dtype(spec["dtype"])))
+        if "value_keys" in d["graph"]:
+            for k, sk in d["graph"]["value_keys"].items():
+                child._values[k] = jnp.asarray(value_source[sk])
+        else:
+            for k, spec in d["graph"].get("values", {}).items():
+                child._values[k] = jnp.asarray(
+                    np.asarray(spec["data"], np.dtype(spec["dtype"])))
         return SubGraph(child, d["args"], d["outs"])
 
 
@@ -425,8 +440,9 @@ class SDNN(_Namespace):
 
 class SDCNN(_Namespace):
     _passthrough = (
-        "conv2d", "conv1d", "depthwiseConv2d", "deconv2d", "maxPooling2d",
-        "avgPooling2d", "globalAvgPooling", "upsampling2d", "im2col",
+        "conv2d", "conv1d", "conv3d", "depthwiseConv2d", "deconv2d",
+        "maxPooling2d", "avgPooling2d", "maxPooling3d", "avgPooling3d",
+        "globalAvgPooling", "upsampling2d", "im2col",
     )
 
 
@@ -440,7 +456,7 @@ class SDLoss(_Namespace):
         "softmaxCrossEntropy", "sparseSoftmaxCrossEntropy",
         "sigmoidCrossEntropy", "meanSquaredError", "absoluteDifference",
         "huberLoss", "logLoss", "hingeLoss", "cosineDistance",
-        "klDivergence",
+        "klDivergence", "ctcLoss",
     )
 
     def __getattr__(self, item):
@@ -472,6 +488,7 @@ class SDImage(_Namespace):
     _passthrough = (
         "imageResize", "extractImagePatches", "spaceToDepth",
         "depthToSpace", "spaceToBatch", "batchToSpace",
+        "nonMaxSuppression",
     )
 
 
@@ -489,6 +506,26 @@ class SDRandom(_Namespace):
     def bernoulli(self, p, *shape, name=None):
         return self.sd._op(
             "randomBernoulli", [], {"shape": list(shape), "p": p}, name=name)
+
+    def gamma(self, alpha, beta, *shape, name=None):
+        return self.sd._op(
+            "randomGamma", [], {"shape": list(shape), "alpha": alpha,
+                                "beta": beta}, name=name)
+
+    def poisson(self, lam, *shape, name=None):
+        return self.sd._op(
+            "randomPoisson", [], {"shape": list(shape), "lam": lam},
+            name=name)
+
+    def exponential(self, lam, *shape, name=None):
+        return self.sd._op(
+            "randomExponential", [], {"shape": list(shape), "lam": lam},
+            name=name)
+
+    def truncatedNormal(self, mean, stddev, *shape, name=None):
+        return self.sd._op(
+            "truncatedNormal", [], {"shape": list(shape), "mean": mean,
+                                    "stddev": stddev}, name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -1088,7 +1125,7 @@ class SameDiff:
     # -- serde (reference: SameDiff.save/load flatbuffers .fb; here a zip of
     # graph JSON + npz values, same round-trip capability, SURVEY.md §5;
     # control-flow bodies serialize as nested sub-graph dicts) ------------
-    def _graph_dict(self) -> dict:
+    def _graph_dict(self, value_sink=None) -> dict:
         return {
             "variables": [
                 {
@@ -1101,14 +1138,15 @@ class SameDiff:
             ],
             "ops": [
                 {"fn": o.fn_name, "inputs": o.inputs, "outputs": o.outputs,
-                 "attrs": _json_attrs(o.attrs)}
-                for o in self._ops
+                 "attrs": _json_attrs(o.attrs, value_sink,
+                                      prefix=f"__sub__/op{i}/")}
+                for i, o in enumerate(self._ops)
             ],
             "lossVariables": self._loss_vars,
         }
 
     @staticmethod
-    def _from_graph_dict(graph: dict) -> "SameDiff":
+    def _from_graph_dict(graph: dict, value_source=None) -> "SameDiff":
         sd = SameDiff()
         for vd in graph["variables"]:
             v = SDVariable(
@@ -1119,14 +1157,18 @@ class SameDiff:
             sd._vars[vd["name"]] = v
         for i, od in enumerate(graph["ops"]):
             sd._ops.append(Op(od["fn"], od["inputs"], od["outputs"],
-                              _attrs_from_json(od["attrs"])))
+                              _attrs_from_json(od["attrs"], value_source)))
             for on in od["outputs"]:
                 sd._producer[on] = i
         sd._loss_vars = graph.get("lossVariables", [])
         return sd
 
     def save(self, path: str, saveUpdaterState: bool = False):
-        graph = self._graph_dict()
+        # control-flow sub-graph values (captured constants can be weight-
+        # sized) ride the binary npz under "__sub__/"-prefixed keys, not
+        # the JSON
+        vals = {k: np.asarray(v) for k, v in self._values.items()}
+        graph = self._graph_dict(value_sink=vals)
         graph.update({
             "trainingConfig": (self.trainingConfig.to_json()
                                if self.trainingConfig else None),
@@ -1137,7 +1179,7 @@ class SameDiff:
         with zipfile.ZipFile(path, "w") as zf:
             zf.writestr("graph.json", json.dumps(graph, indent=1))
             buf = io.BytesIO()
-            np.savez(buf, **{k: np.asarray(v) for k, v in self._values.items()})
+            np.savez(buf, **vals)
             zf.writestr("values.npz", buf.getvalue())
             if saveUpdaterState and self._updater_state is not None:
                 leaves, treedef = jax.tree_util.tree_flatten(self._updater_state)
@@ -1153,9 +1195,10 @@ class SameDiff:
         with zipfile.ZipFile(path) as zf:
             graph = json.loads(zf.read("graph.json"))
             values = np.load(io.BytesIO(zf.read("values.npz")))
-            sd = SameDiff._from_graph_dict(graph)
+            sd = SameDiff._from_graph_dict(graph, value_source=values)
             for k in values.files:
-                sd._values[k] = jnp.asarray(values[k])
+                if not k.startswith("__sub__/"):
+                    sd._values[k] = jnp.asarray(values[k])
             sd._step = graph.get("step", 0)
             if graph.get("trainingConfig"):
                 sd.trainingConfig = TrainingConfig.from_json(
@@ -1209,7 +1252,7 @@ class _BatchOutputBuilder:
         return self.execute()
 
 
-def _json_attrs(attrs: dict) -> dict:
+def _json_attrs(attrs: dict, value_sink=None, prefix="") -> dict:
     # callables whose sub-graph representation exists serialize as the
     # graph; a callable WITHOUT one is a non-traceable body -> still a
     # hard error (same boundary the reference draws at FlatBuffers
@@ -1221,7 +1264,8 @@ def _json_attrs(attrs: dict) -> dict:
         if k in graph_backed:
             continue  # rebuilt from the sub-graph on load
         if isinstance(v, SubGraph):
-            out[k] = {"__subgraph__": v.to_dict()}
+            out[k] = {"__subgraph__": v.to_dict(value_sink,
+                                                prefix=f"{prefix}{k}/")}
             continue
         if callable(v):
             raise ValueError(
@@ -1245,13 +1289,13 @@ def _json_attrs(attrs: dict) -> dict:
     return out
 
 
-def _attrs_from_json(attrs: dict) -> dict:
+def _attrs_from_json(attrs: dict, value_source=None) -> dict:
     """Inverse of _json_attrs: rebuild SubGraph bodies and their runtime
     callables from nested sub-graph dicts."""
     out = {}
     for k, v in attrs.items():
         if isinstance(v, dict) and "__subgraph__" in v:
-            sub = SubGraph.from_dict(v["__subgraph__"])
+            sub = SubGraph.from_dict(v["__subgraph__"], value_source)
             out[k] = sub
             fn_key, squeeze = _SUBGRAPH_ATTRS[k]
             out[fn_key] = sub.callable(squeeze=squeeze)

@@ -289,6 +289,7 @@ class AttrValue:
         self.shape = kw.get("shape")
         self.tensor = kw.get("tensor")
         self.list = kw.get("list")  # dict of name -> list
+        self.func = kw.get("func")  # function name (NameAttrList.name)
 
     @classmethod
     def decode(cls, buf):
@@ -310,6 +311,12 @@ class AttrValue:
                 self.shape = TensorShapeProto.decode(v)
             elif field == 8:
                 self.tensor = TensorProto.decode(v)
+            elif field == 10:
+                # NameAttrList {name=1, attr=2}: functional control flow
+                # (While/If) references its cond/body functions this way
+                for f2, _w2, v2 in iter_fields(v):
+                    if f2 == 1:
+                        self.func = bytes(v2).decode("utf-8")
         return self
 
     @staticmethod
@@ -366,6 +373,10 @@ class AttrValue:
             emit_bytes(out, 7, self.shape.encode())
         if self.tensor is not None:
             emit_bytes(out, 8, self.tensor.encode())
+        if self.func is not None:
+            nal = bytearray()
+            emit_bytes(nal, 1, self.func.encode("utf-8"))
+            emit_bytes(out, 10, nal)
         return bytes(out)
 
 
@@ -419,11 +430,110 @@ class NodeDef:
         return bytes(out)
 
 
-class GraphDef:
-    """graph.proto: node=1 (repeated NodeDef); versions/library ignored."""
+class ArgDef:
+    """op_def.proto ArgDef: name=1, type=3 (DataType)."""
 
-    def __init__(self, nodes=None):
+    def __init__(self, name="", type=DT_FLOAT):  # noqa: A002
+        self.name = name
+        self.type = type
+
+    @classmethod
+    def decode(cls, buf):
+        self = cls()
+        for field, _wt, v in iter_fields(buf):
+            if field == 1:
+                self.name = bytes(v).decode("utf-8")
+            elif field == 3:
+                self.type = v
+        return self
+
+    def encode(self):
+        out = bytearray()
+        emit_bytes(out, 1, self.name.encode("utf-8"))
+        emit_varint(out, 3, self.type)
+        return bytes(out)
+
+
+class OpDefSignature:
+    """op_def.proto OpDef (signature subset): name=1, input_arg=2,
+    output_arg=3 (repeated ArgDef)."""
+
+    def __init__(self, name="", input_args=None, output_args=None):
+        self.name = name
+        self.input_args = list(input_args or [])
+        self.output_args = list(output_args or [])
+
+    @classmethod
+    def decode(cls, buf):
+        self = cls()
+        for field, _wt, v in iter_fields(buf):
+            if field == 1:
+                self.name = bytes(v).decode("utf-8")
+            elif field == 2:
+                self.input_args.append(ArgDef.decode(v))
+            elif field == 3:
+                self.output_args.append(ArgDef.decode(v))
+        return self
+
+    def encode(self):
+        out = bytearray()
+        emit_bytes(out, 1, self.name.encode("utf-8"))
+        for a in self.input_args:
+            emit_bytes(out, 2, a.encode())
+        for a in self.output_args:
+            emit_bytes(out, 3, a.encode())
+        return bytes(out)
+
+
+class FunctionDef:
+    """function.proto FunctionDef: signature=1 (OpDef), node_def=3
+    (repeated NodeDef), ret=4 (map<string,string>: output_arg name ->
+    internal tensor ref)."""
+
+    def __init__(self, signature=None, nodes=None, ret=None):
+        self.signature = signature or OpDefSignature()
         self.nodes = list(nodes or [])
+        self.ret = dict(ret or {})
+
+    @classmethod
+    def decode(cls, buf):
+        self = cls()
+        for field, _wt, v in iter_fields(buf):
+            if field == 1:
+                self.signature = OpDefSignature.decode(v)
+            elif field == 3:
+                self.nodes.append(NodeDef.decode(v))
+            elif field == 4:
+                key, val = None, None
+                for f2, _w2, v2 in iter_fields(v):
+                    if f2 == 1:
+                        key = bytes(v2).decode("utf-8")
+                    elif f2 == 2:
+                        val = bytes(v2).decode("utf-8")
+                if key is not None:
+                    self.ret[key] = val
+        return self
+
+    def encode(self):
+        out = bytearray()
+        emit_bytes(out, 1, self.signature.encode())
+        for n in self.nodes:
+            emit_bytes(out, 3, n.encode())
+        for k, v in self.ret.items():
+            entry = bytearray()
+            emit_bytes(entry, 1, k.encode("utf-8"))
+            emit_bytes(entry, 2, v.encode("utf-8"))
+            emit_bytes(out, 4, entry)
+        return bytes(out)
+
+
+class GraphDef:
+    """graph.proto: node=1 (repeated NodeDef), library=2
+    (FunctionDefLibrary{function=1}); versions ignored."""
+
+    def __init__(self, nodes=None, functions=None):
+        self.nodes = list(nodes or [])
+        self.functions = list(functions or [])   # FunctionDef list
 
     @classmethod
     def decode(cls, buf):
@@ -431,6 +541,10 @@ class GraphDef:
         for field, _wt, v in iter_fields(buf):
             if field == 1:
                 self.nodes.append(NodeDef.decode(v))
+            elif field == 2:
+                for f2, _w2, v2 in iter_fields(v):
+                    if f2 == 1:
+                        self.functions.append(FunctionDef.decode(v2))
         return self
 
     @classmethod
@@ -444,6 +558,11 @@ class GraphDef:
         out = bytearray()
         for node in self.nodes:
             emit_bytes(out, 1, node.encode())
+        if self.functions:
+            lib = bytearray()
+            for fn in self.functions:
+                emit_bytes(lib, 1, fn.encode())
+            emit_bytes(out, 2, lib)
         return bytes(out)
 
     def save(self, path):
@@ -485,3 +604,7 @@ def attr_s(s):
 
 def attr_ilist(vals):
     return AttrValue(list={"i": [int(v) for v in vals]})
+
+
+def attr_func(name):
+    return AttrValue(func=str(name))

@@ -38,11 +38,16 @@ class TFImportError(ValueError):
 
 
 def _ref(name):
-    """'node:k' -> (node, k); '^node' -> control dep (None)."""
+    """'node:k' -> (node, k); '^node' -> control dep (None). FunctionDef
+    bodies use the 3-part form 'node:out_arg:k' — the out_arg name is
+    dropped (flat index k is correct for the single-output-per-arg ops in
+    scope)."""
     if name.startswith("^"):
         return None, 0
     if ":" in name:
         node, idx = name.rsplit(":", 1)
+        if ":" in node:
+            node = node.split(":", 1)[0]
         return node, int(idx)
     return name, 0
 
@@ -108,6 +113,8 @@ class _Importer:
         self.gd = gd
         self.placeholder_shapes = dict(placeholder_shapes or {})
         self.nodes = {n.name: n for n in gd.nodes}
+        self.functions = {f.signature.name: f
+                          for f in getattr(gd, "functions", [])}
         self.sd = SameDiff.create()
         self.vars = {}        # tf tensor name "node:k" -> SDVariable
         self.shapes = {}      # tf tensor name -> tuple (static)
@@ -872,3 +879,130 @@ def _permute(im, node, ref, perm, suffix, out_name=None):
         jax.ShapeDtypeStruct(im.shape(ref), im.dtype(ref)))
     im.bind(name, v, sh.shape, sh.dtype)
     return f"{name}:0"
+
+
+# ---------------------------------------------------------------------------
+# control flow (SURVEY.md §3.4: "control flow from TF interpreted in
+# Java" — here v2 FUNCTIONAL control flow (While/StatelessWhile/If/
+# StatelessIf + FunctionDef library) lowers onto the SameDiff
+# whileLoop/ifCond ops, whose bodies are the imported function sub-graphs
+# (serializable, lax.while_loop/cond at execution). v1 dataflow loops
+# (Enter/Merge/Switch/NextIteration/Exit) are frame-encoded and cyclic;
+# they are rejected with guidance to re-export functionally, which is
+# what TF2's own importer requires too.)
+# ---------------------------------------------------------------------------
+
+def _function_subgraph(im, fname, arg_refs, what):
+    """Import FunctionDef `fname` into a child SameDiff wrapped as a
+    SubGraph; arg shapes/dtypes come from the outer tensors feeding it.
+    Returns (SubGraph, out_shapes, out_dtypes)."""
+    from deeplearning4j_tpu.autodiff.samediff import SubGraph
+    from deeplearning4j_tpu.modelimport.protobuf import (
+        AttrValue, NodeDef, TensorShapeProto, numpy_to_dtype)
+
+    fdef = im.functions.get(fname)
+    if fdef is None:
+        raise TFImportError(
+            f"{what} references function {fname!r} which is not in the "
+            f"GraphDef library (have: {sorted(im.functions)})")
+    sig = fdef.signature
+    if len(sig.input_args) != len(arg_refs):
+        raise TFImportError(
+            f"{what} function {fname!r} takes {len(sig.input_args)} args "
+            f"but {len(arg_refs)} were passed")
+
+    nodes, ph_shapes = [], {}
+    for arg, ref in zip(sig.input_args, arg_refs):
+        shape = im.shape(ref)
+        dt = im.dtype(ref)
+        nodes.append(NodeDef(arg.name, "Placeholder", [], {
+            "dtype": AttrValue(type=numpy_to_dtype(dt)),
+            "shape": AttrValue(shape=TensorShapeProto(list(shape))),
+        }))
+        ph_shapes[arg.name] = shape
+    nodes += fdef.nodes
+
+    sub = _Importer(GraphDef(nodes, functions=list(im.functions.values())),
+                    ph_shapes)
+    child = sub.run()
+
+    out_names, out_shapes, out_dtypes = [], [], []
+    for arg in sig.output_args:
+        ret_ref = fdef.ret.get(arg.name)
+        if ret_ref is None:
+            raise TFImportError(
+                f"{what} function {fname!r} has no ret mapping for "
+                f"output {arg.name!r}")
+        v = sub.var(ret_ref)
+        out_names.append(v.name())
+        node_name, idx = _ref(ret_ref)
+        out_shapes.append(sub.shapes[f"{node_name}:{idx}"])
+        out_dtypes.append(sub.dtypes[f"{node_name}:{idx}"])
+    return (SubGraph(child, [a.name for a in sig.input_args], out_names),
+            out_shapes, out_dtypes)
+
+
+@handler("While", "StatelessWhile")
+def _h_while(im, node):
+    ins = im.data_inputs(node)
+    cond, _, _ = _function_subgraph(im, node.attrs["cond"].func, ins,
+                                    f"While node {node.name!r} cond")
+    body, body_shapes, body_dtypes = _function_subgraph(
+        im, node.attrs["body"].func, ins, f"While node {node.name!r} body")
+    if len(body.out_names) != len(ins):
+        raise TFImportError(
+            f"While body must return {len(ins)} loop vars, got "
+            f"{len(body.out_names)}")
+    in_vars = [im.var(r) for r in ins]
+    attrs = {"cond_graph": cond, "cond_fn": cond.callable(squeeze=True),
+             "body_graph": body, "body_fn": body.callable()}
+    n = len(in_vars)
+    res = im.sd._op("whileLoop", in_vars, attrs, node.name,
+                    n_out=n if n > 1 else 1)
+    outs = res if isinstance(res, tuple) else (res,)
+    for i, v in enumerate(outs):
+        im.bind(node.name, v, body_shapes[i], body_dtypes[i], out_idx=i)
+
+
+@handler("If", "StatelessIf")
+def _h_if(im, node):
+    ins = im.data_inputs(node)
+    pred, rest = ins[0], ins[1:]
+    tb, t_shapes, t_dtypes = _function_subgraph(
+        im, node.attrs["then_branch"].func, rest,
+        f"If node {node.name!r} then_branch")
+    fb, f_shapes, f_dtypes = _function_subgraph(
+        im, node.attrs["else_branch"].func, rest,
+        f"If node {node.name!r} else_branch")
+    if len(tb.out_names) != len(fb.out_names):
+        raise TFImportError(
+            f"If branches return different arities: {len(tb.out_names)} "
+            f"vs {len(fb.out_names)}")
+    if list(t_shapes) != list(f_shapes) or \
+            [np.dtype(d) for d in t_dtypes] != \
+            [np.dtype(d) for d in f_dtypes]:
+        raise TFImportError(
+            f"If node {node.name!r} branches disagree on output "
+            f"shapes/dtypes: then {list(zip(t_shapes, t_dtypes))} vs "
+            f"else {list(zip(f_shapes, f_dtypes))} — lax.cond requires "
+            f"identical branch signatures")
+    attrs = {"true_graph": tb, "true_fn": tb.callable(),
+             "false_graph": fb, "false_fn": fb.callable()}
+    n_out = len(tb.out_names)
+    res = im.sd._op("ifCond", [im.var(pred)] + [im.var(r) for r in rest],
+                    attrs, node.name, n_out=n_out)
+    outs = res if isinstance(res, tuple) else (res,)
+    for i, v in enumerate(outs):
+        im.bind(node.name, v, t_shapes[i], t_dtypes[i], out_idx=i)
+
+
+@handler("Enter", "Exit", "Merge", "Switch", "NextIteration", "LoopCond",
+         "TensorArrayV3", "TensorArrayReadV3", "TensorArrayWriteV3",
+         "TensorArrayScatterV3", "TensorArrayGatherV3", "TensorArraySizeV3")
+def _h_v1_control_flow(im, node):
+    raise TFImportError(
+        f"node {node.name!r} uses TF v1 dataflow control flow "
+        f"({node.op}); these frame-encoded loops are cyclic and cannot "
+        "be interpreted as a graph op — re-export the model with TF2 "
+        "functional control flow (While/If + function library), which "
+        "imports onto SameDiff whileLoop/ifCond")

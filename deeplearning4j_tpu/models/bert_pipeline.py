@@ -61,14 +61,13 @@ def stack_layer_params(cfg: BertConfig, params: dict, n_stages: int):
 def unstack_layer_params(stacked) -> list:
     """Inverse of stack_layer_params: [S, L/S, ...] leaves -> list of L
     per-layer param dicts (for checkpoint interchange with BertTrainer)."""
-    leaves, treedef = jax.tree_util.tree_flatten(stacked)
-    s, per = leaves[0].shape[0], leaves[0].shape[1]
+    lead = jax.tree_util.tree_leaves(stacked)[0]
+    s, per = lead.shape[0], lead.shape[1]
     out = []
     for si in range(s):
         for li in range(per):
             out.append(jax.tree_util.tree_map(
-                lambda a: a[si, li], stacked))
-    del treedef
+                lambda a, si=si, li=li: a[si, li], stacked))
     return out
 
 
@@ -178,7 +177,6 @@ class BertPipelineTrainer:
         into `microbatches` GPipe microbatches; returns the scalar loss."""
         if self._step_fn is None:
             self._step_fn = self._build()
-        cfg = self.cfg
         tokens = np.asarray(tokens)
         b, t = tokens.shape
         m = self.microbatches
@@ -195,5 +193,4 @@ class BertPipelineTrainer:
             weights.reshape(m, mb, -1),
             jnp.asarray(self._step, jnp.int32))
         self._step += 1
-        del cfg
         return loss

@@ -17,15 +17,24 @@ from deeplearning4j_tpu.ndarray.ndarray import INDArray, _unwrap
 
 
 class _RandomStream:
-    """Stateful RNG facade over jax.random (threefry counter RNG)."""
+    """Stateful RNG facade over jax.random (threefry counter RNG).
+
+    Key creation is LAZY: building a PRNG key initializes the XLA
+    backend, and `import deeplearning4j_tpu` must stay side-effect free
+    so multi-host programs can call jax.distributed.initialize (via
+    MultiHost.initialize) after importing the framework."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None
 
     def setSeed(self, seed: int):
-        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._key = None
 
     def nextKey(self) -> jax.Array:
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -90,5 +90,22 @@ def shard_batch(mesh: Mesh, batch):
     return jax.device_put(batch, NamedSharding(mesh, spec))
 
 
+def global_batch(mesh: Mesh, arr, spec=None):
+    """Assemble a (possibly multi-process) global device array from a host
+    array every process holds in full — the SPMD input convention for
+    multi-host training (each host runs the same input pipeline; each
+    device takes its addressable shard). Single-process: plain device_put.
+    """
+    import numpy as _np
+
+    spec = spec if spec is not None else spec_for(mesh, DATA_AXIS)
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    arr = _np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sh,
+                                        lambda idx: arr[idx])
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())

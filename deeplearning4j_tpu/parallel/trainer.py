@@ -26,7 +26,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import (
-    DATA_AXIS, MeshConfig, spec_for)
+    DATA_AXIS, MeshConfig, global_batch, spec_for)
+
+
+def _host_scalar(x) -> float:
+    """float(x) that also works on multi-process replicated outputs (not
+    fully addressable -> read this process's shard, which holds the full
+    replicated value)."""
+    if getattr(x, "is_fully_addressable", True):
+        return float(x)
+    return float(np.asarray(x.addressable_data(0)))
 
 
 def _pad_batch(arr, multiple):
@@ -125,12 +134,22 @@ class ShardedTrainer:
 
     def place_params(self):
         """Device_put params/states/opt with their shardings (replicates or
-        shards across the mesh)."""
+        shards across the mesh; multi-process assembles global arrays from
+        the identical host copies every process initialized)."""
         p_sh, s_sh, o_sh, _, repl = self._shardings()
         net = self.net
-        net._params = jax.device_put(net._params, p_sh)
-        net._states = jax.device_put(net._states, s_sh)
-        net._opt_states = jax.device_put(net._opt_states, o_sh)
+        if jax.process_count() > 1:
+            def put(tree, sh_tree):
+                def one(a, s):
+                    host = np.asarray(jax.device_get(a))
+                    return jax.make_array_from_callback(
+                        host.shape, s, lambda idx, h=host: h[idx])
+                return jax.tree_util.tree_map(one, tree, sh_tree)
+        else:
+            put = jax.device_put
+        net._params = put(net._params, p_sh)
+        net._states = put(net._states, s_sh)
+        net._opt_states = put(net._opt_states, o_sh)
 
     def fit(self, data, epochs: int = 1):
         from deeplearning4j_tpu.autodiff.samediff import (
@@ -156,6 +175,12 @@ class ShardedTrainer:
                           else (l.shape[0],))
                 mask = np.ones(mshape, np.float32)
                 mask[real:] = 0.0
+                if jax.process_count() > 1:
+                    # multi-host SPMD: every process feeds the identical
+                    # global batch; each device takes its own shard
+                    f = global_batch(self.mesh, f)
+                    l = global_batch(self.mesh, l)
+                    mask = global_batch(self.mesh, mask)
                 rng = jax.random.fold_in(base_key, net._iteration)
                 loss, params, states, opts = self._step_fn(
                     params, states, opts, f, l, mask, rng, net._iteration)
@@ -164,13 +189,13 @@ class ShardedTrainer:
                 net._iteration += 1
                 last = loss
                 if net._listeners:
-                    net._score = float(loss)
+                    net._score = _host_scalar(loss)
                     for listener in net._listeners:
                         listener.iterationDone(net, net._iteration,
                                                net._epoch)
             net._epoch += 1
         if last is not None:
-            net._score = float(last)
+            net._score = _host_scalar(last)
         return net
 
 

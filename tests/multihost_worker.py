@@ -1,0 +1,78 @@
+"""Worker process for the two-process multi-host test (invoked by
+tests/test_multihost.py as a subprocess, one per simulated host).
+
+Each process brings 2 virtual CPU devices; jax.distributed.initialize
+wires them into one 4-device global mesh; a tiny MultiLayerNetwork fits
+under ShardedTrainer and the final parameter checksum is printed so the
+parent can assert cross-process equality (SURVEY.md §4 "distributed
+without a cluster": the multi-PROCESS analog of the reference's
+in-process Aeron loopback simulation)."""
+
+import os
+import sys
+
+
+def main():
+    coord, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from deeplearning4j_tpu.parallel.multihost import (
+        MultiHost, VoidConfiguration)
+
+    topo = MultiHost.initialize(
+        VoidConfiguration(controllerAddress=coord),
+        num_processes=n_proc, process_id=pid)
+    print(f"TOPOLOGY {topo['process_index']} {topo['process_count']} "
+          f"{topo['global_devices']}", flush=True)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(5e-2))
+            .list()
+            .layer(DenseLayer.Builder(nOut=8, activation="tanh").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+
+    mesh = MeshConfig.data_parallel()  # all 4 global devices
+    trainer = ShardedTrainer(net, mesh)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    from deeplearning4j_tpu.datasets import DataSet
+
+    trainer.fit([DataSet(X, y)], epochs=3)
+
+    total = 0.0
+    for lp in net._params:
+        for leaf in jax.tree_util.tree_leaves(lp):
+            total += float(jax.numpy.sum(jax.numpy.abs(leaf)))
+    print(f"PARAMS_SUM {total:.8f}", flush=True)
+    print(f"SCORE {net._score:.8f}", flush=True)
+
+    MultiHost.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Two-process multi-host verification on the CPU backend (VERDICT
+round-2 item 7): spawn coordinator+worker subprocesses with
+jax.distributed.initialize, run a ShardedTrainer fit over the 4-device
+global mesh, and assert the processes agree on the trained parameters.
+
+Reference analog: SURVEY.md §4 "distributed without a cluster" — the
+reference simulates multi-node over Aeron loopback in-process; the JAX
+analog is real multi-PROCESS SPMD over the distributed runtime, which is
+what a TPU pod runs (one process per host over DCN)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_training_agrees():
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    env = dict(os.environ)
+    # workers set their own platform/device flags; scrub this suite's
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(worker)))
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+
+    def parse(out, tag):
+        for line in out.splitlines():
+            if line.startswith(tag):
+                return line.split()[1:]
+        raise AssertionError(f"{tag} missing in:\n{out}")
+
+    # both processes saw the full 2-process, 4-device topology
+    for i, out in enumerate(outs):
+        pidx, pcount, gdev = parse(out, "TOPOLOGY")
+        assert int(pcount) == 2 and int(gdev) == 4
+        assert int(pidx) == i
+
+    # trained parameters identical across processes (the in-step psum
+    # over `data` rode the distributed runtime)
+    sums = [float(parse(out, "PARAMS_SUM")[0]) for out in outs]
+    assert sums[0] == pytest.approx(sums[1], rel=1e-6), sums
+    scores = [float(parse(out, "SCORE")[0]) for out in outs]
+    assert scores[0] == pytest.approx(scores[1], rel=1e-6), scores

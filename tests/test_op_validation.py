@@ -241,3 +241,160 @@ class TestReviewRegressions:
         ])
         with pytest.raises((ValueError, TFImportError)):
             TFGraphMapper.importGraph(gd)
+
+
+class TestCtcLoss:
+    """ctcLoss against brute-force path enumeration (reference: libnd4j
+    ctc_loss declarable; SURVEY.md §4 op-validation strategy)."""
+
+    @staticmethod
+    def _brute_force_nll(logits, label, blank=0):
+        """-log P(label) by enumerating all alignment paths."""
+        import itertools
+
+        t, c = logits.shape
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+
+        def collapse(path):
+            out = []
+            prev = None
+            for s in path:
+                if s != prev and s != blank:
+                    out.append(s)
+                prev = s
+            return tuple(out)
+
+        total = 0.0
+        for path in itertools.product(range(c), repeat=t):
+            if collapse(path) == tuple(label):
+                total += float(np.prod([p[i, s]
+                                        for i, s in enumerate(path)]))
+        return -np.log(total)
+
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        t, c = 4, 3
+        logits = rng.normal(size=(2, t, c)).astype(np.float32)
+        labels = np.array([[1, 2], [2, 2]], np.int32)
+        out = np.asarray(OPS["ctcLoss"](labels, logits))
+        for bi in range(2):
+            expect = self._brute_force_nll(logits[bi], labels[bi])
+            assert out[bi] == pytest.approx(expect, rel=1e-4), bi
+
+    def test_variable_lengths(self):
+        rng = np.random.default_rng(1)
+        logits = rng.normal(size=(2, 5, 4)).astype(np.float32)
+        labels = np.array([[1, 3, 0], [2, 0, 0]], np.int32)
+        lab_len = np.array([2, 1], np.int32)
+        log_len = np.array([5, 3], np.int32)
+        out = np.asarray(OPS["ctcLoss"](labels, logits, lab_len, log_len))
+        e0 = self._brute_force_nll(logits[0], [1, 3])
+        e1 = self._brute_force_nll(logits[1, :3], [2])
+        assert out[0] == pytest.approx(e0, rel=1e-4)
+        assert out[1] == pytest.approx(e1, rel=1e-4)
+
+    def test_differentiable(self):
+        import jax
+
+        rng = np.random.default_rng(2)
+        logits = rng.normal(size=(1, 4, 3)).astype(np.float32)
+        labels = np.array([[1, 2]], np.int32)
+        g = jax.grad(lambda lg: jnp.sum(OPS["ctcLoss"](labels, lg)))(
+            jnp.asarray(logits))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).max() > 0
+
+
+class TestNonMaxSuppression:
+    def test_selects_and_suppresses(self):
+        boxes = np.array([
+            [0, 0, 10, 10],
+            [1, 1, 11, 11],     # heavy overlap with 0
+            [50, 50, 60, 60],   # disjoint
+            [0, 0, 5, 5],       # mild overlap with 0 (IoU 0.25)
+        ], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        idx = np.asarray(OPS["nonMaxSuppression"](
+            boxes, scores, maxOutputSize=4, iouThreshold=0.5))
+        assert list(idx) == [0, 2, 3, -1]
+
+    def test_score_threshold(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        scores = np.array([0.9, 0.1], np.float32)
+        idx = np.asarray(OPS["nonMaxSuppression"](
+            boxes, scores, maxOutputSize=2, iouThreshold=0.5,
+            scoreThreshold=0.5))
+        assert list(idx) == [0, -1]
+
+    def test_jittable(self):
+        import jax
+
+        boxes = np.random.default_rng(0).uniform(
+            0, 100, (16, 4)).astype(np.float32)
+        boxes[:, 2:] = boxes[:, :2] + 5
+        scores = np.linspace(1, 0, 16).astype(np.float32)
+        f = jax.jit(lambda b, s: OPS["nonMaxSuppression"](
+            b, s, maxOutputSize=5))
+        out = np.asarray(f(boxes, scores))
+        assert out.shape == (5,)
+
+
+class TestConv3dPool3dOps:
+    def test_conv3d_matches_layer_math(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 5, 5, 5)).astype(np.float32)
+        w = rng.normal(size=(4, 3, 2, 2, 2)).astype(np.float32)
+        b = rng.normal(size=(4,)).astype(np.float32)
+        y = np.asarray(OPS["conv3d"](x, w, b))
+        assert y.shape == (2, 4, 4, 4, 4)
+        # one output element by hand
+        expect = (x[0, :, 0:2, 0:2, 0:2] * w[1]).sum() + b[1]
+        assert y[0, 1, 0, 0, 0] == pytest.approx(expect, rel=1e-4)
+
+    def test_pool3d(self):
+        x = np.arange(2 * 1 * 4 * 4 * 4, dtype=np.float32).reshape(
+            2, 1, 4, 4, 4)
+        mx = np.asarray(OPS["maxPooling3d"](x))
+        av = np.asarray(OPS["avgPooling3d"](x))
+        assert mx.shape == av.shape == (2, 1, 2, 2, 2)
+        assert mx[0, 0, 0, 0, 0] == x[0, 0, :2, :2, :2].max()
+        assert av[0, 0, 0, 0, 0] == pytest.approx(
+            x[0, 0, :2, :2, :2].mean())
+
+
+class TestNewRandomOps:
+    def test_distributions_sane(self):
+        import jax
+
+        key = jax.random.key(0)
+        g = np.asarray(OPS["randomGamma"]((20000,), alpha=3.0, beta=2.0,
+                                          key=key))
+        assert g.mean() == pytest.approx(1.5, rel=0.05)  # alpha/beta
+        p = np.asarray(OPS["randomPoisson"]((20000,), lam=4.0, key=key))
+        assert p.mean() == pytest.approx(4.0, rel=0.05)
+        t = np.asarray(OPS["truncatedNormal"]((20000,), mean=1.0,
+                                              stddev=2.0, key=key))
+        assert np.all(t <= 1.0 + 2 * 2.0 + 1e-5)
+        assert np.all(t >= 1.0 - 2 * 2.0 - 1e-5)
+        e = np.asarray(OPS["randomExponential"]((20000,), lam=2.0,
+                                                key=key))
+        assert e.mean() == pytest.approx(0.5, rel=0.05)
+
+
+class TestResizeVariants:
+    def test_area_exact_average(self):
+        x = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = np.asarray(OPS["imageResize"](x, 2, 2, method="area"))
+        assert y[0, 0, 0, 0] == pytest.approx(x[0, 0, :2, :2].mean())
+
+    def test_area_non_integer_raises(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        with pytest.raises(ValueError, match="integer downscale"):
+            OPS["imageResize"](x, 3, 3, method="area")
+
+    def test_lanczos(self):
+        x = np.random.default_rng(0).normal(size=(1, 2, 8, 8)) \
+            .astype(np.float32)
+        y = np.asarray(OPS["imageResize"](x, 4, 4, method="lanczos3"))
+        assert y.shape == (1, 2, 4, 4)

@@ -604,3 +604,170 @@ class TestRound2Ops:
         import scipy.special as sp
         assert np.allclose(np.asarray(out["lg"]), sp.gammaln(y), atol=1e-4)
         assert np.allclose(np.asarray(out["em"]), np.expm1(x), atol=1e-5)
+
+
+class TestFunctionalControlFlow:
+    """v2 functional control flow: While/If + FunctionDef library lower
+    onto SameDiff whileLoop/ifCond (VERDICT round-2 item 4; SURVEY.md
+    §3.4 control-flow line)."""
+
+    @staticmethod
+    def _while_rnn_graph(T, B, I, H, seed=0):
+        from deeplearning4j_tpu.modelimport.protobuf import (
+            ArgDef, DT_BOOL, DT_FLOAT, DT_INT32, FunctionDef,
+            OpDefSignature, attr_func)
+
+        rng = np.random.default_rng(seed)
+        wx = rng.normal(size=(I, H)).astype(np.float32) * 0.5
+        wh = rng.normal(size=(H, H)).astype(np.float32) * 0.5
+        b = rng.normal(size=(H,)).astype(np.float32) * 0.1
+        x = rng.normal(size=(T, B, I)).astype(np.float32)
+
+        args = [ArgDef("i", DT_INT32), ArgDef("h", DT_FLOAT),
+                ArgDef("x", DT_FLOAT), ArgDef("wx", DT_FLOAT),
+                ArgDef("wh", DT_FLOAT), ArgDef("b", DT_FLOAT)]
+
+        cond_f = FunctionDef(
+            OpDefSignature("rnn_cond", args, [ArgDef("lt", DT_BOOL)]),
+            [const("steps", np.int32(T)),
+             NodeDef("less", "Less", ["i", "steps"],
+                     {"T": attr_type(np.int32)})],
+            {"lt": "less:z:0"})
+
+        body_f = FunctionDef(
+            OpDefSignature("rnn_body", args,
+                           [ArgDef(f"o{k}", a.type)
+                            for k, a in enumerate(args)]),
+            [const("one", np.int32(1)),
+             NodeDef("inext", "AddV2", ["i", "one"],
+                     {"T": attr_type(np.int32)}),
+             const("axis0", np.int32(0)),
+             NodeDef("xt", "GatherV2", ["x", "i", "axis0"], {"T": F32}),
+             NodeDef("mmx", "MatMul", ["xt", "wx"], {"T": F32}),
+             NodeDef("mmh", "MatMul", ["h", "wh"], {"T": F32}),
+             NodeDef("s1", "AddV2", ["mmx", "mmh"], {"T": F32}),
+             NodeDef("s2", "AddV2", ["s1", "b"], {"T": F32}),
+             NodeDef("hn", "Tanh", ["s2"], {"T": F32})],
+            {"o0": "inext:z:0", "o1": "hn:y:0", "o2": "x",
+             "o3": "wx", "o4": "wh", "o5": "b"})
+
+        gd = GraphDef([
+            const("i0", np.int32(0)),
+            const("h0", np.zeros((B, H), np.float32)),
+            placeholder("x_in", [T, B, I]),
+            const("wx_c", wx), const("wh_c", wh), const("b_c", b),
+            NodeDef("loop", "StatelessWhile",
+                    ["i0", "h0", "x_in", "wx_c", "wh_c", "b_c"],
+                    {"cond": attr_func("rnn_cond"),
+                     "body": attr_func("rnn_body")}),
+            NodeDef("h_final", "Identity", ["loop:1"], {"T": F32}),
+        ], functions=[cond_f, body_f])
+        return gd, (x, wx, wh, b)
+
+    def test_while_rnn_matches_numpy(self):
+        T, B, I, H = 5, 3, 4, 6
+        gd, (x, wx, wh, b) = self._while_rnn_graph(T, B, I, H)
+        # wire round-trip: encode + reparse like a real .pb file
+        gd = GraphDef.parse(gd.encode())
+        sd = TFGraphMapper.importGraph(gd)
+        out = sd.output({"x_in": x}, "h_final")["h_final"].numpy()
+        h = np.zeros((B, H), np.float32)
+        for t in range(T):
+            h = np.tanh(x[t] @ wx + h @ wh + b)
+        np.testing.assert_allclose(out, h, rtol=2e-5, atol=1e-5)
+
+    def test_while_graph_serializes(self, tmp_path):
+        T, B, I, H = 4, 2, 3, 5
+        gd, (x, wx, wh, b) = self._while_rnn_graph(T, B, I, H)
+        sd = TFGraphMapper.importGraph(gd)
+        p = str(tmp_path / "rnn.sd")
+        sd.save(p)
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd2 = SameDiff.load(p)
+        a = sd.output({"x_in": x}, "h_final")["h_final"].numpy()
+        c = sd2.output({"x_in": x}, "h_final")["h_final"].numpy()
+        np.testing.assert_allclose(a, c)
+
+    def test_if_branches(self):
+        from deeplearning4j_tpu.modelimport.protobuf import (
+            ArgDef, DT_BOOL, DT_FLOAT, FunctionDef, OpDefSignature,
+            attr_func)
+
+        args = [ArgDef("a", DT_FLOAT)]
+        then_f = FunctionDef(
+            OpDefSignature("then_f", args, [ArgDef("y", DT_FLOAT)]),
+            [const("two", np.float32(2.0)),
+             NodeDef("mul", "Mul", ["a", "two"], {"T": F32})],
+            {"y": "mul:z:0"})
+        else_f = FunctionDef(
+            OpDefSignature("else_f", args, [ArgDef("y", DT_FLOAT)]),
+            [const("one", np.float32(1.0)),
+             NodeDef("sub", "Sub", ["a", "one"], {"T": F32})],
+            {"y": "sub:z:0"})
+        gd = GraphDef([
+            placeholder("p", [], np.bool_),
+            placeholder("a_in", [3]),
+            NodeDef("branch", "StatelessIf", ["p", "a_in"],
+                    {"then_branch": attr_func("then_f"),
+                     "else_branch": attr_func("else_f")}),
+            NodeDef("out", "Identity", ["branch:0"], {"T": F32}),
+        ], functions=[then_f, else_f])
+        gd = GraphDef.parse(gd.encode())
+        sd = TFGraphMapper.importGraph(gd)
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        hi = sd.output({"p": np.bool_(True), "a_in": a}, "out")["out"]
+        lo = sd.output({"p": np.bool_(False), "a_in": a}, "out")["out"]
+        np.testing.assert_allclose(hi.numpy(), a * 2)
+        np.testing.assert_allclose(lo.numpy(), a - 1)
+
+    def test_v1_control_flow_rejected(self):
+        gd = GraphDef([
+            placeholder("x", [2]),
+            NodeDef("enter", "Enter", ["x"], {"T": F32}),
+        ])
+        with pytest.raises(TFImportError, match="functional control flow"):
+            TFGraphMapper.importGraph(gd)
+
+
+class TestFullBertImport:
+    """VERDICT round-2 item 4 done-criterion: an encoder-built BERT
+    GraphDef imports and trains via makeTrainable. The small-dims variant
+    runs in the quick suite; the real-dims BERT-base variant (vocab
+    30522, hidden 768, 12 layers, ~110M params) is slow-marked."""
+
+    @staticmethod
+    def _run(vocab, hidden, layers, heads, ffn, batch, seq, epochs=3):
+        from tests.tf_bert_builder import BertGraphBuilder
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        bd = BertGraphBuilder(vocab=vocab, hidden=hidden, layers=layers,
+                              heads=heads, ffn=ffn, max_len=max(32, seq),
+                              batch=batch, seq=seq)
+        gd = GraphDef.parse(bd.build().encode())   # wire round-trip
+        sd = TFGraphMapper.importGraph(gd)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+        labs = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+        first = float(sd.output({"input_ids": ids, "labels": labs},
+                                "loss")["loss"].numpy())
+        assert abs(first - np.log(vocab)) < 0.5  # untrained ~ uniform
+        converted = TFGraphMapper.makeTrainable(sd)
+        assert len(converted) >= layers * 8
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(1e-3), dataSetFeatureMapping=["input_ids"],
+            dataSetLabelMapping=["labels"]))
+        hist = sd.fit([(ids, labs)], epochs=epochs)
+        assert hist.lossCurve[-1] < hist.lossCurve[0]
+        return hist
+
+    def test_small_dims_imports_and_trains(self):
+        self._run(vocab=100, hidden=16, layers=2, heads=2, ffn=32,
+                  batch=2, seq=8)
+
+    @pytest.mark.slow
+    def test_bert_base_real_dims_imports_and_trains(self):
+        self._run(vocab=30522, hidden=768, layers=12, heads=12, ffn=3072,
+                  batch=2, seq=16, epochs=2)

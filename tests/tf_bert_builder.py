@@ -1,0 +1,180 @@
+"""Encoder-side builder for a full-size BERT GraphDef fixture.
+
+Builds the frozen-graph op decomposition a real TF BERT checkpoint
+freezes to — GatherV2 embeddings, BatchMatMulV2 projections,
+Mean/SquaredDifference/Rsqrt LayerNorm chains, erf-GELU, tied MLM head,
+and an in-graph masked-LM loss — at ANY dims including real BERT-base
+(vocab 30522, hidden 768, 12 layers). Used by the import conformance
+tests (SURVEY.md §4 golden-file strategy; the encoder side of the
+round-trip since TensorFlow itself is not installed)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.protobuf import (
+    GraphDef, NodeDef, attr_b, attr_shape, attr_tensor, attr_type)
+
+F32 = attr_type(np.float32)
+I32 = attr_type(np.int32)
+
+
+def _const(name, arr):
+    arr = np.asarray(arr)
+    return NodeDef(name, "Const", [], {
+        "dtype": attr_type(arr.dtype), "value": attr_tensor(arr)})
+
+
+def _ph(name, shape, dtype=np.float32):
+    return NodeDef(name, "Placeholder", [], {
+        "dtype": attr_type(dtype), "shape": attr_shape(shape)})
+
+
+class BertGraphBuilder:
+    """Emits nodes into one flat GraphDef; helper methods mirror the
+    frozen-graph idioms (LN chain, erf-GELU, head split/merge)."""
+
+    def __init__(self, vocab=30522, hidden=768, layers=12, heads=12,
+                 ffn=3072, max_len=512, batch=2, seq=16, seed=0):
+        self.v, self.h, self.L = vocab, hidden, layers
+        self.nh, self.f = heads, ffn
+        self.hd = hidden // heads
+        self.b, self.t = batch, seq
+        self.max_len = max_len
+        self.rng = np.random.default_rng(seed)
+        self.nodes = []
+
+    def n(self, name, op, inputs, attrs=None):
+        self.nodes.append(NodeDef(name, op, inputs, attrs or {}))
+        return name
+
+    def c(self, name, arr):
+        self.nodes.append(_const(name, arr))
+        return name
+
+    def w(self, name, shape, scale=0.02):
+        return self.c(name, (self.rng.normal(size=shape) * scale)
+                      .astype(np.float32))
+
+    def ln(self, tag, x):
+        """Frozen LayerNorm decomposition over the last axis."""
+        h = self.h
+        axes = self.c(f"{tag}/axes", np.array([2], np.int32))
+        g = self.w(f"{tag}/gamma", (h,), 0.0)
+        self.nodes[-1] = _const(f"{tag}/gamma", np.ones(h, np.float32))
+        be = self.c(f"{tag}/beta", np.zeros(h, np.float32))
+        eps = self.c(f"{tag}/eps", np.float32(1e-12))
+        mu = self.n(f"{tag}/mu", "Mean", [x, axes],
+                    {"keep_dims": attr_b(True), "T": F32})
+        sqd = self.n(f"{tag}/sqd", "SquaredDifference", [x, mu],
+                     {"T": F32})
+        var = self.n(f"{tag}/var", "Mean", [sqd, axes],
+                     {"keep_dims": attr_b(True), "T": F32})
+        veps = self.n(f"{tag}/veps", "AddV2", [var, eps], {"T": F32})
+        rstd = self.n(f"{tag}/rstd", "Rsqrt", [veps], {"T": F32})
+        xc = self.n(f"{tag}/xc", "Sub", [x, mu], {"T": F32})
+        xn = self.n(f"{tag}/xn", "Mul", [xc, rstd], {"T": F32})
+        xg = self.n(f"{tag}/xg", "Mul", [xn, g], {"T": F32})
+        return self.n(f"{tag}/y", "AddV2", [xg, be], {"T": F32})
+
+    def gelu(self, tag, x):
+        r2 = self.c(f"{tag}/r2", np.float32(1.0 / np.sqrt(2.0)))
+        half = self.c(f"{tag}/half", np.float32(0.5))
+        one = self.c(f"{tag}/one", np.float32(1.0))
+        xs = self.n(f"{tag}/xs", "Mul", [x, r2], {"T": F32})
+        er = self.n(f"{tag}/erf", "Erf", [xs], {"T": F32})
+        e1 = self.n(f"{tag}/e1", "AddV2", [er, one], {"T": F32})
+        xh = self.n(f"{tag}/xh", "Mul", [x, half], {"T": F32})
+        return self.n(f"{tag}/y", "Mul", [xh, e1], {"T": F32})
+
+    def dense(self, tag, x, w_name, b_name):
+        mm = self.n(f"{tag}/mm", "BatchMatMulV2", [x, w_name], {"T": F32})
+        return self.n(f"{tag}/ba", "AddV2", [mm, b_name], {"T": F32})
+
+    def layer(self, li, x):
+        h, nh, hd = self.h, self.nh, self.hd
+        b, t = self.b, self.t
+        tag = f"layer{li}"
+        wq = self.w(f"{tag}/wq", (h, h))
+        wk = self.w(f"{tag}/wk", (h, h))
+        wv = self.w(f"{tag}/wv", (h, h))
+        bq = self.c(f"{tag}/bq", np.zeros(h, np.float32))
+        bk = self.c(f"{tag}/bk", np.zeros(h, np.float32))
+        bv = self.c(f"{tag}/bv", np.zeros(h, np.float32))
+        hs = self.c(f"{tag}/hshape", np.array([b, t, nh, hd], np.int32))
+        ms = self.c(f"{tag}/mshape", np.array([b, t, h], np.int32))
+        perm = self.c(f"{tag}/perm", np.array([0, 2, 1, 3], np.int32))
+        scale = self.c(f"{tag}/scale", np.float32(1.0 / np.sqrt(hd)))
+
+        def heads(pt, w, bias):
+            d = self.dense(f"{tag}/{pt}", x, w, bias)
+            r = self.n(f"{tag}/{pt}r", "Reshape", [d, hs], {"T": F32})
+            return self.n(f"{tag}/{pt}t", "Transpose", [r, perm],
+                          {"T": F32})
+
+        q = heads("q", wq, bq)
+        k = heads("k", wk, bk)
+        v = heads("v", wv, bv)
+        s0 = self.n(f"{tag}/s0", "BatchMatMulV2", [q, k],
+                    {"adj_y": attr_b(True), "T": F32})
+        s = self.n(f"{tag}/s", "Mul", [s0, scale], {"T": F32})
+        p = self.n(f"{tag}/p", "Softmax", [s], {"T": F32})
+        ctx = self.n(f"{tag}/ctx", "BatchMatMulV2", [p, v], {"T": F32})
+        ctxt = self.n(f"{tag}/ctxt", "Transpose", [ctx, perm], {"T": F32})
+        ctxm = self.n(f"{tag}/ctxm", "Reshape", [ctxt, ms], {"T": F32})
+        wo = self.w(f"{tag}/wo", (h, h))
+        bo = self.c(f"{tag}/bo", np.zeros(h, np.float32))
+        att = self.dense(f"{tag}/out", ctxm, wo, bo)
+        res1 = self.n(f"{tag}/res1", "AddV2", [x, att], {"T": F32})
+        x1 = self.ln(f"{tag}/ln1", res1)
+
+        wi = self.w(f"{tag}/wi", (h, self.f))
+        bi = self.c(f"{tag}/bi", np.zeros(self.f, np.float32))
+        wo2 = self.w(f"{tag}/wo2", (self.f, h))
+        bo2 = self.c(f"{tag}/bo2", np.zeros(h, np.float32))
+        up = self.dense(f"{tag}/ffn_in", x1, wi, bi)
+        act = self.gelu(f"{tag}/gelu", up)
+        down = self.dense(f"{tag}/ffn_out", act, wo2, bo2)
+        res2 = self.n(f"{tag}/res2", "AddV2", [x1, down], {"T": F32})
+        return self.ln(f"{tag}/ln2", res2)
+
+    def build(self):
+        b, t, h, v = self.b, self.t, self.h, self.v
+        self.nodes.append(_ph("input_ids", [b, t], np.int32))
+        self.nodes.append(_ph("labels", [b, t], np.int32))
+
+        tok = self.w("embeddings/tok", (v, h))
+        pos_full = self.w("embeddings/pos_full", (self.max_len, h))
+        axis0 = self.c("embeddings/axis0", np.int32(0))
+        emb = self.n("embeddings/lookup", "GatherV2",
+                     [tok, "input_ids", "embeddings/axis0"], {"T": F32})
+        begin = self.c("embeddings/begin", np.array([0, 0], np.int32))
+        size = self.c("embeddings/size", np.array([t, h], np.int32))
+        pos = self.n("embeddings/pos", "Slice",
+                     [pos_full, begin, size], {"T": F32})
+        ep = self.n("embeddings/sum", "AddV2", [emb, pos], {"T": F32})
+        x = self.ln("embeddings/ln", ep)
+        del axis0
+
+        for li in range(self.L):
+            x = self.layer(li, x)
+
+        # tied MLM head: logits = x @ tok^T
+        logits = self.n("mlm/logits", "BatchMatMulV2", [x, tok],
+                        {"adj_y": attr_b(True), "T": F32})
+        # in-graph loss: -mean(sum(onehot(labels) * log_softmax(logits)))
+        lsm = self.n("mlm/lsm", "LogSoftmax", [logits], {"T": F32})
+        depth = self.c("mlm/depth", np.int32(v))
+        on = self.c("mlm/on", np.float32(1.0))
+        off = self.c("mlm/off", np.float32(0.0))
+        oh = self.n("mlm/onehot", "OneHot",
+                    ["labels", "mlm/depth", "mlm/on", "mlm/off"],
+                    {"T": F32})
+        prod = self.n("mlm/prod", "Mul", [lsm, oh], {"T": F32})
+        ax2 = self.c("mlm/ax2", np.array([2], np.int32))
+        tok_lp = self.n("mlm/tok_lp", "Sum", [prod, ax2],
+                        {"keep_dims": attr_b(False), "T": F32})
+        nll = self.n("mlm/nll", "Neg", [tok_lp], {"T": F32})
+        axall = self.c("mlm/axall", np.array([0, 1], np.int32))
+        self.n("loss", "Mean", [nll, axall],
+               {"keep_dims": attr_b(False), "T": F32})
+        del on, off, oh, depth
+        return GraphDef(self.nodes)
