@@ -758,9 +758,15 @@ def _lstm_cell(x, h_prev, c_prev, w, r, b=None, forgetBias=0.0):
 
 @op("lstmLayer")
 def _lstm_layer(x, w, r, b=None, h0=None, c0=None, forgetBias=0.0,
-                returnFullSequence=True):
+                returnFullSequence=True, unroll=4):
     """x: [N, I, T] (DL4J NCW time-series layout). Returns ([N,H,T], hT, cT).
-    lax.scan over time -> one compiled while loop on device."""
+
+    TPU lowering (the cuDNN-LSTM trick, SURVEY.md §7 hard part 3): the
+    input projection x@W for ALL timesteps is hoisted out of the
+    recurrence as ONE [T*N, I] x [I, 4H] MXU matmul; only the [N,H] x
+    [H,4H] recurrent matmul stays inside the lax.scan (unrolled to cut
+    loop overhead), so the sequential chain carries half the FLOPs and
+    the rest runs at large-matmul efficiency."""
     n, _, t = x.shape
     hsz = r.shape[0]
     if h0 is None:
@@ -769,13 +775,24 @@ def _lstm_layer(x, w, r, b=None, h0=None, c0=None, forgetBias=0.0,
         c0 = jnp.zeros((n, hsz), x.dtype)
 
     xs = jnp.moveaxis(x, 2, 0)  # [T, N, I]
+    xw = xs @ w                 # [T, N, 4H] — one batched MXU matmul
+    if b is not None:
+        xw = xw + b
 
-    def step(carry, xt):
+    def step(carry, xw_t):
         h, c = carry
-        h2, c2 = _lstm_cell(xt, h, c, w, r, b, forgetBias)
+        z = xw_t + h @ r
+        i, f, g, o = (z[..., k * hsz:(k + 1) * hsz] for k in range(4))
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + forgetBias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
         return (h2, c2), h2
 
-    (hT, cT), hs = lax.scan(step, (h0, c0), xs)
+    (hT, cT), hs = lax.scan(step, (h0, c0), xw,
+                            unroll=min(unroll, t))
     out = jnp.moveaxis(hs, 0, 2)  # [N, H, T]
     if not returnFullSequence:
         return hT, hT, cT
@@ -801,38 +818,53 @@ def _gru_cell(x, h_prev, w, r, b=None):
 
 
 @op("gruLayer")
-def _gru_layer(x, w, r, b=None, h0=None):
+def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
+    """Input projection hoisted out of the scan (same lowering as
+    lstmLayer); the reset-gated candidate keeps only h@r sequential."""
     n, _, t = x.shape
     hsz = r.shape[0]
     if h0 is None:
         h0 = jnp.zeros((n, hsz), x.dtype)
-    xs = jnp.moveaxis(x, 2, 0)
+    xs = jnp.moveaxis(x, 2, 0)            # [T, N, I]
+    xw = xs @ w                           # [T, N, 3H] — one MXU matmul
+    if b is not None:
+        xw = xw + b[: 3 * hsz]
+    rb = None if b is None else b[3 * hsz:]
 
-    def step(h, xt):
-        h2 = _gru_cell(xt, h, w, r, b)
+    def step(h, xw_t):
+        rz = h @ r
+        if rb is not None:
+            rz = rz + rb
+        ru_w, c_w = xw_t[..., : 2 * hsz], xw_t[..., 2 * hsz:]
+        ru_r, c_r = rz[..., : 2 * hsz], rz[..., 2 * hsz:]
+        ru = jax.nn.sigmoid(ru_w + ru_r)
+        rgate, ugate = ru[..., :hsz], ru[..., hsz:]
+        cand = jnp.tanh(c_w + rgate * c_r)
+        h2 = ugate * h + (1.0 - ugate) * cand
         return h2, h2
 
-    hT, hs = lax.scan(step, h0, xs)
+    hT, hs = lax.scan(step, h0, xw, unroll=min(unroll, t))
     return jnp.moveaxis(hs, 0, 2), hT
 
 
 @op("simpleRnnLayer")
-def _simple_rnn_layer(x, w, r, b=None, h0=None, activation="tanh"):
+def _simple_rnn_layer(x, w, r, b=None, h0=None, activation="tanh",
+                      unroll=4):
     n, _, t = x.shape
     hsz = r.shape[0]
     if h0 is None:
         h0 = jnp.zeros((n, hsz), x.dtype)
     act = OPS[activation]
     xs = jnp.moveaxis(x, 2, 0)
+    xw = xs @ w                           # hoisted input projection
+    if b is not None:
+        xw = xw + b
 
-    def step(h, xt):
-        z = xt @ w + h @ r
-        if b is not None:
-            z = z + b
-        h2 = act(z)
+    def step(h, xw_t):
+        h2 = act(xw_t + h @ r)
         return h2, h2
 
-    hT, hs = lax.scan(step, h0, xs)
+    hT, hs = lax.scan(step, h0, xw, unroll=min(unroll, t))
     return jnp.moveaxis(hs, 0, 2), hT
 
 

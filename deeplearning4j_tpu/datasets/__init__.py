@@ -24,3 +24,5 @@ from deeplearning4j_tpu.datasets.image import (  # noqa: F401
     ImageTransform, NativeImageLoader, ParentPathLabelGenerator,
     PathLabelGenerator, PipelineImageTransform, ResizeImageTransform,
     ScaleImageTransform)
+from deeplearning4j_tpu.datasets.parallel_etl import (  # noqa: F401
+    LocalTransformExecutor, ParallelImageDataSetIterator)

@@ -1,0 +1,253 @@
+"""Parallel local ETL: multiprocessing executors for TransformProcess
+pipelines and image-tree ingestion, with async device prefetch.
+
+Reference capability: the reference executes DataVec pipelines on Spark
+(`datavec-spark`) or the multi-threaded local executor
+(`datavec-local` LocalTransformExecutor) and streams batches into
+training via async iterators (SURVEY.md §2.4 executor rows; VERDICT
+round-2 missing item 6: the single-threaded record-by-record
+TransformProcess would starve a ResNet-class config). TPU-first design:
+
+- host-side ETL scales across PROCESSES (Python parses/decodes with the
+  GIL held — threads cannot scale image decode), using the `fork` start
+  method so TransformProcess closures and file lists are inherited, not
+  pickled;
+- workers produce whole BATCH arrays (one IPC transfer per batch, not
+  per record) tagged with sequence numbers; the parent reorders so batch
+  order is deterministic regardless of worker scheduling;
+- the parent optionally `jax.device_put`s each assembled batch on
+  arrival (async dispatch), so the accelerator upload overlaps the next
+  batch's decode — the AsyncDataSetIterator idea, pushed down to the
+  process pool.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as queue_mod
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+# fork-inherited globals (set in the parent right before forking): the
+# executor's TransformProcess / image spec reach workers without pickling
+_WORK = {}
+
+
+def _default_workers():
+    return max(1, (os.cpu_count() or 1))
+
+
+# ---------------------------------------------------------------------------
+# TransformProcess executor
+# ---------------------------------------------------------------------------
+
+def _tp_chunk(args):
+    lo, hi = args
+    tp = _WORK["tp"]
+    records = _WORK["records"]
+    out = []
+    for r in records[lo:hi]:
+        res = tp.executeRecord(r)
+        if res is not None:
+            out.append(res)
+    return out
+
+
+class LocalTransformExecutor:
+    """Chunked multi-process TransformProcess execution (reference:
+    org.datavec.local.transforms.LocalTransformExecutor)."""
+
+    @staticmethod
+    def execute(records, transform_process, numWorkers=None,
+                chunkSize=1024):
+        records = list(records)
+        n = len(records)
+        workers = numWorkers or _default_workers()
+        if workers <= 1 or n <= chunkSize:
+            return transform_process.execute(records)
+        ctx = mp.get_context("fork")
+        _WORK["tp"] = transform_process
+        _WORK["records"] = records
+        try:
+            chunks = [(lo, min(lo + chunkSize, n))
+                      for lo in range(0, n, chunkSize)]
+            with ctx.Pool(workers) as pool:
+                parts = pool.map(_tp_chunk, chunks)
+        finally:
+            _WORK.clear()
+        out = []
+        for p in parts:
+            out.extend(p)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# parallel image ingestion
+# ---------------------------------------------------------------------------
+
+def _image_worker(worker_id, n_workers, batch_size, n_batches, out_q,
+                  seed):
+    """Decode/augment whole batches (worker w owns batches w, w+W, ...)
+    and push (seq, features, label_idx) tuples."""
+    files = _WORK["files"]
+    labels = _WORK["labels"]
+    label_of = _WORK["label_of"]
+    loader = _WORK["loader"]
+    transform = _WORK["transform"]
+    try:
+        for seq in range(worker_id, n_batches, n_workers):
+            chunk = files[seq * batch_size:(seq + 1) * batch_size]
+            rng = np.random.default_rng(seed + (seq,))
+            feats, idxs = [], []
+            for path in chunk:
+                arr = loader.asMatrix(path)
+                if transform is not None:
+                    arr = transform.transform(arr, rng)
+                feats.append(arr)
+                idxs.append(labels.index(label_of(path)))
+            out_q.put((seq, np.stack(feats).astype(np.float32),
+                       np.asarray(idxs, np.int32)))
+        out_q.put(("done", worker_id, None))
+    except Exception as e:  # surfaced by the parent
+        out_q.put(("error", worker_id, f"{type(e).__name__}: {e}"))
+
+
+class ParallelImageDataSetIterator(DataSetIterator):
+    """Image-tree -> DataSet iterator whose decode/augment runs across
+    `numWorkers` processes; batches arrive in deterministic order and are
+    optionally pre-staged on the accelerator.
+
+    Capability analog of ImageRecordReader + RecordReaderDataSetIterator
+    + AsyncDataSetIterator fused, at the throughput the reference gets
+    from its multi-threaded ETL (SURVEY.md §2.4)."""
+
+    def __init__(self, split, height, width, channels=3, batchSize=32,
+                 labelGenerator=None, imageTransform=None, numWorkers=None,
+                 prefetchToDevice=False, seed=0, queueSize=8):
+        super().__init__(batchSize)
+        from deeplearning4j_tpu.datasets.image import (
+            NativeImageLoader, ParentPathLabelGenerator)
+
+        self._split = split
+        self._loader = NativeImageLoader(height, width, channels)
+        self._label_gen = labelGenerator or ParentPathLabelGenerator()
+        self._transform = imageTransform
+        self._workers = numWorkers or _default_workers()
+        self._prefetch = prefetchToDevice
+        self._seed = seed
+        self._qsize = queueSize
+
+        files = [f for f in split.locations()
+                 if f.lower().endswith((".png", ".jpg", ".jpeg", ".bmp",
+                                        ".gif"))]
+        self._files = files
+        self._labels = sorted({self._label_gen.getLabelForPath(f)
+                               for f in files})
+        # ceil: the final partial batch is produced too (the serial
+        # reader path yields every record; silently dropping the tail
+        # would train on a fixed subset forever)
+        self._n_batches = -(-len(files) // batchSize)
+        if self._n_batches == 0:
+            raise ValueError("no images found")
+        self._procs = []
+        self._reorder = {}
+        self._next_seq = 0
+        self._queue = None
+        self._live_workers = 0
+        self._epoch = 0
+
+    def getLabels(self):
+        return list(self._labels)
+
+    def totalOutcomes(self):
+        return len(self._labels)
+
+    def _start(self):
+        ctx = mp.get_context("fork")
+        self._queue = ctx.Queue(maxsize=self._qsize)
+        _WORK["files"] = self._files
+        _WORK["labels"] = self._labels
+        _WORK["label_of"] = self._label_gen.getLabelForPath
+        _WORK["loader"] = self._loader
+        _WORK["transform"] = self._transform
+        try:
+            n = min(self._workers, self._n_batches)
+            # fold the epoch counter into the augmentation seed so
+            # reset() does not replay identical random transforms
+            epoch_seed = (self._seed, self._epoch)
+            self._epoch += 1
+            self._procs = [
+                ctx.Process(target=_image_worker,
+                            args=(w, n, self._batch, self._n_batches,
+                                  self._queue, epoch_seed), daemon=True)
+                for w in range(n)
+            ]
+            for p in self._procs:
+                p.start()
+        finally:
+            _WORK.clear()
+        self._live_workers = len(self._procs)
+        self._reorder = {}
+        self._next_seq = 0
+
+    def hasNext(self):
+        return self._next_seq < self._n_batches
+
+    def next(self):
+        if not self.hasNext():
+            raise StopIteration
+        if self._queue is None:
+            self._start()
+        while self._next_seq not in self._reorder:
+            try:
+                seq, a, b = self._queue.get(timeout=300)
+            except queue_mod.Empty:
+                raise RuntimeError("image workers stalled (>300 s)")
+            if seq == "error":
+                raise RuntimeError(f"image worker {a} failed: {b}")
+            if seq == "done":
+                self._live_workers -= 1
+                if self._live_workers == 0 and \
+                        self._next_seq not in self._reorder and \
+                        not self._reorder:
+                    raise RuntimeError(
+                        "workers finished but batches are missing")
+                continue
+            self._reorder[seq] = (a, b)
+        feats, idxs = self._reorder.pop(self._next_seq)
+        self._next_seq += 1
+        labels = np.zeros((feats.shape[0], len(self._labels)), np.float32)
+        labels[np.arange(feats.shape[0]), idxs] = 1.0
+        if self._prefetch:
+            import jax
+
+            feats = jax.device_put(feats)
+            labels = jax.device_put(labels)
+        ds = DataSet(feats, labels)
+        if self.preProcessor is not None:
+            self.preProcessor.preProcess(ds)
+        return ds
+
+    def reset(self):
+        self._shutdown()
+        self._queue = None
+        self._next_seq = 0
+        self._reorder = {}
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        self._procs = []
+
+    def __del__(self):  # best-effort cleanup
+        try:
+            self._shutdown()
+        except Exception:
+            pass

@@ -79,6 +79,25 @@ def available() -> bool:
     return _load() is not None
 
 
+def sg_pairs_flat(flat, offsets, bs):
+    """sg_pairs over the flat token array + sentence offsets directly
+    (no per-sentence Python list): the 10M-word-corpus path."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    bs = np.ascontiguousarray(bs, dtype=np.int32)
+    if len(flat) == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    cap = int(2 * bs.sum())
+    centers = np.empty(cap, np.int32)
+    contexts = np.empty(cap, np.int32)
+    n = lib.sg_pairs(flat, offsets, len(offsets) - 1, bs, centers,
+                     contexts)
+    return centers[:n].copy(), contexts[:n].copy()
+
+
 def sg_pairs(encoded_sentences, bs):
     """Skip-gram pairs across sentences. encoded_sentences: list of int32
     arrays; bs: int32 window draws, concatenated per token. Returns

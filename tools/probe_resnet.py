@@ -1,0 +1,272 @@
+"""Whole-model ResNet-50 training ablation probe for the MFU diagnosis.
+
+Hand-written minimal ResNet-50 train step (pure jnp, bf16 activations,
+f32 params, SGD momentum) measured at K steps per launch, ablating:
+  --layout NHWC|NCHW        conv/BN data layout
+  --bn twopass|onepass|none batchnorm stats strategy
+  --batch N
+
+The framework model (zoo.ResNet50 via fitMultiBatch) measures 10.9% MFU
+(BENCH_ALL round 2); per-shape convs sustain 25-45% of peak
+(tools/probe_conv.py), so this probe separates framework overhead from
+the model's intrinsic ceiling on v5e and tells us which knobs matter.
+
+Run: python tools/probe_resnet.py --layout NHWC --bn onepass
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+V5E_PEAK_BF16 = 197e12
+TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
+
+# (filters, blocks, stride) per stage
+STAGES = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+
+
+def _conv_init(key, cin, cout, k):
+    std = float(np.sqrt(2.0 / (k * k * cin)))
+    return jax.random.normal(key, (k, k, cin, cout), jnp.float32) * std
+
+
+def init_params(key, num_classes=1000):
+    keys = iter(jax.random.split(key, 256))
+    p = {"stem": {"w": _conv_init(next(keys), 3, 64, 7),
+                  "g": jnp.ones((64,)), "b": jnp.zeros((64,))}}
+    cin = 64
+    for si, (f, blocks, stride) in enumerate(STAGES):
+        for bi in range(blocks):
+            blk = {}
+            s = stride if bi == 0 else 1
+            cout = 4 * f
+            blk["c1"] = {"w": _conv_init(next(keys), cin, f, 1),
+                         "g": jnp.ones((f,)), "b": jnp.zeros((f,))}
+            blk["c2"] = {"w": _conv_init(next(keys), f, f, 3),
+                         "g": jnp.ones((f,)), "b": jnp.zeros((f,))}
+            blk["c3"] = {"w": _conv_init(next(keys), f, cout, 1),
+                         "g": jnp.ones((cout,)), "b": jnp.zeros((cout,))}
+            if bi == 0:
+                blk["proj"] = {"w": _conv_init(next(keys), cin, cout, 1),
+                               "g": jnp.ones((cout,)),
+                               "b": jnp.zeros((cout,))}
+            p[f"s{si}b{bi}"] = blk
+            cin = cout
+    p["fc"] = {"w": jax.random.normal(next(keys), (cin, num_classes),
+                                      jnp.float32) * 0.01,
+               "b": jnp.zeros((num_classes,))}
+    return p
+
+
+def s2d_nhwc(x, b=2):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        n, h // b, w // b, b * b * c)
+
+
+def stem_kernel_s2d(w):
+    """[7,7,3,64] stride-2 stem kernel -> the EXACT-equivalent [4,4,12,64]
+    stride-1 kernel over space-to-depth(2) input (zero-pad 7->8, fold the
+    2x2 phase into channels; the MLPerf ResNet stem transform)."""
+    cin, cout = w.shape[2], w.shape[3]
+    w = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))   # [8,8,cin,cout]
+    w = w.reshape(4, 2, 4, 2, cin, cout)
+    # s2d packs (bh, bw, c) with spatial-block-major, channel-fastest:
+    # in channel index = (bh*2 + bw)*C + c
+    return jnp.transpose(w, (0, 2, 1, 3, 4, 5)).reshape(
+        4, 4, 4 * cin, cout)
+
+
+def make_forward(layout, bn_mode, head=True, stem="conv"):
+    nhwc = layout == "NHWC"
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    caxis = 3 if nhwc else 1
+
+    def conv(x, w, stride):
+        if not nhwc:
+            w = jnp.transpose(w, (3, 2, 0, 1))  # HWIO -> OIHW
+        return lax.conv_general_dilated(
+            x, w.astype(x.dtype), (stride, stride), "SAME",
+            dimension_numbers=dn)
+
+    def bn(x, g, b):
+        axes = tuple(i for i in range(4) if i != caxis)
+        shape = [1, 1, 1, 1]
+        shape[caxis] = -1
+        if bn_mode == "none":
+            return x * g.reshape(shape).astype(x.dtype) \
+                + b.reshape(shape).astype(x.dtype)
+        xf = x.astype(jnp.float32)
+        if bn_mode == "twopass":
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf - mean.reshape(shape)), axis=axes)
+        else:  # onepass: E[x^2] - mean^2, f32 accumulation
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        inv = lax.rsqrt(var + 1e-5) * g
+        return (xf * inv.reshape(shape)
+                + (b - mean * inv).reshape(shape)).astype(x.dtype)
+
+    def cbr(x, pp, stride, relu=True):
+        y = bn(conv(x, pp["w"], stride), pp["g"], pp["b"])
+        return jax.nn.relu(y) if relu else y
+
+    def forward(params, x):
+        if stem == "s2d":
+            if not nhwc:
+                raise ValueError("s2d stem probe is NHWC-only")
+            y = conv(s2d_nhwc(x), stem_kernel_s2d(params["stem"]["w"]), 1)
+            y = bn(y, params["stem"]["g"], params["stem"]["b"])
+            y = jax.nn.relu(y)
+        else:
+            y = cbr(x, params["stem"], 2)
+        window = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
+        strides = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, window, strides, "SAME")
+        cin = 64
+        for si, (f, blocks, stride) in enumerate(STAGES):
+            for bi in range(blocks):
+                blk = params[f"s{si}b{bi}"]
+                s = stride if bi == 0 else 1
+                h = cbr(y, blk["c1"], s)
+                h = cbr(h, blk["c2"], 1)
+                h = cbr(h, blk["c3"], 1, relu=False)
+                if bi == 0:
+                    y = cbr(y, blk["proj"], s, relu=False)
+                y = jax.nn.relu(y + h)
+        y = jnp.mean(y.astype(jnp.float32), axis=(1, 2) if nhwc else (2, 3))
+        if not head:
+            return y
+        return y @ params["fc"]["w"] + params["fc"]["b"]
+
+    return forward
+
+
+def stage_probe(args):
+    """Cumulative-prefix timing: train-step time of the model truncated
+    after each stage; successive deltas localize where the whole-model
+    time goes (vs the per-shape conv numbers)."""
+    nhwc = args.layout == "NHWC"
+    b = args.batch
+    rng = np.random.default_rng(0)
+    params = init_params(jax.random.key(0))
+
+    results = {}
+    full_stages = list(STAGES)
+    prev = None
+    for upto in range(len(full_stages) + 1):
+        STAGES[:] = full_stages[:upto]
+        fwd_u = make_forward(args.layout, args.bn, head=False)
+
+        def loss_fn(params, x, fwd_u=fwd_u):
+            pooled = fwd_u(params, x)
+            # scalar objective over pooled features; grads flow through
+            # every used layer (fc/yet-unbuilt stages get zero grads)
+            return jnp.mean(jnp.square(pooled))
+
+        @jax.jit
+        def fb(params, x):
+            l, g = jax.value_and_grad(loss_fn)(params, x)
+            # touch every grad leaf so nothing is dead-code eliminated
+            return l + sum(jnp.max(jnp.abs(t)) * 1e-30
+                           for t in jax.tree_util.tree_leaves(g))
+
+        shape = (b, 224, 224, 3) if nhwc else (b, 3, 224, 224)
+        x = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+        float(fb(params, x))
+        float(fb(params, x))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fb(params, x))
+            best = min(best, time.perf_counter() - t0)
+        name = "stem+pool" if upto == 0 else f"+stage{upto - 1}"
+        delta = best - prev if prev is not None else best
+        results[name] = {"cum_ms": round(best * 1e3, 2),
+                         "delta_ms": round(delta * 1e3, 2)}
+        print(json.dumps({name: results[name]}), flush=True)
+        prev = best
+    STAGES[:] = full_stages
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layout", default="NHWC", choices=["NHWC", "NCHW"])
+    ap.add_argument("--bn", default="twopass",
+                    choices=["twopass", "onepass", "none"])
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--ksteps", type=int, default=8)
+    ap.add_argument("--mode", default="train",
+                    choices=["train", "stages"])
+    ap.add_argument("--stem", default="conv", choices=["conv", "s2d"])
+    args = ap.parse_args()
+    if args.mode == "stages":
+        stage_probe(args)
+        return
+
+    fwd = make_forward(args.layout, args.bn, stem=args.stem)
+    params = init_params(jax.random.key(0))
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    b = args.batch
+    rng = np.random.default_rng(0)
+    shape = (b, 224, 224, 3) if args.layout == "NHWC" else (b, 3, 224, 224)
+    x = jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (b,)), jnp.int32)
+
+    def loss_fn(params, x, labels):
+        logits = fwd(params, x)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[:, None], 1))
+
+    @jax.jit
+    def steps(params, mom, x, labels):
+        def body(carry, _):
+            params, mom = carry
+            loss, g = jax.value_and_grad(loss_fn)(params, x, labels)
+            mom = jax.tree_util.tree_map(
+                lambda m, gg: 0.9 * m + gg, mom, g)
+            params = jax.tree_util.tree_map(
+                lambda p, m: p - 0.01 * m, params, mom)
+            return (params, mom), loss
+
+        (params, mom), losses = lax.scan(body, (params, mom), None,
+                                         length=args.ksteps)
+        return params, mom, losses
+
+    k = args.ksteps
+    params, mom, losses = steps(params, mom, x, labels)
+    float(losses[-1])  # compile+run
+    params, mom, losses = steps(params, mom, x, labels)
+    float(losses[-1])  # warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, mom, losses = steps(params, mom, x, labels)
+        float(losses[-1])
+        best = min(best, (time.perf_counter() - t0) / k)
+
+    ips = b / best
+    mfu = ips * TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16
+    print(json.dumps({
+        "layout": args.layout, "bn": args.bn, "batch": b,
+        "img_per_sec": round(ips, 1), "step_ms": round(best * 1e3, 2),
+        "mfu": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
