@@ -779,6 +779,27 @@ def _lstm_layer(x, w, r, b=None, h0=None, c0=None, forgetBias=0.0,
     if b is not None:
         xw = xw + b
 
+    # Pallas recurrence kernel on TPU when shapes/dtype allow: h, c and R
+    # stay VMEM-resident across all timesteps (up to ~1.25x the scan at
+    # large batch; kernels/lstm.py documents the design and bounds)
+    import os as _os
+
+    from deeplearning4j_tpu.kernels.lstm import lstm_seq, lstm_seq_available
+
+    if (jax.default_backend() == "tpu"
+            and lstm_seq_available(x.shape[0], hsz, x.dtype)
+            and r.dtype == jnp.float32
+            and _os.environ.get("DL4J_DISABLE_PALLAS_LSTM") != "1"):
+        xw_k = xw.astype(jnp.float32)
+        if forgetBias:
+            xw_k = xw_k.at[:, :, hsz:2 * hsz].add(forgetBias)
+        hs_k, hT, cT = lstm_seq(xw_k, r, h0.astype(jnp.float32),
+                                c0.astype(jnp.float32))
+        out = jnp.moveaxis(hs_k, 0, 2)
+        if not returnFullSequence:
+            return hT, hT, cT
+        return out, hT, cT
+
     def step(carry, xw_t):
         h, c = carry
         z = xw_t + h @ r

@@ -1,0 +1,7 @@
+"""In-repo Pallas TPU kernels — the custom-call tier SURVEY.md §7
+reserves for ops where generic XLA lowering demonstrably misses
+(reference analog: libnd4j's platform-helper kernels, e.g. the cuDNN
+LSTM path). Each kernel ships with an XLA fallback and parity tests."""
+
+from deeplearning4j_tpu.kernels.lstm import (  # noqa: F401
+    lstm_seq, lstm_seq_available)

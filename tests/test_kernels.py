@@ -1,0 +1,80 @@
+"""Parity tests for the in-repo Pallas kernels (interpret mode on the
+CPU test platform; the compiled path is covered by the TPU-gated tier).
+
+Reference analog: libnd4j platform-helper conformance — the custom
+kernel must match the generic lowering bit-for-tolerance (SURVEY.md §4
+op-validation row)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.kernels.lstm import lstm_seq
+
+
+def _scan_reference(xw, r, h0, c0):
+    hsz = r.shape[0]
+
+    def step(carry, xw_t):
+        h, c = carry
+        z = xw_t + h @ r
+        i = jax.nn.sigmoid(z[:, :hsz])
+        f = jax.nn.sigmoid(z[:, hsz:2 * hsz])
+        g = jnp.tanh(z[:, 2 * hsz:3 * hsz])
+        o = jax.nn.sigmoid(z[:, 3 * hsz:])
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0), xw)
+    return hs, hT, cT
+
+
+def _data(t=5, n=8, h=128, seed=0):
+    rng = np.random.default_rng(seed)
+    xw = jnp.asarray(rng.normal(size=(t, n, 4 * h)) * 0.3, jnp.float32)
+    r = jnp.asarray(rng.normal(size=(h, 4 * h)) * 0.1, jnp.float32)
+    h0 = jnp.asarray(rng.normal(size=(n, h)) * 0.2, jnp.float32)
+    c0 = jnp.asarray(rng.normal(size=(n, h)) * 0.2, jnp.float32)
+    return xw, r, h0, c0
+
+
+class TestLstmPallasParity:
+    def test_forward_matches_scan(self):
+        xw, r, h0, c0 = _data()
+        hs_k, hT_k, cT_k = lstm_seq(xw, r, h0, c0, True)
+        hs_s, hT_s, cT_s = _scan_reference(xw, r, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_s),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_s),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cT_k), np.asarray(cT_s),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_scan(self):
+        xw, r, h0, c0 = _data(t=4, n=8, h=128, seed=3)
+
+        def loss_k(xw, r, h0, c0):
+            hs, hT, cT = lstm_seq(xw, r, h0, c0, True)
+            return (jnp.sum(hs * jnp.cos(hs)) + jnp.sum(hT * hT)
+                    + jnp.sum(jnp.abs(cT)))
+
+        def loss_s(xw, r, h0, c0):
+            hs, hT, cT = _scan_reference(xw, r, h0, c0)
+            return (jnp.sum(hs * jnp.cos(hs)) + jnp.sum(hT * hT)
+                    + jnp.sum(jnp.abs(cT)))
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(xw, r, h0, c0)
+        gs = jax.grad(loss_s, argnums=(0, 1, 2, 3))(xw, r, h0, c0)
+        for a, b, name in zip(gk, gs, ("dxw", "dR", "dh0", "dc0")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                err_msg=name)
+
+    def test_single_timestep(self):
+        xw, r, h0, c0 = _data(t=1, n=8, h=128, seed=5)
+        hs_k, hT_k, cT_k = lstm_seq(xw, r, h0, c0, True)
+        hs_s, hT_s, cT_s = _scan_reference(xw, r, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_s),
+                                   rtol=1e-5, atol=1e-6)

@@ -203,3 +203,108 @@ net.fit([(x, y)] * 40)
 assert net.score((x, y)) < s0
 print("OK")
 """)
+
+
+@gated
+class TestPallasLstmOnChip:
+    def test_compiled_kernel_matches_scan(self):
+        out = _run("""
+import numpy as np, jax, jax.numpy as jnp, os
+from deeplearning4j_tpu.kernels.lstm import lstm_seq
+rng = np.random.default_rng(0)
+t, n, h = 12, 8, 128
+xw = jnp.asarray(rng.normal(size=(t, n, 4*h))*0.3, jnp.float32)
+r = jnp.asarray(rng.normal(size=(h, 4*h))*0.1, jnp.float32)
+h0 = jnp.asarray(rng.normal(size=(n, h))*0.2, jnp.float32)
+c0 = jnp.zeros((n, h), jnp.float32)
+hs_c, hT_c, cT_c = jax.jit(lambda *a: lstm_seq(*a, False))(xw, r, h0, c0)
+hs_i, _, _ = lstm_seq(xw, r, h0, c0, True)
+np.testing.assert_allclose(np.asarray(hs_c), np.asarray(hs_i),
+                           rtol=3e-5, atol=2e-5)
+def loss(impl):
+    def f(xw, r):
+        hs, hT, cT = lstm_seq(xw, r, h0, c0, impl)
+        return jnp.sum(hs * hs) + jnp.sum(hT) - jnp.sum(cT)
+    return f
+gc = jax.jit(jax.grad(loss(False), argnums=(0, 1)))(xw, r)
+gi = jax.grad(loss(True), argnums=(0, 1))(xw, r)
+for a, b in zip(gc, gi):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=5e-4, atol=5e-5)
+print("PALLAS_LSTM_PARITY_OK")
+""")
+        assert "PALLAS_LSTM_PARITY_OK" in out
+
+    def test_lstm_layer_routes_to_kernel_and_trains(self):
+        out = _run("""
+import numpy as np
+from deeplearning4j_tpu.nn import (InputType, LSTM, MultiLayerNetwork,
+                                   NeuralNetConfiguration, RnnOutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+# H=128 batch=8: satisfies the kernel's shape gate on TPU
+conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-3))
+        .list()
+        .layer(LSTM.Builder().nOut(128).activation("tanh").build())
+        .layer(RnnOutputLayer.Builder().nOut(5).activation("softmax")
+               .build())
+        .setInputType(InputType.recurrent(5, 16)).build())
+net = MultiLayerNetwork(conf); net.init()
+rng = np.random.default_rng(0)
+ids = rng.integers(0, 5, (8, 17))
+X = np.eye(5, dtype=np.float32)[ids[:, :-1]].transpose(0, 2, 1)
+y = np.eye(5, dtype=np.float32)[ids[:, 1:]].transpose(0, 2, 1)
+s0 = net.score((X, y))
+net.fit([(X, y)] * 25)
+s1 = net.score((X, y))
+assert s1 < s0, (s0, s1)
+print("PALLAS_LSTM_TRAIN_OK", s0, "->", s1)
+""")
+        assert "PALLAS_LSTM_TRAIN_OK" in out
+
+
+@gated
+class TestPallasLstmRoutedBranchParity:
+    def test_lstm_layer_kernel_vs_scan_with_forget_bias(self):
+        """The _lstm_layer ROUTING branch (forgetBias fold,
+        returnFullSequence=False) must match the scan branch numerically
+        — run both in subprocesses toggled by DL4J_DISABLE_PALLAS_LSTM."""
+        script = """
+import numpy as np, jax, jax.numpy as jnp
+from deeplearning4j_tpu.autodiff.ops import OPS
+rng = np.random.default_rng(7)
+n, i_sz, h, t = 8, 16, 128, 10
+x = jnp.asarray(rng.normal(size=(n, i_sz, t)) * 0.5, jnp.float32)
+w = jnp.asarray(rng.normal(size=(i_sz, 4 * h)) * 0.1, jnp.float32)
+r = jnp.asarray(rng.normal(size=(h, 4 * h)) * 0.1, jnp.float32)
+b = jnp.asarray(rng.normal(size=(4 * h,)) * 0.05, jnp.float32)
+out, hT, cT = OPS["lstmLayer"](x, w, r, b, forgetBias=1.0)
+hT2, _, cT2 = OPS["lstmLayer"](x, w, r, b, forgetBias=1.0,
+                               returnFullSequence=False)
+g = jax.grad(lambda w, r: jnp.sum(jnp.square(
+    OPS["lstmLayer"](x, w, r, b, forgetBias=1.0)[0])),
+    argnums=(0, 1))(w, r)
+np.save("/tmp/_lstm_branch_{tag}.npy",
+        {"out": np.asarray(out), "hT": np.asarray(hT),
+         "cT": np.asarray(cT), "hT2": np.asarray(hT2),
+         "cT2": np.asarray(cT2), "gw": np.asarray(g[0]),
+         "gr": np.asarray(g[1])}, allow_pickle=True)
+print("BRANCH_OK")
+"""
+        import numpy as np
+
+        for tag, env_extra in (("kernel", {}),
+                               ("scan", {"DL4J_DISABLE_PALLAS_LSTM": "1"})):
+            env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+            env.update(env_extra)
+            res = subprocess.run(
+                [sys.executable, "-c", script.replace("{tag}", tag)],
+                cwd=_REPO, env=env, capture_output=True, text=True,
+                timeout=420)
+            assert res.returncode == 0, res.stderr
+        a = np.load("/tmp/_lstm_branch_kernel.npy",
+                    allow_pickle=True).item()
+        b = np.load("/tmp/_lstm_branch_scan.npy",
+                    allow_pickle=True).item()
+        for k in a:
+            np.testing.assert_allclose(a[k], b[k], rtol=5e-4, atol=5e-5,
+                                       err_msg=k)
