@@ -217,25 +217,36 @@ class _Importer:
         return var
 
     def emit(self, node, fn_name, in_refs, attrs=None, out_dtype=None,
-             out_idx_base=0):
-        """Emit one SameDiff op; static shape via jax.eval_shape."""
+             out_idx_base=0, out_name=None):
+        """Emit one SameDiff op; static shape via jax.eval_shape.
+        out_name overrides the bound tensor name (for handlers that emit
+        helper ops around the TF node, e.g. NHWC permutes)."""
         import jax
 
         from deeplearning4j_tpu.autodiff.ops import OPS
 
+        name = out_name or node.name
         in_vars = [self.var(r) for r in in_refs]
         structs = [jax.ShapeDtypeStruct(self.shape(r), self.dtype(r))
                    for r in in_refs]
         attrs = {k: v for k, v in (attrs or {}).items() if v is not None}
-        out_struct = jax.eval_shape(lambda *a: OPS[fn_name](*a, **attrs),
-                                    *structs)
+        try:
+            out_struct = jax.eval_shape(
+                lambda *a: OPS[fn_name](*a, **attrs), *structs)
+        except TFImportError:
+            raise
+        except Exception as e:
+            # surface op-level shape/config errors with graph context
+            raise TFImportError(
+                f"node {node.name!r} ({node.op}): {fn_name} rejected "
+                f"the configuration: {e}") from e
         multi = isinstance(out_struct, (tuple, list))
         n_out = len(out_struct) if multi else 1
-        res = self.sd._op(fn_name, in_vars, attrs, node.name, n_out=n_out)
+        res = self.sd._op(fn_name, in_vars, attrs, name, n_out=n_out)
         outs = res if multi else (res,)
         structs_out = out_struct if multi else (out_struct,)
         for i, (v, st) in enumerate(zip(outs, structs_out)):
-            self.bind(node.name, v, st.shape,
+            self.bind(name, v, st.shape,
                       out_dtype or st.dtype, out_idx=i)
         return res
 
@@ -1006,3 +1017,71 @@ def _h_v1_control_flow(im, node):
         "be interpreted as a graph op — re-export the model with TF2 "
         "functional control flow (While/If + function library), which "
         "imports onto SameDiff whileLoop/ifCond")
+
+
+@handler("ResizeBilinear", "ResizeNearestNeighbor", "ResizeBicubic",
+         "ResizeArea")
+def _h_resize(im, node):
+    """TF resize ops are NHWC; route through the NCHW imageResize op via
+    permutes (same pattern as Conv2D).
+
+    Sampling semantics: jax.image.resize implements half-pixel-center
+    sampling (TF2, half_pixel_centers=True). align_corners=True is
+    rejected; graphs with the TF1-legacy default (half_pixel_centers
+    absent/False) import with a warning — interior samples can shift by
+    up to half a source pixel vs TF1. jax 'cubic' is Keys a=-0.5 where
+    TF1 ResizeBicubic uses a=-0.75 (documented divergence)."""
+    ac = node.attrs.get("align_corners")
+    if ac is not None and ac.b:
+        raise TFImportError(
+            f"node {node.name!r} ({node.op}): align_corners=True has no "
+            f"jax.image.resize equivalent — re-export with "
+            f"half_pixel_centers=True")
+    hpc = node.attrs.get("half_pixel_centers")
+    if node.op != "ResizeArea" and (hpc is None or not hpc.b):
+        import warnings
+
+        warnings.warn(
+            f"TF import: {node.op} node {node.name!r} uses TF1-legacy "
+            f"sampling (half_pixel_centers=False); imported with "
+            f"half-pixel-center semantics — interior samples may shift "
+            f"by up to half a source pixel", stacklevel=2)
+    ins = im.data_inputs(node)
+    size = im.need_const(ins[1], "resize size")
+    oh, ow = int(size[0]), int(size[1])
+    method = {"ResizeBilinear": "bilinear",
+              "ResizeNearestNeighbor": "nearest",
+              "ResizeBicubic": "cubic",
+              "ResizeArea": "area"}[node.op]
+    x = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+    im.emit(node, "imageResize", [x],
+            {"height": oh, "width": ow, "method": method},
+            out_name=f"{node.name}__resize")
+    _permute(im, node, f"{node.name}__resize:0", (0, 2, 3, 1), "",
+             out_name=node.name)
+
+
+@handler("NonMaxSuppressionV3", "NonMaxSuppressionV4")
+def _h_nms(im, node):
+    """STATIC-SHAPE deviation from TF (documented): TF returns a
+    dynamic-length [num_selected] index tensor; XLA needs static shapes,
+    so the imported op returns [maxOutputSize] padded with -1. V4
+    consumers get the real `valid_outputs` count as output :1 and must
+    mask before gathering (a -1 fed to gather wraps to the last row);
+    V3 consumers should count idx >= 0 themselves."""
+    ins = im.data_inputs(node)
+    max_out = int(im.need_const(ins[2], "NMS max_output_size"))
+    iou = float(im.need_const(ins[3], "NMS iou_threshold"))
+    attrs = {"maxOutputSize": max_out, "iouThreshold": iou}
+    if len(ins) > 4:
+        attrs["scoreThreshold"] = float(
+            im.need_const(ins[4], "NMS score_threshold"))
+    idx = im.emit(node, "nonMaxSuppression", ins[:2], attrs)
+    if node.op == "NonMaxSuppressionV4":
+        # second output: valid_outputs = count of non-padding indices
+        zero = im.sd.constant(f"{node.name}__zero", np.int32(0))
+        ge = im.sd._op("gte", [idx, zero], {}, f"{node.name}__ge")
+        cnt = im.sd._op("sum", [ge], {}, f"{node.name}__validsum")
+        valid = im.sd._op("cast", [cnt], {"dtype": "int32"},
+                          f"{node.name}__valid")
+        im.bind(node.name, valid, (), np.int32, out_idx=1)

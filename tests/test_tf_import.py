@@ -771,3 +771,81 @@ class TestFullBertImport:
     def test_bert_base_real_dims_imports_and_trains(self):
         self._run(vocab=30522, hidden=768, layers=12, heads=12, ffn=3072,
                   batch=2, seq=16, epochs=2)
+
+
+class TestResizeAndNms:
+    def test_resize_bilinear_nhwc(self):
+        gd = GraphDef([
+            placeholder("img", [1, 4, 4, 2]),
+            const("sz", np.array([8, 8], np.int32)),
+            NodeDef("up", "ResizeBilinear", ["img", "sz"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        x = np.random.default_rng(0).normal(size=(1, 4, 4, 2)) \
+            .astype(np.float32)
+        out = sd.output({"img": x}, "up")["up"].numpy()
+        assert out.shape == (1, 8, 8, 2)
+        # corners of bilinear (antialias off) preserve values
+        np.testing.assert_allclose(out[0, 0, 0], x[0, 0, 0], rtol=1e-4)
+
+    def test_nms_v3(self):
+        gd = GraphDef([
+            const("boxes", np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                                     [50, 50, 60, 60]], np.float32)),
+            const("scores", np.array([0.9, 0.8, 0.7], np.float32)),
+            const("mo", np.int32(3)), const("iou", np.float32(0.5)),
+            const("st", np.float32(0.0)),
+            NodeDef("nms", "NonMaxSuppressionV3",
+                    ["boxes", "scores", "mo", "iou", "st"], {"T": F32}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        out = sd.output({}, "nms")["nms"].numpy()
+        assert list(out) == [0, 2, -1]
+
+    def test_nms_v4_valid_outputs(self):
+        gd = GraphDef([
+            const("boxes", np.array([[0, 0, 5, 5], [0.5, 0.5, 5.5, 5.5],
+                                     [20, 20, 30, 30]], np.float32)),
+            const("scores", np.array([0.9, 0.85, 0.8], np.float32)),
+            const("mo", np.int32(3)), const("iou", np.float32(0.4)),
+            NodeDef("nms", "NonMaxSuppressionV4",
+                    ["boxes", "scores", "mo", "iou"], {"T": F32}),
+            NodeDef("valid", "Identity", ["nms:1"],
+                    {"T": attr_type(np.int32)}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        out = sd.output({}, "nms", "valid")
+        assert list(out["nms"].numpy()) == [0, 2, -1]
+        assert int(out["valid"].numpy()) == 2
+
+    def test_align_corners_rejected_and_legacy_warns(self):
+        import warnings
+
+        from deeplearning4j_tpu.modelimport.protobuf import AttrValue
+
+        gd = GraphDef([
+            placeholder("img", [1, 4, 4, 1]),
+            const("sz", np.array([8, 8], np.int32)),
+            NodeDef("up", "ResizeBilinear", ["img", "sz"],
+                    {"T": F32, "align_corners": AttrValue(b=True)}),
+        ])
+        with pytest.raises(TFImportError, match="align_corners"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        gd2 = GraphDef([
+            placeholder("img", [1, 4, 4, 1]),
+            const("sz", np.array([8, 8], np.int32)),
+            NodeDef("up", "ResizeBilinear", ["img", "sz"], {"T": F32}),
+        ])
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            TFGraphMapper.importGraph(GraphDef.parse(gd2.encode()))
+        assert any("TF1-legacy" in str(x.message) for x in w)
+
+    def test_non_integer_area_resize_is_import_error(self):
+        gd = GraphDef([
+            placeholder("img", [1, 5, 5, 1]),
+            const("sz", np.array([3, 3], np.int32)),
+            NodeDef("dn", "ResizeArea", ["img", "sz"], {"T": F32}),
+        ])
+        with pytest.raises(TFImportError, match="dn"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
