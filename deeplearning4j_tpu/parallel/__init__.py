@@ -14,3 +14,5 @@ from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     alternating_dense_specs, replicated_specs)
 from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401
     MultiHost, VoidConfiguration)
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    ElasticTrainer, PreemptionCheckpoint)

@@ -1,0 +1,164 @@
+"""Elastic training: preemption-safe checkpointing + resume.
+
+Reference capability (SURVEY.md §5 fault-tolerance row): the reference's
+story is checkpoint/resume (Spark training masters re-submit failed
+stages but model state rides on checkpoints), so the TPU-native design
+makes that story explicit and preemption-aware rather than porting a
+transport-layer recovery protocol:
+
+- TPU pods are preempted with SIGTERM; `ElasticTrainer.fit` installs a
+  handler that checkpoints synchronously before exiting (the standard
+  maintenance-event drill), plus periodic every-N-iteration checkpoints
+  with rotation;
+- multi-host: only process 0 writes; the checkpoint directory MUST be
+  shared storage (NFS/GCS-fuse) so every process resumes from the same
+  file after a restart — training is SPMD-deterministic from there, so
+  global state stays consistent;
+- `ElasticTrainer.resume()` restores net + updater state + iteration
+  counter; `fit(data, epochs=TOTAL)` treats `epochs` as the TOTAL
+  budget and skips the epochs the iteration counter already covers
+  (when `data` is a sized list of batches), so a preempted job rerun
+  with the SAME command line completes only the remaining work.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import jax
+
+
+class PreemptionCheckpoint(SystemExit):
+    """Raised (after checkpointing) when fit() is interrupted by
+    SIGTERM/SIGINT; carries the checkpoint path — on multi-host
+    processes other than 0, `path` is None (process 0 owns the write)."""
+
+    def __init__(self, path):
+        super().__init__(143)
+        self.path = path
+
+
+class ElasticTrainer:
+    """Preemption-safe fit wrapper around MultiLayerNetwork /
+    ComputationGraph (anything ModelSerializer handles)."""
+
+    def __init__(self, net, checkpointDir, everyNIterations=100,
+                 keepLast=3, saveUpdaterState=True):
+        self.net = net
+        self.dir = str(checkpointDir)
+        self.every = int(everyNIterations)
+        self.keep = int(keepLast)
+        self.save_updater = saveUpdaterState
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- checkpoint files ---------------------------------------------------
+    def _path(self, iteration):
+        return os.path.join(self.dir, f"checkpoint_{iteration:010d}.zip")
+
+    @staticmethod
+    def latest(checkpointDir):
+        """Newest checkpoint path in the directory, or None."""
+        if not os.path.isdir(checkpointDir):
+            return None
+        cps = sorted(f for f in os.listdir(checkpointDir)
+                     if f.startswith("checkpoint_") and f.endswith(".zip"))
+        return os.path.join(checkpointDir, cps[-1]) if cps else None
+
+    def _write(self, iteration):
+        """Process-0-only checkpoint write with rotation."""
+        if jax.process_index() != 0:
+            return None
+        from deeplearning4j_tpu.utils import ModelSerializer
+
+        path = self._path(iteration)
+        tmp = path + ".tmp"
+        ModelSerializer.writeModel(self.net, tmp, self.save_updater)
+        os.replace(tmp, path)   # atomic: a preempt mid-write leaves .tmp
+        cps = sorted(f for f in os.listdir(self.dir)
+                     if f.startswith("checkpoint_") and f.endswith(".zip"))
+        for old in cps[:-self.keep]:
+            os.remove(os.path.join(self.dir, old))
+        return path
+
+    # -- resume -------------------------------------------------------------
+    @classmethod
+    def resume(cls, checkpointDir, graph=False, **kw):
+        """Restore the newest checkpoint into a fresh ElasticTrainer.
+        Returns None when the directory holds no checkpoint (caller
+        starts from scratch)."""
+        path = cls.latest(checkpointDir)
+        if path is None:
+            return None
+        from deeplearning4j_tpu.utils import ModelSerializer
+
+        if graph:
+            net = ModelSerializer.restoreComputationGraph(path, True)
+        else:
+            net = ModelSerializer.restoreMultiLayerNetwork(path, True)
+        return cls(net, checkpointDir, **kw)
+
+    # -- preemption-safe fit ------------------------------------------------
+    def fit(self, data, epochs=1):
+        """net.fit with periodic checkpoints and SIGTERM/SIGINT
+        checkpoint-then-exit. Raises PreemptionCheckpoint (a SystemExit)
+        after a signal-triggered save so process managers see rc 143.
+
+        `epochs` is the TOTAL training budget: when `data` is a sized
+        list of batches, epochs already covered by the restored
+        iteration counter are skipped, so rerunning the same command
+        after a preemption trains only the remainder. (For one-shot
+        iterables the epoch count cannot be inferred; all `epochs`
+        passes run.)"""
+        try:
+            iters_per_epoch = len(data)
+        except TypeError:
+            iters_per_epoch = None
+        remaining = epochs
+        if iters_per_epoch:
+            done = self.net._iteration // iters_per_epoch
+            remaining = max(0, epochs - done)
+
+        preempted = {"flag": False}
+
+        def on_signal(signum, frame):
+            preempted["flag"] = True
+
+        old_term = signal.signal(signal.SIGTERM, on_signal)
+        old_int = signal.signal(signal.SIGINT, on_signal)
+        last_cp = [self.net._iteration]
+
+        class _Every:
+            """Listener-shaped hook: checkpoint every N iterations and
+            honor a pending preemption between iterations."""
+
+            def __init__(self, outer):
+                self.outer = outer
+
+            def iterationDone(self, model, iteration, epoch=None,
+                              loss=None):
+                if preempted["flag"]:
+                    path = self.outer._write(iteration)
+                    raise PreemptionCheckpoint(path)
+                if iteration - last_cp[0] >= self.outer.every:
+                    self.outer._write(iteration)
+                    last_cp[0] = iteration
+
+        hook = _Every(self)
+        prior = list(getattr(self.net, "_listeners", []))
+        try:
+            self.net.setListeners(*(prior + [hook]))
+            if remaining > 0:
+                self.net.fit(data, remaining)
+            final_path = self._write(self.net._iteration)
+            if preempted["flag"]:
+                # a signal landed after the last in-loop check (or this
+                # fit had nothing left to do): state is saved — honor
+                # the termination request instead of dropping it
+                raise PreemptionCheckpoint(final_path)
+        finally:
+            self.net.setListeners(*prior)
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+        return self.net

@@ -1,0 +1,97 @@
+"""Elastic training tests: periodic checkpoints, preemption
+checkpoint-then-exit, resume continuity (SURVEY.md §5 fault-tolerance
+row; VERDICT round-2 coverage row 38)."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.parallel import ElasticTrainer, PreemptionCheckpoint
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.Builder(nOut=8, activation="tanh").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _data(n=32):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return [(X[i:i + 8], y[i:i + 8]) for i in range(0, n, 8)]
+
+
+class TestElasticTrainer:
+    def test_periodic_checkpoints_and_rotation(self, tmp_path):
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            keepLast=2)
+        tr.fit(_data(), epochs=6)   # 24 iterations
+        cps = sorted(f for f in os.listdir(tmp_path)
+                     if f.endswith(".zip"))
+        assert 1 <= len(cps) <= 2   # rotation keeps <= keepLast
+        assert ElasticTrainer.latest(str(tmp_path)) is not None
+
+    def test_preemption_checkpoints_then_exits(self, tmp_path):
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=1000)
+
+        batches = _data()
+
+        class Bomb:
+            """Deliver SIGTERM to ourselves mid-training."""
+
+            fired = False
+
+            def iterationDone(self, model, iteration, epoch=None):
+                if iteration >= 3 and not Bomb.fired:
+                    Bomb.fired = True
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        net.setListeners(Bomb())
+        before_term = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(PreemptionCheckpoint) as ei:
+            tr.fit(batches, epochs=50)
+        assert ei.value.path is not None and os.path.exists(ei.value.path)
+        # the pre-fit handler is restored after the preemption exit
+        assert signal.getsignal(signal.SIGTERM) is before_term
+
+    def test_resume_continues_iteration_count(self, tmp_path):
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=2)
+        tr.fit(_data(), epochs=3)   # 12 iterations, final checkpoint
+        it_before = net._iteration
+
+        resumed = ElasticTrainer.resume(str(tmp_path),
+                                        everyNIterations=2)
+        assert resumed is not None
+        assert resumed.net._iteration == it_before
+        # params identical to the checkpointed net
+        for a, b in zip(net._params, resumed.net._params):
+            for ka in a:
+                np.testing.assert_allclose(np.asarray(a[ka]),
+                                           np.asarray(b[ka]), rtol=1e-6)
+        # epochs is the TOTAL budget: 3 epochs already done -> a budget
+        # of 4 trains exactly one more epoch (4 iterations)
+        resumed.fit(_data(), epochs=4)
+        assert resumed.net._iteration == it_before + 4
+        # rerunning the SAME command trains nothing further
+        resumed.fit(_data(), epochs=4)
+        assert resumed.net._iteration == it_before + 4
+
+    def test_resume_empty_dir_returns_none(self, tmp_path):
+        assert ElasticTrainer.resume(str(tmp_path)) is None
