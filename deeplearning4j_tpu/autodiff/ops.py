@@ -841,7 +841,9 @@ def _gru_cell(x, h_prev, w, r, b=None):
 @op("gruLayer")
 def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
     """Input projection hoisted out of the scan (same lowering as
-    lstmLayer); the reset-gated candidate keeps only h@r sequential."""
+    lstmLayer); the reset-gated candidate keeps only h@r sequential.
+    On TPU the Pallas recurrence kernel (kernels/gru.py) takes over when
+    shapes allow."""
     n, _, t = x.shape
     hsz = r.shape[0]
     if h0 is None:
@@ -851,6 +853,20 @@ def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
     if b is not None:
         xw = xw + b[: 3 * hsz]
     rb = None if b is None else b[3 * hsz:]
+
+    import os as _os
+
+    from deeplearning4j_tpu.kernels.gru import gru_seq, gru_seq_available
+
+    if (jax.default_backend() == "tpu"
+            and gru_seq_available(n, hsz, x.dtype)
+            and r.dtype == jnp.float32
+            and _os.environ.get("DL4J_DISABLE_PALLAS_GRU") != "1"):
+        rb_k = (jnp.zeros((3 * hsz,), jnp.float32) if rb is None
+                else rb.astype(jnp.float32))
+        hs_k, hT = gru_seq(xw.astype(jnp.float32), r, rb_k,
+                           h0.astype(jnp.float32))
+        return jnp.moveaxis(hs_k, 0, 2), hT
 
     def step(h, xw_t):
         rz = h @ r

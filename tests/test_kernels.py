@@ -78,3 +78,60 @@ class TestLstmPallasParity:
         hs_s, hT_s, cT_s = _scan_reference(xw, r, h0, c0)
         np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_s),
                                    rtol=1e-5, atol=1e-6)
+
+
+def _gru_scan_reference(xw, r, rb, h0):
+    hsz = r.shape[0]
+
+    def step(h, xw_t):
+        rz = h @ r + rb
+        ru = jax.nn.sigmoid(xw_t[:, :2 * hsz] + rz[:, :2 * hsz])
+        cand = jnp.tanh(xw_t[:, 2 * hsz:] + ru[:, :hsz] * rz[:, 2 * hsz:])
+        u = ru[:, hsz:]
+        h2 = u * h + (1.0 - u) * cand
+        return h2, h2
+
+    hT, hs = jax.lax.scan(step, h0, xw)
+    return hs, hT
+
+
+def _gru_data(t=5, n=8, h=128, seed=0):
+    rng = np.random.default_rng(seed)
+    xw = jnp.asarray(rng.normal(size=(t, n, 3 * h)) * 0.3, jnp.float32)
+    r = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.1, jnp.float32)
+    rb = jnp.asarray(rng.normal(size=(3 * h,)) * 0.05, jnp.float32)
+    h0 = jnp.asarray(rng.normal(size=(n, h)) * 0.2, jnp.float32)
+    return xw, r, rb, h0
+
+
+class TestGruPallasParity:
+    def test_forward_matches_scan(self):
+        from deeplearning4j_tpu.kernels.gru import gru_seq
+
+        xw, r, rb, h0 = _gru_data()
+        hs_k, hT_k = gru_seq(xw, r, rb, h0, True)
+        hs_s, hT_s = _gru_scan_reference(xw, r, rb, h0)
+        np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_s),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_s),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_scan(self):
+        from deeplearning4j_tpu.kernels.gru import gru_seq
+
+        xw, r, rb, h0 = _gru_data(t=4, seed=3)
+
+        def loss_k(xw, r, rb, h0):
+            hs, hT = gru_seq(xw, r, rb, h0, True)
+            return jnp.sum(hs * jnp.sin(hs)) + jnp.sum(hT * hT)
+
+        def loss_s(xw, r, rb, h0):
+            hs, hT = _gru_scan_reference(xw, r, rb, h0)
+            return jnp.sum(hs * jnp.sin(hs)) + jnp.sum(hT * hT)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(xw, r, rb, h0)
+        gs = jax.grad(loss_s, argnums=(0, 1, 2, 3))(xw, r, rb, h0)
+        for a, b, name in zip(gk, gs, ("dxw", "dR", "drb", "dh0")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+                err_msg=name)

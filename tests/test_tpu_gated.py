@@ -308,3 +308,49 @@ print("BRANCH_OK")
         for k in a:
             np.testing.assert_allclose(a[k], b[k], rtol=5e-4, atol=5e-5,
                                        err_msg=k)
+
+
+@gated
+class TestPallasGruOnChip:
+    def test_compiled_matches_interpret_and_layer_trains(self):
+        out = _run("""
+import numpy as np, jax, jax.numpy as jnp
+from deeplearning4j_tpu.kernels.gru import gru_seq
+rng = np.random.default_rng(0)
+t, n, h = 10, 8, 128
+xw = jnp.asarray(rng.normal(size=(t, n, 3*h))*0.3, jnp.float32)
+r = jnp.asarray(rng.normal(size=(h, 3*h))*0.1, jnp.float32)
+rb = jnp.asarray(rng.normal(size=(3*h,))*0.05, jnp.float32)
+h0 = jnp.zeros((n, h), jnp.float32)
+hs_c, hT_c = jax.jit(lambda *a: gru_seq(*a, False))(xw, r, rb, h0)
+hs_i, hT_i = gru_seq(xw, r, rb, h0, True)
+np.testing.assert_allclose(np.asarray(hs_c), np.asarray(hs_i),
+                           rtol=3e-5, atol=2e-5)
+def loss(impl):
+    def f(xw, r, rb):
+        hs, hT = gru_seq(xw, r, rb, h0, impl)
+        return jnp.sum(hs * hs) + jnp.sum(hT)
+    return f
+gc = jax.jit(jax.grad(loss(False), argnums=(0, 1, 2)))(xw, r, rb)
+gi = jax.grad(loss(True), argnums=(0, 1, 2))(xw, r, rb)
+for a, b in zip(gc, gi):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=5e-4, atol=5e-5)
+
+# the gruLayer OP routes through the kernel on TPU (H=128, N=8)
+from deeplearning4j_tpu.autodiff.ops import OPS
+x = jnp.asarray(rng.normal(size=(8, 6, 12)) * 0.5, jnp.float32)
+w = jnp.asarray(rng.normal(size=(6, 3 * 128)) * 0.1, jnp.float32)
+r2 = jnp.asarray(rng.normal(size=(128, 3 * 128)) * 0.1, jnp.float32)
+b2 = jnp.asarray(rng.normal(size=(6 * 128,)) * 0.05, jnp.float32)
+out_k, hT_k = OPS["gruLayer"](x, w, r2, b2)
+import os
+os.environ["DL4J_DISABLE_PALLAS_GRU"] = "1"
+out_s, hT_s = OPS["gruLayer"](x, w, r2, b2)
+np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_s),
+                           rtol=5e-4, atol=5e-5)
+np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_s),
+                           rtol=5e-4, atol=5e-5)
+print("PALLAS_GRU_OK")
+""")
+        assert "PALLAS_GRU_OK" in out
