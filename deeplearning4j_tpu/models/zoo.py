@@ -15,7 +15,8 @@ from deeplearning4j_tpu.nn import (
     ActivationLayer, BatchNormalization, ComputationGraph, ConvolutionLayer,
     ConvolutionMode, Deconvolution2D, DenseLayer, DropoutLayer,
     ElementWiseVertex, GlobalPoolingLayer, InputType,
-    LocalResponseNormalization, LossLayer, LSTM, MultiLayerNetwork,
+    LocalResponseNormalization, LossLayer, LSTM, MergeVertex,
+    MultiLayerNetwork,
     NeuralNetConfiguration, OutputLayer, PoolingType, RnnOutputLayer,
     SeparableConvolution2D, SubsamplingLayer, WeightInit)
 from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs
@@ -791,6 +792,162 @@ class YOLO2(ZooModel):
                    .activation("identity").build(), x)
         g.addLayer("out", Yolo2OutputLayer(boundingBoxPriors=self.priors),
                    "head")
+        g.setOutputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+class NASNet(ZooModel):
+    """Reference: zoo.model.NASNet (NASNet-A Mobile: numBlocks normal
+    cells per stage, reduction cells between stages,
+    penultimateFilters = 24 * base filter count). Cell topology follows
+    NASNet-A: each cell squeezes its two inputs (h, h_prev) to the
+    stage's filter count with 1x1 conv+BN, runs the published 5-branch
+    separable-conv/pool block mix, and concatenates the branch outputs;
+    reduction cells stride 2 with a strided 1x1 projection as the
+    h_prev spatial adjust (capability-parity stand-in for the factorized
+    reduction)."""
+
+    def __init__(self, numClasses=1000, seed=123, inputShape=(3, 224, 224),
+                 numBlocks=4, penultimateFilters=1056, stemFilters=32,
+                 updater=None, dataType="float32"):
+        if penultimateFilters % 24:
+            raise ValueError(
+                f"penultimateFilters must be divisible by 24 (NASNet-A "
+                f"concat width), got {penultimateFilters}")
+        self.numClasses = numClasses
+        self.seed = seed
+        self.inputShape = inputShape
+        self.numBlocks = numBlocks
+        self.penultimateFilters = penultimateFilters
+        self.stemFilters = stemFilters
+        self.updater = updater or Adam(1e-3)
+        self.dataType = dataType
+
+    def conf(self):
+        c, h, w = self.inputShape
+        g = (NeuralNetConfiguration.Builder().seed(self.seed)
+             .dataType(self.dataType)
+             .updater(self.updater).weightInit(WeightInit.RELU)
+             .graphBuilder().addInputs("in"))
+        g.setInputTypes(InputType.convolutional(h, w, c))
+        f0 = self.penultimateFilters // 24
+
+        def conv1x1(name, n, inp, stride=1):
+            g.addLayer(f"{name}_c", ConvolutionLayer.Builder().nOut(n)
+                       .kernelSize([1, 1]).stride([stride, stride])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build(), inp)
+            g.addLayer(name, BatchNormalization.Builder().build(),
+                       f"{name}_c")
+            return name
+
+        def sep_block(name, n, k, stride, inp):
+            """relu -> sepconv(k, stride) -> bn -> relu -> sepconv(k) -> bn
+            (the NASNet separable stack)."""
+            g.addLayer(f"{name}_s1", SeparableConvolution2D.Builder()
+                       .nOut(n).kernelSize([k, k]).stride([stride, stride])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("relu").build(), inp)
+            g.addLayer(f"{name}_b1", BatchNormalization.Builder()
+                       .activation("relu").build(), f"{name}_s1")
+            g.addLayer(f"{name}_s2", SeparableConvolution2D.Builder()
+                       .nOut(n).kernelSize([k, k]).stride([1, 1])
+                       .convolutionMode(ConvolutionMode.SAME)
+                       .activation("identity").build(), f"{name}_b1")
+            g.addLayer(name, BatchNormalization.Builder().build(),
+                       f"{name}_s2")
+            return name
+
+        def pool(name, kind, stride, inp):
+            g.addLayer(name, SubsamplingLayer.Builder()
+                       .poolingType(kind)
+                       .kernelSize([3, 3]).stride([stride, stride])
+                       .convolutionMode(ConvolutionMode.SAME).build(), inp)
+            return name
+
+        def add(name, a, b):
+            g.addVertex(name, ElementWiseVertex("Add"), a, b)
+            return name
+
+        # spatial size (square) per tensor name: the h_prev input of a
+        # cell that follows a reduction is at 2x the cell resolution, so
+        # its 1x1 adjust must stride by size[p] // target
+        sz = {}
+
+        def normal_cell(tag, p, x, n):
+            hq = conv1x1(f"{tag}_hq", n, x)
+            # ceil-divide: odd sizes (e.g. 15 -> 8 under SAME/s2) need
+            # stride 2 even though floor(15/8) = 1
+            pq = conv1x1(f"{tag}_pq", n, p, stride=-(-sz[p] // sz[x]))
+            sz[f"{tag}_out"] = sz[x]
+            b1 = add(f"{tag}_b1", sep_block(f"{tag}_b1l", n, 3, 1, hq),
+                     sep_block(f"{tag}_b1r", n, 5, 1, pq))
+            b2 = add(f"{tag}_b2", sep_block(f"{tag}_b2l", n, 5, 1, pq),
+                     sep_block(f"{tag}_b2r", n, 3, 1, pq))
+            b3 = add(f"{tag}_b3", pool(f"{tag}_b3l", PoolingType.AVG, 1,
+                                       hq), pq)
+            b4 = add(f"{tag}_b4", pool(f"{tag}_b4l", PoolingType.AVG, 1,
+                                       pq),
+                     pool(f"{tag}_b4r", PoolingType.AVG, 1, pq))
+            b5 = add(f"{tag}_b5", sep_block(f"{tag}_b5l", n, 3, 1, hq),
+                     hq)
+            g.addVertex(f"{tag}_out", MergeVertex(), pq, b1, b2, b3, b4,
+                        b5)
+            return f"{tag}_out"
+
+        def reduction_cell(tag, p, x, n):
+            target = -(-sz[x] // 2)
+            hq = conv1x1(f"{tag}_hq", n, x)
+            pq = conv1x1(f"{tag}_pq", n, p, stride=-(-sz[p] // target))
+            sz[f"{tag}_out"] = target
+            # pq is already stride-adjusted to the target size, so every
+            # pq-side branch runs stride 1; hq-side branches stride 2
+            b1 = add(f"{tag}_b1", sep_block(f"{tag}_b1l", n, 5, 2, hq),
+                     sep_block(f"{tag}_b1r", n, 7, 1, pq))
+            b2 = add(f"{tag}_b2", pool(f"{tag}_b2l", PoolingType.MAX, 2,
+                                       hq),
+                     sep_block(f"{tag}_b2r", n, 7, 1, pq))
+            b3 = add(f"{tag}_b3", pool(f"{tag}_b3l", PoolingType.AVG, 2,
+                                       hq),
+                     sep_block(f"{tag}_b3r", n, 5, 1, pq))
+            b4 = add(f"{tag}_b4", pool(f"{tag}_b4l", PoolingType.MAX, 2,
+                                       hq),
+                     sep_block(f"{tag}_b4r", n, 3, 1, b1))
+            b5 = add(f"{tag}_b5", pool(f"{tag}_b5l", PoolingType.AVG, 1,
+                                       b1), b2)
+            g.addVertex(f"{tag}_out", MergeVertex(), b2, b3, b4, b5)
+            return f"{tag}_out"
+
+        # stem
+        g.addLayer("stem_conv", ConvolutionLayer.Builder()
+                   .nOut(self.stemFilters).kernelSize([3, 3])
+                   .stride([2, 2]).convolutionMode(ConvolutionMode.SAME)
+                   .build(), "in")
+        g.addLayer("stem_bn", BatchNormalization.Builder().build(),
+                   "stem_conv")
+        sz["stem_bn"] = -(-h // 2)
+        p, x = "stem_bn", reduction_cell("stem_r1", "stem_bn", "stem_bn",
+                                         f0 // 2 or 1)
+        p, x = x, reduction_cell("stem_r2", p, x, f0 // 2 or 1)
+
+        filters = f0
+        for stage in range(3):
+            for i in range(self.numBlocks):
+                p, x = x, normal_cell(f"s{stage}n{i}", p, x, filters)
+            if stage < 2:
+                p, x = x, reduction_cell(f"s{stage}r", p, x, filters * 2)
+                filters *= 2
+
+        g.addLayer("relu_out", ActivationLayer.Builder()
+                   .activation("relu").build(), x)
+        g.addLayer("gap", GlobalPoolingLayer.Builder().build(),
+                   "relu_out")
+        g.addLayer("out", OutputLayer.Builder().nOut(self.numClasses)
+                   .activation("softmax").lossFunction("mcxent").build(),
+                   "gap")
         g.setOutputs("out")
         return g.build()
 

@@ -3,6 +3,7 @@ LearnedSelfAttentionLayer, RecurrentAttentionLayer} +
 conf.graph.AttentionVertex, SURVEY.md §5 long-context row)."""
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn import (
     AttentionVertex, GlobalPoolingLayer, InputType,
@@ -55,6 +56,7 @@ class TestSelfAttention:
         assert net._params[0] == {}
         assert net.output(x).shape() == (6, 2, 5)
 
+    @pytest.mark.slow
     def test_gradient_check(self):
         net = _build([
             SelfAttentionLayer.Builder(nOut=4, nHeads=2,

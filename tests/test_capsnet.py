@@ -2,6 +2,7 @@
 CapsuleLayer, CapsuleStrengthLayer}, SURVEY.md §2.5)."""
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn import (
     ActivationLayer, CapsuleLayer, CapsuleStrengthLayer, ConvolutionLayer,
@@ -56,6 +57,7 @@ class TestCapsNet:
         net.fit([(x, y)] * 30)
         assert net.score((x, y)) < s0
 
+    @pytest.mark.slow
     def test_gradient_check(self):
         b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-3))
              .list()

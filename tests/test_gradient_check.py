@@ -76,6 +76,7 @@ class TestGradientChecks:
                 .build())
         _check(conf, (4, 5), 3, subset=20)
 
+    @pytest.mark.slow
     def test_lstm(self):
         conf = (_base().list()
                 .layer(LSTM.Builder().nOut(4).build())

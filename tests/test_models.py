@@ -53,6 +53,7 @@ class TestZoo:
         assert out.shape() == (2, 7)
         np.testing.assert_allclose(out.numpy().sum(axis=1), 1.0, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_resnet50_short_fit(self):
         from deeplearning4j_tpu.optimize.updaters import Adam
 
@@ -116,6 +117,7 @@ class TestBert:
 class TestNewZooModels:
     """UNet / SqueezeNet / Xception (reference zoo.model.* additions)."""
 
+    @pytest.mark.slow
     def test_unet_shapes_and_training(self):
         from deeplearning4j_tpu.models.zoo import UNet
 
@@ -171,6 +173,7 @@ class TestZooRound2Additions:
         net.fit([(x, y)], 2)
         assert np.isfinite(net.score((x, y)))
 
+    @pytest.mark.slow
     def test_facenet_center_loss_graph(self):
         from deeplearning4j_tpu.models import FaceNetNN4Small2
 
@@ -204,3 +207,45 @@ class TestZooRound2Additions:
         s0 = net.score((x, y))
         net.fit([(x, y)] * 5)
         assert net.score((x, y)) < s0
+
+
+class TestNASNet:
+    """Reference: zoo.model.NASNet — completes the DL4J zoo model list
+    (round 3)."""
+
+    @pytest.mark.slow
+    def test_builds_trains_and_counts_cells(self):
+        from deeplearning4j_tpu.models.zoo import NASNet
+
+        m = NASNet(numClasses=5, inputShape=(3, 32, 32), numBlocks=1,
+                   penultimateFilters=96)
+        net = m.init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4, 3, 32, 32)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 4)]
+        out = np.asarray(net.output(X)[0])
+        assert out.shape == (4, 5)
+        # 2 stem reductions + 3 stages x numBlocks normal + 2 reductions
+        names = set(net.conf.nodes)
+        assert "stem_r1_out" in names and "stem_r2_out" in names
+        assert "s0n0_out" in names and "s2n0_out" in names
+        assert "s0r_out" in names and "s1r_out" in names
+        s0 = net.score((X, y))
+        net.fit([(X, y)] * 20)
+        assert net.score((X, y)) < s0
+
+    def test_penultimate_filters_validated(self):
+        from deeplearning4j_tpu.models.zoo import NASNet
+
+        with pytest.raises(ValueError, match="divisible by 24"):
+            NASNet(penultimateFilters=100)
+
+    def test_odd_input_sizes_build(self):
+        from deeplearning4j_tpu.models import NASNet
+
+        m = NASNet(numClasses=3, inputShape=(3, 30, 30), numBlocks=1,
+                   penultimateFilters=96)
+        net = m.init()
+        X = np.random.default_rng(0).normal(size=(2, 3, 30, 30)) \
+            .astype(np.float32)
+        assert np.asarray(net.output(X)[0]).shape == (2, 3)

@@ -136,6 +136,7 @@ class TestSpaceToDepth:
 
 
 class TestZooDetectionModels:
+    @pytest.mark.slow
     def test_tiny_yolo_builds_and_steps(self):
         from deeplearning4j_tpu.models import TinyYOLO
 
@@ -152,6 +153,7 @@ class TestZooDetectionModels:
         assert np.isfinite(net.score((x, y)))
         assert np.isfinite(s0)
 
+    @pytest.mark.slow
     def test_yolo2_builds_and_steps(self):
         from deeplearning4j_tpu.models import YOLO2
 

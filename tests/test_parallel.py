@@ -162,6 +162,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(out_dense),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_grad_flows(self):
         q, k, v = self._qkv(t=8, seed=2)
         mesh = MeshConfig(data=1, seq=8).build()

@@ -23,6 +23,7 @@ def _seq_reference(params, x):
 
 
 class TestPipeline:
+    @pytest.mark.slow
     def test_forward_matches_sequential_pp4(self):
         mesh = MeshConfig(data=2, pipe=4, devices=jax.devices()).build()
         model = PipelineMlp(mesh, hidden=8, microbatches=4, seed=0)
