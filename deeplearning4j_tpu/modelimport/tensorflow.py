@@ -1085,3 +1085,87 @@ def _h_nms(im, node):
         valid = im.sd._op("cast", [cnt], {"dtype": "int32"},
                           f"{node.name}__valid")
         im.bind(node.name, valid, (), np.int32, out_idx=1)
+
+
+def _check_padding(node, pad):
+    """SAME/VALID only: TF's EXPLICIT padding would otherwise silently
+    compute a VALID conv."""
+    if pad not in ("SAME", "VALID"):
+        raise TFImportError(
+            f"node {node.name!r} ({node.op}): padding={pad!r} is not "
+            f"supported (SAME/VALID only)")
+
+
+@handler("DepthwiseConv2dNative")
+def _h_depthwise_conv2d(im, node):
+    """TF depthwise kernel [kH, kW, inC, mult] -> our
+    [mult, inC, kH, kW] (MobileNet-class graphs)."""
+    ins = im.data_inputs(node)
+    fmt = node.attrs.get("data_format")
+    nhwc = fmt is None or fmt.s in (b"NHWC", None)
+    strides = [int(s) for s in node.attrs["strides"].list["i"]]
+    pad = node.attrs["padding"].s.decode()
+    _check_padding(node, pad)
+    dil = [int(d) for d in node.attrs["dilations"].list["i"]] \
+        if "dilations" in node.attrs else [1, 1, 1, 1]
+    x_ref = ins[0]
+    if nhwc:
+        x_ref = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+        s_hw, d_hw = (strides[1], strides[2]), (dil[1], dil[2])
+    else:
+        s_hw, d_hw = (strides[2], strides[3]), (dil[2], dil[3])
+    w_ref = _permute(im, node, ins[1], (3, 2, 0, 1), "__mihw")
+    attrs = {"strides": s_hw, "dilation": d_hw,
+             "sameMode": pad == "SAME", "padding": (0, 0)}
+    out_name = node.name if not nhwc else f"{node.name}__conv"
+    im.emit(node, "depthwiseConv2d", [x_ref, w_ref], attrs,
+            out_name=out_name)
+    if nhwc:
+        _permute(im, node, f"{out_name}:0", (0, 2, 3, 1), "", node.name)
+
+
+@handler("Conv3D")
+def _h_conv3d(im, node):
+    """TF NDHWC conv3d -> our NCDHW op via permutes; kernel DHWIO ->
+    OIDHW."""
+    ins = im.data_inputs(node)
+    fmt = node.attrs.get("data_format")
+    if fmt is not None and fmt.s not in (b"NDHWC", None):
+        raise TFImportError(
+            f"Conv3D node {node.name!r}: only NDHWC data_format is "
+            f"supported, got {fmt.s!r}")
+    strides = [int(s) for s in node.attrs["strides"].list["i"]]
+    pad = node.attrs["padding"].s.decode()
+    _check_padding(node, pad)
+    dil = [int(d) for d in node.attrs["dilations"].list["i"]] \
+        if "dilations" in node.attrs else [1, 1, 1, 1, 1]
+    x_ref = _permute(im, node, ins[0], (0, 4, 1, 2, 3), "__ncdhw")
+    w_ref = _permute(im, node, ins[1], (4, 3, 0, 1, 2), "__oidhw")
+    attrs = {"strides": tuple(strides[1:4]),
+             "dilation": tuple(dil[1:4]), "sameMode": pad == "SAME",
+             "padding": (0, 0, 0)}
+    im.emit(node, "conv3d", [x_ref, w_ref], attrs,
+            out_name=f"{node.name}__conv")
+    _permute(im, node, f"{node.name}__conv:0", (0, 2, 3, 4, 1), "",
+             node.name)
+
+
+@handler("MaxPool3D", "AvgPool3D")
+def _h_pool3d(im, node):
+    ins = im.data_inputs(node)
+    fmt = node.attrs.get("data_format")
+    if fmt is not None and fmt.s not in (b"NDHWC", None):
+        raise TFImportError(
+            f"{node.op} node {node.name!r}: only NDHWC data_format is "
+            f"supported, got {fmt.s!r}")
+    ksize = [int(k) for k in node.attrs["ksize"].list["i"]]
+    strides = [int(s) for s in node.attrs["strides"].list["i"]]
+    pad = node.attrs["padding"].s.decode()
+    _check_padding(node, pad)
+    x_ref = _permute(im, node, ins[0], (0, 4, 1, 2, 3), "__ncdhw")
+    fn = "maxPooling3d" if node.op == "MaxPool3D" else "avgPooling3d"
+    attrs = {"kernel": tuple(ksize[1:4]), "strides": tuple(strides[1:4]),
+             "sameMode": pad == "SAME", "padding": (0, 0, 0)}
+    im.emit(node, fn, [x_ref], attrs, out_name=f"{node.name}__pool")
+    _permute(im, node, f"{node.name}__pool:0", (0, 2, 3, 4, 1), "",
+             node.name)

@@ -849,3 +849,113 @@ class TestResizeAndNms:
         ])
         with pytest.raises(TFImportError, match="dn"):
             TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+
+
+class TestDepthwiseAnd3D:
+    def test_depthwise_conv2d_matches_numpy(self):
+        from deeplearning4j_tpu.modelimport.protobuf import AttrValue
+
+        rng = np.random.default_rng(0)
+        dw = rng.normal(size=(3, 3, 2, 1)).astype(np.float32) * 0.3
+        gd = GraphDef([
+            placeholder("x", [1, 6, 6, 2]),
+            const("dw", dw),
+            NodeDef("dwc", "DepthwiseConv2dNative", ["x", "dw"],
+                    {"T": F32,
+                     "strides": AttrValue(list={"i": [1, 1, 1, 1]}),
+                     "padding": attr_s("SAME")}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        x = rng.normal(size=(1, 6, 6, 2)).astype(np.float32)
+        out = sd.output({"x": x}, "dwc")["dwc"].numpy()
+        assert out.shape == (1, 6, 6, 2)
+        # channel-wise 3x3 conv at an interior pixel, per channel
+        for c in range(2):
+            expect = (x[0, 1:4, 1:4, c] * dw[:, :, c, 0]).sum()
+            assert out[0, 2, 2, c] == pytest.approx(expect, rel=1e-4)
+
+    def test_conv3d_and_pool3d(self):
+        from deeplearning4j_tpu.modelimport.protobuf import AttrValue
+
+        rng = np.random.default_rng(1)
+        w3 = rng.normal(size=(2, 2, 2, 1, 4)).astype(np.float32) * 0.3
+        gd = GraphDef([
+            placeholder("v", [1, 4, 4, 4, 1]),
+            const("w3", w3),
+            NodeDef("c3", "Conv3D", ["v", "w3"],
+                    {"T": F32,
+                     "strides": AttrValue(list={"i": [1, 1, 1, 1, 1]}),
+                     "padding": attr_s("SAME")}),
+            NodeDef("mp3", "MaxPool3D", ["c3"],
+                    {"T": F32,
+                     "ksize": AttrValue(list={"i": [1, 2, 2, 2, 1]}),
+                     "strides": AttrValue(list={"i": [1, 2, 2, 2, 1]}),
+                     "padding": attr_s("VALID")}),
+            NodeDef("ap3", "AvgPool3D", ["c3"],
+                    {"T": F32,
+                     "ksize": AttrValue(list={"i": [1, 2, 2, 2, 1]}),
+                     "strides": AttrValue(list={"i": [1, 2, 2, 2, 1]}),
+                     "padding": attr_s("VALID")}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        v = rng.normal(size=(1, 4, 4, 4, 1)).astype(np.float32)
+        out = sd.output({"v": v}, "c3", "mp3", "ap3")
+        c3 = out["c3"].numpy()
+        assert c3.shape == (1, 4, 4, 4, 4)
+        # VALID-corner conv element against numpy
+        expect = (v[0, 0:2, 0:2, 0:2, 0] * w3[:, :, :, 0, 1]).sum()
+        assert c3[0, 0, 0, 0, 1] == pytest.approx(expect, rel=1e-4)
+        assert out["mp3"].numpy().shape == (1, 2, 2, 2, 4)
+        np.testing.assert_allclose(
+            out["mp3"].numpy()[0, 0, 0, 0],
+            c3[0, :2, :2, :2].max(axis=(0, 1, 2)), rtol=1e-5)
+        np.testing.assert_allclose(
+            out["ap3"].numpy()[0, 0, 0, 0],
+            c3[0, :2, :2, :2].mean(axis=(0, 1, 2)), rtol=1e-5)
+
+    def test_dilated_depthwise_matches_numpy(self):
+        from deeplearning4j_tpu.modelimport.protobuf import AttrValue
+
+        rng = np.random.default_rng(2)
+        dw = rng.normal(size=(3, 3, 2, 1)).astype(np.float32) * 0.3
+        gd = GraphDef([
+            placeholder("x", [1, 8, 8, 2]),
+            const("dw", dw),
+            NodeDef("dwc", "DepthwiseConv2dNative", ["x", "dw"],
+                    {"T": F32,
+                     "strides": AttrValue(list={"i": [1, 1, 1, 1]}),
+                     "dilations": AttrValue(list={"i": [1, 2, 2, 1]}),
+                     "padding": attr_s("SAME")}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        x = rng.normal(size=(1, 8, 8, 2)).astype(np.float32)
+        out = sd.output({"x": x}, "dwc")["dwc"].numpy()
+        for c in range(2):
+            taps = x[0, 2:7:2, 2:7:2, c]
+            expect = (taps * dw[:, :, c, 0]).sum()
+            assert out[0, 4, 4, c] == pytest.approx(expect, rel=1e-4)
+
+    def test_explicit_padding_and_ncdhw_rejected(self):
+        from deeplearning4j_tpu.modelimport.protobuf import AttrValue
+
+        dw = np.zeros((3, 3, 2, 1), np.float32)
+        gd = GraphDef([
+            placeholder("x", [1, 8, 8, 2]), const("dw", dw),
+            NodeDef("dwc", "DepthwiseConv2dNative", ["x", "dw"],
+                    {"T": F32,
+                     "strides": AttrValue(list={"i": [1, 1, 1, 1]}),
+                     "padding": attr_s("EXPLICIT")}),
+        ])
+        with pytest.raises(TFImportError, match="padding"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        gd2 = GraphDef([
+            placeholder("v", [1, 1, 4, 4, 4]),
+            NodeDef("mp", "MaxPool3D", ["v"],
+                    {"T": F32,
+                     "data_format": attr_s("NCDHW"),
+                     "ksize": AttrValue(list={"i": [1, 1, 2, 2, 2]}),
+                     "strides": AttrValue(list={"i": [1, 1, 2, 2, 2]}),
+                     "padding": attr_s("VALID")}),
+        ])
+        with pytest.raises(TFImportError, match="NDHWC"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd2.encode()))
