@@ -16,10 +16,14 @@ SAME BertConfig/init_params model as models/bert.py on a dp x pp mesh:
 - each stage runs its L/S layers with lax.scan over the stacked layer
   axis, so the stage body is ONE traced layer regardless of depth.
 
-Training is deterministic (no dropout) in pipeline mode: per-microbatch
-RNG threading through the ppermute loop would make the schedule
-rng-dependent; parity with the single-device loss curve is tested in
-tests/test_pipeline_moe.py.
+Dropout is supported: pipeline_apply hands each stage the microbatch
+index it is consuming, and the stage derives its mask keys as
+fold_in(fold_in(step_rng, microbatch), global_layer_index) — the same
+keys on the forward and backward retrace, schedule-independent. With
+cfg.dropout == 0 the path is bit-identical to before; loss-curve parity
+with the single-device BertTrainer is tested at dropout 0 in
+tests/test_pipeline_moe.py (with dropout on, the rng STREAMS differ from
+single-device by construction, so only training progress is asserted).
 
 Reference capability: ABSENT in the reference (SURVEY.md §2.6 pipeline
 row: "NO — XLA multi-computation + collective permute" is the prescribed
@@ -110,24 +114,38 @@ class BertPipelineTrainer:
         self._step = 0
 
     # -- forward through the pipeline ---------------------------------------
-    def _stage_fn(self, stage_params, x):
+    def _stage_fn(self, stage_params, x, mb_idx, rng=None):
         cfg = self.cfg
+        per = cfg.num_layers // self.n_stages
+        deterministic = rng is None or cfg.dropout <= 0
+        base = None if deterministic else jax.random.fold_in(rng, mb_idx)
+        if PIPE_AXIS in self.mesh.axis_names:
+            stage_off = jax.lax.axis_index(PIPE_AXIS) * per
+        else:
+            stage_off = jnp.int32(0)
 
-        def body(h, lp):
-            y, _aux = encoder_layer(lp, h, cfg, mesh=None,
-                                    deterministic=True)
+        def body(h, xs):
+            lp, li_local = xs
+            key = (None if deterministic
+                   else jax.random.fold_in(base, stage_off + li_local))
+            y, _aux = encoder_layer(lp, h, cfg, mesh=None, li=0,
+                                    deterministic=deterministic, rng=key)
             return y, None
 
-        y, _ = jax.lax.scan(body, x, stage_params)
+        y, _ = jax.lax.scan(
+            body, x, (stage_params, jnp.arange(per, dtype=jnp.int32)))
         return y
 
-    def _loss(self, params, tokens_mb, positions, mlm_labels, weights):
+    def _loss(self, params, tokens_mb, positions, mlm_labels, weights,
+              rng):
         cfg, mesh = self.cfg, self.mesh
         m, mb, t = tokens_mb.shape
         full = {"layers": [], **params["emb"]}
         x = embed(full, cfg, tokens_mb.reshape(m * mb, t))
         x = x.reshape(m, mb, t, -1)
-        y = pipeline_apply(self._stage_fn, params["stages"], x, mesh)
+        y = pipeline_apply(
+            lambda p, h, i: self._stage_fn(p, h, i, rng),
+            params["stages"], x, mesh)
         hs = y.reshape(m * mb, t, -1)
         gathered = jnp.take_along_axis(
             hs, positions.reshape(m * mb, -1)[..., None], axis=1)
@@ -147,9 +165,10 @@ class BertPipelineTrainer:
         repl = NamedSharding(self.mesh, P())
         lr = self.lr
 
-        def step(params, opt, tokens_mb, positions, mlm_labels, weights, t):
+        def step(params, opt, tokens_mb, positions, mlm_labels, weights,
+                 rng, t):
             loss, grads = jax.value_and_grad(self._loss)(
-                params, tokens_mb, positions, mlm_labels, weights)
+                params, tokens_mb, positions, mlm_labels, weights, rng)
             b1, b2, eps = 0.9, 0.999, 1e-8
             m = jax.tree_util.tree_map(
                 lambda m_, g: b1 * m_ + (1 - b1) * g, opt["m"], grads)
@@ -166,8 +185,7 @@ class BertPipelineTrainer:
         return jax.jit(
             step,
             in_shardings=(self.p_sh, self.o_sh, self.x_sh, self.x_sh,
-                          self.x_sh, self.x_sh, NamedSharding(
-                              self.mesh, P())),
+                          self.x_sh, self.x_sh, repl, repl),
             out_shardings=(repl, self.p_sh, self.o_sh),
             donate_argnums=(0, 1),
         )
@@ -186,11 +204,12 @@ class BertPipelineTrainer:
         positions, mlm_labels, weights = mlm_gather(
             labels, max_preds=mlm_max_preds(t))
         mb = b // m
+        rng = jax.random.key(self._step + 1, impl="rbg")
         loss, self.params, self.opt = self._step_fn(
             self.params, self.opt,
             jnp.asarray(tokens.reshape(m, mb, t), jnp.int32),
             positions.reshape(m, mb, -1), mlm_labels.reshape(m, mb, -1),
-            weights.reshape(m, mb, -1),
+            weights.reshape(m, mb, -1), rng,
             jnp.asarray(self._step, jnp.int32))
         self._step += 1
         return loss

@@ -41,7 +41,12 @@ def _stage_spec(mesh):
 def pipeline_apply(stage_fn, stage_params, x_mb, mesh):
     """Run the S-stage pipeline over M microbatches.
 
-    stage_fn:      (params_one_stage, x) -> y  (same structure per stage)
+    stage_fn:      (params_one_stage, x, microbatch_index) -> y (same
+                   structure per stage); microbatch_index is the
+                   microbatch the stage is consuming at that tick (a
+                   traced int32 scalar) — stage bodies needing
+                   per-microbatch state (dropout RNG) key off it, others
+                   ignore it.
     stage_params:  pytree with leading axis S (sharded over `pipe`)
     x_mb:          [M, mb, ...] microbatches (replicated over `pipe`,
                    shardable over `data`)
@@ -49,14 +54,16 @@ def pipeline_apply(stage_fn, stage_params, x_mb, mesh):
     """
     n_stages = mesh.shape.get(PIPE_AXIS, 1)
     if n_stages == 1:
-        def seq(params, x):
+        def seq(params, x, mb_idx):
             s = jax.tree_util.tree_leaves(params)[0].shape[0]
             y = x
             for i in range(s):
                 p_i = jax.tree_util.tree_map(lambda a: a[i], params)
-                y = stage_fn(p_i, y)
+                y = stage_fn(p_i, y, mb_idx)
             return y
-        return jax.vmap(lambda mb: seq(stage_params, mb))(x_mb)
+        m1 = x_mb.shape[0]
+        return jax.vmap(lambda mb, i: seq(stage_params, mb, i))(
+            x_mb, jnp.arange(m1, dtype=jnp.int32))
 
     m = x_mb.shape[0]
     p_spec = _stage_spec(mesh)
@@ -75,10 +82,13 @@ def pipeline_apply(stage_fn, stage_params, x_mb, mesh):
         outs = jnp.zeros_like(x_local)
         for t in range(m + n_stages - 1):
             # first stage consumes microbatch t; others consume the
-            # activation handed to them last tick
+            # activation handed to them last tick. Stage s at tick t is
+            # working on microbatch t - s (clipped; out-of-range ticks
+            # are pipeline-bubble work that never reaches the output).
+            mb_idx = jnp.clip(t - stage, 0, m - 1).astype(jnp.int32)
             inp = jnp.where(stage == 0,
                             x_local[jnp.minimum(t, m - 1)], state)
-            out = stage_fn(p_here, inp)
+            out = stage_fn(p_here, inp, mb_idx)
             # collect on the LAST stage once the pipe is full
             is_ready = jnp.logical_and(stage == n_stages - 1,
                                        t >= n_stages - 1)
@@ -123,7 +133,8 @@ class PipelineMlp:
         self._step_fn = None
 
     @staticmethod
-    def stage_fn(p, x):
+    def stage_fn(p, x, mb_idx):
+        del mb_idx  # stateless stage
         return jnp.tanh(x @ p["W"] + p["b"])
 
     def forward(self, params, x_mb):

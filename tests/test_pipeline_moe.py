@@ -295,3 +295,50 @@ class TestMoELayerDSL:
         ModelSerializer.writeModel(net, p, True)
         net2 = ModelSerializer.restoreMultiLayerNetwork(p)
         np.testing.assert_allclose(net2.output(X), y_before, rtol=1e-5)
+
+
+class TestBertPipelineDropout:
+    """Dropout in pipeline mode: per-(microbatch, layer) rng keys ride
+    the GPipe schedule (pipeline_apply's microbatch-index protocol)."""
+
+    def test_dropout_pipeline_trains(self):
+        from deeplearning4j_tpu.models.bert import (
+            BertConfig, synthetic_mlm_batch)
+        from deeplearning4j_tpu.models.bert_pipeline import (
+            BertPipelineTrainer)
+
+        cfg = BertConfig(vocab_size=64, hidden=16, num_layers=4,
+                         num_heads=2, ffn=32, max_len=32, dropout=0.2,
+                         compute_dtype="float32")
+        mesh = MeshConfig(data=2, pipe=2, devices=jax.devices()[:4]).build()
+        tr = BertPipelineTrainer(cfg, mesh, microbatches=2, lr=5e-3,
+                                 seed=1)
+        toks, labs = synthetic_mlm_batch(cfg, 8, 16, seed=0)
+        l0 = float(tr.train_step(toks, labs))
+        last = l0
+        for _ in range(8):
+            last = float(tr.train_step(toks, labs))
+        assert np.isfinite(last) and last < l0
+
+    def test_dropout_zero_still_matches_single_device(self):
+        """The new rng plumbing must not perturb the deterministic path:
+        dropout=0 pipeline still tracks BertTrainer step for step."""
+        from deeplearning4j_tpu.models.bert import (
+            BertConfig, BertTrainer, synthetic_mlm_batch)
+        from deeplearning4j_tpu.models.bert_pipeline import (
+            BertPipelineTrainer)
+
+        cfg = BertConfig(vocab_size=64, hidden=16, num_layers=2,
+                         num_heads=2, ffn=32, max_len=32, dropout=0.0,
+                         compute_dtype="float32")
+        mesh_pp = MeshConfig(data=1, pipe=2,
+                             devices=jax.devices()[:2]).build()
+        mesh_1 = MeshConfig(data=1, devices=jax.devices()[:1]).build()
+        pp = BertPipelineTrainer(cfg, mesh_pp, microbatches=2, lr=1e-3,
+                                 seed=7)
+        single = BertTrainer(cfg, mesh_1, lr=1e-3, seed=7)
+        toks, labs = synthetic_mlm_batch(cfg, 4, 16, seed=0)
+        for _ in range(2):
+            l_pp = float(pp.train_step(toks, labs))
+            l_1 = float(single.train_step(toks, labs))
+            assert l_pp == pytest.approx(l_1, rel=2e-4)
