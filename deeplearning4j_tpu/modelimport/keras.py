@@ -201,6 +201,19 @@ def _convert_layer(class_name, kc, is_last, prev_returns_sequences):
 
         size = kc.get("size", (2, 2))
         return Upsampling2D.Builder().size(list(size)).build()
+    if class_name == "DepthwiseConv2D":
+        from deeplearning4j_tpu.nn import DepthwiseConvolution2D
+
+        ks = kc["kernel_size"]
+        st = kc.get("strides", (1, 1))
+        b = (DepthwiseConvolution2D.Builder()
+             .depthMultiplier(kc.get("depth_multiplier", 1))
+             .kernelSize(list(ks)).stride(list(st))
+             .activation(_act(kc.get("activation")))
+             .hasBias(kc.get("use_bias", True)))
+        if kc.get("padding") == "same":
+            b = b.convolutionMode("same")
+        return b.build()
     if class_name == "SeparableConv2D":
         from deeplearning4j_tpu.nn import SeparableConvolution2D
 
@@ -423,6 +436,9 @@ def _convert_weights(layer, arrs):
         if len(arrs) > 2:
             out["b"] = arrs[2]
         return out
+    # (DepthwiseConvolution2D falls through to the generic conv branch:
+    # its (kh,kw,in,mult) kernel takes the same (3,2,0,1) transpose and
+    # its bias flattening matches the op's c*mult+m output order)
     from deeplearning4j_tpu.nn import (
         Convolution1DLayer, Convolution3D, PReLULayer)
 

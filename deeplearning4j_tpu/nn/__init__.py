@@ -17,7 +17,7 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
     GravesLSTM, LastTimeStep, LocalResponseNormalization, LossLayer, LSTM,
     DepthToSpace, OutputLayer, PoolingType, RnnOutputLayer,
-    SeparableConvolution2D, SimpleRnn, SpaceToDepth, Subsampling1DLayer,
+    DepthwiseConvolution2D, SeparableConvolution2D, SimpleRnn, SpaceToDepth, Subsampling1DLayer,
     SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.objdetect import (  # noqa: F401
     Yolo2OutputLayer)

@@ -433,11 +433,57 @@ class SeparableConvolution2D(ConvolutionLayer):
         return p
 
     def apply(self, params, state, x, training, rng):
+        x = self._dropout(x, training, rng)
         y = OPS["depthwiseConv2d"](x, params["dW"], None,
                                    strides=self.stride, padding=self.padding,
                                    dilation=self.dilation,
                                    sameMode=self._same())
         y = OPS["conv2d"](y, params["pW"], params.get("b"))
+        return self._act(y), state
+
+
+@_register
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Depthwise-only convolution (reference:
+    conf.layers.DepthwiseConvolution2D): each input channel convolves
+    with depthMultiplier filters of its own; nOut = nIn *
+    depthMultiplier."""
+
+    def __init__(self, depthMultiplier=1, **kw):
+        super().__init__(**kw)
+        self.depthMultiplier = int(depthMultiplier)
+
+    def infer(self, input_type):
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(
+                f"DepthwiseConvolution2D needs convolutional input, got "
+                f"{input_type}")
+        self.nIn = self.nIn or input_type.channels
+        self.nOut = self.nIn * self.depthMultiplier
+        # spatial math (incl. dilation) delegates to the base conv infer
+        return super().infer(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = self.kernelSize
+        fan_in = self.nIn * kh * kw
+        # Keras DepthwiseConv2D bias flattening is (in, mult) — the same
+        # c*depthMultiplier + m ordering the depthwiseConv2d op emits, so
+        # imported biases install without a permute.
+        p = {"W": init_weight(self.weightInit, key,
+                              (self.depthMultiplier, self.nIn, kh, kw),
+                              fan_in, self.depthMultiplier * kh * kw,
+                              dtype)}
+        if self.hasBias:
+            p["b"] = jnp.full((self.nOut,), self.biasInit, dtype)
+        return p
+
+    def apply(self, params, state, x, training, rng):
+        x = self._dropout(x, training, rng)
+        y = OPS["depthwiseConv2d"](x, params["W"], params.get("b"),
+                                   strides=self.stride,
+                                   padding=self.padding,
+                                   dilation=self.dilation,
+                                   sameMode=self._same())
         return self._act(y), state
 
 

@@ -335,3 +335,40 @@ class TestRound2LayerCoverage:
         _write_h5(p2, causal, {})
         with pytest.raises(ValueError, match="causal"):
             KerasModelImport.importKerasSequentialModelAndWeights(str(p2))
+
+
+class TestDepthwiseConv2DImport:
+    def test_depthwise_matches_numpy(self, tmp_path):
+        rng = np.random.default_rng(3)
+        dw = rng.normal(size=(3, 3, 2, 2)).astype(np.float32) * 0.3
+        db = rng.normal(size=(4,)).astype(np.float32) * 0.1
+        wd = rng.normal(size=(4, 3)).astype(np.float32)
+        bd = np.zeros(3, np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "DepthwiseConv2D", "config": {
+                "name": "dw", "kernel_size": [3, 3], "strides": [1, 1],
+                "padding": "same", "depth_multiplier": 2,
+                "activation": "linear", "use_bias": True,
+                "batch_input_shape": [None, 6, 6, 2]}},
+            {"class_name": "GlobalAveragePooling2D", "config": {
+                "name": "gap"}},
+            _dense_cfg("out", 3, "softmax"),
+        ]}}
+        p = tmp_path / "dw.h5"
+        _write_h5(p, cfg, {
+            "dw": [("depthwise_kernel:0", dw), ("bias:0", db)],
+            "out": [("kernel:0", wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        x = rng.normal(size=(2, 2, 6, 6)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 3)
+        # weights installed as (mult, in, kh, kw)
+        got = np.asarray(net.getParam(0, "W"))
+        np.testing.assert_allclose(got, dw.transpose(3, 2, 0, 1),
+                                   rtol=1e-6)
+        # numeric: depthwise channel (c=0, m=1) at interior pixel matches
+        acts = net.feedForward(x)
+        y = np.asarray(acts[1].numpy() if hasattr(acts[1], "numpy")
+                       else acts[1])
+        expect = (x[0, 0, 1:4, 1:4] * dw[:, :, 0, 1].T.T).sum() + db[1]
+        assert y[0, 1, 2, 2] == pytest.approx(expect, rel=1e-4)

@@ -298,3 +298,83 @@ class TestOCNN:
         oc = conf2.layers[1]
         assert isinstance(oc, OCNNOutputLayer)
         assert oc.nu == 0.05 and oc.hiddenSize == 6
+
+
+class TestDepthwiseConvolution2D:
+    """Reference: conf.layers.DepthwiseConvolution2D (round 3)."""
+
+    def test_forward_matches_numpy(self):
+        from deeplearning4j_tpu.nn import (
+            DepthwiseConvolution2D, InputType, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .list()
+                .layer(DepthwiseConvolution2D.Builder()
+                       .depthMultiplier(2).kernelSize([3, 3])
+                       .convolutionMode("same")
+                       .activation("identity").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .build())
+                .setInputType(InputType.convolutional(6, 6, 3))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+        acts = net.feedForward(x)
+        y = np.asarray(acts[1].numpy() if hasattr(acts[1], "numpy")
+                       else acts[1])
+        assert y.shape == (2, 6, 6, 6)  # 3 channels x mult 2
+        W = np.asarray(net._params[0]["W"])   # [mult, in, kh, kw]
+        b = np.asarray(net._params[0]["b"])
+        # interior pixel, channel c, multiplier m -> out channel c*2+m
+        c, m = 1, 1
+        expect = (x[0, c, 1:4, 1:4] * W[m, c]).sum() + b[c * 2 + m]
+        assert y[0, c * 2 + m, 2, 2] == pytest.approx(expect, rel=1e-4)
+
+    def test_trains(self):
+        from deeplearning4j_tpu.nn import (
+            DepthwiseConvolution2D, InputType, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+                .list()
+                .layer(DepthwiseConvolution2D.Builder()
+                       .depthMultiplier(2).kernelSize([3, 3])
+                       .convolutionMode("same").activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(3).activation("softmax")
+                       .build())
+                .setInputType(InputType.convolutional(6, 6, 2))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(8, 2, 6, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        s0 = net.score((X, y))
+        net.fit([(X, y)] * 25)
+        assert net.score((X, y)) < s0
+
+    def test_dilated_infer_matches_runtime(self):
+        """infer() must account for dilation (review finding: truncate-
+        mode output size diverged from the op's actual output)."""
+        from deeplearning4j_tpu.nn import (
+            DepthwiseConvolution2D, InputType, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .list()
+                .layer(DepthwiseConvolution2D.Builder()
+                       .kernelSize([3, 3]).dilation([2, 2])
+                       .activation("identity").build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .build())
+                .setInputType(InputType.convolutional(8, 8, 2))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        x = np.zeros((2, 2, 8, 8), np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 2)   # effective kernel 5 -> 4x4 spatial
