@@ -1511,3 +1511,65 @@ def _non_max_suppression(boxes, scores, maxOutputSize=10,
     _, out = lax.fori_loop(0, k, body,
                            (live, jnp.full((k,), -1, jnp.int32)))
     return out
+
+
+# ---------------------------------------------------------------------------
+# round-3 declarable widening: shape/index utilities (reference: libnd4j
+# transforms — roll, eye, repeat, flip, sort/argsort, scatter, fill)
+# ---------------------------------------------------------------------------
+
+@op("roll")
+def _roll(x, shift=1, dimensions=None):
+    return jnp.roll(x, shift, axis=_axis(dimensions, x.ndim))
+
+
+@op("eye")
+def _eye(rows=None, cols=None, dtype="float32"):
+    return jnp.eye(int(rows), None if cols is None else int(cols),
+                   dtype=jnp.dtype(dtype))
+
+
+@op("repeat")
+def _repeat(x, repeats=1, dimension=0):
+    return jnp.repeat(x, int(repeats), axis=int(dimension))
+
+
+OPS["flip"] = OPS["reverse"]   # TF/DL4J name alias for the same op
+
+
+@op("sort")
+def _sort(x, dimension=-1, descending=False):
+    y = jnp.sort(x, axis=dimension)
+    return jnp.flip(y, axis=dimension) if descending else y
+
+
+@op("argsort")
+def _argsort(x, dimension=-1, descending=False):
+    i = jnp.argsort(x, axis=dimension)
+    return jnp.flip(i, axis=dimension) if descending else i
+
+
+@op("fill")
+def _fill(shape=None, value=0.0, dtype="float32"):
+    return jnp.full(tuple(int(s) for s in shape), value,
+                    jnp.dtype(dtype))
+
+
+@op("tensorScatterUpdate")
+def _tensor_scatter_update(x, indices, updates):
+    """TF tensor_scatter_nd_update semantics: indices [N, K] index the
+    first K dims of x; updates [N, ...]."""
+    idx = tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))
+    return jnp.asarray(x).at[idx].set(updates)
+
+
+@op("uniqueWithCounts")
+def _unique_with_counts(x, size=None):
+    """Static-shape unique (XLA needs fixed shapes): returns
+    (values [size], counts [size]) padded with the first value /
+    zero counts. `size` defaults to x.size."""
+    flat = x.reshape(-1)
+    n = flat.shape[0] if size is None else int(size)
+    # jnp.unique(size=n) zero-pads counts and fills values itself
+    return jnp.unique(flat, return_counts=True, size=n,
+                      fill_value=flat[0])

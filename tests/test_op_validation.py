@@ -398,3 +398,41 @@ class TestResizeVariants:
             .astype(np.float32)
         y = np.asarray(OPS["imageResize"](x, 4, 4, method="lanczos3"))
         assert y.shape == (1, 2, 4, 4)
+
+
+class TestRound3ShapeOps:
+    """Round-3 declarable widening: roll/eye/repeat/flip/sort/argsort/
+    fill/tensorScatterUpdate/uniqueWithCounts."""
+
+    def test_shape_utilities(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(OPS["roll"](x, 1, [1]),
+                                   np.roll(x, 1, 1))
+        np.testing.assert_allclose(OPS["eye"](3), np.eye(3))
+        assert OPS["repeat"](x, 2, 0).shape == (4, 3)
+        np.testing.assert_allclose(OPS["flip"](x, [0]), x[::-1])
+        np.testing.assert_allclose(OPS["fill"]([2, 2], 7.0),
+                                   np.full((2, 2), 7.0))
+
+    def test_sort_argsort(self):
+        s = np.array([3.0, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(OPS["sort"](s), [1, 2, 3])
+        np.testing.assert_allclose(OPS["sort"](s, descending=True),
+                                   [3, 2, 1])
+        np.testing.assert_allclose(OPS["argsort"](s), [1, 2, 0])
+        np.testing.assert_allclose(OPS["argsort"](s, descending=True),
+                                   [0, 2, 1])
+
+    def test_tensor_scatter_update(self):
+        y = np.asarray(OPS["tensorScatterUpdate"](
+            np.zeros((3, 2), np.float32), np.array([[0], [2]]),
+            np.array([[1., 1.], [2., 2.]], np.float32)))
+        np.testing.assert_allclose(y, [[1, 1], [0, 0], [2, 2]])
+
+    def test_unique_with_counts_static_shape(self):
+        v, c = OPS["uniqueWithCounts"](np.array([1, 2, 2, 3, 3, 3]))
+        v, c = np.asarray(v), np.asarray(c)
+        assert v.shape == (6,) and c.shape == (6,)  # static size
+        assert list(v[:3]) == [1, 2, 3]
+        assert list(c[:3]) == [1, 2, 3]
+        assert c[3:].sum() == 0
