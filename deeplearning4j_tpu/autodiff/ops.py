@@ -1295,23 +1295,51 @@ OPS["zeroFraction"] = lambda x: jnp.mean((x == 0).astype(jnp.float32))
 # reference routes these through custom kernels, here jax.image / lax)
 # ---------------------------------------------------------------------------
 
+def _area_weight_matrix(n_in, n_out):
+    """(n_out, n_in) row-stochastic overlap weights: output cell i spans
+    input range [i*s, (i+1)*s), s = n_in/n_out; each input pixel
+    contributes its fractional overlap (TF ResizeArea region averaging,
+    valid for any ratio incl. upscale). Host-side numpy — shapes are
+    static at trace time."""
+    import numpy as np
+
+    s = n_in / n_out
+    mat = np.zeros((n_out, n_in), np.float32)
+    for i in range(n_out):
+        lo, hi = i * s, (i + 1) * s
+        for j in range(int(np.floor(lo)), min(int(np.ceil(hi)), n_in)):
+            mat[i, j] = min(hi, j + 1) - max(lo, j)
+        mat[i] /= s
+    return mat
+
+
 @op("imageResize")
 def _image_resize(x, height, width, method="bilinear", antialias=False):
     """x: [N,C,H,W] (DL4J layout); method: bilinear|nearest|cubic|
     lanczos3|lanczos5|area. antialias defaults OFF to match the TF/DL4J
     resize ops this mirrors (jax.image.resize's own default is
-    antialias=True). `area` averages exact input regions and requires
-    integer downscale factors (the TF ResizeArea fast path)."""
+    antialias=True). `area` averages exact input regions; integer
+    downscale factors take the reshape fast path, general ratios go
+    through per-axis overlap-weight matmuls (TF ResizeArea semantics,
+    MXU-shaped)."""
     height, width = int(height), int(width)
     n, c, h, w = x.shape
     m = str(method).lower()
     if m == "area":
-        if h % height or w % width:
-            raise ValueError(
-                f"imageResize method='area' needs integer downscale "
-                f"factors, got {h}x{w} -> {height}x{width}")
-        fh, fw = h // height, w // width
-        return x.reshape(n, c, height, fh, width, fw).mean(axis=(3, 5))
+        if h % height == 0 and w % width == 0:
+            fh, fw = h // height, w // width
+            return x.reshape(n, c, height, fh, width, fw).mean(
+                axis=(3, 5))
+        # contract in f32 regardless of input dtype (integer images would
+        # truncate the fractional weights to zero; matches the integer
+        # fast path, whose .mean() also yields float) at full precision —
+        # resize is an exact-semantics op, the MXU bf16 default would
+        # shift pixel values visibly
+        xf = x.astype(jnp.float32)
+        wh = jnp.asarray(_area_weight_matrix(h, height))
+        ww = jnp.asarray(_area_weight_matrix(w, width))
+        return jnp.einsum("nchw,Hh,Ww->ncHW", xf, wh, ww,
+                          precision=lax.Precision.HIGHEST)
     meth = {"bilinear": "bilinear", "nearest": "nearest",
             "cubic": "cubic", "bicubic": "cubic",
             "lanczos3": "lanczos3", "lanczos5": "lanczos5"}[m]

@@ -77,7 +77,23 @@ class SubGraph:
 
     def callable(self, squeeze: bool = False):
         """Compile the child graph into a plain jnp-arrays callable with
-        the signature control-flow op kernels expect."""
+        the signature control-flow op kernels expect.
+
+        Random ops are rejected: the body runs with a fixed RNG detached
+        from the parent graph's stream, so a random op would draw the
+        SAME values on every call and every loop iteration — a silent
+        correctness trap (ADVICE r3). (Inference-mode dropout is fine:
+        it is deterministic at training=False.)"""
+        for o in self.graph._ops:
+            if o.fn_name in RANDOM_OPS and o.fn_name not in \
+                    TRAINING_AWARE_OPS:
+                raise ValueError(
+                    f"control-flow body contains random op "
+                    f"{o.fn_name!r} ({o.outputs[0]!r}): loop/branch "
+                    "bodies run with a fixed RNG key, so every call and "
+                    "every iteration would draw identical values. Hoist "
+                    "the random draw out of the body and pass it in as a "
+                    "loop variable instead.")
         fn = self.graph._make_fn(tuple(self.out_names), training=False)
         params, consts = self.graph._split_values()
         arg_names, out_names = self.arg_names, self.out_names
@@ -98,7 +114,10 @@ class SubGraph:
         be weight-matrix sized) go into `value_sink` — the parent's npz
         dict — under prefixed keys, not into the JSON; the tiny scalar
         fallback inlines them when no sink is provided (in-memory use)."""
-        d = self.graph._graph_dict()
+        # forward the sink so doubly-nested control-flow bodies also land
+        # their captured values in the npz instead of inlining JSON lists
+        d = self.graph._graph_dict(value_sink=value_sink,
+                                   prefix=prefix or "__sub__/")
         if value_sink is not None:
             d["value_keys"] = {}
             for k, v in self.graph._values.items():
@@ -116,7 +135,8 @@ class SubGraph:
 
     @staticmethod
     def from_dict(d: dict, value_source=None) -> "SubGraph":
-        child = SameDiff._from_graph_dict(d["graph"])
+        child = SameDiff._from_graph_dict(d["graph"],
+                                          value_source=value_source)
         if "value_keys" in d["graph"]:
             for k, sk in d["graph"]["value_keys"].items():
                 child._values[k] = jnp.asarray(value_source[sk])
@@ -1125,7 +1145,7 @@ class SameDiff:
     # -- serde (reference: SameDiff.save/load flatbuffers .fb; here a zip of
     # graph JSON + npz values, same round-trip capability, SURVEY.md §5;
     # control-flow bodies serialize as nested sub-graph dicts) ------------
-    def _graph_dict(self, value_sink=None) -> dict:
+    def _graph_dict(self, value_sink=None, prefix="__sub__/") -> dict:
         return {
             "variables": [
                 {
@@ -1139,7 +1159,7 @@ class SameDiff:
             "ops": [
                 {"fn": o.fn_name, "inputs": o.inputs, "outputs": o.outputs,
                  "attrs": _json_attrs(o.attrs, value_sink,
-                                      prefix=f"__sub__/op{i}/")}
+                                      prefix=f"{prefix}op{i}/")}
                 for i, o in enumerate(self._ops)
             ],
             "lossVariables": self._loss_vars,

@@ -41,6 +41,20 @@ def _default_workers():
     return max(1, (os.cpu_count() or 1))
 
 
+def _fork_ctx():
+    """The 'fork' start method, or None where it does not exist (Windows)
+    or is unsafe as a non-default (macOS, spawn-default since 3.8): the
+    _WORK global-inheritance scheme is fork-only, so callers degrade to
+    their serial path instead of crashing (ADVICE r3)."""
+    import sys
+    if sys.platform in ("win32", "darwin"):
+        return None
+    try:
+        return mp.get_context("fork")
+    except ValueError:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # TransformProcess executor
 # ---------------------------------------------------------------------------
@@ -67,9 +81,9 @@ class LocalTransformExecutor:
         records = list(records)
         n = len(records)
         workers = numWorkers or _default_workers()
-        if workers <= 1 or n <= chunkSize:
+        ctx = _fork_ctx()
+        if workers <= 1 or n <= chunkSize or ctx is None:
             return transform_process.execute(records)
-        ctx = mp.get_context("fork")
         _WORK["tp"] = transform_process
         _WORK["records"] = records
         try:
@@ -89,6 +103,24 @@ class LocalTransformExecutor:
 # parallel image ingestion
 # ---------------------------------------------------------------------------
 
+def _decode_batch(files, labels, label_of, loader, transform, batch_size,
+                  seq, epoch_seed):
+    """Decode/augment ONE batch — the single source of truth for both the
+    forked _image_worker and the serial fallback (identical seeding, so
+    the two paths are deterministically interchangeable)."""
+    chunk = files[seq * batch_size:(seq + 1) * batch_size]
+    rng = np.random.default_rng(epoch_seed + (seq,))
+    feats, idxs = [], []
+    for path in chunk:
+        arr = loader.asMatrix(path)
+        if transform is not None:
+            arr = transform.transform(arr, rng)
+        feats.append(arr)
+        idxs.append(labels.index(label_of(path)))
+    return (np.stack(feats).astype(np.float32),
+            np.asarray(idxs, np.int32))
+
+
 def _image_worker(worker_id, n_workers, batch_size, n_batches, out_q,
                   seed):
     """Decode/augment whole batches (worker w owns batches w, w+W, ...)
@@ -100,17 +132,9 @@ def _image_worker(worker_id, n_workers, batch_size, n_batches, out_q,
     transform = _WORK["transform"]
     try:
         for seq in range(worker_id, n_batches, n_workers):
-            chunk = files[seq * batch_size:(seq + 1) * batch_size]
-            rng = np.random.default_rng(seed + (seq,))
-            feats, idxs = [], []
-            for path in chunk:
-                arr = loader.asMatrix(path)
-                if transform is not None:
-                    arr = transform.transform(arr, rng)
-                feats.append(arr)
-                idxs.append(labels.index(label_of(path)))
-            out_q.put((seq, np.stack(feats).astype(np.float32),
-                       np.asarray(idxs, np.int32)))
+            feats, idxs = _decode_batch(files, labels, label_of, loader,
+                                        transform, batch_size, seq, seed)
+            out_q.put((seq, feats, idxs))
         out_q.put(("done", worker_id, None))
     except Exception as e:  # surfaced by the parent
         out_q.put(("error", worker_id, f"{type(e).__name__}: {e}"))
@@ -166,8 +190,24 @@ class ParallelImageDataSetIterator(DataSetIterator):
     def totalOutcomes(self):
         return len(self._labels)
 
+    def _serial_batch(self, seq):
+        """In-process fallback for one batch on hosts without the fork
+        start method — same _decode_batch, same seeding as the workers."""
+        return _decode_batch(self._files, self._labels,
+                             self._label_gen.getLabelForPath, self._loader,
+                             self._transform, self._batch, seq,
+                             self._epoch_seed)
+
     def _start(self):
-        ctx = mp.get_context("fork")
+        ctx = _fork_ctx()
+        if ctx is None:
+            self._queue = "serial"
+            self._epoch_seed = (self._seed, self._epoch)
+            self._epoch += 1
+            self._live_workers = 0
+            self._reorder = {}
+            self._next_seq = 0
+            return
         self._queue = ctx.Queue(maxsize=self._qsize)
         _WORK["files"] = self._files
         _WORK["labels"] = self._labels
@@ -202,6 +242,9 @@ class ParallelImageDataSetIterator(DataSetIterator):
             raise StopIteration
         if self._queue is None:
             self._start()
+        if self._queue == "serial":
+            self._reorder[self._next_seq] = \
+                self._serial_batch(self._next_seq)
         while self._next_seq not in self._reorder:
             try:
                 seq, a, b = self._queue.get(timeout=300)

@@ -37,19 +37,45 @@ class TFImportError(ValueError):
     pass
 
 
-def _ref(name):
-    """'node:k' -> (node, k); '^node' -> control dep (None). FunctionDef
-    bodies use the 3-part form 'node:out_arg:k' — the out_arg name is
-    dropped (flat index k is correct for the single-output-per-arg ops in
-    scope)."""
+def _ref_parts(name):
+    """'node:k' -> (node, None, k); 'node:out_arg:k' -> (node, out_arg, k);
+    '^node' -> (None, None, 0)."""
     if name.startswith("^"):
-        return None, 0
+        return None, None, 0
     if ":" in name:
         node, idx = name.rsplit(":", 1)
+        arg = None
         if ":" in node:
-            node = node.split(":", 1)[0]
-        return node, int(idx)
-    return name, 0
+            node, arg = node.split(":", 1)
+        return node, arg, int(idx)
+    return name, None, 0
+
+
+def _ref(name):
+    """'node:k' -> (node, k); '^node' -> control dep (None). FunctionDef
+    bodies use the 3-part form 'node:out_arg:k'; this bare helper drops
+    the arg name (flat index k is only correct for a sole-output-arg op)
+    — the importer's _resolve() adds the layout-aware mapping plus the
+    distinct-arg-name rejection for ops outside the layout table."""
+    node, _, idx = _ref_parts(name)
+    return node, idx
+
+
+# TF ops with multiple NAMED output args, in OpDef order (matching the
+# order our handlers bind outputs): lets _resolve() compute the true flat
+# index for 3-part FunctionDef refs like 'u:idx:0'. Ops not listed here
+# are assumed single-output-arg; a reference through a second distinct
+# arg name is detected and rejected (see _resolve), but a LONE reference
+# to a non-first arg of an unlisted op cannot be detected without the TF
+# OpDef and would resolve to flat index k.
+_OUT_ARG_LAYOUTS = {
+    "Unique": ("y", "idx"),
+    "UniqueV2": ("y", "idx"),
+    "UniqueWithCounts": ("y", "idx", "count"),
+    "TopKV2": ("values", "indices"),
+    "NonMaxSuppressionV4": ("selected_indices", "valid_outputs"),
+    "MaxPoolWithArgmax": ("output", "argmax"),
+}
 
 
 class TFGraphMapper:
@@ -57,7 +83,7 @@ class TFGraphMapper:
 
     @staticmethod
     def importGraph(path_or_graphdef, placeholder_shapes=None,
-                    trainable=False) -> SameDiff:
+                    trainable=False, strict=False) -> SameDiff:
         """placeholder_shapes: {placeholder_name: concrete shape} for
         graphs whose recorded input shapes have unknown (-1) dims; the
         import specializes to them (like feeding fixed shapes to the
@@ -65,12 +91,15 @@ class TFGraphMapper:
 
         trainable=True converts the imported weight constants to
         VARIABLEs (see makeTrainable) so the graph can be fine-tuned —
-        the reference's imported-BERT training flow (SURVEY.md §3.4)."""
+        the reference's imported-BERT training flow (SURVEY.md §3.4).
+
+        strict=True turns documented-deviation warnings (e.g. TF1-legacy
+        resize sampling) into TFImportError."""
         if isinstance(path_or_graphdef, GraphDef):
             gd = path_or_graphdef
         else:
             gd = GraphDef.parse(path_or_graphdef)
-        sd = _Importer(gd, placeholder_shapes).run()
+        sd = _Importer(gd, placeholder_shapes, strict=strict).run()
         if trainable:
             TFGraphMapper.makeTrainable(sd)
         return sd
@@ -109,8 +138,10 @@ class TFGraphMapper:
 
 
 class _Importer:
-    def __init__(self, gd: GraphDef, placeholder_shapes=None):
+    def __init__(self, gd: GraphDef, placeholder_shapes=None,
+                 strict=False):
         self.gd = gd
+        self.strict = strict
         self.placeholder_shapes = dict(placeholder_shapes or {})
         self.nodes = {n.name: n for n in gd.nodes}
         self.functions = {f.signature.name: f
@@ -120,6 +151,7 @@ class _Importer:
         self.shapes = {}      # tf tensor name -> tuple (static)
         self.dtypes = {}      # tf tensor name -> np.dtype
         self.consts = {}      # node name -> np.ndarray (host-foldable)
+        self._out_args = {}   # node name -> out_arg name seen in 3-part refs
 
     # -- public ------------------------------------------------------------
 
@@ -169,9 +201,39 @@ class _Importer:
     def data_inputs(self, node):
         return [i for i in node.inputs if not i.startswith("^")]
 
+    def _resolve(self, ref):
+        """(node, flat_output_index) for a tensor ref, honouring the
+        FunctionDef 3-part form 'node:out_arg:k'. Ops in _OUT_ARG_LAYOUTS
+        get the exact layout-based index; for unlisted ops flat=k is only
+        correct when out_arg is the node's sole output arg, so two
+        DISTINCT arg names on one node (which would alias to the same
+        index and silently wire the wrong tensor, ADVICE r3) are
+        rejected."""
+        node, arg, k = _ref_parts(ref)
+        if node is None or arg is None:
+            return node, k
+        nd = self.nodes.get(node)
+        layout = _OUT_ARG_LAYOUTS.get(nd.op) if nd is not None else None
+        if layout is not None:
+            if arg not in layout:
+                raise TFImportError(
+                    f"ref {ref!r}: op {nd.op} has output args {layout}, "
+                    f"not {arg!r}")
+            return node, layout.index(arg) + k
+        seen = self._out_args.setdefault(node, arg)
+        if seen != arg:
+            raise TFImportError(
+                f"node {node!r} is referenced through two distinct output "
+                f"args ({seen!r} and {arg!r}); ops with multiple named "
+                "output args inside While/If function bodies cannot be "
+                "flat-indexed without the TF OpDef layout — re-export the "
+                "graph with such multi-output ops outside the function "
+                "body, or split the op")
+        return node, k
+
     def var(self, ref):
         """SDVariable for a tf tensor ref, materializing host constants."""
-        node, idx = _ref(ref)
+        node, idx = self._resolve(ref)
         key = f"{node}:{idx}"
         if key in self.vars:
             return self.vars[key]
@@ -183,7 +245,7 @@ class _Importer:
 
     def const(self, ref):
         """numpy value of a host-foldable tensor ref, or None."""
-        node, idx = _ref(ref)
+        node, idx = self._resolve(ref)
         if idx != 0:
             return None
         return self._fold(node)
@@ -197,14 +259,14 @@ class _Importer:
         return v
 
     def shape(self, ref):
-        node, idx = _ref(ref)
+        node, idx = self._resolve(ref)
         key = f"{node}:{idx}"
         if key not in self.shapes:
             raise TFImportError(f"no static shape for {ref!r}")
         return self.shapes[key]
 
     def dtype(self, ref):
-        node, idx = _ref(ref)
+        node, idx = self._resolve(ref)
         return self.dtypes.get(f"{node}:{idx}", np.dtype(np.float32))
 
     # -- emission ------------------------------------------------------------
@@ -934,7 +996,7 @@ def _function_subgraph(im, fname, arg_refs, what):
     nodes += fdef.nodes
 
     sub = _Importer(GraphDef(nodes, functions=list(im.functions.values())),
-                    ph_shapes)
+                    ph_shapes, strict=im.strict)
     child = sub.run()
 
     out_names, out_shapes, out_dtypes = [], [], []
@@ -946,7 +1008,7 @@ def _function_subgraph(im, fname, arg_refs, what):
                 f"output {arg.name!r}")
         v = sub.var(ret_ref)
         out_names.append(v.name())
-        node_name, idx = _ref(ret_ref)
+        node_name, idx = sub._resolve(ret_ref)
         out_shapes.append(sub.shapes[f"{node_name}:{idx}"])
         out_dtypes.append(sub.dtypes[f"{node_name}:{idx}"])
     return (SubGraph(child, [a.name for a in sig.input_args], out_names),
@@ -1039,6 +1101,13 @@ def _h_resize(im, node):
             f"half_pixel_centers=True")
     hpc = node.attrs.get("half_pixel_centers")
     if node.op != "ResizeArea" and (hpc is None or not hpc.b):
+        if im.strict:
+            raise TFImportError(
+                f"node {node.name!r} ({node.op}): TF1-legacy sampling "
+                f"(half_pixel_centers=False) rejected under "
+                f"strict=True — interior samples would shift by up to "
+                f"half a source pixel; re-export with "
+                f"half_pixel_centers=True or import with strict=False")
         import warnings
 
         warnings.warn(

@@ -256,3 +256,169 @@ class TestAttentionVertexHeadValidation:
         v = AttentionVertex(nHeads=2, projectInput=False)
         with pytest.raises(ValueError, match="projectInput=False"):
             v.infer(InputType.recurrent(4, 5))
+
+
+class TestFunctionDefMultiOutputArgGuard:
+    """ADVICE r3 medium: FunctionDef 3-part refs 'node:out_arg:k' with
+    two DISTINCT out_arg names on the same node alias to the same flat
+    index — the importer must reject, not silently mis-wire."""
+
+    def test_distinct_out_args_raise(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import (
+            TFImportError, _Importer)
+        from deeplearning4j_tpu.modelimport.protobuf import GraphDef
+
+        im = _Importer(GraphDef([], functions=[]))
+        im._resolve("u:y:0")
+        with pytest.raises(TFImportError, match="distinct output args"):
+            im._resolve("u:idx:0")
+
+    def test_same_out_arg_ok(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import _Importer
+        from deeplearning4j_tpu.modelimport.protobuf import GraphDef
+
+        im = _Importer(GraphDef([], functions=[]))
+        assert im._resolve("u:output:0") == ("u", 0)
+        assert im._resolve("u:output:1") == ("u", 1)  # same arg: fine
+        assert im._resolve("v:0") == ("v", 0)         # 2-part form
+
+    def test_layout_table_resolves_exactly(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import _Importer
+        from deeplearning4j_tpu.modelimport.protobuf import (
+            GraphDef, NodeDef)
+
+        # a Unique node in the graph: 'u:idx:0' must flat-index to 1
+        im = _Importer(GraphDef([NodeDef("u", "Unique", [], {})],
+                                functions=[]))
+        assert im._resolve("u:y:0") == ("u", 0)
+        assert im._resolve("u:idx:0") == ("u", 1)
+
+
+class TestSubGraphRandomRejection:
+    """ADVICE r3 low: random ops inside control-flow bodies would draw
+    identical values every iteration (fixed key) — callable() rejects."""
+
+    def test_random_in_body_raises(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff()
+        i0 = sd.constant("i0", np.asarray(0.0, np.float32))
+
+        def body(i):
+            # draws randomness inside the (traced child) loop body
+            r = i.sd.random.normal("r_in_body", (2,))
+            return i + r.sum() * 0.0 + 1.0
+
+        with pytest.raises(ValueError, match="random op"):
+            sd.whileLoop(lambda i: i < 3.0, body, i0, name="w")
+
+
+class TestNestedSubGraphValueSink:
+    """ADVICE r3 low: doubly-nested control-flow bodies must land their
+    captured values in the npz (value_sink), not inline JSON lists."""
+
+    def test_nested_values_ride_npz(self, tmp_path):
+        import json
+        import zipfile
+
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff()
+        x0 = sd.constant("x0", np.zeros((4096,), np.float32))
+        big = np.arange(4096, dtype=np.float32) * 1e-6
+
+        def outer_body(x):
+            # constant lives in the OUTER body's child graph; the inner
+            # body captures it -> captured-constant table of the inner
+            # (depth-2) sub-graph
+            cap = x.sd.constant("cap_outer", big)
+
+            def inner_body(y):
+                # ops ordered so they land on the inner traced graph and
+                # cap is captured directly (build-time value -> table)
+                return y * 0.0 + cap + 1.0
+
+            return x.sd.whileLoop(
+                lambda y: y.sum() < 2.0, inner_body, x, name="inner")
+
+        out = sd.whileLoop(lambda x: x.sum() < 1.0, outer_body, x0,
+                           name="outer")
+        _ = out
+        p = str(tmp_path / "nested.sd.zip")
+        sd.save(p)
+        with zipfile.ZipFile(p) as zf:
+            graph = json.loads(zf.read("graph.json"))
+        # no weight-sized JSON anywhere in the doc: the serialized JSON
+        # must stay small because cap_outer (4096 floats) rides the npz
+        assert len(json.dumps(graph)) < 20000
+        sd2 = SameDiff.load(p)
+        r1 = np.asarray(sd.output({}, out.name())[out.name()].toNumpy())
+        r2 = np.asarray(sd2.output({}, out.name())[out.name()].toNumpy())
+        np.testing.assert_allclose(r1, r2, atol=1e-6)
+
+
+class TestForkContextFallback:
+    """ADVICE r3 low: fork-only multiprocessing entry points degrade to
+    the serial path when the fork start method is unavailable."""
+
+    def test_transform_executor_serial_fallback(self, monkeypatch):
+        from deeplearning4j_tpu.datasets import parallel_etl
+        from deeplearning4j_tpu.datasets.transform import (
+            Schema, TransformProcess)
+
+        monkeypatch.setattr(parallel_etl, "_fork_ctx", lambda: None)
+        schema = (Schema.Builder().addColumnDouble("a").build())
+        tp = (TransformProcess.Builder(schema)
+              .doubleMathOp("a", "Add", 1.0).build())
+        recs = [[float(i)] for i in range(10)]
+        out = parallel_etl.LocalTransformExecutor.execute(
+            recs, tp, numWorkers=4, chunkSize=2)
+        assert [r[0] for r in out] == [float(i) + 1.0 for i in range(10)]
+
+    def test_image_iterator_serial_fallback(self, tmp_path, monkeypatch):
+        import struct
+        import zlib
+
+        from deeplearning4j_tpu.datasets import parallel_etl
+        from deeplearning4j_tpu.datasets.records import FileSplit
+
+        def write_png(path, w, h, val):
+            # minimal grayscale PNG writer (no PIL dependency)
+            def chunk(typ, data):
+                c = typ + data
+                return (struct.pack(">I", len(data)) + c +
+                        struct.pack(">I", zlib.crc32(c)))
+
+            raw = b"".join(
+                b"\x00" + bytes([val] * w) for _ in range(h))
+            png = (b"\x89PNG\r\n\x1a\n" +
+                   chunk(b"IHDR", struct.pack(">IIBBBBB", w, h, 8, 0,
+                                              0, 0, 0)) +
+                   chunk(b"IDAT", zlib.compress(raw)) +
+                   chunk(b"IEND", b""))
+            path.write_bytes(png)
+
+        for label in ("cat", "dog"):
+            d = tmp_path / label
+            d.mkdir()
+            for i in range(3):
+                write_png(d / f"{i}.png", 4, 4,
+                          60 if label == "cat" else 200)
+
+        monkeypatch.setattr(parallel_etl, "_fork_ctx", lambda: None)
+        it = parallel_etl.ParallelImageDataSetIterator(
+            FileSplit(str(tmp_path)), height=4, width=4, channels=1,
+            batchSize=2, numWorkers=2, seed=3)
+        first_epoch = []
+        while it.hasNext():
+            ds = it.next()
+            first_epoch.append(np.asarray(ds.getFeatures()))
+        assert sum(f.shape[0] for f in first_epoch) == 6
+        it.reset()
+        second_epoch = []
+        while it.hasNext():
+            second_epoch.append(np.asarray(it.next().getFeatures()))
+        # no augmentation: epochs must be identical; with reset() the
+        # iterator must replay every batch
+        for a, b in zip(first_epoch, second_epoch):
+            np.testing.assert_array_equal(a, b)

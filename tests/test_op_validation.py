@@ -388,10 +388,26 @@ class TestResizeVariants:
         y = np.asarray(OPS["imageResize"](x, 2, 2, method="area"))
         assert y[0, 0, 0, 0] == pytest.approx(x[0, 0, :2, :2].mean())
 
-    def test_area_non_integer_raises(self):
-        x = np.zeros((1, 1, 4, 4), np.float32)
-        with pytest.raises(ValueError, match="integer downscale"):
-            OPS["imageResize"](x, 3, 3, method="area")
+    def test_area_general_ratio(self):
+        # 4 -> 3: output cell i averages input range [i*4/3, (i+1)*4/3)
+        # with fractional overlap weights (TF ResizeArea semantics)
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+        x = np.broadcast_to(x, (1, 1, 4, 4)).copy()
+        y = np.asarray(OPS["imageResize"](x, 4, 3, method="area"))
+        s = 4 / 3
+        for i in range(3):
+            lo, hi = i * s, (i + 1) * s
+            want = sum(
+                (min(hi, j + 1) - max(lo, j)) * j
+                for j in range(int(np.floor(lo)), int(np.ceil(hi)))) / s
+            assert y[0, 0, 0, i] == pytest.approx(want, rel=1e-5)
+
+    def test_area_upscale(self):
+        # upscale regions are sub-pixel; each output draws from the one
+        # or two inputs it overlaps
+        x = np.asarray([[0.0, 1.0]], np.float32).reshape(1, 1, 1, 2)
+        y = np.asarray(OPS["imageResize"](x, 1, 4, method="area"))
+        assert np.allclose(y[0, 0, 0], [0.0, 0.0, 1.0, 1.0])
 
     def test_lanczos(self):
         x = np.random.default_rng(0).normal(size=(1, 2, 8, 8)) \

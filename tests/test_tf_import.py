@@ -841,14 +841,25 @@ class TestResizeAndNms:
             TFGraphMapper.importGraph(GraphDef.parse(gd2.encode()))
         assert any("TF1-legacy" in str(x.message) for x in w)
 
-    def test_non_integer_area_resize_is_import_error(self):
+    def test_non_integer_area_resize_matches_region_average(self):
+        # 5 -> 3 is a non-integer ratio: general overlap-weight path
+        # (was a TFImportError before the r4 ADVICE fix)
         gd = GraphDef([
             placeholder("img", [1, 5, 5, 1]),
             const("sz", np.array([3, 3], np.int32)),
             NodeDef("dn", "ResizeArea", ["img", "sz"], {"T": F32}),
         ])
-        with pytest.raises(TFImportError, match="dn"):
-            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        img = np.arange(25, dtype=np.float32).reshape(1, 5, 5, 1)
+        out = sd.output({"img": img}, "dn")["dn"].toNumpy()
+        assert out.shape == (1, 3, 3, 1)
+        # reference region average for output cell (0,0): rows/cols
+        # [0, 5/3) with fractional weight 2/3 on index 1
+        s = 5 / 3
+        w = np.array([1.0, s - 1.0]) / s
+        want00 = (w[:, None] * w[None, :] *
+                  img[0, :2, :2, 0]).sum()
+        assert out[0, 0, 0, 0] == pytest.approx(want00, rel=1e-5)
 
 
 class TestDepthwiseAnd3D:
@@ -959,3 +970,18 @@ class TestDepthwiseAnd3D:
         ])
         with pytest.raises(TFImportError, match="NDHWC"):
             TFGraphMapper.importGraph(GraphDef.parse(gd2.encode()))
+
+
+class TestStrictMode:
+    def test_strict_rejects_legacy_sampling(self):
+        gd = GraphDef([
+            placeholder("img", [1, 4, 4, 1]),
+            const("sz", np.array([8, 8], np.int32)),
+            NodeDef("up", "ResizeBilinear", ["img", "sz"], {"T": F32}),
+        ])
+        with pytest.raises(TFImportError, match="strict"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()),
+                                      strict=True)
+        # default (strict=False): imports with a warning
+        with pytest.warns(UserWarning, match="TF1-legacy"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
