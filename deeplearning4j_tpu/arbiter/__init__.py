@@ -2,4 +2,4 @@ from deeplearning4j_tpu.arbiter.optimize import (  # noqa: F401
     CandidateGenerator, ContinuousParameterSpace, DiscreteParameterSpace,
     GridSearchCandidateGenerator, IntegerParameterSpace,
     LocalOptimizationRunner, OptimizationConfiguration, OptimizationResult,
-    RandomSearchGenerator)
+    RandomSearchGenerator, TpeCandidateGenerator)

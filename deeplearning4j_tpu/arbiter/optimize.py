@@ -155,6 +155,10 @@ class LocalOptimizationRunner:
 
         cfg = self.config
         minimize = getattr(cfg, "minimize", True)
+        if hasattr(cfg.generator, "minimize"):
+            # model-based generators rank observations themselves; their
+            # good/bad split must agree with the runner's objective sense
+            cfg.generator.minimize = minimize
         best = None
         t0 = time.time()
         for i, cand in enumerate(
@@ -165,6 +169,8 @@ class LocalOptimizationRunner:
             score = cfg.score_fn(model)
             res = OptimizationResult(cand, score, i, model)
             self.results.append(res)
+            if hasattr(cfg.generator, "observe"):
+                cfg.generator.observe(cand, score)
             if best is None or ((score < best.score) if minimize
                                 else (score > best.score)):
                 best = res
@@ -177,3 +183,113 @@ class LocalOptimizationRunner:
             return None
         minimize = getattr(self.config, "minimize", True)
         return (min if minimize else max)(r.score for r in self.results)
+
+
+class TpeCandidateGenerator(CandidateGenerator):
+    """Tree-structured Parzen Estimator candidate generator — the
+    model-based ("Bayesian-ish") search the reference's arbiter offers
+    beyond random/grid (SURVEY.md §2.7 arbiter row).
+
+    Standard TPE recipe (Bergstra et al. 2011), per-parameter factored:
+    observations are split at the gamma-quantile into good/bad sets; each
+    parameter fits a Parzen (Gaussian-kernel) density l(x) over the good
+    set and g(x) over the bad; candidates are drawn from l and ranked by
+    l(x)/g(x), maximizing expected improvement. Discrete parameters use
+    smoothed category frequencies.
+
+    The runner feeds scores back via observe(); until n_startup
+    observations arrive the generator emits random samples (TPE needs a
+    seed population)."""
+
+    def __init__(self, space: dict, seed=0, n_startup=8, gamma=0.25,
+                 n_ei_candidates=24, minimize=True):
+        super().__init__(space)
+        self.rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_ei = n_ei_candidates
+        self.minimize = minimize
+        self._obs: list[tuple[dict, float]] = []
+
+    def observe(self, candidate: dict, score: float):
+        self._obs.append((candidate, float(score)))
+
+    # -- per-parameter parzen machinery ---------------------------------
+    def _split(self):
+        obs = sorted(self._obs, key=lambda cs: cs[1],
+                     reverse=not self.minimize)
+        n_good = max(1, int(np.ceil(self.gamma * len(obs))))
+        good = [c for c, _ in obs[:n_good]]
+        bad = [c for c, _ in obs[n_good:]] or good
+        return good, bad
+
+    def _transform(self, p, v):
+        if isinstance(p, ContinuousParameterSpace) and p.log:
+            return math.log(v)
+        return float(v)
+
+    def _bounds(self, p):
+        if isinstance(p, ContinuousParameterSpace) and p.log:
+            return math.log(p.lo), math.log(p.hi)
+        return float(p.lo), float(p.hi)
+
+    def _parzen_sample(self, p, values):
+        lo, hi = self._bounds(p)
+        zs = [self._transform(p, v) for v in values]
+        bw = max((hi - lo) / max(len(zs), 1) * 2.0, 1e-6 * (hi - lo))
+        z = self.rng.choice(zs) + self.rng.normal(0.0, bw)
+        z = float(np.clip(z, lo, hi))
+        x = math.exp(z) if (isinstance(p, ContinuousParameterSpace)
+                            and p.log) else z
+        if isinstance(p, IntegerParameterSpace):
+            x = int(round(np.clip(x, p.lo, p.hi)))
+        return x
+
+    def _parzen_logpdf(self, p, values, x):
+        lo, hi = self._bounds(p)
+        zs = np.asarray([self._transform(p, v) for v in values])
+        bw = max((hi - lo) / max(len(zs), 1) * 2.0, 1e-6 * (hi - lo))
+        z = self._transform(p, x)
+        comp = -0.5 * ((z - zs) / bw) ** 2 - math.log(bw)
+        m = float(np.max(comp))
+        return m + math.log(float(np.mean(np.exp(comp - m))))
+
+    def _propose(self):
+        good, bad = self._split()
+        best_cand, best_ratio = None, -np.inf
+        for _ in range(self.n_ei):
+            cand, ratio = {}, 0.0
+            for k, p in self.space.items():
+                if not hasattr(p, "sample"):
+                    cand[k] = p
+                    continue
+                if isinstance(p, DiscreteParameterSpace):
+                    vals = p.values
+                    cg = [g[k] for g in good]
+                    cb = [b[k] for b in bad]
+                    pg = np.asarray([1.0 + cg.count(v) for v in vals])
+                    pb = np.asarray([1.0 + cb.count(v) for v in vals])
+                    pg = pg / pg.sum()
+                    pb = pb / pb.sum()
+                    idx = self.rng.choice(len(vals), p=pg)
+                    cand[k] = vals[idx]
+                    ratio += math.log(pg[idx] / pb[idx])
+                else:
+                    x = self._parzen_sample(p, [g[k] for g in good])
+                    cand[k] = x
+                    ratio += (self._parzen_logpdf(p, [g[k] for g in good],
+                                                  x)
+                              - self._parzen_logpdf(p,
+                                                    [b[k] for b in bad],
+                                                    x))
+            if ratio > best_ratio:
+                best_cand, best_ratio = cand, ratio
+        return best_cand
+
+    def candidates(self, limit):
+        for i in range(limit):
+            if len(self._obs) < self.n_startup:
+                yield {k: (v.sample(self.rng) if hasattr(v, "sample")
+                           else v) for k, v in self.space.items()}
+            else:
+                yield self._propose()

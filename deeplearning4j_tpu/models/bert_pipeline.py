@@ -48,16 +48,16 @@ from deeplearning4j_tpu.parallel.pipeline import pipeline_apply
 def stack_layer_params(cfg: BertConfig, params: dict, n_stages: int):
     """Split init_params' output into (emb_head, stages):
     emb_head = everything but the layers; stages = per-layer trees stacked
-    to leaves [S, L/S, ...]."""
-    layers = params["layers"]
+    to leaves [S, L/S, ...] via the shared pipeline_trainer helper (the
+    same stacking any MultiLayerNetwork gets)."""
+    from deeplearning4j_tpu.parallel.pipeline_trainer import (
+        stack_run_params)
+
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by "
             f"pipe={n_stages}")
-    per = cfg.num_layers // n_stages
-    stacked = jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves).reshape(
-            (n_stages, per) + leaves[0].shape), *layers)
+    stacked = stack_run_params(params["layers"], n_stages)
     emb_head = {k: v for k, v in params.items() if k != "layers"}
     return emb_head, stacked
 
@@ -65,14 +65,10 @@ def stack_layer_params(cfg: BertConfig, params: dict, n_stages: int):
 def unstack_layer_params(stacked) -> list:
     """Inverse of stack_layer_params: [S, L/S, ...] leaves -> list of L
     per-layer param dicts (for checkpoint interchange with BertTrainer)."""
-    lead = jax.tree_util.tree_leaves(stacked)[0]
-    s, per = lead.shape[0], lead.shape[1]
-    out = []
-    for si in range(s):
-        for li in range(per):
-            out.append(jax.tree_util.tree_map(
-                lambda a, si=si, li=li: a[si, li], stacked))
-    return out
+    from deeplearning4j_tpu.parallel.pipeline_trainer import (
+        unstack_run_params)
+
+    return unstack_run_params(stacked)
 
 
 class BertPipelineTrainer:

@@ -16,3 +16,5 @@ from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401
     MultiHost, VoidConfiguration)
 from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
     ElasticTrainer, PreemptionCheckpoint)
+from deeplearning4j_tpu.parallel.pipeline_trainer import (  # noqa: F401
+    PipelineParallelTrainer)

@@ -392,3 +392,102 @@ class TestBackendSeam:
         import pytest
         with pytest.raises(ValueError, match="class index -1"):
             it.next()
+
+
+class TestTpeGenerator:
+    """VERDICT r3 item 7: model-based (TPE) arbiter generator must beat
+    random search on a 2-param toy objective within a fixed budget."""
+
+    SPACE = None  # built per-test (depends on imports)
+
+    @staticmethod
+    def _space():
+        from deeplearning4j_tpu.arbiter.optimize import (
+            ContinuousParameterSpace, IntegerParameterSpace)
+
+        return {
+            "lr": ContinuousParameterSpace(1e-5, 1.0, log=True),
+            "width": IntegerParameterSpace(4, 256),
+        }
+
+    @staticmethod
+    def _objective(cand):
+        # narrow basin around lr=3e-3, width=96: random search with a
+        # 30-candidate budget rarely lands close; TPE should zero in
+        import math
+        return ((math.log(cand["lr"]) - math.log(3e-3)) ** 2
+                + ((cand["width"] - 96) / 32.0) ** 2)
+
+    def _run(self, generator, budget=30):
+        from deeplearning4j_tpu.arbiter.optimize import (
+            LocalOptimizationRunner, OptimizationConfiguration)
+
+        cfg = (OptimizationConfiguration.Builder()
+               .candidateGenerator(generator)
+               .modelBuilder(lambda cand: cand)
+               .scoreFunction(self._objective)
+               .terminationConditions(maxCandidates=budget)
+               .build())
+        return LocalOptimizationRunner(cfg).execute()
+
+    def test_tpe_beats_random(self):
+        from deeplearning4j_tpu.arbiter.optimize import (
+            RandomSearchGenerator, TpeCandidateGenerator)
+
+        tpe_best, rnd_best = [], []
+        for seed in (0, 1, 2):
+            tpe = self._run(TpeCandidateGenerator(self._space(),
+                                                  seed=seed))
+            rnd = self._run(RandomSearchGenerator(self._space(),
+                                                  seed=seed))
+            tpe_best.append(tpe.score)
+            rnd_best.append(rnd.score)
+        # averaged over seeds the model-based search must be strictly
+        # better on this basin (margin guards flakiness)
+        assert np.mean(tpe_best) < 0.7 * np.mean(rnd_best), (
+            tpe_best, rnd_best)
+
+    def test_tpe_concentrates_near_optimum(self):
+        from deeplearning4j_tpu.arbiter.optimize import (
+            TpeCandidateGenerator)
+
+        gen = TpeCandidateGenerator(self._space(), seed=3)
+        sampled = []
+        for cand in gen.candidates(40):
+            gen.observe(cand, self._objective(cand))
+            sampled.append(cand)
+        early = [self._objective(c) for c in sampled[:10]]
+        late = [self._objective(c) for c in sampled[-10:]]
+        assert np.mean(late) < np.mean(early)
+
+    def test_discrete_space_supported(self):
+        from deeplearning4j_tpu.arbiter.optimize import (
+            DiscreteParameterSpace, TpeCandidateGenerator)
+
+        space = {"act": DiscreteParameterSpace("relu", "tanh", "gelu")}
+        gen = TpeCandidateGenerator(space, seed=0, n_startup=4)
+        score = {"relu": 1.0, "tanh": 0.1, "gelu": 2.0}
+        picks = []
+        for cand in gen.candidates(40):
+            gen.observe(cand, score[cand["act"]])
+            picks.append(cand["act"])
+        # after warmup the good category must dominate
+        assert picks[-20:].count("tanh") >= 12
+
+    def test_tpe_follows_runner_maximize(self):
+        from deeplearning4j_tpu.arbiter.optimize import (
+            LocalOptimizationRunner, OptimizationConfiguration,
+            TpeCandidateGenerator)
+
+        gen = TpeCandidateGenerator(self._space(), seed=4)
+        cfg = (OptimizationConfiguration.Builder()
+               .candidateGenerator(gen)
+               .modelBuilder(lambda cand: cand)
+               .scoreFunction(lambda c: -self._objective(c),
+                              minimize=False)
+               .terminationConditions(maxCandidates=30).build())
+        best = LocalOptimizationRunner(cfg).execute()
+        # runner propagates minimize=False into the generator: TPE must
+        # still concentrate near the optimum (negated objective max = 0)
+        assert gen.minimize is False
+        assert best.score > -0.5
