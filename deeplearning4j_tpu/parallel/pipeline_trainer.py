@@ -175,6 +175,22 @@ class PipelineParallelTrainer:
             raise ValueError(
                 f"run length {hi - lo} not divisible by "
                 f"pipe={self.n_stages}")
+        # an explicit run= tuple must pass the same homogeneity bar the
+        # auto-detection enforces — otherwise heterogeneous layers would
+        # silently execute with layer lo's config
+        sig0 = _layer_signature(net, lo)
+        for i in range(lo, hi):
+            if _layer_signature(net, i) != sig0:
+                raise ValueError(
+                    f"run layer {i} differs in structure/config from "
+                    f"layer {lo}; stage stacking requires identical "
+                    "layers (class, shapes, activation, updater, "
+                    "regularization)")
+            if net.conf.preprocessors[i] is not None:
+                raise ValueError(
+                    f"layer {i} has an input preprocessor inside the "
+                    "pipelined run; preprocessors are only supported "
+                    "before/after the run")
         for i, lr in enumerate(net.layers):
             # EVERY layer runs with an empty state dict and rng=None in
             # this trainer: stateful layers (BatchNormalization running
@@ -250,7 +266,7 @@ class PipelineParallelTrainer:
         # L1/L2 regularization, mirroring MultiLayerNetwork._loss_from
         reg = 0.0
         for i, lr in enumerate(net.layers):
-            p_i = outer_params[i] if outer_params[i] is not None else None
+            p_i = outer_params[i]
             if lo <= i < hi:
                 continue  # handled stacked below
             if not p_i:
@@ -332,8 +348,13 @@ class PipelineParallelTrainer:
             it = iter(data)
             for d in it:
                 if hasattr(d, "getFeatures"):
+                    lm = None
+                    if hasattr(d, "getLabelsMaskArray"):
+                        lm = d.getLabelsMaskArray()
+                        lm = None if lm is None else np.asarray(lm)
                     self.train_step(np.asarray(d.getFeatures()),
-                                    np.asarray(d.getLabels()))
+                                    np.asarray(d.getLabels()),
+                                    labels_mask=lm)
                 else:
                     self.train_step(*d)
             if hasattr(data, "reset"):

@@ -398,8 +398,6 @@ class TestTpeGenerator:
     """VERDICT r3 item 7: model-based (TPE) arbiter generator must beat
     random search on a 2-param toy objective within a fixed budget."""
 
-    SPACE = None  # built per-test (depends on imports)
-
     @staticmethod
     def _space():
         from deeplearning4j_tpu.arbiter.optimize import (

@@ -236,3 +236,63 @@ class TestWord2VecBinaryFormat:
         r2 = WordVectorSerializer.loadStaticModel(pt)
         assert np.allclose(np.asarray(r2.getWordVectorMatrix()), mat,
                            atol=1e-5)
+
+
+class TestDevicePairGen:
+    """r4: SGNS pair generation runs on device (host uploads only the
+    subsampled corpus). Parity contract vs the host/native generator."""
+
+    def _w2v(self, window, sampling=0.0):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sents = ["a b c d e", "f g a b", "c c d"] * 40
+        w = (Word2Vec.Builder().minWordFrequency(1).layerSize(16)
+             .windowSize(window).negativeSample(2).batchSize(64)
+             .sampling(sampling).epochs(1).seed(3).iterate(sents)
+             .build())
+        w.buildVocab()
+        return w
+
+    def test_window1_exact_parity(self):
+        # window=1 makes the per-position radius deterministic (b == 1),
+        # so device pairs must equal host pairs exactly, in order
+        w2v = self._w2v(1)
+        rng = np.random.default_rng(0)
+        flat, offsets = w2v._subsampled_flat(rng)
+        hc, hx = w2v._make_pairs_flat(flat, offsets,
+                                      np.random.default_rng(1))
+        cent, ctx, n = w2v._device_pairs(np.random.default_rng(2))
+        assert n == len(hc)
+        np.testing.assert_array_equal(np.asarray(cent)[:n], hc)
+        np.testing.assert_array_equal(np.asarray(ctx)[:n], hx)
+
+    def test_window3_pair_count_and_validity(self):
+        # wider window draws b on device: counts match the host
+        # generator's distribution support and every pair is in-vocab
+        w2v = self._w2v(3)
+        cent, ctx, n = w2v._device_pairs(np.random.default_rng(5))
+        v = w2v.vocab.numWords()
+        c = np.asarray(cent)[:n]
+        x = np.asarray(ctx)[:n]
+        assert n > 0
+        assert ((0 <= c) & (c < v)).all() and ((0 <= x) & (x < v)).all()
+        # b in [1,3]: pair count bounded by the b==3 host run count and
+        # at least the b==1 count
+        rng = np.random.default_rng(0)
+        flat, offsets = w2v._subsampled_flat(rng)
+        w1 = self._w2v(1)
+        lo, _ = w1._make_pairs_flat(flat, offsets,
+                                    np.random.default_rng(1))
+        assert len(lo) <= n
+
+    def test_host_path_still_available(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sents = ["x y z w v u t s"] * 30
+        w2v = (Word2Vec.Builder().minWordFrequency(1).layerSize(8)
+               .windowSize(2).negativeSample(2).batchSize(32)
+               .epochs(2).seed(0).deviceETL(False).iterate(sents)
+               .build())
+        w2v.buildVocab()
+        w2v.fit()
+        assert np.isfinite(np.asarray(w2v.syn0)).all()

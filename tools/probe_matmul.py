@@ -1,0 +1,110 @@
+"""Raw MXU efficiency vs matmul shape (Pallas grid kernel and XLA dot),
+bf16 operands, f32 accumulate. Calibrates what fraction of the 197
+TFLOPs peak each (M,K,N) sustains — the shape ceiling any conv
+formulation inherits."""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V5E_PEAK_BF16 = 197e12
+K_ITERS = 30
+
+
+def bench_xla(m, k, n):
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(m, k)) * 0.1,
+                    jnp.bfloat16)
+    b = jnp.asarray(np.random.default_rng(1).normal(size=(k, n)) * 0.1,
+                    jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        def body(c, _):
+            # rotate the accumulator back into bf16 lhs-shaped input by
+            # a cheap projection to keep a serial dependence
+            y = lax.dot_general(c, b, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            return (c + y[:, :1].astype(jnp.bfloat16) * 1e-6), 0.0
+        c, _ = lax.scan(body, a, None, length=K_ITERS)
+        return c
+
+    y = chain(a, b)
+    float(jnp.sum(y.astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        y = chain(a, b)
+        float(jnp.sum(y.astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / K_ITERS)
+    return 2 * m * k * n / best / V5E_PEAK_BF16
+
+
+def bench_pallas(m, k, n, bm):
+    """grid over M blocks of bm rows; weights resident."""
+    def kern(a_ref, b_ref, o_ref):
+        o_ref[...] = lax.dot_general(
+            a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+    def run(a, b):
+        return pl.pallas_call(
+            kern, grid=(m // bm,),
+            in_specs=[pl.BlockSpec((bm, k), lambda i: (i, 0)),
+                      pl.BlockSpec((k, n), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024),
+        )(a, b)
+
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(m, k)) * 0.1,
+                    jnp.bfloat16)
+    b = jnp.asarray(np.random.default_rng(1).normal(size=(k, n)) * 0.1,
+                    jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        def body(c, _):
+            y = run(c, b)
+            return (c + y[:, :1] * jnp.bfloat16(1e-6)
+                    if n == k else c + y[:, :1] * jnp.bfloat16(0)), 0.0
+        c, _ = lax.scan(body, a, None, length=K_ITERS)
+        return c
+
+    y = chain(a, b)
+    float(jnp.sum(y.astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        y = chain(a, b)
+        float(jnp.sum(y.astype(jnp.float32)))
+        best = min(best, (time.perf_counter() - t0) / K_ITERS)
+    return 2 * m * k * n / best / V5E_PEAK_BF16
+
+
+shapes = [
+    (784, 1024, 256), (1568, 1024, 256), (3136, 1024, 256),
+    (784, 256, 256), (3136, 256, 256),
+    (784, 256, 1024), (3136, 256, 1024),
+    (4096, 4096, 4096), (8192, 2048, 2048),
+    (50176, 1024, 256), (50176, 256, 256),
+]
+for m, k, n in shapes:
+    e_xla = bench_xla(m, k, n)
+    row = {"m": m, "k": k, "n": n, "xla": round(e_xla, 3)}
+    for bm in (784, 3136):
+        if m % bm == 0 and bm * (k + n) * 2 < 80e6:
+            row[f"pallas_bm{bm}"] = round(bench_pallas(m, k, n, bm), 3)
+    print(json.dumps(row), flush=True)

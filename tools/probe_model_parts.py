@@ -1,0 +1,113 @@
+"""Slope-timed decomposition of the ResNet-50 b256 train step.
+
+probe_block_train r4: identity bottleneck blocks run at 53-62% of peak
+in train mode, yet the full model measures ~16-17% MFU — a 3x gap that
+RESNET_MFU.md (r3) mis-attributed to a per-conv XLA ceiling on polluted
+timing. This probe cumulatively truncates the hand-written model
+(probe_resnet.make_forward) and slope-times each prefix's TRAIN step,
+so the per-segment deltas say where the ~98 ms actually goes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import probe_resnet as pr
+
+V5E_PEAK_BF16 = 197e12
+
+
+def slope_time(step_fn, args0, k1=4, reps=3, target=2.0):
+    """Per-iteration time of step_fn via two-span slope (RTT cancels)."""
+    def chain_t(iters):
+        @jax.jit
+        def chain(a):
+            def body(carry, _):
+                return step_fn(carry), None
+            c, _ = lax.scan(body, a, None, length=iters)
+            return jax.tree_util.tree_reduce(
+                lambda s, t: s + jnp.sum(t[..., :1].astype(jnp.float32)),
+                c, 0.0)
+
+        float(chain(args0))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(chain(args0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_probe = chain_t(k1)
+    per0 = max(t_probe / k1, 1e-4)
+    k_long = max(k1, int(target / per0))
+    k_short = max(1, k_long // 5)
+    t1 = chain_t(k_short)
+    t2 = chain_t(k_long)
+    return (t2 - t1) / (k_long - k_short)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--bn", default="onepass")
+    ap.add_argument("--stem", default="conv", choices=["conv", "s2d"])
+    args = ap.parse_args()
+    b = args.batch
+    rng = np.random.default_rng(0)
+    params = pr.init_params(jax.random.key(0))
+    x = jnp.asarray(rng.normal(size=(b, 224, 224, 3)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (b,)), jnp.int32)
+    full_stages = list(pr.STAGES)
+
+    def train_step_factory(fwd, head):
+        def step(carry):
+            params, xx = carry
+
+            def loss_fn(p):
+                out = fwd(p, xx)
+                if head:
+                    lp = jax.nn.log_softmax(out)
+                    return -jnp.mean(jnp.take_along_axis(
+                        lp, labels[:, None], 1))
+                return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+            l, g = jax.value_and_grad(loss_fn)(params)
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - 1e-6 * gg.astype(p.dtype), params, g)
+            return (params, xx + (l * 1e-30).astype(xx.dtype))
+        return step
+
+    prev = 0.0
+    rows = []
+    for upto in range(len(full_stages) + 2):
+        pr.STAGES[:] = full_stages[:min(upto, len(full_stages))]
+        head = upto == len(full_stages) + 1
+        fwd = pr.make_forward("NHWC", args.bn, head=head, stem=args.stem)
+        step = train_step_factory(fwd, head)
+        per = slope_time(step, (params, x))
+        name = ("stem+pool" if upto == 0 else
+                "full+head" if head else f"+stage{upto - 1}")
+        rows.append({"prefix": name,
+                     "cum_ms": round(per * 1e3, 2),
+                     "delta_ms": round((per - prev) * 1e3, 2)})
+        print(json.dumps(rows[-1]), flush=True)
+        prev = per
+    pr.STAGES[:] = full_stages
+    ips = b / prev
+    mfu = ips * pr.TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16
+    print(json.dumps({"img_per_sec": round(ips, 1),
+                      "mfu": round(mfu, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

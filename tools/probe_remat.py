@@ -1,0 +1,197 @@
+"""Full-model ResNet-50 b256 train-step levers, slope-timed:
+  baseline     - probe_resnet fwd as-is
+  remat_all    - each bottleneck block wrapped in jax.checkpoint
+  remat_early  - only stages 0-1 blocks checkpointed (the HBM-bound ones)
+probe_model_parts r4 localized ~60% of step time to stages 0-1 at ~16-30%
+efficiency (saved-activation HBM traffic); remat trades +1/3 FLOPs for
+that traffic.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import probe_resnet as pr
+
+V5E_PEAK_BF16 = 197e12
+
+
+def make_forward_remat(layout, bn_mode, remat_stages, stem="conv"):
+    nhwc = layout == "NHWC"
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    caxis = 3 if nhwc else 1
+
+    def conv(x, w, stride):
+        if not nhwc:
+            w = jnp.transpose(w, (3, 2, 0, 1))
+        return lax.conv_general_dilated(
+            x, w.astype(x.dtype), (stride, stride), "SAME",
+            dimension_numbers=dn)
+
+    def bn(x, g, b):
+        axes = tuple(i for i in range(4) if i != caxis)
+        shape = [1, 1, 1, 1]
+        shape[caxis] = -1
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        inv = lax.rsqrt(var + 1e-5) * g
+        return (xf * inv.reshape(shape)
+                + (b - mean * inv).reshape(shape)).astype(x.dtype)
+
+    def cbr(x, pp, stride, relu=True):
+        y = bn(conv(x, pp["w"], stride), pp["g"], pp["b"])
+        return jax.nn.relu(y) if relu else y
+
+    def block(blk, y, s, has_proj):
+        h = cbr(y, blk["c1"], s)
+        h = cbr(h, blk["c2"], 1)
+        h = cbr(h, blk["c3"], 1, relu=False)
+        if has_proj:
+            y = cbr(y, blk["proj"], s, relu=False)
+        return jax.nn.relu(y + h)
+
+    def forward(params, x):
+        y = cbr(x, params["stem"], 2)
+        window = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
+        strides = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, window, strides,
+                              "SAME")
+        for si, (f, blocks, stride) in enumerate(pr.STAGES):
+            for bi in range(blocks):
+                blk = params[f"s{si}b{bi}"]
+                s = stride if bi == 0 else 1
+                fn = block
+                if si in remat_stages:
+                    fn = jax.checkpoint(block, static_argnums=(2, 3))
+                y = fn(blk, y, s, bi == 0)
+        y = jnp.mean(y.astype(jnp.float32), axis=(1, 2) if nhwc else (2, 3))
+        return y @ params["fc"]["w"] + params["fc"]["b"]
+
+    return forward
+
+
+def slope_time(step_fn, args0, k1=4, reps=3, target=2.0):
+    def chain_t(iters):
+        @jax.jit
+        def chain(a):
+            def body(carry, _):
+                return step_fn(carry), None
+            c, _ = lax.scan(body, a, None, length=iters)
+            return jax.tree_util.tree_reduce(
+                lambda s, t: s + jnp.sum(t[..., :1].astype(jnp.float32)),
+                c, 0.0)
+
+        float(chain(args0))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(chain(args0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_probe = chain_t(k1)
+    per0 = max(t_probe / k1, 1e-4)
+    k_long = max(k1, int(target / per0))
+    k_short = max(1, k_long // 5)
+    t1 = chain_t(k_short)
+    t2 = chain_t(k_long)
+    return (t2 - t1) / (k_long - k_short)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+    b = args.batch
+    rng = np.random.default_rng(0)
+    params = pr.init_params(jax.random.key(0))
+    x = jnp.asarray(rng.normal(size=(b, 224, 224, 3)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (b,)), jnp.int32)
+
+    def step_for(fwd):
+        def step(carry):
+            params, xx = carry
+
+            def loss_fn(p):
+                lp = jax.nn.log_softmax(fwd(p, xx))
+                return -jnp.mean(jnp.take_along_axis(lp, labels[:, None],
+                                                     1))
+
+            l, g = jax.value_and_grad(loss_fn)(params)
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - 1e-6 * gg.astype(p.dtype), params, g)
+            return (params, xx + (l * 1e-30).astype(xx.dtype))
+        return step
+
+    variants = [
+        ("baseline", make_forward_remat("NHWC", "onepass", ())),
+        ("remat_all", make_forward_remat("NHWC", "onepass", (0, 1, 2, 3))),
+        ("remat_early", make_forward_remat("NHWC", "onepass", (0, 1))),
+        ("nchw", make_forward_remat("NCHW", "onepass", ())),
+    ]
+    for name, fwd in variants:
+        per = slope_time(step_for(fwd), (params, x))
+        ips = b / per
+        mfu = ips * pr.TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16
+        print(json.dumps({"variant": name, "step_ms": round(per * 1e3, 2),
+                          "img_per_sec": round(ips, 1),
+                          "mfu": round(mfu, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def make_forward_bnlite(layout="NHWC"):
+    """One-pass BN with bf16 stat reductions (f32 accumulate via dot...
+    actually jnp.mean on bf16 inputs with f32 dtype arg): halves the
+    stat-pass HBM traffic at s0-sized tensors."""
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def conv(x, w, stride):
+        return lax.conv_general_dilated(
+            x, w.astype(x.dtype), (stride, stride), "SAME",
+            dimension_numbers=dn)
+
+    def bn(x, g, b):
+        mean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
+        var = jnp.mean(jnp.square(x), axis=(0, 1, 2),
+                       dtype=jnp.float32) - jnp.square(mean)
+        inv = lax.rsqrt(var + 1e-5) * g
+        shape = [1, 1, 1, -1]
+        return (x.astype(jnp.float32) * inv.reshape(shape)
+                + (b - mean * inv).reshape(shape)).astype(x.dtype)
+
+    def cbr(x, pp, stride, relu=True):
+        y = bn(conv(x, pp["w"], stride), pp["g"], pp["b"])
+        return jax.nn.relu(y) if relu else y
+
+    def forward(params, x):
+        y = cbr(x, params["stem"], 2)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        for si, (f, blocks, stride) in enumerate(pr.STAGES):
+            for bi in range(blocks):
+                blk = params[f"s{si}b{bi}"]
+                s = stride if bi == 0 else 1
+                h = cbr(y, blk["c1"], s)
+                h = cbr(h, blk["c2"], 1)
+                h = cbr(h, blk["c3"], 1, relu=False)
+                if bi == 0:
+                    y = cbr(y, blk["proj"], s, relu=False)
+                y = jax.nn.relu(y + h)
+        y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+        return y @ params["fc"]["w"] + params["fc"]["b"]
+
+    return forward

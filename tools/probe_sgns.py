@@ -1,0 +1,156 @@
+"""Full SGNS step throughput vs batch size (r4).
+
+probe_scatter r4: raw scatter-add runs at 78M rows/s (sorted 125M) —
+4x the r3 claim (RTT-polluted). The measured word2vec epoch (~375k
+words/s ~ 2.6M pairs/s ~ 18M rows/s) is therefore NOT scatter-bound.
+This probe times one fused SGNS step (gathers + loss + grads + scatter
+updates, donated) at varying batch size, plus a sorted-custom-backward
+variant, to find the real ceiling.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from deeplearning4j_tpu.nlp.word2vec import _sgns_loss
+
+V, D, K = 100_000, 128, 5
+LR = 0.025
+
+
+def slope(step_fn, carry0, k1=60, reps=3):
+    def chain_t(iters):
+        @jax.jit
+        def chain(c):
+            def body(carry, i):
+                return step_fn(carry, i), None
+            c2, _ = lax.scan(body, c, jnp.arange(iters))
+            return jnp.sum(c2[0][0, :1])
+
+        float(chain(carry0))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(chain(carry0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = chain_t(k1)
+    t2 = chain_t(5 * k1)
+    return (t2 - t1) / (4 * k1)
+
+
+rng = np.random.default_rng(0)
+probs = (np.arange(1, V + 1) ** -0.75)
+probs /= probs.sum()
+table_np = rng.choice(V, size=1_000_000, p=probs).astype(np.int32)
+table_dev = jnp.asarray(table_np)
+
+
+def bench(bsz, variant):
+    centers = jnp.asarray(rng.choice(V, size=bsz, p=probs).astype(np.int32))
+    contexts = jnp.asarray(rng.choice(V, size=bsz, p=probs).astype(np.int32))
+    w = jnp.ones((bsz,), jnp.float32)
+    syn0 = jnp.asarray(rng.normal(size=(V, D)) * 0.01, jnp.float32)
+    syn1 = jnp.zeros((V, D), jnp.float32)
+    key = jax.random.key(0)
+
+    if variant == "grad":
+        def step(carry, i):
+            syn0, syn1 = carry
+            negs = table_dev[jax.random.randint(
+                jax.random.fold_in(key, i), (bsz, K), 0, table_dev.shape[0])]
+            loss, (g0, g1) = jax.value_and_grad(
+                _sgns_loss, argnums=(0, 1))(syn0, syn1, centers, contexts,
+                                            negs, w)
+            return (syn0 - LR * g0, syn1 - LR * g1)
+
+    else:  # sorted custom backward: analytic grads, one sorted scatter/table
+        def step(carry, i):
+            syn0, syn1 = carry
+            negs = table_dev[jax.random.randint(
+                jax.random.fold_in(key, i), (bsz, K), 0,
+                table_dev.shape[0])]
+            c = syn0[centers]
+            pos = syn1[contexts]
+            neg = syn1[negs]
+            pos_s = jnp.sum(c * pos, axis=-1)
+            neg_s = jnp.einsum("bd,bkd->bk", c, neg)
+            # d/ds softplus(-s) = -(1-sigmoid(s)); softplus(s) = sigmoid(s)
+            dpos = -(1.0 - jax.nn.sigmoid(pos_s)) * w          # [B]
+            dneg = jax.nn.sigmoid(neg_s) * w[:, None]          # [B,K]
+            gc = dpos[:, None] * pos + jnp.einsum("bk,bkd->bd", dneg, neg)
+            gpos = dpos[:, None] * c
+            gneg = dneg[..., None] * c[:, None, :]
+            ids0 = centers
+            o0 = jnp.argsort(ids0)
+            syn0 = syn0.at[ids0[o0]].add(-LR * gc[o0],
+                                         indices_are_sorted=True)
+            ids1 = jnp.concatenate([contexts, negs.reshape(-1)])
+            u1 = jnp.concatenate([gpos, gneg.reshape(-1, D)])
+            o1 = jnp.argsort(ids1)
+            syn1 = syn1.at[ids1[o1]].add(-LR * u1[o1],
+                                        indices_are_sorted=True)
+            return (syn0, syn1)
+
+    per = slope(step, (syn0, syn1))
+    pairs_per_s = bsz / per
+    print(json.dumps({"variant": variant, "bsz": bsz,
+                      "step_us": round(per * 1e6, 1),
+                      "Mpairs_per_s": round(pairs_per_s / 1e6, 2)}),
+          flush=True)
+
+
+for bsz in (512, 2048, 8192, 32768):
+    bench(bsz, "grad")
+for bsz in (2048, 8192, 32768):
+    bench(bsz, "sorted")
+
+
+def host_numpy_reference(n_pairs=200_000):
+    """Vectorized numpy SGNS on this host — the CPU reference point
+    VERDICT r3 item 2 asks for (how fast would the reference's
+    CPU-side path go HERE). Batched like the device path (bsz 8192)."""
+    rng_l = np.random.default_rng(3)
+    syn0 = rng_l.normal(size=(V, D)).astype(np.float32) * 0.01
+    syn1 = np.zeros((V, D), np.float32)
+    bsz = 8192
+    cents = rng_l.choice(V, size=n_pairs, p=probs).astype(np.int32)
+    ctxs = rng_l.choice(V, size=n_pairs, p=probs).astype(np.int32)
+    t0 = time.perf_counter()
+    for lo in range(0, n_pairs, bsz):
+        c_i = cents[lo:lo + bsz]
+        x_i = ctxs[lo:lo + bsz]
+        negs = table_np[rng_l.integers(0, len(table_np),
+                                       (len(c_i), K))]
+        c = syn0[c_i]
+        pos = syn1[x_i]
+        neg = syn1[negs]
+        pos_s = np.sum(c * pos, axis=-1)
+        neg_s = np.einsum("bd,bkd->bk", c, neg)
+        sig_p = 1.0 / (1.0 + np.exp(pos_s))
+        sig_n = 1.0 / (1.0 + np.exp(-neg_s))
+        gc = -sig_p[:, None] * pos + np.einsum("bk,bkd->bd", sig_n, neg)
+        np.add.at(syn0, c_i, -LR * gc)
+        np.add.at(syn1, x_i, LR * sig_p[:, None] * c)
+        np.add.at(syn1, negs.reshape(-1),
+                  (-LR * sig_n[..., None] * c[:, None, :]).reshape(-1, D))
+    dt = time.perf_counter() - t0
+    print(json.dumps({"variant": "host_numpy", "bsz": bsz,
+                      "Mpairs_per_s": round(n_pairs / dt / 1e6, 3),
+                      "words_per_s_at_3.8pairs":
+                          round(n_pairs / dt / 3.8, 1)}), flush=True)
+
+
+if "--host" in sys.argv or True:
+    host_numpy_reference()
