@@ -140,6 +140,7 @@ class TFGraphMapper:
 class _Importer:
     def __init__(self, gd: GraphDef, placeholder_shapes=None,
                  strict=False):
+        gd = _rewrite_v1_loops(gd)
         self.gd = gd
         self.strict = strict
         self.placeholder_shapes = dict(placeholder_shapes or {})
@@ -422,8 +423,38 @@ def _apply_strided_slice(node, x, begin, end, strides):
     get = lambda a: node.attrs[a].i if a in node.attrs else 0  # noqa: E731
     bm, em = get("begin_mask"), get("end_mask")
     sm, nm = get("shrink_axis_mask"), get("new_axis_mask")
-    if get("ellipsis_mask"):
-        raise TFImportError("StridedSlice ellipsis_mask unsupported")
+    elm = get("ellipsis_mask")
+    if elm:
+        # expand the (single) ellipsis into full slices over the dims
+        # not covered by the other spec entries (TF allows exactly one)
+        if bin(elm).count("1") > 1:
+            raise TFImportError(
+                "StridedSlice with multiple ellipses is invalid")
+        pos = elm.bit_length() - 1
+        n_spec = len(begin) - 1  # entries besides the ellipsis
+        n_new = bin(nm).count("1")
+        rank = np.asarray(x).ndim
+        fill = rank - (n_spec - n_new)
+
+        def expand(arr, fill_val):
+            return np.concatenate([
+                arr[:pos], np.full(fill, fill_val, np.int64),
+                arr[pos + 1:]])
+
+        begin = expand(begin, 0)
+        end = expand(end, 0)
+        strides = expand(strides, 1)
+
+        def expand_mask(mask, set_fill):
+            lo = mask & ((1 << pos) - 1)
+            hi = (mask >> (pos + 1)) << (pos + fill)
+            mid = (((1 << fill) - 1) << pos) if set_fill else 0
+            return lo | hi | mid
+
+        bm = expand_mask(bm, True)
+        em = expand_mask(em, True)
+        sm = expand_mask(sm, False)
+        nm = expand_mask(nm, False)
     idx = []
     for i in range(len(begin)):
         if nm & (1 << i):
@@ -1073,12 +1104,304 @@ def _h_if(im, node):
          "TensorArrayV3", "TensorArrayReadV3", "TensorArrayWriteV3",
          "TensorArrayScatterV3", "TensorArrayGatherV3", "TensorArraySizeV3")
 def _h_v1_control_flow(im, node):
+    # single-frame while loops are rewritten into _V1While by
+    # _rewrite_v1_loops before import; anything that still reaches this
+    # handler is outside the supported subset
     raise TFImportError(
         f"node {node.name!r} uses TF v1 dataflow control flow "
-        f"({node.op}); these frame-encoded loops are cyclic and cannot "
-        "be interpreted as a graph op — re-export the model with TF2 "
+        f"({node.op}) outside the supported single-frame while-loop "
+        "subset (nested frames / TensorArray / cond-via-Switch are "
+        "frame-encoded and cyclic) — re-export the model with TF2 "
         "functional control flow (While/If + function library), which "
         "imports onto SameDiff whileLoop/ifCond")
+
+
+# ---------------------------------------------------------------------------
+# TF v1 dataflow while-loops (VERDICT r3 item 4): the acyclic-frame
+# subset — ONE frame per loop, no nesting, no TensorArray — is rewritten
+# into a synthetic functional node before import and lowered onto the
+# same SameDiff whileLoop the TF2 While handler uses. The reference
+# interprets Enter/Merge/Switch/Exit in Java (SURVEY.md §3.4); here the
+# frame is translated once at import time:
+#   Enter_i -> loop var i's init value (outer graph)
+#   Merge_i -> cond-graph placeholder i   (cond computes LoopCond input)
+#   Switch_i:1 -> body-graph placeholder i (body computes NextIteration)
+#   Exit_i -> whileLoop output i
+# Loop-invariant Enters (is_constant=true) and references to outer
+# tensors inline as constants when host-foldable; otherwise rejected.
+# ---------------------------------------------------------------------------
+
+class _V1Frame:
+    def __init__(self, name):
+        self.name = name
+        self.enters = []        # loop-var Enter nodes
+        self.const_enters = []  # is_constant Enters (loop invariants)
+        self.nodes = {}         # interior name -> NodeDef (incl. merges)
+        self.merges = []
+        self.switches = {}      # merge name -> Switch node
+        self.exits = {}         # merge name -> Exit node
+        self.next_iters = {}    # merge name -> NextIteration input ref
+        self.loop_cond = None
+
+
+def _find_v1_frames(gd):
+    """Group v1 control-flow nodes by frame_name; returns
+    {frame: _V1Frame} or raises for unsupported shapes."""
+    producers = {n.name: n for n in gd.nodes}
+    frames = {}
+    frame_of = {}  # node name -> frame name (propagated)
+
+    def frame_attr(n):
+        a = n.attrs.get("frame_name")
+        if a is None:
+            raise TFImportError(
+                f"Enter node {n.name!r} has no frame_name attr")
+        return a.s.decode() if isinstance(a.s, bytes) else a.s
+
+    enters = [n for n in gd.nodes if n.op == "Enter"]
+    if not enters:
+        return {}
+    for n in enters:
+        f = frames.setdefault(frame_attr(n), _V1Frame(frame_attr(n)))
+        const = n.attrs.get("is_constant")
+        if const is not None and const.b:
+            f.const_enters.append(n)
+        else:
+            f.enters.append(n)
+        frame_of[n.name] = f.name
+    # forward-propagate frame membership (Exit leaves the frame)
+    changed = True
+    while changed:
+        changed = False
+        for n in gd.nodes:
+            if n.name in frame_of or n.op in ("Enter", "Exit"):
+                continue
+            for inp in n.inputs:
+                src, _ = _ref(inp)
+                if src in frame_of:
+                    fname = frame_of[src]
+                    if producers[src].op == "Exit":
+                        continue
+                    frame_of[n.name] = fname
+                    frames[fname].nodes[n.name] = n
+                    changed = True
+                    break
+    for n in gd.nodes:
+        if n.op == "Exit":
+            src, _ = _ref(n.inputs[0])
+            if src not in frame_of:
+                raise TFImportError(
+                    f"Exit node {n.name!r} input does not trace to a "
+                    "frame")
+            frame_of[n.name] = None  # Exit output is outer
+    for f in frames.values():
+        _classify_frame(f, producers)
+    return frames
+
+
+def _classify_frame(f, producers):
+    for name, n in list(f.nodes.items()):
+        if n.op == "Merge":
+            f.merges.append(n)
+        elif n.op == "LoopCond":
+            f.loop_cond = n
+        elif n.op.startswith("TensorArray"):
+            raise TFImportError(
+                f"v1 frame {f.name!r} uses {n.op}: TensorArray loops "
+                "are outside the supported subset — re-export with TF2 "
+                "functional control flow")
+    if f.loop_cond is None:
+        raise TFImportError(
+            f"v1 frame {f.name!r} has no LoopCond — not a while loop")
+    f.merges.sort(key=lambda n: n.name)
+    enters_by_name = {n.name: n for n in f.enters}
+    for m in f.merges:
+        srcs = [_ref(i)[0] for i in m.inputs]
+        enter = next((s for s in srcs if s in enters_by_name), None)
+        ni = next((producers[s] for s in srcs
+                   if producers[s].op == "NextIteration"), None)
+        if enter is None or ni is None:
+            raise TFImportError(
+                f"v1 Merge {m.name!r} is not an (Enter, NextIteration) "
+                "merge — unsupported frame shape")
+        m._enter = enters_by_name[enter]
+        f.next_iters[m.name] = ni.inputs[0]
+    # order enters to match merges
+    f.enters = [m._enter for m in f.merges]
+    for n in f.nodes.values():
+        if n.op == "Switch":
+            src, _ = _ref(n.inputs[0])
+            if src in {m.name for m in f.merges}:
+                f.switches[src] = n
+    for m in f.merges:
+        if m.name not in f.switches:
+            raise TFImportError(
+                f"v1 Merge {m.name!r} has no Switch — unsupported "
+                "frame shape")
+
+
+def _rewrite_v1_loops(gd):
+    """Replace each supported v1 while frame with one synthetic
+    _V1While node (frame object stashed on the NodeDef); returns the
+    rewritten GraphDef (or the original when no frames exist)."""
+    from deeplearning4j_tpu.modelimport.protobuf import NodeDef
+
+    frames = _find_v1_frames(gd)
+    if not frames:
+        return gd
+    drop = set()
+    synth = []
+    exits_of = {}
+    for f in frames.values():
+        names = set(f.nodes)
+        names.update(n.name for n in f.enters + f.const_enters)
+        # exits: outer nodes consuming a Switch:0 of this frame
+        f.exit_nodes = []
+        sw_names = {sw.name: mn for mn, sw in f.switches.items()}
+        for n in gd.nodes:
+            if n.op == "Exit":
+                src, _ = _ref(n.inputs[0])
+                if src in sw_names:
+                    n._merge = sw_names[src]
+                    f.exit_nodes.append(n)
+                    names.add(n.name)
+        drop |= names
+        init_refs = [e.inputs[0] for e in f.enters]
+        node = NodeDef(f"__v1while_{len(synth)}", "_V1While",
+                       list(init_refs), {})
+        node._frame = f
+        synth.append(node)
+        exits_of[node.name] = f.exit_nodes
+    # Exit nodes become Identity over the synthetic node's outputs:
+    # their names stay addressable both for downstream refs and as
+    # user-requested output tensors
+    exit_identities = []
+    for node in synth:
+        f = node._frame
+        merge_pos = {m.name: i for i, m in enumerate(f.merges)}
+        for ex in f.exit_nodes:
+            i = merge_pos[ex._merge]
+            ref = f"{node.name}:{i}" if i else node.name
+            exit_identities.append(
+                type(ex)(ex.name, "Identity", [ref], dict(ex.attrs)))
+
+    kept = [n for n in gd.nodes if n.name not in drop]
+    gd2 = type(gd)(kept + synth + exit_identities, functions=list(
+        getattr(gd, "functions", []) or []))
+    return gd2
+
+
+def _const_nodedef(name, arr):
+    from deeplearning4j_tpu.modelimport.protobuf import (
+        NodeDef, attr_tensor, attr_type)
+
+    return NodeDef(name, "Const", [], {
+        "dtype": attr_type(arr.dtype), "value": attr_tensor(arr)})
+
+
+def _subgraph_from_nodes(im, frame, targets, placeholder_map, what):
+    """Child SameDiff over the frame interior: `targets` are the refs to
+    return; placeholder_map maps interior node names to (shape, dtype)
+    formal args (Merge or Switch). Outer refs inline as constants when
+    foldable."""
+    from deeplearning4j_tpu.autodiff.samediff import SubGraph
+    from deeplearning4j_tpu.modelimport.protobuf import (
+        AttrValue, NodeDef, TensorShapeProto, numpy_to_dtype)
+
+    ph_nodes, ph_shapes = [], {}
+    for name, (shape, dt) in placeholder_map.items():
+        ph_nodes.append(NodeDef(name, "Placeholder", [], {
+            "dtype": AttrValue(type=numpy_to_dtype(dt)),
+            "shape": AttrValue(shape=TensorShapeProto(list(shape))),
+        }))
+        ph_shapes[name] = shape
+
+    # backward closure over interior nodes from the targets
+    needed, stack = set(), [
+        _ref(t)[0] for t in targets]
+    interior = dict(frame.nodes)
+    rewritten = {}
+    const_enter_names = {n.name: n for n in frame.const_enters}
+    sw_to_merge = {sw.name: mn for mn, sw in frame.switches.items()}
+    while stack:
+        nm = stack.pop()
+        if nm in needed or nm in placeholder_map:
+            continue
+        needed.add(nm)
+        n = interior.get(nm)
+        if n is None:
+            if nm in const_enter_names:
+                outer_ref = const_enter_names[nm].inputs[0]
+                val = im.const(outer_ref)
+                if val is None:
+                    raise TFImportError(
+                        f"{what}: loop-invariant Enter {nm!r} is not "
+                        "host-foldable — pass it through the loop state "
+                        "or re-export with TF2 control flow")
+                arr = np.asarray(val)
+                rewritten[nm] = _const_nodedef(nm, arr)
+                continue
+            val = im.const(nm)
+            if val is None:
+                raise TFImportError(
+                    f"{what}: body references outer tensor {nm!r} "
+                    "which is not host-foldable — pass it through the "
+                    "loop state or re-export with TF2 control flow")
+            rewritten[nm] = _const_nodedef(nm, np.asarray(val))
+            continue
+        # strip Switch:1 refs down to the placeholder names
+        new_inputs = []
+        for inp in n.inputs:
+            if inp.startswith("^"):
+                continue
+            src, idx = _ref(inp)
+            if src in sw_to_merge:
+                new_inputs.append(sw_to_merge[src])
+                stack.append(sw_to_merge[src])
+            else:
+                new_inputs.append(inp)
+                stack.append(src)
+        rewritten[nm] = NodeDef(nm, n.op, new_inputs, dict(n.attrs))
+
+    gd_nodes = ph_nodes + [rewritten[nm] for nm in rewritten]
+    from deeplearning4j_tpu.modelimport.protobuf import GraphDef
+    sub = _Importer(GraphDef(gd_nodes, functions=[]), ph_shapes,
+                    strict=im.strict)
+    child = sub.run()
+    out_names, out_shapes, out_dtypes = [], [], []
+    for t in targets:
+        src, idx = _ref(t)
+        src = sw_to_merge.get(src, src)
+        v = sub.var(f"{src}:{idx}" if idx else src)
+        out_names.append(v.name())
+        out_shapes.append(sub.shapes[f"{src}:{idx}"])
+        out_dtypes.append(sub.dtypes[f"{src}:{idx}"])
+    return (SubGraph(child, list(placeholder_map), out_names),
+            out_shapes, out_dtypes)
+
+
+@handler("_V1While")
+def _h_v1_while(im, node):
+    f = node._frame
+    init_refs = list(node.inputs)
+    ph_map = {}
+    for m, ref in zip(f.merges, init_refs):
+        ph_map[m.name] = (im.shape(ref), im.dtype(ref))
+    what = f"v1 while frame {f.name!r}"
+    cond, _, _ = _subgraph_from_nodes(
+        im, f, [f.loop_cond.inputs[0]], ph_map, what + " cond")
+    body_targets = [f.next_iters[m.name] for m in f.merges]
+    body, body_shapes, body_dtypes = _subgraph_from_nodes(
+        im, f, body_targets, ph_map, what + " body")
+    in_vars = [im.var(r) for r in init_refs]
+    attrs = {"cond_graph": cond, "cond_fn": cond.callable(squeeze=True),
+             "body_graph": body, "body_fn": body.callable()}
+    n = len(in_vars)
+    res = im.sd._op("whileLoop", in_vars, attrs, node.name,
+                    n_out=n if n > 1 else 1)
+    outs = res if isinstance(res, tuple) else (res,)
+    for i, v in enumerate(outs):
+        im.bind(node.name, v, body_shapes[i], body_dtypes[i], out_idx=i)
 
 
 @handler("ResizeBilinear", "ResizeNearestNeighbor", "ResizeBicubic",
@@ -1238,3 +1561,141 @@ def _h_pool3d(im, node):
     im.emit(node, fn, [x_ref], attrs, out_name=f"{node.name}__pool")
     _permute(im, node, f"{node.name}__pool:0", (0, 2, 3, 4, 1), "",
              node.name)
+
+
+# ---------------------------------------------------------------------------
+# r4 handler widening (VERDICT r3 item 8)
+# ---------------------------------------------------------------------------
+
+@handler("SparseSoftmaxCrossEntropyWithLogits")
+def _h_sparse_softmax_ce(im, node):
+    """TF op with TWO outputs: per-example loss [B] and backprop
+    [B, C] (softmax(logits) - onehot(labels))."""
+    im.emit(node, "sparseSoftmaxCrossEntropyGrad", im.data_inputs(node))
+
+
+@handler("MirrorPad")
+def _h_mirror_pad(im, node):
+    ins = im.data_inputs(node)
+    pads = im.need_const(ins[1], "MirrorPad paddings")
+    mode = node.attrs.get("mode")
+    mode = (mode.s.decode() if mode is not None and
+            isinstance(mode.s, bytes) else "REFLECT")
+    im.emit(node, "mirrorPad", [ins[0]],
+            {"paddings": tuple(map(tuple, np.asarray(pads).tolist())),
+             "mode": mode})
+
+
+@handler("ReverseSequence")
+def _h_reverse_sequence(im, node):
+    ins = im.data_inputs(node)
+    im.emit(node, "reverseSequence", [ins[0], ins[1]],
+            {"seqAxis": int(node.attrs["seq_dim"].i),
+             "batchAxis": int(node.attrs.get("batch_dim").i
+                              if "batch_dim" in node.attrs else 0)})
+
+
+@handler("LRN")
+def _h_lrn(im, node):
+    # TF LRN is NHWC with depth_radius; our op is NCHW with full depth
+    ins = im.data_inputs(node)
+    r = int(node.attrs["depth_radius"].i) \
+        if "depth_radius" in node.attrs else 5
+    getf = lambda k, d: (node.attrs[k].f  # noqa: E731
+                         if k in node.attrs else d)
+    x = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+    im.emit(node, "localResponseNormalization", [x],
+            {"depth": r, "bias": getf("bias", 1.0),
+             "alpha": getf("alpha", 1.0), "beta": getf("beta", 0.5)},
+            out_name=f"{node.name}__lrn")
+    _permute(im, node, f"{node.name}__lrn:0", (0, 2, 3, 1), "",
+             out_name=node.name)
+
+
+@handler("RGBToHSV")
+def _h_rgb_to_hsv(im, node):
+    im.emit(node, "rgbToHsv", im.data_inputs(node))
+
+
+@handler("HSVToRGB")
+def _h_hsv_to_rgb(im, node):
+    im.emit(node, "hsvToRgb", im.data_inputs(node))
+
+
+@handler("AdjustContrastv2")
+def _h_adjust_contrast(im, node):
+    ins = im.data_inputs(node)
+    f = float(im.need_const(ins[1], "AdjustContrastv2 factor"))
+    im.emit(node, "adjustContrastV2", [ins[0]], {"factor": f})
+
+
+@handler("AdjustHue")
+def _h_adjust_hue(im, node):
+    ins = im.data_inputs(node)
+    d = float(im.need_const(ins[1], "AdjustHue delta"))
+    im.emit(node, "adjustHue", [ins[0]], {"delta": d})
+
+
+@handler("AdjustSaturation")
+def _h_adjust_saturation(im, node):
+    ins = im.data_inputs(node)
+    f = float(im.need_const(ins[1], "AdjustSaturation scale"))
+    im.emit(node, "adjustSaturation", [ins[0]], {"factor": f})
+
+
+@handler("Cross")
+def _h_cross(im, node):
+    im.emit(node, "cross", im.data_inputs(node))
+
+
+@handler("Rint")
+def _h_rint(im, node):
+    im.emit(node, "rint", im.data_inputs(node))
+
+
+@handler("Erfinv")
+def _h_erfinv(im, node):
+    im.emit(node, "erfinv", im.data_inputs(node))
+
+
+@handler("HistogramFixedWidth")
+def _h_histogram(im, node):
+    ins = im.data_inputs(node)
+    vr = im.need_const(ins[1], "HistogramFixedWidth value_range")
+    nbins = int(im.need_const(ins[2], "HistogramFixedWidth nbins")) \
+        if len(ins) > 2 else 100
+    im.emit(node, "histogramFixedWidth", [ins[0]],
+            {"range_lo": float(vr[0]), "range_hi": float(vr[1]),
+             "nbins": nbins})
+
+
+@handler("ScatterNd")
+def _h_scatter_nd(im, node):
+    ins = im.data_inputs(node)
+    shape = im.need_const(ins[2], "ScatterNd shape")
+    im.emit(node, "scatterNd", [ins[0], ins[1]],
+            {"shape": tuple(int(s) for s in np.asarray(shape))})
+
+
+@handler("Dilation2D")
+def _h_dilation2d(im, node):
+    ins = im.data_inputs(node)
+    strides = [int(v) for v in node.attrs["strides"].ints] \
+        if "strides" in node.attrs else [1, 1, 1, 1]
+    rates = [int(v) for v in node.attrs["rates"].ints] \
+        if "rates" in node.attrs else [1, 1, 1, 1]
+    if any(r != 1 for r in rates):
+        raise TFImportError(
+            f"node {node.name!r} (Dilation2D): atrous rates {rates} "
+            "are unsupported (only [1,1,1,1]) — importing would "
+            "silently compute a dense dilation")
+    pad = node.attrs.get("padding")
+    same = pad is None or pad.s == b"SAME"
+    # TF NHWC x [N,H,W,C], filter [kH,kW,C] -> our NCHW op
+    x = _permute(im, node, ins[0], (0, 3, 1, 2), "__nchw")
+    w = _permute(im, node, ins[1], (2, 0, 1), "__chw")
+    im.emit(node, "dilation2d", [x, w],
+            {"sH": strides[1], "sW": strides[2], "sameMode": same},
+            out_name=f"{node.name}__dil")
+    _permute(im, node, f"{node.name}__dil:0", (0, 2, 3, 1), "",
+             out_name=node.name)

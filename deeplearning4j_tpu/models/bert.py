@@ -416,11 +416,13 @@ class BertTrainer:
             donate_argnums=(0, 1),
         )
 
-    def _build_multi(self):
+    def _build_multi(self, repeats=1):
         """K training steps in ONE device launch: lax.scan over a stacked
         [K, ...] batch dimension. Amortizes per-dispatch host/RPC latency
         (the axon tunnel costs ~25 ms per launch — larger than a whole
-        BERT-base step) the way an on-device input pipeline would."""
+        BERT-base step) the way an on-device input pipeline would.
+        repeats > 1 makes R passes over the same K batches (slope-based
+        benchmarking / tiny-corpus epochs); last pass's losses return."""
         repl = NamedSharding(self.mesh, P())
 
         def stack_sh(sh):
@@ -435,8 +437,18 @@ class BertTrainer:
                     params, opt, tokens, pos, lab, w, rng, t)
                 return (params, opt, t + 1), loss
 
-            (params, opt, _), losses = jax.lax.scan(
-                body, (params, opt, t0), (tokens_k, pos_k, lab_k, w_k))
+            def scan_once(carry, _):
+                return jax.lax.scan(body, carry,
+                                    (tokens_k, pos_k, lab_k, w_k))
+
+            carry = (params, opt, t0)
+            if repeats == 1:
+                carry, losses = scan_once(carry, None)
+            else:
+                carry, losses_r = jax.lax.scan(scan_once, carry, None,
+                                               length=repeats)
+                losses = losses_r[-1]
+            params, opt, _ = carry
             return losses, params, opt
 
         return jax.jit(
@@ -448,11 +460,14 @@ class BertTrainer:
             donate_argnums=(0, 1),
         )
 
-    def train_steps(self, tokens_k, labels_k):
-        """Run K = tokens_k.shape[0] optimizer steps in one launch.
-        tokens_k/labels_k: [K, B, T]. Returns the [K] losses."""
-        if getattr(self, "_multi_fn", None) is None:
-            self._multi_fn = self._build_multi()
+    def train_steps(self, tokens_k, labels_k, repeats: int = 1):
+        """Run K = tokens_k.shape[0] optimizer steps in one launch
+        (R*K with repeats=R). tokens_k/labels_k: [K, B, T]. Returns the
+        [K] losses of the last pass."""
+        if not isinstance(getattr(self, "_multi_fn", None), dict):
+            self._multi_fn = {}
+        if repeats not in self._multi_fn:
+            self._multi_fn[repeats] = self._build_multi(repeats)
         k, b, t = np.asarray(tokens_k).shape
         pos_k, lab_k, w_k = [], [], []
         for i in range(k):
@@ -462,11 +477,11 @@ class BertTrainer:
             lab_k.append(l_)
             w_k.append(w_)
         rng0 = jax.random.key(self._step + 1, impl="rbg")
-        losses, self.params, self.opt = self._multi_fn(
+        losses, self.params, self.opt = self._multi_fn[repeats](
             self.params, self.opt, jnp.asarray(tokens_k, jnp.int32),
             np.stack(pos_k), np.stack(lab_k), np.stack(w_k), rng0,
             jnp.asarray(self._step, jnp.int32))
-        self._step += k
+        self._step += k * repeats
         return losses
 
     def train_step(self, tokens, labels):

@@ -174,7 +174,7 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _build_multi_step(self):
+    def _build_multi_step(self, repeats=1):
         def many(params, states, opts, inputs_k, labels_k, masks_k, rng0,
                  it0):
             def body(carry, xs):
@@ -185,21 +185,33 @@ class ComputationGraph:
                     params, states, opts, inputs, labels, masks, rng, it)
                 return (params, states, opts, it + 1), loss
 
-            (params, states, opts, _), losses = jax.lax.scan(
-                body, (params, states, opts, it0),
-                (inputs_k, labels_k, masks_k))
+            def scan_once(carry, _):
+                return jax.lax.scan(body, carry,
+                                    (inputs_k, labels_k, masks_k))
+
+            carry = (params, states, opts, it0)
+            if repeats == 1:
+                carry, losses = scan_once(carry, None)
+            else:
+                carry, losses_r = jax.lax.scan(scan_once, carry, None,
+                                               length=repeats)
+                losses = losses_r[-1]
+            params, states, opts, _ = carry
             return losses, params, states, opts
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
-    def fitMultiBatch(self, features_k, labels_k):
+    def fitMultiBatch(self, features_k, labels_k, repeats: int = 1):
         """K optimizer steps in ONE device launch over stacked [K, B, ...]
         minibatches via lax.scan (see MultiLayerNetwork.fitMultiBatch:
-        amortizes per-dispatch RPC latency). Single-input single-output
-        graphs only. Returns the [K] losses."""
+        amortizes per-dispatch RPC latency; repeats=R makes R passes in
+        the launch). Single-input single-output graphs only. Returns the
+        [K] losses (last pass)."""
         self._check_init()
-        if getattr(self, "_multi_step", None) is None:
-            self._multi_step = self._build_multi_step()
+        if not isinstance(getattr(self, "_multi_step", None), dict):
+            self._multi_step = {}
+        if repeats not in self._multi_step:
+            self._multi_step[repeats] = self._build_multi_step(repeats)
         # keep device-resident stacks on device (a _host_array bounce
         # would round-trip the whole [K,B,...] block D2H then H2D)
         f_k = _unwrap(features_k) if isinstance(
@@ -212,10 +224,11 @@ class ComputationGraph:
             (l_k.shape[0],) + _ones_mask(l_k[0]).shape, np.float32)}
         rng0 = jax.random.key(self.conf.seed + 1)
         losses, self._params, self._states, self._opt_states = \
-            self._multi_step(self._params, self._states, self._opt_states,
-                             inputs_k, labels_k, masks_k, rng0,
-                             jnp.asarray(self._iteration, jnp.int32))
-        self._iteration += int(f_k.shape[0])
+            self._multi_step[repeats](
+                self._params, self._states, self._opt_states,
+                inputs_k, labels_k, masks_k, rng0,
+                jnp.asarray(self._iteration, jnp.int32))
+        self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
         return losses
 

@@ -203,7 +203,7 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _build_multi_step(self):
+    def _build_multi_step(self, repeats=1):
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
         def many(params, states, opts, f_k, l_k, m_k, rng0, it0):
@@ -215,22 +215,37 @@ class MultiLayerNetwork:
                     updaters, params, states, opts, f, l, m, rng, it)
                 return (params, states, opts, it + 1), loss
 
-            (params, states, opts, _), losses = jax.lax.scan(
-                body, (params, states, opts, it0), (f_k, l_k, m_k))
+            def scan_once(carry, _):
+                return jax.lax.scan(body, carry, (f_k, l_k, m_k))
+
+            carry = (params, states, opts, it0)
+            if repeats == 1:
+                carry, losses = scan_once(carry, None)
+            else:
+                # R passes over the same K batches in one launch (used by
+                # slope-based benchmarking; also a legit small-dataset
+                # multi-epoch fit) — only the last pass's losses return
+                carry, losses_r = jax.lax.scan(scan_once, carry,
+                                               None, length=repeats)
+                losses = losses_r[-1]
+            params, states, opts, _ = carry
             return losses, params, states, opts
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
-    def fitMultiBatch(self, features_k, labels_k):
+    def fitMultiBatch(self, features_k, labels_k, repeats: int = 1):
         """K optimizer steps in ONE device launch: features_k/labels_k are
         stacked [K, batch, ...] minibatches consumed by a lax.scan. This
         amortizes per-dispatch host/RPC latency (on the axon TPU tunnel a
         single dispatch round-trip exceeds a whole small-model step) the
         way an on-device input pipeline would; semantics match K
-        successive fit() calls on the K slices. Returns the [K] losses."""
+        successive fit() calls on the K slices. Returns the [K] losses
+        (of the last pass when repeats > 1)."""
         self._check_init()
-        if self._multi_step is None:
-            self._multi_step = self._build_multi_step()
+        if not isinstance(self._multi_step, dict):
+            self._multi_step = {}
+        if repeats not in self._multi_step:
+            self._multi_step[repeats] = self._build_multi_step(repeats)
         # keep device-resident stacks on device (a _host_array bounce
         # would round-trip the whole [K,B,...] block D2H then H2D)
         f_k = _unwrap(features_k) if isinstance(
@@ -241,10 +256,11 @@ class MultiLayerNetwork:
                       np.float32)
         rng0 = jax.random.key(self.conf.seed + 1)
         losses, self._params, self._states, self._opt_states = \
-            self._multi_step(self._params, self._states, self._opt_states,
-                             f_k, l_k, m_k, rng0,
-                             jnp.asarray(self._iteration, jnp.int32))
-        self._iteration += int(f_k.shape[0])
+            self._multi_step[repeats](
+                self._params, self._states, self._opt_states,
+                f_k, l_k, m_k, rng0,
+                jnp.asarray(self._iteration, jnp.int32))
+        self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
         return losses
 

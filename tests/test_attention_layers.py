@@ -126,6 +126,7 @@ class TestRecurrentAttention:
         net.fit([(x, yr)] * 20)
         assert net.score((x, yr)) < s0
 
+    @pytest.mark.slow
     def test_gradient_check(self):
         net = _build([
             RecurrentAttentionLayer.Builder(nOut=3).build(),

@@ -30,6 +30,7 @@ def _capsnet(seed=3):
 
 
 class TestCapsNet:
+    @pytest.mark.slow
     def test_shapes_through_stack(self):
         net = _capsnet()
         x = np.random.RandomState(0).randn(2, 1, 12, 12).astype(np.float32)
@@ -48,6 +49,7 @@ class TestCapsNet:
         norms = np.linalg.norm(caps, axis=-1)
         assert np.all(norms < 1.0)   # squash bounds lengths to [0, 1)
 
+    @pytest.mark.slow
     def test_trains(self):
         net = _capsnet()
         rng = np.random.RandomState(0)

@@ -38,6 +38,7 @@ class TestBinArrayLayout:
 
 
 class TestDl4jCheckpoint:
+    @pytest.mark.slow
     def test_lenet_round_trip_weights_and_updater(self, tmp_path):
         rng = np.random.default_rng(0)
         net = LeNet(numClasses=4, inputShape=(1, 12, 12)).init()

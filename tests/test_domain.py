@@ -19,6 +19,7 @@ from deeplearning4j_tpu.ui import (
 
 
 class TestDeepWalk:
+    @pytest.mark.slow
     def test_two_cliques_embed_apart(self):
         # two 6-cliques joined by one edge
         g = Graph(12)
@@ -74,6 +75,7 @@ class TestKMeans:
 
 
 class TestRL:
+    @pytest.mark.slow
     def test_dqn_solves_gridworld(self):
         conf = QLearningConfiguration(
             seed=1, maxStep=6000, batchSize=64, gamma=0.9,
@@ -86,6 +88,7 @@ class TestRL:
         # optimal: 6 steps * -0.01 + 1 = 0.95; random walk often times out
         assert reward > 0.5, reward
 
+    @pytest.mark.slow
     def test_a2c_improves(self):
         conf = A2CConfiguration(seed=2, maxStep=12000, nThreads=8, nSteps=8,
                                 gamma=0.9, learningRate=3e-3, hidden=(32,))
@@ -96,6 +99,7 @@ class TestRL:
         late = np.mean(episodes[-10:])
         assert late > early, (early, late)
 
+    @pytest.mark.slow
     def test_a3c_async_workers_improve(self):
         from deeplearning4j_tpu.rl import A3CConfiguration, A3CDiscreteDense
 
@@ -199,9 +203,11 @@ class TestDQNVariants:
         ql.train()
         return ql.getPolicy().play(SimpleGridWorld(4))
 
+    @pytest.mark.slow
     def test_double_dqn_solves_chain(self):
         assert self._solve(doubleDQN=True) > 0.5
 
+    @pytest.mark.slow
     def test_dueling_dqn_solves_chain(self):
         assert self._solve(dueling=True) > 0.5
 

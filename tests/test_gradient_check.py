@@ -86,6 +86,7 @@ class TestGradientChecks:
                 .build())
         _check(conf, (2, 3, 5), 3, rnn=True, subset=15)
 
+    @pytest.mark.slow
     def test_simple_rnn(self):
         conf = (_base().list()
                 .layer(SimpleRnn.Builder().nOut(4).build())

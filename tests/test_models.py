@@ -29,6 +29,7 @@ class TestZoo:
             np.float32)
         assert net.output(x).shape() == (2, 5)
 
+    @pytest.mark.slow
     def test_vgg16_builds_small(self):
         net = VGG16(numClasses=10, inputShape=(3, 32, 32)).init()
         # 13 conv + 5 pool + 2 dense + 1 out = 21 layers
@@ -37,6 +38,7 @@ class TestZoo:
             np.float32)
         assert net.output(x).shape() == (1, 10)
 
+    @pytest.mark.slow
     def test_resnet50_structure_and_forward(self):
         model = ResNet50(numClasses=7, inputShape=(3, 64, 64))
         net = model.init()
@@ -102,6 +104,7 @@ class TestBert:
                   for _ in range(10)]
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow
     def test_dp_only_matches_tp_sp(self):
         """Sharding must not change the math: loss trajectory on dp-only
         mesh equals the dp x tp x sp trajectory."""
@@ -131,6 +134,7 @@ class TestNewZooModels:
         net.fit([(X, y)], 3)
         assert float(net.score((X, y))) < s0
 
+    @pytest.mark.slow
     def test_squeezenet_fire_modules(self):
         from deeplearning4j_tpu.models.zoo import SqueezeNet
 
@@ -141,6 +145,7 @@ class TestNewZooModels:
         assert out.shape == (2, 5)
         np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_xception_separable_residuals(self):
         from deeplearning4j_tpu.models.zoo import Xception
 
@@ -159,6 +164,7 @@ class TestNewZooModels:
 class TestZooRound2Additions:
     """VGG19 / FaceNetNN4Small2 (reference zoo.model.* additions)."""
 
+    @pytest.mark.slow
     def test_vgg19_builds_and_trains(self):
         from deeplearning4j_tpu.models import VGG19
 
@@ -193,6 +199,7 @@ class TestZooRound2Additions:
         assert not np.allclose(
             np.asarray(net._params["out"]["centers"]), 0.0)
 
+    @pytest.mark.slow
     def test_inception_resnet_v1(self):
         from deeplearning4j_tpu.models import InceptionResNetV1
 
@@ -240,6 +247,7 @@ class TestNASNet:
         with pytest.raises(ValueError, match="divisible by 24"):
             NASNet(penultimateFilters=100)
 
+    @pytest.mark.slow
     def test_odd_input_sizes_build(self):
         from deeplearning4j_tpu.models import NASNet
 

@@ -62,6 +62,7 @@ class TestPipeline:
 
 
 class TestMoE:
+    @pytest.mark.slow
     def test_sharded_matches_replicated(self):
         params = moe_init(jax.random.key(0), 16, 32, 4)
         rng = np.random.default_rng(0)
@@ -74,6 +75,7 @@ class TestMoE:
                                    rtol=2e-5, atol=1e-6)
         assert float(aux_ref) == pytest.approx(float(aux_sh), rel=1e-6)
 
+    @pytest.mark.slow
     def test_capacity_drops_overflow(self):
         """With capacity_factor ~0, every token overflows and the output
         must be exactly zero (dropped tokens contribute nothing)."""
@@ -97,6 +99,7 @@ class TestMoE:
             l2 = float(tr.train_step(x, t))
         assert l2 < l1
 
+    @pytest.mark.slow
     def test_aux_loss_balances(self):
         """The load-balance loss for a uniform router is ~1.0 (its
         minimum); a collapsed router scores higher."""
@@ -128,6 +131,7 @@ class TestBertPipeline:
                           num_heads=2, ffn=32, max_len=32, dropout=0.0,
                           compute_dtype="float32")
 
+    @pytest.mark.slow
     def test_loss_curve_matches_single_device(self):
         from deeplearning4j_tpu.models.bert import (
             BertTrainer, synthetic_mlm_batch)
@@ -183,6 +187,7 @@ class TestBertMoE:
                           num_heads=2, ffn=32, max_len=32, dropout=0.0,
                           compute_dtype="float32", n_experts=n_experts)
 
+    @pytest.mark.slow
     def test_dp_ep_matches_single_device(self):
         from deeplearning4j_tpu.models.bert import (
             BertTrainer, synthetic_mlm_batch)
@@ -215,6 +220,7 @@ class TestBertMoE:
                                     deterministic=True))
         assert base != pytest.approx(off, abs=1e-9)
 
+    @pytest.mark.slow
     def test_gate_params_train(self):
         from deeplearning4j_tpu.models.bert import (
             BertTrainer, synthetic_mlm_batch)
@@ -301,6 +307,7 @@ class TestBertPipelineDropout:
     """Dropout in pipeline mode: per-(microbatch, layer) rng keys ride
     the GPipe schedule (pipeline_apply's microbatch-index protocol)."""
 
+    @pytest.mark.slow
     def test_dropout_pipeline_trains(self):
         from deeplearning4j_tpu.models.bert import (
             BertConfig, synthetic_mlm_batch)
@@ -320,6 +327,7 @@ class TestBertPipelineDropout:
             last = float(tr.train_step(toks, labs))
         assert np.isfinite(last) and last < l0
 
+    @pytest.mark.slow
     def test_dropout_zero_still_matches_single_device(self):
         """The new rng plumbing must not perturb the deterministic path:
         dropout=0 pipeline still tracks BertTrainer step for step."""

@@ -113,6 +113,7 @@ class TestLstmParity:
         net.init()
         return net
 
+    @pytest.mark.slow
     def test_loss_parity(self):
         mesh = MeshConfig(data=4, pipe=2).build()
         rng = np.random.default_rng(1)

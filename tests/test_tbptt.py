@@ -42,6 +42,7 @@ class TestTbpttTraining:
         assert conf2.backpropType == BackpropType.TruncatedBPTT
         assert conf2.tbpttLength == 8
 
+    @pytest.mark.slow
     def test_tbptt_trains_and_counts_segments(self):
         conf = _char_rnn_conf(t=24, tbptt=8)
         net = MultiLayerNetwork(conf).init()
