@@ -1780,7 +1780,14 @@ def _dilation2d(x, w, sH=1, sW=1, sameMode=True):
     w = jnp.asarray(w)
     c, kh, kw = w.shape
     if sameMode:
-        ph, pw_ = kh - 1, kw - 1
+        # TF SAME pad depends on the strided output size:
+        # pad = max((ceil(H/s)-1)*s + k - H, 0) — NOT a flat k-1,
+        # which over-pads when stride > 1 and shifts every window
+        h, w_in = x.shape[2], x.shape[3]
+        oh = -(-h // int(sH))
+        ow_ = -(-w_in // int(sW))
+        ph = max((oh - 1) * int(sH) + kh - h, 0)
+        pw_ = max((ow_ - 1) * int(sW) + kw - w_in, 0)
         # large finite negative, not -inf (one-hot-conv patch
         # extraction computes 0*pad, and -inf would poison it with
         # NaN) and bf16-representable (the TPU conv truncates operands
@@ -1791,7 +1798,8 @@ def _dilation2d(x, w, sH=1, sW=1, sameMode=True):
                     constant_values=-1e30)
     patches = lax.conv_general_dilated_patches(
         x, (kh, kw), (int(sH), int(sW)), "VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST)
     n, _, oh, ow = patches.shape
     patches = patches.reshape(n, c, kh * kw, oh, ow)
     return jnp.max(patches + w.reshape(1, c, kh * kw, 1, 1), axis=2)

@@ -1316,13 +1316,17 @@ def _subgraph_from_nodes(im, frame, targets, placeholder_map, what):
         }))
         ph_shapes[name] = shape
 
-    # backward closure over interior nodes from the targets
-    needed, stack = set(), [
-        _ref(t)[0] for t in targets]
-    interior = dict(frame.nodes)
-    rewritten = {}
+    # backward closure over interior nodes from the targets; a target
+    # that is itself a Switch:1 ref (pass-through loop var: NextIteration
+    # fed straight from the Switch) must seed the stack as its Merge
+    # placeholder, or the Switch/LoopCond chain gets pulled into the
+    # body subgraph (ADVICE r4)
     const_enter_names = {n.name: n for n in frame.const_enters}
     sw_to_merge = {sw.name: mn for mn, sw in frame.switches.items()}
+    needed, stack = set(), [
+        sw_to_merge.get(_ref(t)[0], _ref(t)[0]) for t in targets]
+    interior = dict(frame.nodes)
+    rewritten = {}
     while stack:
         nm = stack.pop()
         if nm in needed or nm in placeholder_map:
@@ -1371,7 +1375,8 @@ def _subgraph_from_nodes(im, frame, targets, placeholder_map, what):
     out_names, out_shapes, out_dtypes = [], [], []
     for t in targets:
         src, idx = _ref(t)
-        src = sw_to_merge.get(src, src)
+        if src in sw_to_merge:  # Switch:1 -> single-output placeholder
+            src, idx = sw_to_merge[src], 0
         v = sub.var(f"{src}:{idx}" if idx else src)
         out_names.append(v.name())
         out_shapes.append(sub.shapes[f"{src}:{idx}"])

@@ -97,6 +97,20 @@ def _cbow_loss(syn0, syn1, contexts_mat, context_mask, centers, negatives,
     return jnp.sum(per_pair * weights)
 
 
+def _compaction_dests(val_s, cap):
+    """Stream-compaction scatter destinations for `cap` slots with
+    validity mask `val_s`: valid slot -> its rank among valid slots
+    (cumsum-1), invalid slot -> a DISTINCT out-of-range dest (cap +
+    slot index). Every dest is unique across the whole array — the
+    downstream scatters promise unique_indices=True, and a shared
+    sentinel dest would be UB per the JAX scatter docs even though
+    mode="drop" discards those writes (ADVICE r4). Returns
+    (dests, n_valid) — the count rides the cumsum already computed."""
+    csum = jnp.cumsum(val_s.astype(jnp.int32))
+    return jnp.where(val_s, csum - 1,
+                     cap + jnp.arange(cap, dtype=jnp.int32)), csum[-1]
+
+
 class Word2Vec:
     class Builder:
         def __init__(self):
@@ -337,8 +351,7 @@ class Word2Vec:
             ctx_s = jnp.stack(ctxs, 1).reshape(-1)
             val_s = jnp.stack(vals, 1).reshape(-1)
             cap = cent_s.shape[0]
-            csum = jnp.cumsum(val_s.astype(jnp.int32))
-            dest = jnp.where(val_s, csum - 1, cap)  # invalid -> dropped
+            dest, n_real = _compaction_dests(val_s, cap)
             # (a packed-slot single-scatter + gather-decode variant
             # measured SLOWER than these two element scatters — the
             # decode gathers over 75M slots cost more than one scatter)
@@ -346,7 +359,7 @@ class Word2Vec:
                 cent_s, mode="drop", unique_indices=True)
             out_x = jnp.zeros((cap,), jnp.int32).at[dest].set(
                 ctx_s, mode="drop", unique_indices=True)
-            return out_c, out_x, csum[-1]
+            return out_c, out_x, n_real
 
         return jax.jit(gen)
 
