@@ -153,6 +153,7 @@ class _Importer:
         self.dtypes = {}      # tf tensor name -> np.dtype
         self.consts = {}      # node name -> np.ndarray (host-foldable)
         self._out_args = {}   # node name -> out_arg name seen in 3-part refs
+        self.tensor_arrays = {}  # TensorArrayV3 node -> {size,dtype,elem}
 
     # -- public ------------------------------------------------------------
 
@@ -1100,20 +1101,18 @@ def _h_if(im, node):
         im.bind(node.name, v, t_shapes[i], t_dtypes[i], out_idx=i)
 
 
-@handler("Enter", "Exit", "Merge", "Switch", "NextIteration", "LoopCond",
-         "TensorArrayV3", "TensorArrayReadV3", "TensorArrayWriteV3",
-         "TensorArrayScatterV3", "TensorArrayGatherV3", "TensorArraySizeV3")
+@handler("Enter", "Exit", "Merge", "Switch", "NextIteration", "LoopCond")
 def _h_v1_control_flow(im, node):
-    # single-frame while loops are rewritten into _V1While by
-    # _rewrite_v1_loops before import; anything that still reaches this
-    # handler is outside the supported subset
+    # single-frame while loops (incl. single-frame TensorArray loops) are
+    # rewritten into _V1While by _rewrite_v1_loops before import; anything
+    # that still reaches this handler is outside the supported subset
     raise TFImportError(
         f"node {node.name!r} uses TF v1 dataflow control flow "
         f"({node.op}) outside the supported single-frame while-loop "
-        "subset (nested frames / TensorArray / cond-via-Switch are "
-        "frame-encoded and cyclic) — re-export the model with TF2 "
-        "functional control flow (While/If + function library), which "
-        "imports onto SameDiff whileLoop/ifCond")
+        "subset (nested frames / cond-via-Switch are frame-encoded and "
+        "cyclic) — re-export the model with TF2 functional control flow "
+        "(While/If + function library), which imports onto SameDiff "
+        "whileLoop/ifCond")
 
 
 # ---------------------------------------------------------------------------
@@ -1136,12 +1135,25 @@ class _V1Frame:
         self.name = name
         self.enters = []        # loop-var Enter nodes
         self.const_enters = []  # is_constant Enters (loop invariants)
+        self.handle_enters = {}  # enter name -> TensorArrayV3 node name
         self.nodes = {}         # interior name -> NodeDef (incl. merges)
         self.merges = []
         self.switches = {}      # merge name -> Switch node
         self.exits = {}         # merge name -> Exit node
         self.next_iters = {}    # merge name -> NextIteration input ref
         self.loop_cond = None
+
+
+# interior TensorArray ops lower onto a loop-carried [size, ...] buffer
+# (the TF "flow" edge is reinterpreted as the buffer tensor itself):
+# op -> (synthetic op, original input positions kept, in order)
+_TA_INTERIOR = {
+    "TensorArrayReadV3": ("_TARead", (2, 1)),      # (flow, index)
+    "TensorArrayWriteV3": ("_TAWrite", (3, 1, 2)),  # (flow, index, value)
+    "TensorArrayGatherV3": ("_TAGather", (2, 1)),   # (flow, indices)
+    "TensorArrayScatterV3": ("_TAWrite", (3, 1, 2)),
+    "TensorArraySizeV3": ("_TASize", (1,)),         # (flow,)
+}
 
 
 def _find_v1_frames(gd):
@@ -1200,16 +1212,12 @@ def _find_v1_frames(gd):
 
 
 def _classify_frame(f, producers):
+    _rewrite_frame_tensor_arrays(f, producers)
     for name, n in list(f.nodes.items()):
         if n.op == "Merge":
             f.merges.append(n)
         elif n.op == "LoopCond":
             f.loop_cond = n
-        elif n.op.startswith("TensorArray"):
-            raise TFImportError(
-                f"v1 frame {f.name!r} uses {n.op}: TensorArray loops "
-                "are outside the supported subset — re-export with TF2 "
-                "functional control flow")
     if f.loop_cond is None:
         raise TFImportError(
             f"v1 frame {f.name!r} has no LoopCond — not a while loop")
@@ -1240,6 +1248,52 @@ def _classify_frame(f, producers):
                 "frame shape")
 
 
+def _rewrite_frame_tensor_arrays(f, producers):
+    """Lower interior TensorArrayV3 ops to synthetic _TA* nodes over the
+    flow edge, reinterpreted as the [size, ...] buffer tensor (the
+    dynamic_rnn idiom: per-step reads from an input array, per-step
+    writes of cell outputs). The array handle (a TF resource) is only an
+    identity token — every TA op also carries the flow — so handle
+    Enters are dropped and each op keeps (flow, index[, value]) inputs.
+    Reference: SURVEY.md §3.4 (v1 control flow interpreted in Java);
+    §2.3 TF-import row."""
+    from deeplearning4j_tpu.modelimport.protobuf import NodeDef
+
+    ta_nodes = [n for n in f.nodes.values()
+                if n.op.startswith("TensorArray")]
+    if not ta_nodes:
+        return
+    # handle Enters: loop-invariant Enters fed from a TensorArrayV3:0
+    for e in list(f.const_enters):
+        src, idx = _ref(e.inputs[0])
+        p = producers.get(src)
+        if p is not None and p.op == "TensorArrayV3" and idx == 0:
+            f.handle_enters[e.name] = src
+            f.const_enters.remove(e)
+    for n in ta_nodes:
+        if n.op == "TensorArrayCloseV3":
+            del f.nodes[n.name]
+            continue
+        spec = _TA_INTERIOR.get(n.op)
+        if spec is None:
+            raise TFImportError(
+                f"v1 frame {f.name!r} uses {n.op}, which has no "
+                "loop-carried-buffer lowering (supported inside a "
+                "frame: TensorArray Read/Write/Scatter/Gather/Size) — "
+                "re-export with TF2 functional control flow")
+        new_op, keep = spec
+        h_src, _h_idx = _ref(n.inputs[0])
+        ta_name = f.handle_enters.get(h_src)
+        if ta_name is None:
+            raise TFImportError(
+                f"v1 frame {f.name!r}: {n.op} node {n.name!r} handle "
+                "does not come from a loop-invariant Enter of a "
+                "TensorArrayV3 created outside the frame — TensorArrays "
+                "created inside the loop are unsupported")
+        f.nodes[n.name] = NodeDef(
+            n.name, new_op, [n.inputs[p] for p in keep], dict(n.attrs))
+
+
 def _rewrite_v1_loops(gd):
     """Replace each supported v1 while frame with one synthetic
     _V1While node (frame object stashed on the NodeDef); returns the
@@ -1255,6 +1309,7 @@ def _rewrite_v1_loops(gd):
     for f in frames.values():
         names = set(f.nodes)
         names.update(n.name for n in f.enters + f.const_enters)
+        names.update(f.handle_enters)
         # exits: outer nodes consuming a Switch:0 of this frame
         f.exit_nodes = []
         sw_names = {sw.name: mn for mn, sw in f.switches.items()}
@@ -1267,9 +1322,15 @@ def _rewrite_v1_loops(gd):
                     names.add(n.name)
         drop |= names
         init_refs = [e.inputs[0] for e in f.enters]
+        # loop-invariant Enter refs ride as extra inputs so the importer
+        # visits their producers first; at import time each is either
+        # inlined as a constant (host-foldable) or promoted to a
+        # pass-through loop variable (e.g. an input TensorArray buffer)
+        inv_refs = [e.inputs[0] for e in f.const_enters]
         node = NodeDef(f"__v1while_{len(synth)}", "_V1While",
-                       list(init_refs), {})
+                       list(init_refs) + inv_refs, {})
         node._frame = f
+        node._n_loop = len(init_refs)
         synth.append(node)
         exits_of[node.name] = f.exit_nodes
     # Exit nodes become Identity over the synthetic node's outputs:
@@ -1385,28 +1446,372 @@ def _subgraph_from_nodes(im, frame, targets, placeholder_map, what):
             out_shapes, out_dtypes)
 
 
+def _resolve_ta_flow_init(im, f, merge, ref, ph_known, what):
+    """An output-TensorArray flow loop var whose init is an unbound
+    TensorArrayV3 flow (element_shape unknown at creation): infer the
+    element shape by importing just the frame's write-value expression,
+    then bind a zeros buffer at the TA's flow output."""
+    src, idx = _ref(ref)
+    if f"{src}:{idx}" in im.shapes:
+        return
+    nd = im.nodes.get(src)
+    if nd is None or nd.op != "TensorArrayV3" or src not in \
+            im.tensor_arrays:
+        im.shape(ref)  # raises the standard "no static shape" error
+        return
+    info = im.tensor_arrays[src]
+    if info["elem"] is not None:  # declared element_shape: no probe
+        _bind_ta_zeros(im, src, info["elem"], None, out_idx=idx)
+        return
+    # find a _TAWrite into this loop var: its flow input chain ends at
+    # this merge's Switch:1 (possibly through other writes/Identity)
+    sw = f.switches[merge.name].name
+    write = None
+    for n in f.nodes.values():
+        if n.op != "_TAWrite":
+            continue
+        chain, seen = _ref(n.inputs[0])[0], set()
+        while chain not in seen:
+            seen.add(chain)
+            if chain == sw:
+                write = n
+                break
+            p = f.nodes.get(chain)
+            if p is None or p.op not in ("_TAWrite", "Identity"):
+                break
+            chain = _ref(p.inputs[0])[0]
+        if write is not None:
+            break
+    if write is None:
+        raise TFImportError(
+            f"{what}: TensorArray {src!r} has no element_shape and no "
+            "write inside the frame to infer it from")
+    try:
+        _, shapes, dtypes = _subgraph_from_nodes(
+            im, f, [write.inputs[2]], ph_known,
+            what + f" (element-shape probe for TensorArray {src!r})")
+    except TFImportError as e:
+        raise TFImportError(
+            f"{what}: cannot infer the element shape of TensorArray "
+            f"{src!r} — the written value depends on state that is not "
+            f"resolvable before the loop: {e}") from e
+    _bind_ta_zeros(im, src, tuple(shapes[0]), dtypes[0], out_idx=idx)
+
+
+def _static_trip_count(im, f, init_refs, cap=100_000):
+    """Exact trip count when the loop condition is confined to integer/
+    bool loop variables with host-foldable inits whose updates are
+    themselves so confined (the counter idiom TF1 emits for dynamic_rnn
+    and counted loops); None otherwise. Enables lowering onto forLoop —
+    a static-bound fori_loop lowers to scan, which is reverse-mode
+    differentiable where XLA's while is not."""
+    sw_to_merge = {sw.name: mn for mn, sw in f.switches.items()}
+    merge_idx = {m.name: i for i, m in enumerate(f.merges)}
+    const_enter_names = {n.name for n in f.const_enters}
+
+    needed, frontier, visited = set(), [f.loop_cond.inputs[0]], set()
+    while frontier:
+        ref = frontier.pop()
+        nm = _ref(ref)[0]
+        nm = sw_to_merge.get(nm, nm)
+        if nm in visited:
+            continue
+        visited.add(nm)
+        if nm in merge_idx:
+            if nm not in needed:
+                needed.add(nm)
+                frontier.append(f.next_iters[nm])
+            continue
+        n = f.nodes.get(nm)
+        if n is None:
+            # outer tensor or loop-invariant Enter: must be foldable
+            if nm in const_enter_names:
+                e = next(e for e in f.const_enters if e.name == nm)
+                if im.const(e.inputs[0]) is None:
+                    return None
+            elif im.const(nm) is None:
+                return None
+            continue
+        if n.op.startswith("_TA"):
+            return None  # depends on a buffer: not simulable
+        frontier.extend(i for i in n.inputs if not i.startswith("^"))
+
+    inits = []
+    for mn in sorted(needed, key=lambda x: merge_idx[x]):
+        val = im.const(init_refs[merge_idx[mn]])
+        if val is None:
+            return None
+        val = np.asarray(val)
+        if not (np.issubdtype(val.dtype, np.integer)
+                or val.dtype == np.bool_):
+            return None  # float counters: simulation could drift
+        inits.append((mn, val))
+    if not inits:
+        return None  # cond is loop-invariant: either 0 or infinite
+
+    ph = {mn: (tuple(v.shape), v.dtype) for mn, v in inits}
+    try:
+        sub, _, _ = _subgraph_from_nodes(
+            im, f, [f.loop_cond.inputs[0]] +
+            [f.next_iters[mn] for mn, _ in inits], ph,
+            f"v1 frame {f.name!r} trip-count simulation")
+    except TFImportError:
+        return None
+    import contextlib
+
+    import jax
+
+    fn = jax.jit(sub.callable())  # one tiny compile beats 10^4 dispatches
+    state = [v for _, v in inits]
+    trips = 0
+    try:  # keep the per-iteration dispatch off any remote device
+        ctx = jax.default_device(jax.devices("cpu")[0])
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        while trips <= cap:
+            outs = fn(*state)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            if not bool(np.asarray(outs[0]).reshape(())):
+                return trips
+            state = [np.asarray(o) for o in outs[1:]]
+            trips += 1
+    return None
+
+
 @handler("_V1While")
 def _h_v1_while(im, node):
     f = node._frame
-    init_refs = list(node.inputs)
+    n_loop = node._n_loop
+    init_refs = list(node.inputs[:n_loop])
+    inv_refs = list(node.inputs[n_loop:])
+    what = f"v1 while frame {f.name!r}"
+
+    # ALL loop-invariant Enters become pass-through loop variables wired
+    # to the parent-graph tensor (weights stay parent vars, so
+    # makeTrainable + autodiff reach them through the loop; also the
+    # only sound choice for non-foldable invariants such as an input
+    # TensorArray buffer scattered from a placeholder)
+    promoted = list(zip(f.const_enters, inv_refs))
+    ph_partial = {e.name: (im.shape(r), im.dtype(r)) for e, r in promoted}
+    for m, ref in zip(f.merges, init_refs):
+        src, idx = _ref(ref)
+        if f"{src}:{idx}" in im.shapes:
+            ph_partial[m.name] = (im.shape(ref), im.dtype(ref))
+    for m, ref in zip(f.merges, init_refs):
+        _resolve_ta_flow_init(im, f, m, ref, ph_partial, what)
+
     ph_map = {}
     for m, ref in zip(f.merges, init_refs):
         ph_map[m.name] = (im.shape(ref), im.dtype(ref))
-    what = f"v1 while frame {f.name!r}"
-    cond, _, _ = _subgraph_from_nodes(
-        im, f, [f.loop_cond.inputs[0]], ph_map, what + " cond")
-    body_targets = [f.next_iters[m.name] for m in f.merges]
-    body, body_shapes, body_dtypes = _subgraph_from_nodes(
-        im, f, body_targets, ph_map, what + " body")
-    in_vars = [im.var(r) for r in init_refs]
-    attrs = {"cond_graph": cond, "cond_fn": cond.callable(squeeze=True),
-             "body_graph": body, "body_fn": body.callable()}
+    for e, r in promoted:
+        ph_map[e.name] = (im.shape(r), im.dtype(r))
+
+    body_targets = [f.next_iters[m.name] for m in f.merges] + \
+        [e.name for e, _ in promoted]
+    in_refs = init_refs + [r for _, r in promoted]
+    in_vars = [im.var(r) for r in in_refs]
     n = len(in_vars)
-    res = im.sd._op("whileLoop", in_vars, attrs, node.name,
-                    n_out=n if n > 1 else 1)
+
+    trip = _static_trip_count(im, f, init_refs)
+    if trip is not None:
+        # exact trip count: run the body trip times under forLoop (the
+        # body subgraph gets a leading, unused iteration placeholder to
+        # match the forLoop body signature body(i, *vars))
+        iter_ph = f"{node.name}__iter"
+        ph_body = {iter_ph: ((), np.dtype(np.int32))}
+        ph_body.update(ph_map)
+        body, body_shapes, body_dtypes = _subgraph_from_nodes(
+            im, f, body_targets, ph_body, what + " body")
+        attrs = {"n": trip, "body_graph": body,
+                 "body_fn": body.callable()}
+        res = im.sd._op("forLoop", in_vars, attrs, node.name,
+                        n_out=n if n > 1 else 1)
+    else:
+        cond, _, _ = _subgraph_from_nodes(
+            im, f, [f.loop_cond.inputs[0]], ph_map, what + " cond")
+        body, body_shapes, body_dtypes = _subgraph_from_nodes(
+            im, f, body_targets, ph_map, what + " body")
+        attrs = {"cond_graph": cond,
+                 "cond_fn": cond.callable(squeeze=True),
+                 "body_graph": body, "body_fn": body.callable()}
+        res = im.sd._op("whileLoop", in_vars, attrs, node.name,
+                        n_out=n if n > 1 else 1)
     outs = res if isinstance(res, tuple) else (res,)
     for i, v in enumerate(outs):
         im.bind(node.name, v, body_shapes[i], body_dtypes[i], out_idx=i)
+
+
+# ---------------------------------------------------------------------------
+# TensorArrayV3 family: a TF1 TensorArray lowers to a plain [size, ...]
+# buffer tensor carried on the array's "flow" edge (reads are gathers,
+# writes are row scatter-updates). The resource handle output (:0) is
+# never materialized — every consumer also receives the flow, which
+# identifies the buffer. Reference: SURVEY.md §2.3 TF-import row.
+# ---------------------------------------------------------------------------
+
+def _ta_resolve(im, handle_ref, what):
+    src, idx = _ref(handle_ref)
+    while True:
+        nd = im.nodes.get(src)
+        if nd is not None and nd.op == "Identity" and idx == 0:
+            src, idx = _ref(nd.inputs[0])
+            continue
+        break
+    if nd is None or nd.op != "TensorArrayV3" or idx != 0 or \
+            src not in im.tensor_arrays:
+        raise TFImportError(
+            f"{what}: handle input does not trace to a TensorArrayV3 "
+            "node in the outer graph")
+    return src
+
+
+def _bind_ta_zeros(im, ta, elem, dtype_hint, out_idx=1):
+    """The single place the lazy zeros buffer for an unwritten
+    TensorArray gets created and bound at the TA's flow output."""
+    info = im.tensor_arrays[ta]
+    dt = info["dtype"] or dtype_hint or np.dtype(np.float32)
+    size = info["size"]
+    if info["elem"] is None and elem is None:
+        raise TFImportError(
+            f"TensorArray {ta!r} is read before any write and has no "
+            "element_shape — declare element_shape on the TensorArrayV3")
+    elem = tuple(info["elem"] if info["elem"] is not None else elem)
+    v = im.sd.constant(f"{ta}__ta_zeros",
+                       np.zeros((size,) + elem, dt))
+    im.bind(ta, v, (size,) + elem, dt, out_idx=out_idx)
+
+
+def _ta_buffer_ref(im, flow_ref, ta, elem, dtype, what):
+    """Resolve the TA's current buffer; on first use of an unbound flow
+    bind a zeros buffer there (lazily — an eagerly bound zeros constant
+    would serialize buffer-size dead weight whenever the first op
+    overwrites the whole array)."""
+    try:
+        im.shape(flow_ref)
+        return flow_ref
+    except TFImportError:
+        pass
+    src, idx = _ref(flow_ref)
+    info = im.tensor_arrays.get(ta)
+    if info is None or src != ta:
+        raise TFImportError(
+            f"{what}: flow input {flow_ref!r} has no producer and does "
+            "not trace to a TensorArrayV3 flow output")
+    _bind_ta_zeros(im, ta, elem, dtype, out_idx=idx)
+    return flow_ref
+
+
+@handler("TensorArrayV3")
+def _h_tensor_array_v3(im, node):
+    dyn = node.attrs.get("dynamic_size")
+    if dyn is not None and dyn.b:
+        raise TFImportError(
+            f"node {node.name!r}: TensorArrayV3 with dynamic_size=True "
+            "has no static-shape lowering (XLA buffers are fixed-size) "
+            "— re-export with a fixed-size TensorArray")
+    ins = im.data_inputs(node)
+    size = int(im.need_const(ins[0], "TensorArray size"))
+    dt = dtype_to_numpy(node.attrs["dtype"].type) \
+        if "dtype" in node.attrs else None
+    elem = None
+    es = node.attrs.get("element_shape")
+    if es is not None and es.shape is not None and \
+            not es.shape.unknown_rank:
+        dims = [int(d) for d in es.shape.dims]
+        if dims and all(d >= 0 for d in dims):
+            elem = tuple(dims)
+    im.tensor_arrays[node.name] = {"size": size, "dtype": dt,
+                                   "elem": elem}
+    # the flow output (:1) binds LAZILY on first read/loop use — an
+    # eager zeros constant would serialize buffer-size dead weight for
+    # the common scatter-everything idiom, which never reads it. The
+    # :0 resource handle is deliberately left unbound.
+
+
+@handler("TensorArrayScatterV3")
+def _h_ta_scatter_outer(im, node):
+    what = f"node {node.name!r} ({node.op})"
+    ins = im.data_inputs(node)  # handle, indices, value, flow
+    ta = _ta_resolve(im, ins[0], what)
+    info = im.tensor_arrays[ta]
+    idxs = im.const(ins[1])  # None: computed indices, general lowering
+    vshape, vd = im.shape(ins[2]), im.dtype(ins[2])
+    size = info["size"]
+    if idxs is not None and vshape and vshape[0] == size and \
+            np.array_equal(np.asarray(idxs).ravel(), np.arange(size)):
+        im.emit(node, "identity", [ins[2]])  # buffer = value
+        return
+    flow = _ta_buffer_ref(im, ins[3], ta, tuple(vshape[1:]), vd, what)
+    im.emit(node, "scatterUpdate", [flow, ins[1], ins[2]], {})
+
+
+@handler("TensorArrayWriteV3")
+def _h_ta_write_outer(im, node):
+    what = f"node {node.name!r} ({node.op})"
+    ins = im.data_inputs(node)  # handle, index, value, flow
+    ta = _ta_resolve(im, ins[0], what)
+    vshape, vd = im.shape(ins[2]), im.dtype(ins[2])
+    flow = _ta_buffer_ref(im, ins[3], ta, vshape, vd, what)
+    im.emit(node, "scatterUpdate", [flow, ins[1], ins[2]], {})
+
+
+@handler("TensorArrayGatherV3")
+def _h_ta_gather_outer(im, node):
+    what = f"node {node.name!r} ({node.op})"
+    ins = im.data_inputs(node)  # handle, indices, flow
+    ta = _ta_resolve(im, ins[0], what)
+    info = im.tensor_arrays[ta]
+    idxs = im.const(ins[1])  # None: computed indices, general lowering
+    flow = _ta_buffer_ref(im, ins[2], ta, None, None, what)
+    fshape = im.shape(flow)
+    if idxs is not None and fshape and fshape[0] == info["size"] and \
+            np.array_equal(np.asarray(idxs).ravel(),
+                           np.arange(info["size"])):
+        im.emit(node, "identity", [flow])
+        return
+    im.emit(node, "gather", [flow, ins[1]], {"axis": 0})
+
+
+@handler("TensorArrayReadV3")
+def _h_ta_read_outer(im, node):
+    what = f"node {node.name!r} ({node.op})"
+    ins = im.data_inputs(node)  # handle, index, flow
+    ta = _ta_resolve(im, ins[0], what)
+    flow = _ta_buffer_ref(im, ins[2], ta, None, None, what)
+    im.emit(node, "gather", [flow, ins[1]], {"axis": 0})
+
+
+@handler("TensorArraySizeV3")
+def _h_ta_size_outer(im, node):
+    ins = im.data_inputs(node)
+    ta = _ta_resolve(im, ins[0], f"node {node.name!r} ({node.op})")
+    v = im.sd.constant(node.name,
+                       np.asarray(im.tensor_arrays[ta]["size"], np.int32))
+    im.bind(node.name, v, (), np.int32)
+
+
+@handler("TensorArrayCloseV3")
+def _h_ta_close(im, node):
+    pass  # resource cleanup: nothing to materialize
+
+
+@handler("_TARead", "_TAGather")
+def _h_ta_read_interior(im, node):
+    im.emit(node, "gather", node.inputs, {"axis": 0})
+
+
+@handler("_TAWrite")
+def _h_ta_write_interior(im, node):
+    im.emit(node, "scatterUpdate", node.inputs, {})
+
+
+@handler("_TASize")
+def _h_ta_size_interior(im, node):
+    t = im.shape(node.inputs[0])[0]
+    v = im.sd.constant(node.name, np.asarray(t, np.int32))
+    im.bind(node.name, v, (), np.int32)
 
 
 @handler("ResizeBilinear", "ResizeNearestNeighbor", "ResizeBicubic",

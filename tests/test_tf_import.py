@@ -1119,6 +1119,243 @@ class TestV1WhileImport:
             TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
 
 
+def _dynamic_rnn_graph(T=5, B=2, I=3, H=4, seed=0, with_loss=False):
+    """TF1 dynamic_rnn idiom (r5): input TensorArray scattered from x
+    [T,B,I] outside the loop; the while frame reads x_t, computes
+    h' = tanh(x_t Wx + h Wh + b), writes h' into an output TensorArray
+    created WITHOUT element_shape (exercises the write-value probe);
+    TensorArrayGather stacks [T,B,H] after the Exit. Mirrors the graphs
+    tf.nn.dynamic_rnn emitted (SURVEY.md §3.4, §2.3 TF-import row)."""
+    rng = np.random.default_rng(seed)
+    Wx = rng.normal(size=(I, H)).astype(np.float32) * 0.5
+    Wh = rng.normal(size=(H, H)).astype(np.float32) * 0.5
+    b = rng.normal(size=(H,)).astype(np.float32) * 0.1
+    I32 = attr_type(np.int32)
+    F = "rnn/while"
+    nodes = [
+        placeholder("x", [T, B, I]),
+        const("Wx", Wx), const("Wh", Wh), const("bias", b),
+        const("ta_size", np.int32(T)),
+        const("range_T", np.arange(T, dtype=np.int32)),
+        const("h0", np.zeros((B, H), np.float32)),
+        const("time0", np.int32(0)),
+        const("limit", np.int32(T)),
+        const("one", np.int32(1)),
+        NodeDef("ta_in", "TensorArrayV3", ["ta_size"], {"dtype": F32}),
+        NodeDef("ta_in_scatter", "TensorArrayScatterV3",
+                ["ta_in", "range_T", "x", "ta_in:1"], {"T": F32}),
+        NodeDef("ta_out", "TensorArrayV3", ["ta_size"], {"dtype": F32}),
+        NodeDef("enter_t", "Enter", ["time0"],
+                {"frame_name": attr_s(F), "T": I32}),
+        NodeDef("enter_h", "Enter", ["h0"],
+                {"frame_name": attr_s(F), "T": F32}),
+        NodeDef("enter_flow", "Enter", ["ta_out:1"],
+                {"frame_name": attr_s(F), "T": F32}),
+        NodeDef("merge_t", "Merge", ["enter_t", "ni_t"], {"T": I32}),
+        NodeDef("merge_h", "Merge", ["enter_h", "ni_h"], {"T": F32}),
+        NodeDef("merge_flow", "Merge", ["enter_flow", "ni_flow"],
+                {"T": F32}),
+        NodeDef("lim_e", "Enter", ["limit"],
+                {"frame_name": attr_s(F), "T": I32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("less", "Less", ["merge_t", "lim_e"], {"T": I32}),
+        NodeDef("cond", "LoopCond", ["less"], {}),
+        NodeDef("switch_t", "Switch", ["merge_t", "cond"], {"T": I32}),
+        NodeDef("switch_h", "Switch", ["merge_h", "cond"], {"T": F32}),
+        NodeDef("switch_flow", "Switch", ["merge_flow", "cond"],
+                {"T": F32}),
+        NodeDef("Wx_e", "Enter", ["Wx"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("Wh_e", "Enter", ["Wh"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("b_e", "Enter", ["bias"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("in_handle_e", "Enter", ["ta_in"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("in_flow_e", "Enter", ["ta_in_scatter"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("out_handle_e", "Enter", ["ta_out"],
+                {"frame_name": attr_s(F), "T": F32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("sw_t_id", "Identity", ["switch_t:1"], {"T": I32}),
+        NodeDef("x_t", "TensorArrayReadV3",
+                ["in_handle_e", "sw_t_id", "in_flow_e"], {"dtype": F32}),
+        NodeDef("xw", "MatMul", ["x_t", "Wx_e"], {"T": F32}),
+        NodeDef("hw", "MatMul", ["switch_h:1", "Wh_e"], {"T": F32}),
+        NodeDef("acc", "Add", ["xw", "hw"], {"T": F32}),
+        NodeDef("accb", "Add", ["acc", "b_e"], {"T": F32}),
+        NodeDef("h_new", "Tanh", ["accb"], {"T": F32}),
+        NodeDef("flow_new", "TensorArrayWriteV3",
+                ["out_handle_e", "sw_t_id", "h_new", "switch_flow:1"],
+                {"T": F32}),
+        NodeDef("one_e", "Enter", ["one"],
+                {"frame_name": attr_s(F), "T": I32,
+                 "is_constant": attr_b(True)}),
+        NodeDef("inc", "Add", ["sw_t_id", "one_e"], {"T": I32}),
+        NodeDef("ni_t", "NextIteration", ["inc"], {"T": I32}),
+        NodeDef("ni_h", "NextIteration", ["h_new"], {"T": F32}),
+        NodeDef("ni_flow", "NextIteration", ["flow_new"], {"T": F32}),
+        NodeDef("exit_h", "Exit", ["switch_h"], {"T": F32}),
+        NodeDef("exit_flow", "Exit", ["switch_flow"], {"T": F32}),
+        NodeDef("outputs", "TensorArrayGatherV3",
+                ["ta_out", "range_T", "exit_flow"], {"dtype": F32}),
+    ]
+    if with_loss:
+        nodes += [
+            placeholder("targets", [T, B, H]),
+            NodeDef("diff", "Sub", ["outputs", "targets"], {"T": F32}),
+            NodeDef("sq", "Square", ["diff"], {"T": F32}),
+            const("all_axes", np.array([0, 1, 2], np.int32)),
+            NodeDef("loss", "Mean", ["sq", "all_axes"], {"T": F32}),
+        ]
+    return GraphDef(nodes), (Wx, Wh, b)
+
+
+def _ref_rnn(x, Wx, Wh, b):
+    T, B, _ = x.shape
+    h = np.zeros((B, Wh.shape[0]), np.float32)
+    outs = []
+    for t in range(T):
+        h = np.tanh(x[t] @ Wx + h @ Wh + b)
+        outs.append(h)
+    return np.stack(outs), h
+
+
+class TestTensorArrayImport:
+    """TF1 TensorArray-in-single-frame lowering (VERDICT r4 item 3): the
+    array's flow edge becomes a loop-carried [size, ...] buffer; reads
+    are gathers, writes dynamic row updates. Counter-style frames with a
+    statically simulable trip count lower onto forLoop (scan under the
+    hood), so the imported loop is reverse-mode differentiable."""
+
+    def test_dynamic_rnn_matches_numpy(self):
+        T, B, I, H = 5, 2, 3, 4
+        gd, (Wx, Wh, b) = _dynamic_rnn_graph(T, B, I, H)
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        x = np.random.default_rng(1).normal(size=(T, B, I)) \
+            .astype(np.float32)
+        got = sd.output({"x": x}, "outputs")["outputs"].toNumpy()
+        want, h_last = _ref_rnn(x, Wx, Wh, b)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        got_h = sd.output({"x": x}, "exit_h")["exit_h"].toNumpy()
+        np.testing.assert_allclose(got_h, h_last, rtol=2e-5, atol=2e-5)
+
+    def test_lowered_onto_differentiable_forloop(self):
+        gd, _ = _dynamic_rnn_graph()
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        kinds = {o.fn_name for o in sd._ops}
+        assert "forLoop" in kinds and "whileLoop" not in kinds
+
+    def test_dynamic_rnn_serializes(self, tmp_path):
+        from deeplearning4j_tpu.autodiff import SameDiff
+
+        gd, _ = _dynamic_rnn_graph()
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        p = str(tmp_path / "ta_rnn.sd")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        x = np.random.default_rng(2).normal(size=(5, 2, 3)) \
+            .astype(np.float32)
+        a = sd.output({"x": x}, "outputs")["outputs"].toNumpy()
+        c = sd2.output({"x": x}, "outputs")["outputs"].toNumpy()
+        np.testing.assert_allclose(a, c, rtol=1e-6)
+
+    def test_dynamic_rnn_finetunes(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        T, B, I, H = 5, 2, 3, 4
+        gd, (Wx, Wh, b) = _dynamic_rnn_graph(T, B, I, H, with_loss=True)
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        conv = TFGraphMapper.makeTrainable(
+            sd, names={"Wx", "Wh", "bias"})
+        assert sorted(conv) == ["Wh", "Wx", "bias"]
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(T, B, I)).astype(np.float32)
+        tgt = rng.normal(size=(T, B, H)).astype(np.float32) * 0.3
+        sd.setLossVariables("loss")
+        sd.setTrainingConfig(TrainingConfig(
+            updater=Adam(5e-2), dataSetFeatureMapping=["x"],
+            dataSetLabelMapping=["targets"]))
+        hist = sd.fit([(x, tgt)], epochs=15)
+        assert hist.lossCurve[-1] < hist.lossCurve[0] * 0.7
+
+    def test_tensorarray_ops_outside_loops(self):
+        """Scatter/read/write/gather/size as plain dataflow (no frame)."""
+        T, B = 4, 3
+        gd = GraphDef([
+            placeholder("x", [T, B]),
+            const("sz", np.int32(T)),
+            const("rng_T", np.arange(T, dtype=np.int32)),
+            const("i1", np.int32(1)),
+            const("row", np.full((B,), 7.0, np.float32)),
+            NodeDef("ta", "TensorArrayV3", ["sz"], {"dtype": F32}),
+            NodeDef("fl0", "TensorArrayScatterV3",
+                    ["ta", "rng_T", "x", "ta:1"], {"T": F32}),
+            NodeDef("fl1", "TensorArrayWriteV3",
+                    ["ta", "i1", "row", "fl0"], {"T": F32}),
+            NodeDef("r2", "TensorArrayReadV3", ["ta", "i1", "fl1"],
+                    {"dtype": F32}),
+            NodeDef("stacked", "TensorArrayGatherV3",
+                    ["ta", "rng_T", "fl1"], {"dtype": F32}),
+            NodeDef("n", "TensorArraySizeV3", ["ta", "fl1"], {}),
+        ])
+        sd = TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+        x = np.random.default_rng(0).normal(size=(T, B)) \
+            .astype(np.float32)
+        out = sd.output({"x": x}, "stacked", "r2", "n")
+        want = x.copy()
+        want[1] = 7.0
+        np.testing.assert_allclose(out["stacked"].toNumpy(), want)
+        np.testing.assert_allclose(out["r2"].toNumpy(), want[1])
+        assert int(out["n"].toNumpy()) == T
+
+    def test_unsupported_ta_op_in_frame_still_rejected(self):
+        """TensorArrayConcatV3 has no lowering: loud rejection, with
+        the supported subset named."""
+        F = "f"
+        I32 = attr_type(np.int32)
+        gd = GraphDef([
+            const("sz", np.int32(2)),
+            const("i0", np.int32(0)), const("lim", np.int32(2)),
+            const("one", np.int32(1)),
+            NodeDef("ta", "TensorArrayV3", ["sz"], {"dtype": F32}),
+            NodeDef("e_i", "Enter", ["i0"],
+                    {"frame_name": attr_s(F), "T": I32}),
+            NodeDef("h_e", "Enter", ["ta"],
+                    {"frame_name": attr_s(F), "T": F32,
+                     "is_constant": attr_b(True)}),
+            NodeDef("f_e", "Enter", ["ta:1"],
+                    {"frame_name": attr_s(F), "T": F32,
+                     "is_constant": attr_b(True)}),
+            NodeDef("m_i", "Merge", ["e_i", "ni"], {"T": I32}),
+            NodeDef("lim_e", "Enter", ["lim"],
+                    {"frame_name": attr_s(F), "T": I32,
+                     "is_constant": attr_b(True)}),
+            NodeDef("less", "Less", ["m_i", "lim_e"], {"T": I32}),
+            NodeDef("cond", "LoopCond", ["less"], {}),
+            NodeDef("sw_i", "Switch", ["m_i", "cond"], {"T": I32}),
+            NodeDef("cc", "TensorArrayConcatV3", ["h_e", "f_e"],
+                    {"dtype": F32}),
+            NodeDef("cc_dep", "Size", ["cc"], {"T": F32}),
+            NodeDef("one_e", "Enter", ["one"],
+                    {"frame_name": attr_s(F), "T": I32,
+                     "is_constant": attr_b(True)}),
+            NodeDef("inc0", "Add", ["sw_i:1", "one_e"], {"T": I32}),
+            NodeDef("inc", "Add", ["inc0", "cc_dep"], {"T": I32}),
+            NodeDef("ni", "NextIteration", ["inc"], {"T": I32}),
+            NodeDef("i_out", "Exit", ["sw_i"], {"T": I32}),
+        ])
+        with pytest.raises(TFImportError,
+                           match="no loop-carried-buffer lowering"):
+            TFGraphMapper.importGraph(GraphDef.parse(gd.encode()))
+
+
 class TestR4HandlerWidening:
     """Conformance for the r4 handler additions (VERDICT r3 item 8)."""
 
