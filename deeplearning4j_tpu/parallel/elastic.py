@@ -45,41 +45,82 @@ class ElasticTrainer:
     ComputationGraph (anything ModelSerializer handles)."""
 
     def __init__(self, net, checkpointDir, everyNIterations=100,
-                 keepLast=3, saveUpdaterState=True):
+                 keepLast=3, saveUpdaterState=True, sharded=False):
         self.net = net
         self.dir = str(checkpointDir)
         self.every = int(everyNIterations)
         self.keep = int(keepLast)
         self.save_updater = saveUpdaterState
+        # sharded=True: pod-scale checkpoints — every process writes its
+        # own param shards into a checkpoint DIRECTORY (SURVEY §5
+        # "sharded save for pod-scale params"); resume re-shards onto
+        # the current topology, so a job can resume after a re-scale
+        self.sharded = bool(sharded)
         os.makedirs(self.dir, exist_ok=True)
 
     # -- checkpoint files ---------------------------------------------------
     def _path(self, iteration):
-        return os.path.join(self.dir, f"checkpoint_{iteration:010d}.zip")
+        suffix = "" if self.sharded else ".zip"
+        return os.path.join(self.dir,
+                            f"checkpoint_{iteration:010d}{suffix}")
 
     @staticmethod
     def latest(checkpointDir):
-        """Newest checkpoint path in the directory, or None."""
+        """Newest checkpoint path in the directory (zip file or sharded
+        directory), or None."""
         if not os.path.isdir(checkpointDir):
             return None
-        cps = sorted(f for f in os.listdir(checkpointDir)
-                     if f.startswith("checkpoint_") and f.endswith(".zip"))
+        from deeplearning4j_tpu.utils.sharded_checkpoint import MANIFEST
+
+        cps = sorted(
+            f for f in os.listdir(checkpointDir)
+            if f.startswith("checkpoint_") and
+            (f.endswith(".zip") or os.path.exists(os.path.join(
+                checkpointDir, f, MANIFEST))))
         return os.path.join(checkpointDir, cps[-1]) if cps else None
 
     def _write(self, iteration):
-        """Process-0-only checkpoint write with rotation."""
-        if jax.process_index() != 0:
-            return None
+        """Checkpoint write with rotation. Single-file mode: process 0
+        writes the zip. Sharded mode: EVERY process writes its shard
+        directory entry (save_sharded syncs internally; the manifest
+        lands only after all shards are complete)."""
         from deeplearning4j_tpu.utils import ModelSerializer
 
         path = self._path(iteration)
-        tmp = path + ".tmp"
-        ModelSerializer.writeModel(self.net, tmp, self.save_updater)
-        os.replace(tmp, path)   # atomic: a preempt mid-write leaves .tmp
-        cps = sorted(f for f in os.listdir(self.dir)
-                     if f.startswith("checkpoint_") and f.endswith(".zip"))
-        for old in cps[:-self.keep]:
-            os.remove(os.path.join(self.dir, old))
+        if self.sharded:
+            ModelSerializer.writeModel(self.net, path, self.save_updater,
+                                       sharded=True)
+        else:
+            if jax.process_index() != 0:
+                return None
+            tmp = path + ".tmp"
+            ModelSerializer.writeModel(self.net, tmp, self.save_updater)
+            os.replace(tmp, path)  # atomic: preempt mid-write leaves .tmp
+        if jax.process_index() == 0:
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                MANIFEST)
+            import shutil
+
+            complete, dead = [], []
+            for f in sorted(os.listdir(self.dir)):
+                if not f.startswith("checkpoint_") or f.endswith(".tmp"):
+                    continue
+                full = os.path.join(self.dir, f)
+                if os.path.isdir(full):
+                    # a manifest-less directory is a mid-save remnant
+                    # (save_sharded writes the manifest last, after the
+                    # cross-process sync) — it must not count toward
+                    # keepLast, and it never becomes restorable
+                    (complete if os.path.exists(
+                        os.path.join(full, MANIFEST)) else dead).append(f)
+                else:
+                    complete.append(f)
+            for old in complete[:-self.keep] + dead:
+                full = os.path.join(self.dir, old)
+                if os.path.isdir(full):
+                    shutil.rmtree(full)
+                else:
+                    os.remove(full)
         return path
 
     # -- resume -------------------------------------------------------------
@@ -93,10 +134,14 @@ class ElasticTrainer:
             return None
         from deeplearning4j_tpu.utils import ModelSerializer
 
+        sharded = os.path.isdir(path)
         if graph:
-            net = ModelSerializer.restoreComputationGraph(path, True)
+            net = ModelSerializer.restoreComputationGraph(
+                path, True, sharded=sharded)
         else:
-            net = ModelSerializer.restoreMultiLayerNetwork(path, True)
+            net = ModelSerializer.restoreMultiLayerNetwork(
+                path, True, sharded=sharded)
+        kw.setdefault("sharded", sharded)
         return cls(net, checkpointDir, **kw)
 
     # -- preemption-safe fit ------------------------------------------------

@@ -26,10 +26,39 @@ _SEP = "\x1f"  # unit separator: cannot appear in layer names
 class ModelSerializer:
     @staticmethod
     def writeModel(model, path, saveUpdater: bool = True, normalizer=None,
-                   includeFlatCoefficients: bool = False):
+                   includeFlatCoefficients: bool = False,
+                   sharded: bool = False):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         is_graph = isinstance(model, ComputationGraph)
+        if sharded:
+            # pod-scale path: `path` is a DIRECTORY; every process must
+            # call this (each writes its own shard file). Normalizers
+            # ride the manifest; flat coefficients are gather-based and
+            # meaningless sharded, so unsupported here.
+            if includeFlatCoefficients:
+                raise ValueError(
+                    "includeFlatCoefficients requires the single-file "
+                    "(gathering) writeModel path")
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                save_sharded)
+
+            tree = {"p": model._params, "s": model._states}
+            if saveUpdater:
+                tree["o"] = model._opt_states
+            meta = {"modelType": ("ComputationGraph" if is_graph
+                                  else "MultiLayerNetwork"),
+                    "configuration": model.conf.to_json(),
+                    "saveUpdater": bool(saveUpdater),
+                    "trainingState": {"iteration": model._iteration,
+                                      "epoch": model._epoch}}
+            if normalizer is not None:
+                meta["normalizer"] = {
+                    "class": type(normalizer).__name__,
+                    "state": {k: np.asarray(v).tolist()
+                              for k, v in normalizer._state().items()}}
+            save_sharded(path, tree, step=model._iteration, meta=meta)
+            return
         with zipfile.ZipFile(path, "w") as zf:
             zf.writestr("configuration.json", model.conf.to_json())
             zf.writestr("modelType",
@@ -38,22 +67,29 @@ class ModelSerializer:
             if includeFlatCoefficients:
                 flat = model.params().toNumpy().astype("<f4")
                 zf.writestr("coefficients.bin", flat.tobytes())
-            # named per-layer arrays (the canonical restore source)
+            # named per-layer arrays (the canonical restore source);
+            # one nesting level (Bidirectional {"fwd": {...}}) flattens
+            # into a 4-part key
+            def _put(named, kind, owner, pdict):
+                for k, v in pdict.items():
+                    if isinstance(v, dict):
+                        for kk, vv in v.items():
+                            named[_SEP.join((kind, owner, k, kk))] = \
+                                np.asarray(vv)
+                    else:
+                        named[_SEP.join((kind, owner, k))] = np.asarray(v)
+
             named = {}
             if is_graph:
                 for name, p in model._params.items():
-                    for k, v in p.items():
-                        named[_SEP.join(("p", name, k))] = np.asarray(v)
+                    _put(named, "p", name, p)
                 for name, s in model._states.items():
-                    for k, v in s.items():
-                        named[_SEP.join(("s", name, k))] = np.asarray(v)
+                    _put(named, "s", name, s)
             else:
                 for i, p in enumerate(model._params):
-                    for k, v in p.items():
-                        named[_SEP.join(("p", str(i), k))] = np.asarray(v)
+                    _put(named, "p", str(i), p)
                 for i, s in enumerate(model._states):
-                    for k, v in s.items():
-                        named[_SEP.join(("s", str(i), k))] = np.asarray(v)
+                    _put(named, "s", str(i), s)
             buf = io.BytesIO()
             np.savez(buf, **named)
             zf.writestr("params.npz", buf.getvalue())
@@ -98,14 +134,19 @@ class ModelSerializer:
             model.init()
             named = np.load(io.BytesIO(zf.read("params.npz")))
             for key in named.files:
-                kind, idx, pname = key.split(_SEP, 2)
+                parts = key.split(_SEP)
+                kind, idx, pname = parts[0], parts[1], parts[2]
                 arr = jnp.asarray(named[key])
-                if mtype == "ComputationGraph":
-                    target = model._params if kind == "p" else model._states
-                    target[idx][pname] = arr
+                target = model._params if kind == "p" else model._states
+                slot = target[idx if mtype == "ComputationGraph"
+                              else int(idx)]
+                if len(parts) == 4:  # nested group (Bidirectional)
+                    sub = slot.get(pname)
+                    if not isinstance(sub, dict):
+                        sub = slot[pname] = {}
+                    sub[parts[3]] = arr
                 else:
-                    target = model._params if kind == "p" else model._states
-                    target[int(idx)][pname] = arr
+                    slot[pname] = arr
             if loadUpdater and "updaterState.npz" in zf.namelist():
                 proto_leaves, treedef = jax.tree_util.tree_flatten(
                     model._opt_states)
@@ -120,12 +161,69 @@ class ModelSerializer:
         return model
 
     @staticmethod
-    def restoreMultiLayerNetwork(path, loadUpdater: bool = True):
+    def _restore_sharded(path, expect, loadUpdater):
+        import jax
+        import os
+
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            MANIFEST, load_sharded)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with open(os.path.join(path, MANIFEST)) as f:
+            meta = json.load(f)["meta"]
+        if expect and meta["modelType"] != expect:
+            raise ValueError(
+                f"checkpoint holds a {meta['modelType']}, not {expect}")
+        if meta["modelType"] == "ComputationGraph":
+            model = ComputationGraph(
+                ComputationGraphConfiguration.from_json(
+                    meta["configuration"]))
+        else:
+            model = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(meta["configuration"]))
+        model.init()
+        want_updater = loadUpdater and meta.get("saveUpdater")
+        # the template must mirror the SAVED tree (incl. updater state
+        # even when the caller skips it — it is dropped after load)
+        template = {"p": model._params, "s": model._states}
+        if meta.get("saveUpdater"):
+            template["o"] = model._opt_states
+        # restore each leaf with the sharding the freshly initialized
+        # model gave it (re-shards from any saved topology)
+        shardings = jax.tree_util.tree_map(
+            lambda l: l.sharding if isinstance(l, jax.Array)
+            else jax.sharding.SingleDeviceSharding(jax.devices()[0]),
+            template)
+        tree, _step, _ = load_sharded(path, template=template,
+                                      shardings=shardings)
+        model._params, model._states = tree["p"], tree["s"]
+        if want_updater:
+            model._opt_states = tree["o"]
+            ts = meta["trainingState"]
+            model._iteration = ts["iteration"]
+            model._epoch = ts["epoch"]
+        return model
+
+    @staticmethod
+    def restoreMultiLayerNetwork(path, loadUpdater: bool = True,
+                                 sharded: bool = False):
+        if sharded:
+            return ModelSerializer._restore_sharded(
+                path, "MultiLayerNetwork", loadUpdater)
         return ModelSerializer._restore(path, "MultiLayerNetwork",
                                         loadUpdater)
 
     @staticmethod
-    def restoreComputationGraph(path, loadUpdater: bool = True):
+    def restoreComputationGraph(path, loadUpdater: bool = True,
+                                sharded: bool = False):
+        if sharded:
+            return ModelSerializer._restore_sharded(
+                path, "ComputationGraph", loadUpdater)
         return ModelSerializer._restore(path, "ComputationGraph", loadUpdater)
 
     @staticmethod
@@ -134,6 +232,23 @@ class ModelSerializer:
             ImagePreProcessingScaler, NormalizerMinMaxScaler,
             NormalizerStandardize)
 
+        import os
+        if os.path.isdir(path):  # sharded checkpoint: meta-held
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                MANIFEST)
+
+            with open(os.path.join(path, MANIFEST)) as f:
+                meta = json.load(f)["meta"]
+            nz = meta.get("normalizer")
+            if nz is None:
+                return None
+            cls = {c.__name__: c for c in (
+                NormalizerStandardize, NormalizerMinMaxScaler,
+                ImagePreProcessingScaler)}[nz["class"]]
+            obj = cls.__new__(cls)
+            obj._load_state({k: np.asarray(v)
+                             for k, v in nz["state"].items()})
+            return obj
         with zipfile.ZipFile(path) as zf:
             if "normalizer.npz" not in zf.namelist():
                 return None

@@ -1,0 +1,218 @@
+"""Sharded (pod-scale) checkpointing: per-process shard files + a JSON
+manifest, restorable onto a different mesh shape or process count.
+
+Reference capability (SURVEY.md §5 checkpoint row): the reference's
+ModelSerializer gathers everything to one host file; SURVEY prescribes
+"add sharded save for pod-scale params" for the TPU build. Design:
+
+- Every process writes ONE `shard_{pid}.npz` holding the param chunks it
+  owns. A chunk is one distinct shard of a `jax.Array`'s sharding; the
+  owner is the lowest-id device holding that chunk, so replicated arrays
+  are written exactly once and the chunk->file map is computed
+  identically on every process with no communication
+  (`Sharding.devices_indices_map` is a global view).
+- Process 0 writes `manifest.json` (leaf names, shapes, dtypes, the
+  chunk->file map, step, optional metadata) after a cross-process sync,
+  so a complete manifest implies complete shard files.
+- Restore assembles each requested region from the chunk files it
+  overlaps: with a target sharding, `jax.make_array_from_callback`
+  materializes only the chunks each process actually needs — restoring
+  onto a different mesh/process count re-shards for free; without one,
+  the full numpy array is assembled (single-host restore).
+
+The checkpoint directory must be shared storage for multi-process use
+(same contract as ElasticTrainer)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+
+def _sync(tag="dl4j_tpu_sharded_ckpt"):
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _norm_index(index, shape):
+    """Slice tuple -> [[start, stop], ...] (one per dim)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        if sl.step not in (None, 1):
+            raise ValueError(f"strided shard index {sl} unsupported")
+        out.append([start, stop])
+    return out
+
+
+def _flatten_with_names(tree):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf)
+            for path, leaf in flat], treedef
+
+
+def save_sharded(directory, tree, step=0, meta=None):
+    """Write this process's chunks of `tree` (a pytree of jax/numpy
+    arrays) under `directory`; process 0 also writes the manifest."""
+    import jax
+
+    pid = jax.process_index()
+    os.makedirs(directory, exist_ok=True)
+    named, _ = _flatten_with_names(tree)
+    payload, leaves_spec = {}, {}
+    for i, (name, leaf) in enumerate(named):
+        key_base = f"leaf{i}"
+        if isinstance(leaf, jax.Array):
+            shape, dtype = leaf.shape, np.dtype(leaf.dtype)
+            gmap = leaf.sharding.devices_indices_map(shape)
+            owners = {}  # chunk slices (as json) -> owning device
+            for dev, index in gmap.items():
+                k = json.dumps(_norm_index(index, shape))
+                if k not in owners or dev.id < owners[k].id:
+                    owners[k] = dev
+            local = {json.dumps(_norm_index(s.index, shape)):
+                     s.data for s in leaf.addressable_shards}
+            chunks = []
+            for j, (k, dev) in enumerate(sorted(owners.items())):
+                npz_key = f"{key_base}.{j}"
+                chunks.append({
+                    "slices": json.loads(k),
+                    "file": f"shard_{dev.process_index}.npz",
+                    "key": npz_key})
+                if dev.process_index == pid:
+                    payload[npz_key] = np.asarray(local[k])
+        else:  # host value: single chunk owned by process 0
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+            npz_key = f"{key_base}.0"
+            chunks = [{"slices": [[0, d] for d in shape],
+                       "file": "shard_0.npz", "key": npz_key}]
+            if pid == 0:
+                payload[npz_key] = arr
+        leaves_spec[name] = {"shape": list(shape), "dtype": str(dtype),
+                             "host": not isinstance(leaf, jax.Array),
+                             "chunks": chunks}
+    tmp = os.path.join(directory, f"shard_{pid}.tmp.npz")
+    np.savez(tmp, **payload)
+    os.replace(tmp, os.path.join(directory, f"shard_{pid}.npz"))
+    _sync("shards_written")
+    if pid == 0:
+        man = {"step": int(step), "process_count": jax.process_count(),
+               "leaves": leaves_spec, "meta": meta or {}}
+        mtmp = os.path.join(directory, MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(man, f)
+        os.replace(mtmp, os.path.join(directory, MANIFEST))
+    _sync("manifest_written")
+
+
+class _ChunkReader:
+    def __init__(self, directory, manifest):
+        self.dir = directory
+        self.man = manifest
+        self._files = {}
+
+    def _npz(self, fname):
+        if fname not in self._files:
+            self._files[fname] = np.load(os.path.join(self.dir, fname))
+        return self._files[fname]
+
+    def region(self, name, index=None):
+        """Assemble the region `index` (slice tuple, or None for the
+        whole array) of leaf `name` from its overlapping chunks."""
+        spec = self.man["leaves"][name]
+        shape = tuple(spec["shape"])
+        dtype = np.dtype(spec["dtype"])
+        want = _norm_index(index, shape) if index is not None else \
+            [[0, d] for d in shape]
+        out = np.empty([e - s for s, e in want], dtype)
+        filled = 0
+        for ch in spec["chunks"]:
+            inter = [(max(ws, cs), min(we, ce)) for (ws, we), (cs, ce)
+                     in zip(want, ch["slices"])]
+            if any(s >= e for s, e in inter):
+                continue
+            src = self._npz(ch["file"])[ch["key"]]
+            src_sl = tuple(slice(s - cs, e - cs) for (s, e), (cs, _ce)
+                           in zip(inter, ch["slices"]))
+            dst_sl = tuple(slice(s - ws, e - ws) for (s, e), (ws, _we)
+                           in zip(inter, want))
+            out[dst_sl] = src[src_sl]
+            filled += int(np.prod([e - s for s, e in inter]))
+        if filled < int(np.prod(out.shape)):
+            raise ValueError(
+                f"checkpoint chunks do not cover leaf {name!r} region "
+                f"{want} — incomplete shard files?")
+        return out
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+
+
+def load_sharded(directory, template=None, shardings=None):
+    """Restore a checkpoint written by save_sharded.
+
+    template: pytree with the same structure as the saved tree — the
+      result is unflattened into that structure (leaf values unused).
+      None returns a flat {name: array} dict.
+    shardings: pytree of jax.sharding.Sharding (matching template
+      structure), a single Sharding for all leaves, or None for plain
+      numpy arrays. With shardings, each process materializes only its
+      addressable chunks (pod-scale restore onto any mesh).
+    Returns (tree, step, meta)."""
+    import jax
+
+    with open(os.path.join(directory, MANIFEST)) as f:
+        man = json.load(f)
+    reader = _ChunkReader(directory, man)
+    names = list(man["leaves"])
+
+    if template is not None:
+        tnamed, treedef = _flatten_with_names(template)
+        tnames = [n for n, _ in tnamed]
+        if sorted(tnames) != sorted(names):
+            missing = sorted(set(names) ^ set(tnames))
+            raise ValueError(
+                f"template structure does not match checkpoint "
+                f"(mismatched leaves: {missing[:5]}...)")
+        names = tnames  # template order
+    shard_list = None
+    if shardings is not None:
+        if hasattr(shardings, "devices_indices_map"):  # single sharding
+            shard_list = [shardings] * len(names)
+        else:
+            snamed, _ = _flatten_with_names(shardings)
+            smap = {n: s for n, s in snamed}
+            shard_list = [smap[n] for n in names]
+
+    out = []
+    for i, name in enumerate(names):
+        spec = man["leaves"][name]
+        shape = tuple(spec["shape"])
+        if shard_list is not None and not spec.get("host"):
+            arr = jax.make_array_from_callback(
+                shape, shard_list[i],
+                lambda idx, _n=name: reader.region(_n, idx))
+        else:  # host-saved leaves come back as numpy (dtype-exact)
+            arr = reader.region(name)
+        out.append(arr)
+    reader.close()
+    if template is not None:
+        import jax as _jax
+
+        tree = _jax.tree_util.tree_unflatten(treedef, out)
+    else:
+        tree = dict(zip(names, out))
+    return tree, man["step"], man.get("meta", {})

@@ -1,0 +1,89 @@
+"""Worker for the two-process sharded-checkpoint test (spawned by
+tests/test_sharded_checkpoint.py, one per simulated host).
+
+Phase "save": build a deterministic param tree sharded over the
+4-device global mesh and save_sharded it — each process writes only its
+own shard file. Phase "restore": load the checkpoint back onto a
+DIFFERENT mesh axis order and print a content hash, proving the
+re-shard path and cross-process agreement."""
+
+import hashlib
+import os
+import sys
+
+
+def expected_tree_np():
+    import numpy as np
+
+    w = np.arange(8 * 6, dtype=np.float32).reshape(8, 6) * 0.25
+    b = np.arange(6, dtype=np.float32) - 2.5
+    step_scale = np.float32(3.0)
+    return {"w": w, "b": b, "scale": step_scale}
+
+
+def tree_hash(tree):
+    import numpy as np
+
+    h = hashlib.sha256()
+    for k in sorted(tree):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(np.asarray(tree[k])).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    coord, n_proc, pid, phase, ckdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n_proc, process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        load_sharded, save_sharded)
+
+    devs = np.array(jax.devices())
+    exp = expected_tree_np()
+
+    if phase == "save":
+        mesh = Mesh(devs.reshape(4), ("d",))
+        sh_w = NamedSharding(mesh, P("d", None))   # rows over 4 devices
+        sh_b = NamedSharding(mesh, P())            # replicated
+        tree = {
+            "w": jax.make_array_from_callback(
+                exp["w"].shape, sh_w, lambda idx: exp["w"][idx]),
+            "b": jax.make_array_from_callback(
+                exp["b"].shape, sh_b, lambda idx: exp["b"][idx]),
+            "scale": exp["scale"],  # host scalar
+        }
+        save_sharded(ckdir, tree, step=17, meta={"tag": "two-proc"})
+        print(f"SAVED {pid}", flush=True)
+    else:  # restore on 2 processes, different mesh (2x2), replicated
+        mesh = Mesh(devs.reshape(2, 2), ("a", "b"))
+        repl = NamedSharding(mesh, P())
+        template = {"w": 0, "b": 0, "scale": 0}
+        tree, step, meta = load_sharded(ckdir, template=template,
+                                        shardings=repl)
+        assert step == 17 and meta["tag"] == "two-proc"
+        # fully replicated arrays are fully addressable on every process
+        host = {k: np.asarray(v) for k, v in tree.items()}
+        for k in exp:
+            np.testing.assert_array_equal(host[k], exp[k])
+        print(f"HASH {tree_hash(host)}", flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
