@@ -9,9 +9,14 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
 from deeplearning4j_tpu.datasets.mnist import (  # noqa: F401
     MnistDataSetIterator, synthesize_mnist)
 from deeplearning4j_tpu.datasets.records import (  # noqa: F401
-    CSVRecordReader, FileSplit, InputSplit, LineRecordReader,
-    ListStringSplit, RecordReader, RecordReaderDataSetIterator,
-    RecordReaderMultiDataSetIterator)
+    CollectionRecordReader, CSVRecordReader, FileSplit, InputSplit,
+    LineRecordReader, ListStringSplit, RecordReader,
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator)
+from deeplearning4j_tpu.datasets.join import (  # noqa: F401
+    Join, JoinType, executeJoin)
+from deeplearning4j_tpu.datasets.analysis import (  # noqa: F401
+    AnalyzeLocal, CategoricalColumnAnalysis, DataAnalysis,
+    NumericalColumnAnalysis)
 from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler, Normalizer, NormalizerMinMaxScaler,
     NormalizerStandardize)

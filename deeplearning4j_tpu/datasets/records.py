@@ -65,6 +65,33 @@ class RecordReader:
         raise NotImplementedError
 
 
+class CollectionRecordReader(RecordReader):
+    """Reader over an in-memory list of records (reference:
+    org.datavec.api.records.reader.impl.collection
+    .CollectionRecordReader) — the bridge from executeJoin /
+    TransformProcess.execute output back into the iterator stack."""
+
+    def __init__(self, records):
+        self.records = list(records)
+        self._pos = 0
+
+    def initialize(self, split=None):
+        self._pos = 0
+
+    def hasNext(self):
+        return self._pos < len(self.records)
+
+    def next(self):
+        if not self.hasNext():
+            raise StopIteration
+        rec = self.records[self._pos]
+        self._pos += 1
+        return list(rec)
+
+    def reset(self):
+        self._pos = 0
+
+
 class CSVRecordReader(RecordReader):
     """Reference: CSVRecordReader(numLinesToSkip, delimiter)."""
 

@@ -1,0 +1,143 @@
+"""DataVec Join + AnalyzeLocal (VERDICT r4 item 5).
+
+Reference: org.datavec.api.transform.join.Join and
+org.datavec.local.transforms.AnalyzeLocal (SURVEY.md §2.4 — transform
+row names map/filter/JOIN; reference also ships column analysis).
+Expectations are hand-computed, no pandas."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AnalyzeLocal, CollectionRecordReader, ColumnType, Join, JoinType,
+    RecordReaderDataSetIterator, Schema, TransformProcess,
+    TransformProcessRecordReader, executeJoin)
+
+
+def _schemas():
+    left = (Schema.Builder()
+            .addColumnInteger("id")
+            .addColumnDouble("x1")
+            .addColumnDouble("x2")
+            .build())
+    right = (Schema.Builder()
+             .addColumnInteger("id")
+             .addColumnDouble("x3")
+             .addColumnInteger("label")
+             .build())
+    return left, right
+
+
+LEFT = [[1, 0.1, 0.2], [2, 0.3, 0.4], [3, 0.5, 0.6]]
+RIGHT = [[1, 10.0, 0], [3, 30.0, 1], [4, 40.0, 2]]
+
+
+class TestJoin:
+    def _join(self, jtype):
+        left, right = _schemas()
+        return (Join.Builder(jtype).setSchemas(left, right)
+                .setKeyColumns("id").build())
+
+    def test_output_schema(self):
+        j = self._join(JoinType.INNER)
+        out = j.getOutputSchema()
+        assert out.getColumnNames() == ["id", "x1", "x2", "x3", "label"]
+        assert out.getColumnTypes()[0] == ColumnType.Integer
+
+    def test_inner(self):
+        got = self._join(JoinType.INNER).execute(LEFT, RIGHT)
+        assert got == [[1, 0.1, 0.2, 10.0, 0], [3, 0.5, 0.6, 30.0, 1]]
+
+    def test_left_outer(self):
+        got = self._join(JoinType.LEFT_OUTER).execute(LEFT, RIGHT)
+        assert got == [[1, 0.1, 0.2, 10.0, 0],
+                       [2, 0.3, 0.4, None, None],
+                       [3, 0.5, 0.6, 30.0, 1]]
+
+    def test_right_outer(self):
+        got = self._join(JoinType.RIGHT_OUTER).execute(LEFT, RIGHT)
+        assert [1, 0.1, 0.2, 10.0, 0] in got
+        assert [3, 0.5, 0.6, 30.0, 1] in got
+        assert [4, None, None, 40.0, 2] in got
+        assert len(got) == 3
+
+    def test_full_outer(self):
+        got = self._join(JoinType.FULL_OUTER).execute(LEFT, RIGHT)
+        assert len(got) == 4
+        assert [2, 0.3, 0.4, None, None] in got
+        assert [4, None, None, 40.0, 2] in got
+
+    def test_duplicate_matches_cross_product(self):
+        left, right = _schemas()
+        j = (Join.Builder(JoinType.INNER).setSchemas(left, right)
+             .setKeyColumns("id").build())
+        got = j.execute([[1, 0.0, 0.0]], [[1, 5.0, 0], [1, 6.0, 1]])
+        assert got == [[1, 0.0, 0.0, 5.0, 0], [1, 0.0, 0.0, 6.0, 1]]
+
+    def test_mismatched_key_arity_rejected(self):
+        left, right = _schemas()
+        with pytest.raises(ValueError, match="arity"):
+            Join(JoinType.INNER, left, right, ["id"], ["id", "x3"])
+
+    def test_duplicate_noncol_names_rejected(self):
+        left = Schema.Builder().addColumnInteger("id") \
+            .addColumnDouble("v").build()
+        right = Schema.Builder().addColumnInteger("id") \
+            .addColumnDouble("v").build()
+        j = Join(JoinType.INNER, left, right, ["id"], ["id"])
+        with pytest.raises(ValueError, match="duplicate"):
+            j.getOutputSchema()
+
+    def test_join_feeds_iterator_end_to_end(self):
+        """Joined records -> TransformProcess -> DataSetIterator (the
+        SURVEY §2.4 'done' path)."""
+        left, right = _schemas()
+        join = (Join.Builder(JoinType.INNER).setSchemas(left, right)
+                .setKeyColumns("id").build())
+        joined = executeJoin(join,
+                             CollectionRecordReader(LEFT),
+                             CollectionRecordReader(RIGHT))
+        tp = (TransformProcess.Builder(join.getOutputSchema())
+              .removeColumns("id")
+              .build())
+        reader = TransformProcessRecordReader(
+            CollectionRecordReader(joined), tp)
+        it = RecordReaderDataSetIterator(
+            reader, batchSize=2, labelIndex=3, numPossibleLabels=2)
+        ds = it.next()
+        np.testing.assert_allclose(
+            np.asarray(ds.getFeatures()),
+            [[0.1, 0.2, 10.0], [0.5, 0.6, 30.0]], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ds.getLabels()), [[1, 0], [0, 1]])
+
+
+class TestAnalyzeLocal:
+    def test_numeric_and_categorical_stats(self):
+        schema = (Schema.Builder()
+                  .addColumnDouble("v")
+                  .addColumnCategorical("c", "a", "b")
+                  .addColumnString("s")
+                  .build())
+        recs = [[1.0, "a", "x"], [2.0, "b", "y"], [3.0, "a", "x"],
+                [None, "a", ""]]
+        an = AnalyzeLocal.analyze(schema, recs)
+        v = an.getColumnAnalysis("v")
+        assert v.getMin() == 1.0 and v.getMax() == 3.0
+        assert v.getMean() == pytest.approx(2.0)
+        assert v.getSampleStdev() == pytest.approx(1.0)
+        assert v.countTotal == 4 and v.countMissing == 1
+        c = an.getColumnAnalysis("c")
+        assert c.getUnique() == 2
+        assert c.getMapOfUniqueToCount() == {"a": 3, "b": 1}
+        s = an.getColumnAnalysis("s")
+        assert s.getUnique() == 2 and s.countMissing == 1
+        assert "DataAnalysis" in repr(an)
+
+    def test_reader_source_and_width_check(self):
+        schema = Schema.Builder().addColumnDouble("v").build()
+        reader = CollectionRecordReader([[1.5], [2.5]])
+        an = AnalyzeLocal.analyze(schema, reader)
+        assert an.getColumnAnalysis("v").getMean() == pytest.approx(2.0)
+        with pytest.raises(ValueError, match="width"):
+            AnalyzeLocal.analyze(schema, [[1.0, 2.0]])
