@@ -840,11 +840,18 @@ def _gru_cell(x, h_prev, w, r, b=None):
 
 
 @op("gruLayer")
-def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
+def _gru_layer(x, w, r, b=None, h0=None, unroll=4, resetAfter=True,
+               activation="tanh"):
     """Input projection hoisted out of the scan (same lowering as
     lstmLayer); the reset-gated candidate keeps only h@r sequential.
     On TPU the Pallas recurrence kernel (kernels/gru.py) takes over when
-    shapes allow."""
+    shapes allow.
+
+    Gate layout [reset | update | candidate]. resetAfter=True (cuDNN /
+    Keras v2 convention): candidate = tanh(c_w + r * (h@Rc + rb_c)),
+    bias b is [3H input || 3H recurrent]. resetAfter=False (classic
+    Cho et al. / Keras reset_after=False): candidate =
+    tanh(c_w + (r*h)@Rc), bias b is 3H input-side only."""
     n, _, t = x.shape
     hsz = r.shape[0]
     if h0 is None:
@@ -853,13 +860,27 @@ def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
     xw = xs @ w                           # [T, N, 3H] — one MXU matmul
     if b is not None:
         xw = xw + b[: 3 * hsz]
-    rb = None if b is None else b[3 * hsz:]
+    rb = b[3 * hsz:] if b is not None and b.shape[0] > 3 * hsz else None
+    act = OPS[activation]
+
+    if not resetAfter:
+        def step_before(h, xw_t):
+            ru_w, c_w = xw_t[..., : 2 * hsz], xw_t[..., 2 * hsz:]
+            ru = jax.nn.sigmoid(ru_w + h @ r[:, : 2 * hsz])
+            rgate, ugate = ru[..., :hsz], ru[..., hsz:]
+            cand = act(c_w + (rgate * h) @ r[:, 2 * hsz:])
+            h2 = ugate * h + (1.0 - ugate) * cand
+            return h2, h2
+
+        hT, hs = lax.scan(step_before, h0, xw, unroll=min(unroll, t))
+        return jnp.moveaxis(hs, 0, 2), hT
 
     import os as _os
 
     from deeplearning4j_tpu.kernels.gru import gru_seq, gru_seq_available
 
     if (jax.default_backend() == "tpu"
+            and activation == "tanh"  # the Pallas kernel fixes tanh
             and gru_seq_available(n, hsz, x.dtype)
             and r.dtype == jnp.float32
             and _os.environ.get("DL4J_DISABLE_PALLAS_GRU") != "1"):
@@ -877,7 +898,7 @@ def _gru_layer(x, w, r, b=None, h0=None, unroll=4):
         ru_r, c_r = rz[..., : 2 * hsz], rz[..., 2 * hsz:]
         ru = jax.nn.sigmoid(ru_w + ru_r)
         rgate, ugate = ru[..., :hsz], ru[..., hsz:]
-        cand = jnp.tanh(c_w + rgate * c_r)
+        cand = act(c_w + rgate * c_r)
         h2 = ugate * h + (1.0 - ugate) * cand
         return h2, h2
 

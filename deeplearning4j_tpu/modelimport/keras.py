@@ -29,11 +29,11 @@ import json
 import numpy as np
 
 from deeplearning4j_tpu.nn import (
-    ActivationLayer, BatchNormalization, ComputationGraph, ConvolutionLayer,
-    DenseLayer, DropoutLayer, EmbeddingSequenceLayer, InputType,
-    LastTimeStep, LossFunction, LSTM, MergeVertex, MultiLayerNetwork,
-    NeuralNetConfiguration, OutputLayer, RnnOutputLayer, SimpleRnn,
-    SubsamplingLayer)
+    ActivationLayer, BatchNormalization, Bidirectional, ComputationGraph,
+    ConvolutionLayer, DenseLayer, DropoutLayer, EmbeddingSequenceLayer,
+    GRU, InputType, LastTimeStep, LossFunction, LSTM, MergeVertex,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+    SimpleRnn, SubsamplingLayer)
 from deeplearning4j_tpu.nn.conf.layers import PoolingType
 
 _ACTIVATIONS = {
@@ -171,13 +171,61 @@ def _convert_layer(class_name, kc, is_last, prev_returns_sequences):
     if class_name == "Embedding":
         return EmbeddingSequenceLayer.Builder() \
             .nIn(kc["input_dim"]).nOut(kc["output_dim"]).build()
-    if class_name in ("LSTM", "SimpleRNN"):
-        cls = LSTM if class_name == "LSTM" else SimpleRnn
-        act = _act(kc.get("activation", "tanh"))
-        rnn = cls.Builder().nOut(kc["units"]).activation(act).build()
+    if class_name in ("LSTM", "SimpleRNN", "GRU"):
+        if class_name == "GRU":
+            # a config MISSING these keys is a pre-TF2 Keras save whose
+            # actual defaults were hard_sigmoid gates and
+            # reset_after=False — don't silently assume TF2 semantics
+            ract = kc.get("recurrent_activation")
+            if ract != "sigmoid":
+                raise ValueError(
+                    f"GRU recurrent_activation={ract!r} unsupported — "
+                    "the gruLayer op computes exact sigmoid gates "
+                    "(Keras-2-era hard_sigmoid, the default when the "
+                    "key is absent, would silently diverge); re-export "
+                    "with recurrent_activation='sigmoid'")
+            rnn = (GRU.Builder()
+                   .nOut(kc["units"])
+                   .resetAfter(kc.get("reset_after", False))
+                   .activation(_act(kc.get("activation", "tanh")))
+                   .build())
+        elif class_name == "LSTM":
+            # Keras bakes unit_forget_bias into the SAVED bias; the
+            # DSL's runtime forgetGateBiasInit add must be zero or the
+            # forget gate would get +1 twice
+            rnn = (LSTM.Builder().nOut(kc["units"])
+                   .activation(_act(kc.get("activation", "tanh")))
+                   .forgetGateBiasInit(0.0).build())
+        else:
+            rnn = (SimpleRnn.Builder().nOut(kc["units"])
+                   .activation(_act(kc.get("activation", "tanh")))
+                   .build())
         if not kc.get("return_sequences", False):
             return LastTimeStep(rnn)
         return rnn
+    if class_name == "Bidirectional":
+        inner_spec = kc["layer"]
+        inner_cn = inner_spec["class_name"]
+        inner_kc = inner_spec.get("config", {})
+        if inner_cn not in ("LSTM", "SimpleRNN", "GRU"):
+            raise ValueError(
+                f"Bidirectional wraps {inner_cn}, not a supported RNN")
+        if not inner_kc.get("return_sequences", False):
+            raise ValueError(
+                "Bidirectional with return_sequences=False is not "
+                "importable: Keras concatenates the forward layer's "
+                "LAST step with the backward layer's FIRST — re-export "
+                "with return_sequences=True (+ pooling) instead")
+        rnn = _convert_layer(inner_cn, inner_kc, False, False)
+        mode = {"concat": Bidirectional.CONCAT, "sum": Bidirectional.ADD,
+                "ave": Bidirectional.AVERAGE,
+                "mul": Bidirectional.MUL}.get(
+                    kc.get("merge_mode", "concat"))
+        if mode is None:
+            raise ValueError(
+                f"Bidirectional merge_mode={kc.get('merge_mode')!r} "
+                "unsupported")
+        return Bidirectional(rnn=rnn, mode=mode)
     if class_name in ("GlobalAveragePooling2D", "GlobalMaxPooling2D"):
         from deeplearning4j_tpu.nn import GlobalPoolingLayer
 
@@ -340,7 +388,8 @@ def _build_sequential(cfg, weights) -> MultiLayerNetwork:
 
     # find the index of the last WEIGHTED layer (it becomes the output)
     last_w = max(i for i, (cn, _kc, _n) in enumerate(specs)
-                 if cn in ("Dense", "Conv2D", "LSTM", "SimpleRNN"))
+                 if cn in ("Dense", "Conv2D", "LSTM", "SimpleRNN", "GRU",
+                           "Bidirectional"))
 
     built = []
     names = []
@@ -422,10 +471,40 @@ def _build_functional(cfg, weights) -> ComputationGraph:
 # weight installation
 # ---------------------------------------------------------------------------
 
+def _gru_gate_perm(a):
+    """Keras GRU gate blocks [z | r | h] -> gruLayer's [r | u | c]."""
+    h3 = a.shape[-1]
+    if h3 % 3:
+        raise ValueError(f"GRU weight last dim {h3} not divisible by 3")
+    h = h3 // 3
+    return np.concatenate(
+        [a[..., h:2 * h], a[..., :h], a[..., 2 * h:]], axis=-1)
+
+
 def _convert_weights(layer, arrs):
     """Keras weight list -> our param dict for one layer."""
     if isinstance(layer, LastTimeStep):
         return _convert_weights(layer.rnn, arrs)
+    if isinstance(layer, Bidirectional):
+        # Keras stores [forward weights..., backward weights...]
+        half = len(arrs) // 2
+        return {"fwd": _convert_weights(layer.rnn, arrs[:half]),
+                "bwd": _convert_weights(layer.rnn, arrs[half:])}
+    if isinstance(layer, GRU):
+        out = {"W": _gru_gate_perm(arrs[0]),
+               "R": _gru_gate_perm(arrs[1])}
+        h = arrs[1].shape[0]
+        if len(arrs) > 2:
+            b = np.asarray(arrs[2])
+            if b.ndim == 2:   # reset_after=True: [input_bias, rec_bias]
+                out["b"] = np.concatenate(
+                    [_gru_gate_perm(b[0]), _gru_gate_perm(b[1])])
+            else:             # reset_after=False: input bias only
+                out["b"] = _gru_gate_perm(b)
+        else:
+            out["b"] = np.zeros(
+                (6 * h if layer.resetAfter else 3 * h,), np.float32)
+        return out
     from deeplearning4j_tpu.nn import SeparableConvolution2D
 
     if isinstance(layer, SeparableConvolution2D):
@@ -505,7 +584,11 @@ def _set_params(net_set_param, layer, idx_or_name, arrs, set_state):
         if k in conv:
             state[k.lstrip("_")] = conv.pop(k)
     for k, v in conv.items():
-        net_set_param(idx_or_name, k, np.asarray(v, np.float32))
+        if isinstance(v, dict):  # nested group (Bidirectional fwd/bwd)
+            net_set_param(idx_or_name, k, {
+                kk: np.asarray(vv, np.float32) for kk, vv in v.items()})
+        else:
+            net_set_param(idx_or_name, k, np.asarray(v, np.float32))
     if state:
         set_state(idx_or_name, state)
 

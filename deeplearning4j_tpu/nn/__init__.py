@@ -15,7 +15,8 @@ from deeplearning4j_tpu.nn.conf.layers import (  # noqa: F401
     ActivationLayer, BatchNormalization, Bidirectional, Convolution1DLayer,
     ConvolutionLayer, ConvolutionMode, Deconvolution2D, DenseLayer,
     DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
-    GravesLSTM, LastTimeStep, LocalResponseNormalization, LossLayer, LSTM,
+    GravesLSTM, GRU, LastTimeStep, LocalResponseNormalization, LossLayer,
+    LSTM,
     DepthToSpace, OutputLayer, PoolingType, RnnOutputLayer,
     DepthwiseConvolution2D, SeparableConvolution2D, SimpleRnn, SpaceToDepth, Subsampling1DLayer,
     SubsamplingLayer, Upsampling2D, ZeroPaddingLayer)

@@ -892,6 +892,56 @@ class GravesLSTM(LSTM):
 
 
 @_register
+class GRU(BaseLayer):
+    """Gated recurrent unit (reference: conf.layers.recurrent.GRU /
+    libnd4j gruCell+gruLayer declarables, SURVEY.md §2.1). Backed by the
+    gruLayer op (input projection hoisted to one MXU matmul; Pallas
+    recurrence kernel on TPU when shapes allow). resetAfter=True is the
+    cuDNN/Keras-v2 bias convention (b holds [3H input || 3H recurrent]);
+    False is the classic Cho et al. form (3H input bias only)."""
+
+    def __init__(self, nIn=None, nOut=None, resetAfter=True, **kw):
+        super().__init__(**kw)
+        self.nIn = nIn
+        self.nOut = nOut
+        self.resetAfter = resetAfter
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def infer(self, input_type):
+        self.nIn = self.nIn or input_type.size
+        t = getattr(input_type, "timeSeriesLength", None)
+        return InputType.recurrent(self.nOut, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        h = self.nOut
+        nb = 6 * h if self.resetAfter else 3 * h
+        return {
+            "W": init_weight(self.weightInit, k1, (self.nIn, 3 * h),
+                             self.nIn, h, dtype),
+            "R": init_weight(self.weightInit, k2, (h, 3 * h), h, h, dtype),
+            "b": jnp.zeros((nb,), dtype),
+        }
+
+    IS_RECURRENT = True
+
+    def apply(self, params, state, x, training, rng):
+        x = self._dropout(x, training, rng)
+        h0 = state.get("h") if isinstance(state, dict) else None
+        out, hT = OPS["gruLayer"](x, params["W"], params["R"],
+                                  params["b"], h0=h0,
+                                  resetAfter=self.resetAfter,
+                                  activation=self.activation)
+        if h0 is not None:
+            return out, {"h": hT}
+        return out, state
+
+    def streaming_state(self, batch_size, dtype=jnp.float32):
+        return {"h": jnp.zeros((batch_size, self.nOut), dtype)}
+
+
+@_register
 class SimpleRnn(BaseLayer):
     def __init__(self, nIn=None, nOut=None, **kw):
         super().__init__(**kw)

@@ -623,7 +623,11 @@ class MultiLayerNetwork:
         return INDArray(self._params[layer_idx][name])
 
     def setParam(self, layer_idx: int, name: str, value):
-        self._params[layer_idx][name] = _unwrap(value)
+        if isinstance(value, dict):  # nested group (Bidirectional fwd/bwd)
+            self._params[layer_idx][name] = {
+                k: _unwrap(v) for k, v in value.items()}
+        else:
+            self._params[layer_idx][name] = _unwrap(value)
 
     def paramTable(self) -> dict:
         return {f"{i}_{k}": INDArray(v)

@@ -77,6 +77,21 @@ class TestGradientChecks:
         _check(conf, (4, 5), 3, subset=20)
 
     @pytest.mark.slow
+    def test_gru(self):
+        from deeplearning4j_tpu.nn import GRU
+
+        for reset_after in (True, False):
+            conf = (_base().list()
+                    .layer(GRU.Builder().nOut(4)
+                           .resetAfter(reset_after).build())
+                    .layer(RnnOutputLayer.Builder().nOut(3)
+                           .activation("softmax")
+                           .lossFunction("mcxent").build())
+                    .setInputType(InputType.recurrent(3, 5))
+                    .build())
+            _check(conf, (2, 3, 5), 3, rnn=True, subset=15)
+
+    @pytest.mark.slow
     def test_lstm(self):
         conf = (_base().list()
                 .layer(LSTM.Builder().nOut(4).build())

@@ -372,3 +372,231 @@ class TestDepthwiseConv2DImport:
                        else acts[1])
         expect = (x[0, 0, 1:4, 1:4] * dw[:, :, 0, 1].T.T).sum() + db[1]
         assert y[0, 1, 2, 2] == pytest.approx(expect, rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# r5: Bidirectional + GRU import (VERDICT r4 item 6)
+# ---------------------------------------------------------------------------
+
+def _np_gru(x_tc, K, R, b, reset_after):
+    """Keras GRU forward, time-major x [T, I]; gate blocks [z | r | h]."""
+    H = R.shape[0]
+    h = np.zeros((H,), np.float32)
+    if reset_after:
+        bi, br = b[0], b[1]
+    else:
+        bi, br = b, np.zeros((3 * H,), np.float32)
+    Kz, Kr, Kh = K[:, :H], K[:, H:2 * H], K[:, 2 * H:]
+    Rz, Rr, Rh = R[:, :H], R[:, H:2 * H], R[:, 2 * H:]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    outs = []
+    for x in x_tc:
+        z = sig(x @ Kz + h @ Rz + bi[:H] + br[:H])
+        r = sig(x @ Kr + h @ Rr + bi[H:2 * H] + br[H:2 * H])
+        if reset_after:
+            hh = np.tanh(x @ Kh + bi[2 * H:] + r * (h @ Rh + br[2 * H:]))
+        else:
+            hh = np.tanh(x @ Kh + bi[2 * H:] + (r * h) @ Rh)
+        h = z * h + (1.0 - z) * hh
+        outs.append(h)
+    return np.stack(outs)  # [T, H]
+
+
+def _np_lstm(x_tc, K, R, b):
+    """Keras LSTM forward, [T, I]; gate blocks [i | f | c | o]."""
+    H = R.shape[0]
+    h = np.zeros((H,), np.float32)
+    c = np.zeros((H,), np.float32)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    outs = []
+    for x in x_tc:
+        zz = x @ K + h @ R + b
+        i, f = sig(zz[:H]), sig(zz[H:2 * H])
+        g, o = np.tanh(zz[2 * H:3 * H]), sig(zz[3 * H:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs)
+
+
+def _gru_cfg(name, units, reset_after, return_sequences,
+             input_shape=None):
+    cfg = {"name": name, "units": units, "activation": "tanh",
+           "recurrent_activation": "sigmoid", "use_bias": True,
+           "reset_after": reset_after,
+           "return_sequences": return_sequences}
+    if input_shape is not None:
+        cfg["batch_input_shape"] = [None] + list(input_shape)
+    return {"class_name": "GRU", "config": cfg}
+
+
+class TestGruImport:
+    def _run(self, reset_after, tmp_path):
+        rng = np.random.default_rng(5)
+        T, I, H = 6, 4, 5
+        K = rng.normal(size=(I, 3 * H)).astype(np.float32) * 0.5
+        R = rng.normal(size=(H, 3 * H)).astype(np.float32) * 0.5
+        b = (rng.normal(size=(2, 3 * H)) if reset_after
+             else rng.normal(size=(3 * H,))).astype(np.float32) * 0.3
+        Wd = rng.normal(size=(H, 3)).astype(np.float32)
+        bd = rng.normal(size=(3,)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            _gru_cfg("gru", H, reset_after, False, input_shape=[T, I]),
+            _dense_cfg("out", 3, "softmax"),
+        ]}}
+        p = tmp_path / f"gru_{reset_after}.h5"
+        _write_h5(p, cfg, {
+            "gru": [("kernel:0", K), ("recurrent_kernel:0", R),
+                    ("bias:0", b)],
+            "out": [("kernel:0", Wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        x = rng.normal(size=(2, I, T)).astype(np.float32)  # our NCW
+        out = np.asarray(net.output(x))
+        for n in range(2):
+            hs = _np_gru(x[n].T, K, R, b, reset_after)   # [T, H]
+            logits = hs[-1] @ Wd + bd
+            e = np.exp(logits - logits.max())
+            np.testing.assert_allclose(out[n], e / e.sum(),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_reset_after_true_matches_keras_math(self, tmp_path):
+        self._run(True, tmp_path)
+
+    def test_reset_after_false_matches_keras_math(self, tmp_path):
+        self._run(False, tmp_path)
+
+
+class TestBidirectionalImport:
+    def test_bilstm_gru_stack_matches_keras_math(self, tmp_path):
+        rng = np.random.default_rng(9)
+        T, I, H, G = 5, 3, 4, 6
+        Kf = rng.normal(size=(I, 4 * H)).astype(np.float32) * 0.5
+        Rf = rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.5
+        bf = rng.normal(size=(4 * H,)).astype(np.float32) * 0.3
+        Kb = rng.normal(size=(I, 4 * H)).astype(np.float32) * 0.5
+        Rb = rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.5
+        bb = rng.normal(size=(4 * H,)).astype(np.float32) * 0.3
+        Kg = rng.normal(size=(2 * H, 3 * G)).astype(np.float32) * 0.4
+        Rg = rng.normal(size=(G, 3 * G)).astype(np.float32) * 0.4
+        bg = rng.normal(size=(2, 3 * G)).astype(np.float32) * 0.3
+        Wd = rng.normal(size=(G, 2)).astype(np.float32)
+        bd = rng.normal(size=(2,)).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "concat",
+                "batch_input_shape": [None, T, I],
+                "layer": {"class_name": "LSTM", "config": {
+                    "units": H, "activation": "tanh",
+                    "return_sequences": True}}}},
+            _gru_cfg("gru", G, True, False),
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "bilstm.h5"
+        _write_h5(p, cfg, {
+            "bi": [("fw/kernel:0", Kf), ("fw/recurrent_kernel:0", Rf),
+                   ("fw/bias:0", bf), ("bw/kernel:0", Kb),
+                   ("bw/recurrent_kernel:0", Rb), ("bw/bias:0", bb)],
+            "gru": [("kernel:0", Kg), ("recurrent_kernel:0", Rg),
+                    ("bias:0", bg)],
+            "out": [("kernel:0", Wd), ("bias:0", bd)]})
+        net = KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+        x = rng.normal(size=(2, I, T)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        for n in range(2):
+            xf = x[n].T                            # [T, I]
+            hf = _np_lstm(xf, Kf, Rf, bf)          # [T, H]
+            hb = _np_lstm(xf[::-1], Kb, Rb, bb)[::-1]
+            seq = np.concatenate([hf, hb], axis=1)  # [T, 2H]
+            hg = _np_gru(seq, Kg, Rg, bg, True)
+            logits = hg[-1] @ Wd + bd
+            e = np.exp(logits - logits.max())
+            np.testing.assert_allclose(out[n], e / e.sum(),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_return_sequences_false_rejected(self, tmp_path):
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "concat",
+                "batch_input_shape": [None, 4, 3],
+                "layer": {"class_name": "LSTM", "config": {
+                    "units": 4, "return_sequences": False}}}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "bad.h5"
+        _write_h5(p, cfg, {})
+        with pytest.raises(ValueError, match="return_sequences"):
+            KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+
+    def test_unsupported_merge_mode_rejected(self, tmp_path):
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Bidirectional", "config": {
+                "name": "bi", "merge_mode": "weird",
+                "batch_input_shape": [None, 4, 3],
+                "layer": {"class_name": "LSTM", "config": {
+                    "units": 4, "return_sequences": True}}}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "bad2.h5"
+        _write_h5(p, cfg, {})
+        with pytest.raises(ValueError, match="merge_mode"):
+            KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+
+
+class TestR5ReviewFixes:
+    def test_hard_sigmoid_gru_rejected(self, tmp_path):
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "GRU", "config": {
+                "name": "g", "units": 4, "activation": "tanh",
+                "recurrent_activation": "hard_sigmoid",
+                "reset_after": True, "return_sequences": False,
+                "batch_input_shape": [None, 4, 3]}},
+            _dense_cfg("out", 2, "softmax"),
+        ]}}
+        p = tmp_path / "hs.h5"
+        _write_h5(p, cfg, {})
+        with pytest.raises(ValueError, match="hard_sigmoid"):
+            KerasModelImport.importKerasSequentialModelAndWeights(str(p))
+
+    def test_gru_candidate_activation_plumbs_through(self):
+        """GRU activation='relu' must actually change the candidate
+        activation (it was silently tanh)."""
+        from deeplearning4j_tpu.autodiff.ops import OPS
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        W = rng.normal(size=(3, 12)).astype(np.float32)
+        R = rng.normal(size=(4, 12)).astype(np.float32)
+        b = np.zeros(24, np.float32)
+        out_t, _ = OPS["gruLayer"](x, W, R, b, activation="tanh")
+        out_r, _ = OPS["gruLayer"](x, W, R, b, activation="relu")
+        assert not np.allclose(np.asarray(out_t), np.asarray(out_r))
+
+    def test_bidirectional_net_zip_roundtrip(self, tmp_path):
+        """Nested fwd/bwd param groups must survive the single-file
+        (zip) ModelSerializer path (np.savez cannot hold dicts)."""
+        from deeplearning4j_tpu.nn import (
+            Bidirectional, GlobalPoolingLayer, InputType, LossFunction,
+            LSTM, MultiLayerNetwork, NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.nn.conf.layers import PoolingType
+        from deeplearning4j_tpu.optimize.updaters import Adam
+        from deeplearning4j_tpu.utils import ModelSerializer
+
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .updater(Adam(1e-2)).list()
+                .layer(Bidirectional(rnn=LSTM(nOut=6), mode="concat"))
+                .layer(GlobalPoolingLayer.Builder()
+                       .poolingType(PoolingType.AVG).build())
+                .layer(OutputLayer.Builder().nOut(2)
+                       .activation("softmax")
+                       .lossFunction(LossFunction.MCXENT).build())
+                .setInputType(InputType.recurrent(3, 7)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        p = str(tmp_path / "bi.zip")
+        ModelSerializer.writeModel(net, p, saveUpdater=False)
+        net2 = ModelSerializer.restoreMultiLayerNetwork(p,
+                                                        loadUpdater=False)
+        x = np.random.default_rng(1).normal(size=(2, 3, 7)) \
+            .astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(net2.output(x)), rtol=1e-5)
