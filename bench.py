@@ -344,7 +344,11 @@ def bench_word2vec(total_words=10_000_000):
     # Primitive roofline (r4, slope-timed: tools/probe_scatter.py):
     # sorted row scatter sustains ~125M rows/s; each pair moves
     # ~2*(2+k_neg) rows (gather + scatter across both tables), ~3.8
-    # pairs/word after subsampling at window 5
+    # pairs/word after subsampling at window 5. r5 correction: at the
+    # production batch width the scatter phase already RUNS at that
+    # roofline (0.32 ms for 57k rows/step) — the binding bound is the
+    # step's gather/einsum math floor plus scan overhead, not the
+    # scatter (tools/probe_w2v_step.py E vs A variants).
     k_neg, pairs_per_word = 5, 3.8
     rows_per_word = pairs_per_word * 2 * (2 + k_neg)
     roof_wps = 125e6 / rows_per_word
@@ -356,14 +360,19 @@ def bench_word2vec(total_words=10_000_000):
         "corpus_words": total_words,
         "scatter_roofline_words_per_sec": round(roof_wps, 1),
         "frac_of_roofline": round(wps / roof_wps, 4),
-        "bound": ("epoch = device pair-gen (~4.4s/10M words) + the "
-                  "training scan (~8.9s: sorted analytic-gradient row "
-                  "updates at 4.3-4.6M pairs/s, ~2x the 125M-rows/s "
-                  "sorted-scatter roofline; tools/probe_sgns.py, "
-                  "tools/probe_scatter.py). Host numpy reference on "
-                  "this 1-core host: ~24k words/s (26x slower). r3's "
-                  "'1.8M pairs/s scatter bound' was an RTT-polluted "
-                  "measurement (ROUND4_NOTES)"),
+        "bound": ("r5 epoch = ~2.0s fully-device ETL (subsample + "
+                  "slice-shift windows + compaction; was 4.4s device + "
+                  "~3.5s host in r4) + ~7.4s training scan at 1.57 "
+                  "ms/step (pooled negatives; per-step floor: 0.49 ms "
+                  "gather/einsum math + 0.32 ms sort+scatter, scatter "
+                  "AT its 125M rows/s roofline). Probes: "
+                  "tools/probe_w2v_step.py (batch sweep peaks at 8192; "
+                  "segment-sum dedup, unsorted scatter, bulk-draw "
+                  "hoist, scan unroll all measured slower), "
+                  "tools/probe_w2v_pairgen.py (scalar gathers 0.19 "
+                  "GB/s -> slice-shifts; searchsorted and row-scatter "
+                  "compaction 4-10x slower). Host numpy reference on "
+                  "this 1-core host: ~24k words/s."),
     }
 
 

@@ -188,6 +188,17 @@ class Word2Vec:
             self._kw["sampling"] = s
             return self
 
+        def exactNegatives(self, b=True):
+            """Draw fresh negatives for every pair inside every step
+            (the r4 semantics). Default OFF: negatives come from a
+            per-launch pool of iid unigram^0.75 draws, each step
+            slicing a pseudo-random window — 0.65 ms/step cheaper on
+            the tunnel-attached chip (tools/probe_w2v_step.py), same
+            marginal distribution, but pool windows can overlap across
+            steps."""
+            self._kw["exactNegatives"] = bool(b)
+            return self
+
         def elementsLearningAlgorithm(self, name):
             self._kw["algorithm"] = ("cbow" if "cbow" in str(name).lower()
                                      else "skipgram")
@@ -317,35 +328,57 @@ class Word2Vec:
         new_offsets = csum[offsets]
         return kept.astype(np.int32), new_offsets
 
-    # -- device-side pair generation (r4) -----------------------------------
-    def _build_pairgen(self):
-        """Jitted skip-gram pair generation + stream compaction ON
-        DEVICE: the host uploads only the ~30 MB subsampled corpus (plus
-        sentence ids), not the ~465 MB of materialized (center, context,
-        weight) batches — whose transfer through the tunnel's
-        host-side compression was measured at 2x the whole training
-        scan's cost on this 1-core host (ROUND4_NOTES).
+    # -- device-side pair generation (r4, reworked r5) ----------------------
+    def _build_pairgen(self, subsample: bool):
+        """Jitted per-epoch ETL entirely ON DEVICE: frequent-word
+        subsampling (bernoulli keep + stream compaction of the token
+        stream), then skip-gram pair generation + pair compaction. The
+        host uploads the tokenized corpus ONCE across all epochs; the
+        r4 design re-uploaded the host-subsampled corpus every epoch
+        and spent ~3.5 s/epoch of a 10M-word fit in host numpy +
+        tunnel transfer (r5 phase instrumentation).
 
-        Semantics match the host pair-gen exactly: per-position window
-        radius b ~ U[1, W], contexts pos+d for 0 < |d| <= b within the
-        same sentence, pairs emitted in corpus order (position-major,
-        d ascending). Compaction is cumsum + unique-index scatter; the
-        invalid slots' scatter targets fall off the end and are dropped.
-        """
+        Semantics match the host pair-gen: subsample-then-window (the
+        window closes over removed tokens), per-position window radius
+        b ~ U[1, W], contexts pos+d for 0 < |d| <= b within the same
+        sentence, pairs emitted in corpus order (position-major, d
+        ascending). Compaction is cumsum + unique-index scatter; the
+        invalid slots' scatter targets fall off the end and are
+        dropped."""
         w = self.cfg["windowSize"]
 
-        def gen(flat, sid, key):
+        def shift(a, d):
+            """a[clip(pos+d, 0, p-1)] as slice+concat: TPU scalar
+            gathers measured ~0.19 GB/s on this chip where slices run
+            at full bandwidth — the r4 gather formulation spent ~3.4 s
+            of the 4.4 s pair-gen in 10 shifted gathers
+            (tools/probe_w2v_pairgen.py, r5)."""
+            p = a.shape[0]
+            if d > 0:
+                return jnp.concatenate(
+                    [a[d:], jnp.broadcast_to(a[-1:], (d,))])
+            return jnp.concatenate(
+                [jnp.broadcast_to(a[:1], (-d,)), a[:d]])
+
+        def gen(flat, sid, keep_prob, key_sub, key_b):
             p = flat.shape[0]
+            if subsample:
+                u = jax.random.uniform(key_sub, (p,))
+                keep = (sid >= 0) & (u < keep_prob[flat])
+                dest, _nk = _compaction_dests(keep, p)
+                flat = jnp.zeros((p,), jnp.int32).at[dest].set(
+                    flat, mode="drop", unique_indices=True)
+                sid = jnp.full((p,), -1, jnp.int32).at[dest].set(
+                    sid, mode="drop", unique_indices=True)
             pos = jnp.arange(p, dtype=jnp.int32)
-            b = jax.random.randint(key, (p,), 1, w + 1)
+            b = jax.random.randint(key_b, (p,), 1, w + 1)
             cents, ctxs, vals = [], [], []
             for d in (*range(-w, 0), *range(1, w + 1)):
-                j = jnp.clip(pos + d, 0, p - 1)
-                valid = ((sid >= 0) & (sid[j] == sid)
+                valid = ((sid >= 0) & (shift(sid, d) == sid)
                          & (jnp.abs(d) <= b)
                          & (pos + d >= 0) & (pos + d < p))
                 cents.append(flat)
-                ctxs.append(flat[j])
+                ctxs.append(shift(flat, d))
                 vals.append(valid)
             cent_s = jnp.stack(cents, 1).reshape(-1)
             ctx_s = jnp.stack(ctxs, 1).reshape(-1)
@@ -353,8 +386,10 @@ class Word2Vec:
             cap = cent_s.shape[0]
             dest, n_real = _compaction_dests(val_s, cap)
             # (a packed-slot single-scatter + gather-decode variant
-            # measured SLOWER than these two element scatters — the
-            # decode gathers over 75M slots cost more than one scatter)
+            # measured SLOWER than these two element scatters — r4; a
+            # [cap, 2] row-scatter variant measured 4x slower still,
+            # and scatter-free searchsorted compaction 10x slower —
+            # tools/probe_w2v_pairgen.py, r5)
             out_c = jnp.zeros((cap,), jnp.int32).at[dest].set(
                 cent_s, mode="drop", unique_indices=True)
             out_x = jnp.zeros((cap,), jnp.int32).at[dest].set(
@@ -364,29 +399,30 @@ class Word2Vec:
         return jax.jit(gen)
 
     def _device_pairs(self, rng):
-        """Subsample on host, generate + compact pairs on device.
-        Returns (cent_dev, ctx_dev, n_real) with cent/ctx length = the
-        padded slot capacity (first n_real entries are real)."""
-        flat, offsets = self._subsampled_flat(rng)
-        sid = np.repeat(
-            np.arange(len(offsets) - 1, dtype=np.int32),
-            np.diff(offsets))
-        # bucket the corpus length (2% margin, like the batch-count
-        # bucket) so subsampling jitter reuses one compiled pair-gen
-        p = len(flat)
-        p_b = -(-(p + max(1024, p // 50)) // 1024) * 1024
-        if getattr(self, "_p_bucket", None) is None or p_b > self._p_bucket:
-            self._p_bucket = p_b
-        p_b = self._p_bucket
-        flat_pad = np.zeros(p_b, np.int32)
-        flat_pad[:p] = flat
-        sid_pad = np.full(p_b, -1, np.int32)
-        sid_pad[:p] = sid
+        """Generate + compact the epoch's pairs on device (subsampling
+        included). Returns (cent_dev, ctx_dev, n_real) with cent/ctx
+        length = the padded slot capacity (first n_real are real)."""
+        flat, offsets, keep_prob = self._flat_token_cache()
+        if getattr(self, "_corpus_dev", None) is None:
+            sid = np.repeat(
+                np.arange(len(offsets) - 1, dtype=np.int32),
+                np.diff(offsets))
+            p_b = -(-max(1, len(flat)) // 1024) * 1024
+            flat_pad = np.zeros(p_b, np.int32)
+            flat_pad[:len(flat)] = flat
+            sid_pad = np.full(p_b, -1, np.int32)
+            sid_pad[:len(flat)] = sid
+            self._corpus_dev = (jax.device_put(flat_pad),
+                                jax.device_put(sid_pad))
+            self._keep_prob_dev = (
+                jax.device_put(keep_prob) if keep_prob is not None
+                else jnp.zeros((1,), jnp.float32))
         if getattr(self, "_pairgen_fn", None) is None:
-            self._pairgen_fn = self._build_pairgen()
-        key = jax.random.key(int(rng.integers(0, 2 ** 31)), impl="rbg")
+            self._pairgen_fn = self._build_pairgen(keep_prob is not None)
+        key_sub = jax.random.key(int(rng.integers(0, 2 ** 31)))
+        key_b = jax.random.key(int(rng.integers(0, 2 ** 31)), impl="rbg")
         cent, ctx, n = self._pairgen_fn(
-            jax.device_put(flat_pad), jax.device_put(sid_pad), key)
+            *self._corpus_dev, self._keep_prob_dev, key_sub, key_b)
         return cent, ctx, int(n)
 
     def _make_pairs_flat(self, flat, offsets, rng):
@@ -438,14 +474,77 @@ class Word2Vec:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def _build_multi_step(self):
+    def _build_multi_step_fused(self, k, bsz, n_pool):
         """Whole-epoch SGNS training in ONE device launch: lax.scan over
-        stacked [K, bsz] batches (same dispatch-amortization as
-        MultiLayerNetwork.fitMultiBatch — per-launch RPC latency exceeds
-        a whole SGNS step at default batch sizes). Negative draws happen
-        ON DEVICE inside the scan (uniform ints into the quantized
-        unigram table) — at 10M-word scale the host-drawn [K, bsz, k_neg]
-        tensor alone is ~1 GB/epoch of host RNG + upload."""
+        the epoch's [K, bsz] batches, sliced+reshaped from the pair-gen
+        output INSIDE the jit (r5: the separate pad/reshape/weights
+        prep launches were ~0.4 s/epoch of tunnel round-trips).
+
+        Negatives come from a per-launch POOL: one vectorized
+        randint+table-gather of n_pool draws, with each step taking a
+        pseudo-random contiguous slice. The r4 per-step fold_in +
+        randint + gather cost 0.65 ms of the 1.9 ms step
+        (tools/probe_w2v_step.py G variant) — a dynamic slice is free,
+        and each slice is still iid unigram^0.75 draws independent of
+        the step's pairs (windows may overlap across steps; set
+        exactNegatives(True) for per-step draws). Step losses are not
+        computed (nothing consumed them; the analytic gradients don't
+        need the loss value)."""
+        lr = self.cfg["learningRate"]
+        k_neg = self.cfg["negative"]
+        full = k * bsz
+
+        def many_fused(syn0, syn1, cent_all, ctx_all, n_real, table,
+                       key):
+            tsize = table.shape[0]
+            d = syn0.shape[1]
+            cent_k = cent_all[:full].reshape(k, bsz)
+            ctx_k = ctx_all[:full].reshape(k, bsz)
+            w_k = (jnp.arange(full, dtype=jnp.int32) < n_real) \
+                .astype(jnp.float32).reshape(k, bsz)
+            draws = jax.random.randint(key, (n_pool,), 0, tsize)
+            pool = table[draws]
+            span = bsz * k_neg
+
+            def body(carry, xs):
+                syn0, syn1, i = carry
+                cent, ctx, w = xs
+                off = (i.astype(jnp.uint32) * jnp.uint32(2654435761)
+                       % jnp.uint32(n_pool - span)).astype(jnp.int32)
+                negs = jax.lax.dynamic_slice(
+                    pool, (off,), (span,)).reshape(bsz, k_neg)
+                c = syn0[cent]
+                pos = syn1[ctx]
+                neg = syn1[negs]
+                pos_s = jnp.sum(c * pos, axis=-1)
+                neg_s = jnp.einsum("bd,bkd->bk", c, neg)
+                dpos = -(1.0 - jax.nn.sigmoid(pos_s)) * w
+                dneg = jax.nn.sigmoid(neg_s) * w[:, None]
+                gc = dpos[:, None] * pos + \
+                    jnp.einsum("bk,bkd->bd", dneg, neg)
+                o0 = jnp.argsort(cent)
+                syn0 = syn0.at[cent[o0]].add(
+                    -lr * gc[o0], indices_are_sorted=True)
+                ids1 = jnp.concatenate([ctx, negs.reshape(-1)])
+                u1 = jnp.concatenate([
+                    dpos[:, None] * c,
+                    (dneg[..., None] * c[:, None, :]).reshape(-1, d)])
+                o1 = jnp.argsort(ids1)
+                syn1 = syn1.at[ids1[o1]].add(
+                    -lr * u1[o1], indices_are_sorted=True)
+                return (syn0, syn1, i + 1), None
+
+            (syn0, syn1, _), _ = jax.lax.scan(
+                body, (syn0, syn1, jnp.int32(0)), (cent_k, ctx_k, w_k))
+            return syn0, syn1
+
+        return jax.jit(many_fused, donate_argnums=(0, 1),
+                       static_argnames=())
+
+    def _build_multi_step(self):
+        """Pre-r5 scan over host-prepared [K, bsz] batches with exact
+        per-step negative draws (exactNegatives(True) / shufflePairs
+        path)."""
         lr = self.cfg["learningRate"]
         k_neg = self.cfg["negative"]
 
@@ -557,6 +656,27 @@ class Word2Vec:
                     self._k_bucket = k
                 k = self._k_bucket
                 full = k * bsz
+                if device_etl and not self.cfg.get("exactNegatives"):
+                    # fused path: slice/reshape/weights + pooled
+                    # negatives inside ONE launch
+                    if full > cent_all.shape[0]:
+                        cent_all = jnp.pad(
+                            cent_all, (0, full - cent_all.shape[0]))
+                        ctx_all = jnp.pad(
+                            ctx_all, (0, full - ctx_all.shape[0]))
+                    pool = max(1 << 21, 2 * bsz * k_neg)
+                    if getattr(self, "_fused_fn", None) is None or \
+                            self._fused_sig != (k, bsz):
+                        self._fused_fn = self._build_multi_step_fused(
+                            k, bsz, pool)
+                        self._fused_sig = (k, bsz)
+                    for it in range(cfg["iterations"]):
+                        key = jax.random.key(
+                            int(rng.integers(0, 2**31)))
+                        syn0, syn1 = self._fused_fn(
+                            syn0, syn1, cent_all, ctx_all,
+                            jnp.int32(n), self._neg_table_dev, key)
+                    continue
                 if device_etl:
                     # first n slots are real pairs; the tail (and any
                     # slice beyond the compacted region) is zero-weighted
@@ -583,8 +703,11 @@ class Word2Vec:
                 if getattr(self, "_multi_fn", None) is None:
                     self._multi_fn = self._build_multi_step()
                 for it in range(cfg["iterations"]):
-                    key = jax.random.key(
-                        int(rng.integers(0, 2**31)), impl="rbg")
+                    # threefry, not rbg: the per-step fold_in+randint
+                    # inside the scan measured 0.26 ms/step cheaper
+                    # (1.55 vs 1.81 ms, tools/probe_w2v_step.py F
+                    # variants, r5) — rbg's fold_in is the slow part
+                    key = jax.random.key(int(rng.integers(0, 2**31)))
                     _losses, syn0, syn1 = self._multi_fn(
                         syn0, syn1, cent_k, ctx_k, w_k,
                         self._neg_table_dev, key)
