@@ -183,6 +183,7 @@ class ParallelImageDataSetIterator(DataSetIterator):
         self._queue = None
         self._live_workers = 0
         self._epoch = 0
+        self._tele = None  # loop instruments, bound on first next()
 
     def getLabels(self):
         return list(self._labels)
@@ -238,8 +239,19 @@ class ParallelImageDataSetIterator(DataSetIterator):
         return self._next_seq < self._n_batches
 
     def next(self):
+        import time
+
+        from deeplearning4j_tpu import telemetry
+
         if not self.hasNext():
             raise StopIteration
+        # bound once per iterator; while disabled this stays a single
+        # flag check per batch (loop_instruments returns None)
+        tele = self._tele
+        if tele is None:
+            tele = self._tele = telemetry.loop_instruments("image_etl")
+        if tele is not None:
+            t0 = time.perf_counter()
         if self._queue is None:
             self._start()
         if self._queue == "serial":
@@ -262,6 +274,11 @@ class ParallelImageDataSetIterator(DataSetIterator):
                 continue
             self._reorder[seq] = (a, b)
         feats, idxs = self._reorder.pop(self._next_seq)
+        if tele is not None:
+            # time this consumer spent blocked on the worker pool (decode
+            # wait), the per-batch analog of the trainers' etl metric
+            tele.record_etl_wait(time.perf_counter() - t0)
+            tele.examples.inc(feats.shape[0])
         self._next_seq += 1
         labels = np.zeros((feats.shape[0], len(self._labels)), np.float32)
         labels[np.arange(feats.shape[0]), idxs] = 1.0

@@ -230,7 +230,29 @@ class Word2Vec:
         self._k_bucket = None
 
     # -- vocab ---------------------------------------------------------------
+    def _invalidate_corpus_caches(self):
+        """Drop every token/corpus/pairgen cache derived from the current
+        sentences+vocab (ADVICE r5: the caches were never invalidated, so
+        refitting after a corpus or vocab change silently trained on the
+        stale uploaded corpus). Called by buildVocab(); call directly
+        after mutating `sentences` in place without rebuilding the
+        vocab."""
+        for attr in ("_tok_flat", "_tok_offsets", "_keep_prob",
+                     "_corpus_dev", "_keep_prob_dev", "_pairgen_fn",
+                     "_neg_table_dev", "_fused_fn", "_fused_sig"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+        # K-bucket / step fns are shape-keyed: a new corpus/vocab means
+        # new pair counts and possibly a new vocab size, so let them
+        # rebuild rather than reuse a stale bucket
+        self._k_bucket = None
+        self._step_fn = None
+        self._multi_fn = None
+
     def buildVocab(self):
+        self._invalidate_corpus_caches()
+        old_words = [w.word for w in self.vocab.words]
+        self.vocab = VocabCache()
         counts: dict[str, int] = {}
         for sent in self.sentences:
             for t in self.tokenizer.create(sent).getTokens():
@@ -242,6 +264,13 @@ class Word2Vec:
         if self.vocab.numWords() == 0:
             raise ValueError(
                 f"empty vocab: no word reaches minWordFrequency={min_f}")
+        if self.syn0 is not None and \
+                [w.word for w in self.vocab.words] != old_words:
+            # the word -> index mapping changed (size OR order OR
+            # membership): trained vectors no longer line up with
+            # indices — restart rather than silently misassign
+            self.syn0 = None
+            self.syn1 = None
         self._build_neg_tables()
         return self
 

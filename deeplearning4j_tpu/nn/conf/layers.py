@@ -896,11 +896,13 @@ class GRU(BaseLayer):
     """Gated recurrent unit (reference: conf.layers.recurrent.GRU /
     libnd4j gruCell+gruLayer declarables, SURVEY.md §2.1). Backed by the
     gruLayer op (input projection hoisted to one MXU matmul; Pallas
-    recurrence kernel on TPU when shapes allow). resetAfter=True is the
-    cuDNN/Keras-v2 bias convention (b holds [3H input || 3H recurrent]);
-    False is the classic Cho et al. form (3H input bias only)."""
+    recurrence kernel on TPU when shapes allow). resetAfter=False (the
+    default, matching the reference's gruCell/gruLayer classic Cho et
+    al. reset-before form with a 3H input bias); True is the
+    cuDNN/Keras-v2 convention (b holds [3H input || 3H recurrent]),
+    which the Keras importer selects explicitly from reset_after."""
 
-    def __init__(self, nIn=None, nOut=None, resetAfter=True, **kw):
+    def __init__(self, nIn=None, nOut=None, resetAfter=False, **kw):
         super().__init__(**kw)
         self.nIn = nIn
         self.nOut = nOut

@@ -275,12 +275,27 @@ class MultiLayerNetwork:
         if self._train_step is None:
             self._train_step = self._build_train_step()
 
+        import time as _time
+
+        from deeplearning4j_tpu import telemetry
+
         params, states, opts = self._params, self._states, self._opt_states
         base_key = jax.random.key(self.conf.seed + 1)
         last_loss = None
+        # one flag check per fit(): with telemetry disabled tele is None
+        # and the loop body makes zero registry calls per step
+        tele = telemetry.loop_instruments("fit")
         for epoch_i in range(epochs):
             batches, data = _prepare_batches(data, epoch_i, epochs)
-            for ds in batches:
+            batch_iter = iter(batches)
+            while True:
+                if tele is not None:
+                    t_etl = _time.perf_counter()
+                ds = next(batch_iter, None)
+                if ds is None:
+                    break
+                if tele is not None:
+                    tele.record_etl_wait(_time.perf_counter() - t_etl)
                 feats, labels, _, lmasks = _split_dataset_full(ds)
                 f = _host_array(feats[0])
                 l = _host_array(labels[0])
@@ -297,6 +312,8 @@ class MultiLayerNetwork:
                 tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
                          and self.conf.tbpttLength and f.ndim == 3
                          and f.shape[2] > self.conf.tbpttLength)
+                if tele is not None:
+                    t_step = _time.perf_counter()
                 if tbptt:
                     loss, params, states, opts = self._fit_tbptt(
                         params, states, opts, f, l, lmask, base_key)
@@ -306,6 +323,9 @@ class MultiLayerNetwork:
                         params, states, opts, f, l, lmask, rng,
                         self._iteration)
                     self._iteration += 1
+                if tele is not None:
+                    tele.record_step(_time.perf_counter() - t_step,
+                                     f.shape[0])
                 # rebind before anything can observe donated buffers
                 self._params, self._states, self._opt_states = (
                     params, states, opts)

@@ -86,16 +86,33 @@ class ElasticTrainer:
         lands only after all shards are complete)."""
         from deeplearning4j_tpu.utils import ModelSerializer
 
+        t0 = time.perf_counter()
         path = self._path(iteration)
+        is_writer = True
         if self.sharded:
+            # telemetry recorded inside save_sharded (every process
+            # writes a shard) — recording here too would double-count
             ModelSerializer.writeModel(self.net, path, self.save_updater,
                                        sharded=True)
         else:
-            if jax.process_index() != 0:
+            is_writer = jax.process_index() == 0
+            if is_writer:
+                tmp = path + ".tmp"
+                ModelSerializer.writeModel(self.net, tmp,
+                                           self.save_updater)
+                os.replace(tmp, path)  # atomic: preempt leaves .tmp
+            # EVERY process records (non-writers with 0 bytes): the
+            # multi-host aggregate contract requires identical
+            # instrument sets on all hosts (telemetry/aggregate.py)
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                _record_checkpoint)
+
+            _record_checkpoint(
+                "save", t0,
+                os.path.getsize(path)
+                if is_writer and os.path.exists(path) else 0)
+            if not is_writer:
                 return None
-            tmp = path + ".tmp"
-            ModelSerializer.writeModel(self.net, tmp, self.save_updater)
-            os.replace(tmp, path)  # atomic: preempt mid-write leaves .tmp
         if jax.process_index() == 0:
             from deeplearning4j_tpu.utils.sharded_checkpoint import (
                 MANIFEST)

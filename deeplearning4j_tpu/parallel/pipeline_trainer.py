@@ -324,14 +324,25 @@ class PipelineParallelTrainer:
             donate_argnums=(0, 1),
         )
 
-    def train_step(self, features, labels, labels_mask=None) -> float:
+    def train_step(self, features, labels, labels_mask=None,
+                   _tele=None) -> float:
+        import time
+
+        from deeplearning4j_tpu import telemetry
+
         if self._step_fn is None:
             self._step_fn = self._build()
+        # fit() passes its per-loop instruments; standalone calls do one
+        # flag check (None when telemetry is disabled: no registry calls)
+        tele = _tele if _tele is not None else \
+            telemetry.loop_instruments("pipeline")
         f = np.asarray(features)
         if f.shape[0] % self.microbatches:
             raise ValueError(
                 f"batch {f.shape[0]} not divisible by microbatches="
                 f"{self.microbatches}")
+        if tele is not None:
+            t0 = time.perf_counter()
         loss, self.params, self.opt = self._step_fn(
             self.params, self.opt, jnp.asarray(f),
             jnp.asarray(np.asarray(labels)),
@@ -339,14 +350,30 @@ class PipelineParallelTrainer:
             jnp.asarray(self._it, jnp.int32))
         self._it += 1
         val = float(loss)
+        if tele is not None:
+            # float(loss) above synced, so this span is the TRUE device
+            # step time for the pipeline schedule
+            tele.record_step(time.perf_counter() - t0, f.shape[0])
         self.lossCurve.append(val)
         return val
 
     def fit(self, data, epochs: int = 1):
         """data: iterable of (features, labels) or DataSet-likes."""
+        import time
+
+        from deeplearning4j_tpu import telemetry
+
+        tele = telemetry.loop_instruments("pipeline")
         for _ in range(epochs):
             it = iter(data)
-            for d in it:
+            while True:
+                if tele is not None:
+                    t_etl = time.perf_counter()
+                d = next(it, None)
+                if d is None:
+                    break
+                if tele is not None:
+                    tele.record_etl_wait(time.perf_counter() - t_etl)
                 if hasattr(d, "getFeatures"):
                     lm = None
                     if hasattr(d, "getLabelsMaskArray"):
@@ -354,9 +381,9 @@ class PipelineParallelTrainer:
                         lm = None if lm is None else np.asarray(lm)
                     self.train_step(np.asarray(d.getFeatures()),
                                     np.asarray(d.getLabels()),
-                                    labels_mask=lm)
+                                    labels_mask=lm, _tele=tele)
                 else:
-                    self.train_step(*d)
+                    self.train_step(*d, _tele=tele)
             if hasattr(data, "reset"):
                 data.reset()
         return self

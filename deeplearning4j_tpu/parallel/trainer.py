@@ -152,6 +152,9 @@ class ShardedTrainer:
         net._opt_states = put(net._opt_states, o_sh)
 
     def fit(self, data, epochs: int = 1):
+        import time
+
+        from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.autodiff.samediff import (
             _as_batches, _split_dataset)
 
@@ -162,8 +165,19 @@ class ShardedTrainer:
         params, states, opts = net._params, net._states, net._opt_states
         base_key = jax.random.key(net.conf.seed + 1)
         last = None
+        # one flag check per fit(): tele is None when telemetry is
+        # disabled, and the loop body then makes zero registry calls
+        tele = telemetry.loop_instruments("sharded")
         for _ in range(epochs):
-            for ds in _as_batches(data):
+            batch_iter = iter(_as_batches(data))
+            while True:
+                if tele is not None:
+                    t_etl = time.perf_counter()
+                ds = next(batch_iter, None)
+                if ds is None:
+                    break
+                if tele is not None:
+                    tele.record_etl_wait(time.perf_counter() - t_etl)
                 feats, labels = _split_dataset(ds)
                 f = np.asarray(feats[0])
                 l = np.asarray(labels[0])
@@ -182,8 +196,21 @@ class ShardedTrainer:
                     l = global_batch(self.mesh, l)
                     mask = global_batch(self.mesh, mask)
                 rng = jax.random.fold_in(base_key, net._iteration)
-                loss, params, states, opts = self._step_fn(
-                    params, states, opts, f, l, mask, rng, net._iteration)
+                if tele is None:
+                    loss, params, states, opts = self._step_fn(
+                        params, states, opts, f, l, mask, rng,
+                        net._iteration)
+                else:
+                    # the span is also a TraceAnnotation, so the host
+                    # step region lines up with XPlane device traces;
+                    # dispatch-queue backpressure makes its wall time
+                    # equal the device step time in steady state (no
+                    # sync added)
+                    with tele.step_span():
+                        loss, params, states, opts = self._step_fn(
+                            params, states, opts, f, l, mask, rng,
+                            net._iteration)
+                    tele.examples.inc(real)
                 net._params, net._states, net._opt_states = (
                     params, states, opts)
                 net._iteration += 1

@@ -1,0 +1,33 @@
+"""Unified telemetry: process-wide metrics registry, phase tracing,
+multi-host aggregation, Prometheus exposition (ISSUE 1 tentpole;
+SURVEY.md §5 observability — the TPU-native OpProfiler /
+PerformanceTracker / StatsListener replacement).
+
+Quick use::
+
+    from deeplearning4j_tpu import telemetry
+    telemetry.enable()                       # on by default
+    net.fit(data, 3)                         # hot loops self-instrument
+    print(telemetry.prometheus.render())     # or GET /metrics on UIServer
+    agg = telemetry.aggregate_snapshot()     # cross-host min/max/mean/sum
+
+Disabling (`telemetry.disable()`) removes every per-step registry call
+from the training loops — they check the flag once per fit()."""
+
+from deeplearning4j_tpu.telemetry import aggregate, prometheus
+from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
+from deeplearning4j_tpu.telemetry.listener import MetricsListener
+from deeplearning4j_tpu.telemetry.registry import (
+    BYTES_BUCKETS, Counter, ETL_HELP, Gauge, Histogram, LoopInstruments,
+    MetricsRegistry, SECONDS_BUCKETS, STEP_HELP, Timer,
+    collect_device_memory, disable, enable, enabled, get_registry,
+    log_buckets, loop_instruments, set_registry, span)
+
+__all__ = [
+    "BYTES_BUCKETS", "Counter", "ETL_HELP", "Gauge", "Histogram",
+    "LoopInstruments", "MetricsListener", "MetricsRegistry",
+    "SECONDS_BUCKETS", "STEP_HELP", "Timer", "aggregate",
+    "aggregate_snapshot", "collect_device_memory", "disable", "enable",
+    "enabled", "get_registry", "log_buckets", "loop_instruments",
+    "prometheus", "set_registry", "span",
+]

@@ -1,0 +1,64 @@
+"""Multi-host metric aggregation: one gather, min/max/mean/sum per
+sample.
+
+Reference capability: the reference's StatsStorage aggregated per-worker
+stats through the parameter-server transport (SURVEY.md §2.6/§2.7); on
+a TPU pod the equivalent is a single
+`jax.experimental.multihost_utils.process_allgather` of the flat
+snapshot vector — every process computes the identical aggregate with
+no extra round trips, and process 0 can serve/persist it.
+
+Contract: every process must hold the SAME instrument set (same metric
+names, labels, bucket layouts) — true for the built-in instruments,
+which are declared identically by the SPMD program on every host. A
+key-set mismatch is detected (via a key-fingerprint lane in the same
+gather) and raised, not silently mis-joined."""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry.registry import get_registry
+
+
+def aggregate_snapshot(snapshot=None, registry=None) -> dict:
+    """{sample_name: {"min","max","mean","sum","hosts"}} across all
+    processes. Single-process (or no distributed runtime): a local-only
+    aggregate with hosts=1 — the same shape, so callers never branch."""
+    if snapshot is None:
+        snapshot = (registry or get_registry()).snapshot()
+    keys = sorted(snapshot)
+    fingerprint = zlib.crc32("\n".join(keys).encode())
+    vals = np.asarray([float(snapshot[k]) for k in keys], np.float64)
+    lanes = np.concatenate([[np.float64(fingerprint)], vals])
+
+    n_hosts = 1
+    try:
+        import jax
+
+        n_hosts = jax.process_count()
+    except Exception:
+        pass
+    if n_hosts > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(lanes))  # [P, 1 + N]
+        if not np.all(gathered[:, 0] == float(fingerprint)):
+            raise ValueError(
+                "metric snapshots differ across hosts (key-set "
+                "fingerprints disagree) — every process must register "
+                "the same instruments before aggregating")
+        table = gathered[:, 1:]
+    else:
+        table = vals[None, :]
+
+    out = {}
+    for i, k in enumerate(keys):
+        col = table[:, i]
+        out[k] = {"min": float(col.min()), "max": float(col.max()),
+                  "mean": float(col.mean()), "sum": float(col.sum()),
+                  "hosts": int(table.shape[0])}
+    return out

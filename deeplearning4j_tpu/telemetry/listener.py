@@ -1,0 +1,45 @@
+"""MetricsListener: registry -> TrainingListener/StatsStorage bridge.
+
+Reference capability: StatsListener fed StatsStorage, which the vertx
+UI charted (SURVEY.md §2.7). MetricsListener keeps that machinery
+working against the new registry: every `frequency` iterations it puts
+one record holding the score plus a registry snapshot, so existing
+dashboards (ui/server.py charts, FileStatsStorage JSONL consumers)
+see telemetry without knowing the registry exists."""
+
+from __future__ import annotations
+
+import time
+
+from deeplearning4j_tpu.telemetry.registry import enabled, get_registry
+from deeplearning4j_tpu.utils.listeners import TrainingListener
+
+
+class MetricsListener(TrainingListener):
+    """Put {"session", "iteration", "epoch", "score", "metrics"} records
+    into any StatsStorage. `metrics` is the flat registry snapshot
+    (counters/gauges/histogram samples); set snapshot=False to record
+    score-only rows at high frequency."""
+
+    def __init__(self, storage, frequency=10, sessionId=None,
+                 registry=None, snapshot=True):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session = sessionId or f"telemetry_{int(time.time())}"
+        self.registry = registry
+        self.snapshot = snapshot
+
+    def iterationDone(self, model, iteration, epoch):
+        if iteration % self.frequency != 0:
+            return
+        record = {
+            "session": self.session,
+            "iteration": iteration,
+            "epoch": epoch,
+            "timestamp": time.time(),
+            "score": model.score(),
+        }
+        if self.snapshot and enabled():
+            reg = self.registry or get_registry()
+            record["metrics"] = reg.snapshot()
+        self.storage.put(record)

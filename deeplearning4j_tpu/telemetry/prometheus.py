@@ -1,0 +1,73 @@
+"""Prometheus text-exposition (format 0.0.4) for a MetricsRegistry.
+
+Reference capability: the scrape surface DL4J never had — the vertx UI
+(SURVEY.md §2.7) served humans; a production TPU serving stack is
+scraped by Prometheus. `render()` is served from the existing UI
+server's `/metrics` route (ui/server.py) and refreshes on-demand system
+gauges (device memory) before rendering, so nothing polls devices in
+the background."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.telemetry.registry import (
+    collect_device_memory, enabled, fmt_float, get_registry)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s):
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s):
+    return (s.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels_text(labels):
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def render(registry=None, collect_system=True) -> str:
+    """The whole registry in Prometheus text exposition. With
+    collect_system, on-demand gauges (device memory) refresh first."""
+    reg = registry or get_registry()
+    if collect_system and enabled():
+        collect_device_memory(reg)
+    lines = []
+    for fam in reg.collect():
+        lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for labels, child in fam.children():
+            if fam.kind == "histogram":
+                acc = 0
+                for bound, c in zip(child.buckets, child.counts):
+                    acc += c
+                    lt = _labels_text(labels + (("le", fmt_float(bound)),))
+                    lines.append(f"{fam.name}_bucket{lt} {acc}")
+                lt = _labels_text(labels + (("le", "+Inf"),))
+                lines.append(f"{fam.name}_bucket{lt} {child.count}")
+                lines.append(f"{fam.name}_sum{_labels_text(labels)} "
+                             f"{fmt_float(child.sum)}")
+                lines.append(f"{fam.name}_count{_labels_text(labels)} "
+                             f"{child.count}")
+            else:
+                lines.append(f"{fam.name}{_labels_text(labels)} "
+                             f"{fmt_float(child.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse(text) -> dict:
+    """Parse a text exposition back to {sample_name: float} (tests /
+    round-trip verification; sample_name includes the label set)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        out[name] = float(value)
+    return out

@@ -1,0 +1,502 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram / Timer.
+
+Reference capability: the observability primitives behind OpProfiler /
+PerformanceTracker / StatsListener (SURVEY.md §2.3, §2.7, §5) unified
+into one registry the way a production serving stack expects — every
+hot loop records through the same named instruments, exporters
+(Prometheus text exposition, StatsStorage bridge, multi-host
+aggregation) read one snapshot.
+
+Design constraints (ISSUE 1 tentpole):
+
+- zero-overhead when disabled: trainers call `loop_instruments(...)`
+  ONCE per fit loop; it checks the module flag and returns None, so a
+  disabled loop performs no registry calls per step;
+- Histogram uses fixed log-scale buckets with a preallocated count
+  list — `observe` is a bisect + two adds, no per-sample allocation;
+- Timer doubles as a `jax.profiler.TraceAnnotation` context so the
+  host-side span shows up in XPlane device traces (TensorBoard) at the
+  same wall-clock position as the device work it covers;
+- compile visibility comes from a `jax.monitoring` event listener (the
+  jit-cache-miss hook): every backend compile increments
+  `dl4j_compile_total` and adds to `dl4j_compile_seconds_total`;
+- nothing here touches a device on the record path (`memory_stats` is
+  read only when an exporter asks for it).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from bisect import bisect_right
+
+# -- module state ------------------------------------------------------------
+
+_state = {"enabled": True, "registry": None}
+_lock = threading.Lock()
+_compile_hook_installed = False
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def enable():
+    _state["enabled"] = True
+    _install_compile_hook()
+    return get_registry()
+
+
+def disable():
+    _state["enabled"] = False
+
+
+def get_registry() -> "MetricsRegistry":
+    """The process-wide registry (created lazily; compile hook installed
+    on first use)."""
+    reg = _state["registry"]
+    if reg is None:
+        with _lock:
+            reg = _state["registry"]
+            if reg is None:
+                reg = MetricsRegistry()
+                _state["registry"] = reg
+    _install_compile_hook()
+    return reg
+
+
+def set_registry(registry):
+    """Swap the process registry (tests: counting stubs). Returns the
+    previous registry."""
+    prev = _state["registry"]
+    _state["registry"] = registry
+    return prev
+
+
+# -- label handling ----------------------------------------------------------
+
+def _label_key(labelnames, labels):
+    if sorted(labels) != sorted(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared labelnames "
+            f"{sorted(labelnames)}")
+    return tuple((k, str(labels[k])) for k in labelnames)
+
+
+class _Family:
+    """One named metric family; unlabeled families hold their values
+    directly, labeled ones hand out per-labelset children. `local` marks
+    host-specific families (per-device gauges) that exporters render but
+    snapshot()/aggregation skip — their label sets differ per host,
+    which would break the identical-key-set aggregation contract."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help="", labelnames=()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.local = False
+        self._children = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels):
+        key = _label_key(self.labelnames, labels)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._make_child()
+                    self._children[key] = child
+        return child
+
+    def children(self):
+        """[(labels_tuple, child)] — the unlabeled family yields itself
+        under the empty labelset once it has been touched."""
+        if self.labelnames:
+            # copy under the lock: a /metrics scrape (UI server thread)
+            # must not race a training thread's first labels() call
+            with self._lock:
+                return sorted(self._children.items())
+        return [((), self)]
+
+    def reset(self):
+        with self._lock:
+            self._children.clear()
+        self._reset_self()
+
+
+class Counter(_Family):
+    """Monotonic counter. `inc(v)` with v >= 0."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self.value = 0.0
+
+    def _make_child(self):
+        return Counter(self.name)
+
+    def _reset_self(self):
+        self.value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        self.value += amount
+
+
+class Gauge(_Family):
+    """Last-value gauge."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self.value = 0.0
+
+    def _make_child(self):
+        return Gauge(self.name)
+
+    def _reset_self(self):
+        self.value = 0.0
+
+    def set(self, value):
+        self.value = float(value)
+
+    def inc(self, amount=1.0):
+        self.value += amount
+
+    def dec(self, amount=1.0):
+        self.value -= amount
+
+
+def log_buckets(lo, hi, per_decade=4):
+    """Fixed log-scale bucket upper bounds covering [lo, hi]."""
+    if not (lo > 0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+    n = int(math.ceil(math.log10(hi / lo) * per_decade)) + 1
+    # 3 significant digits keep the exposition readable; per_decade <= 10
+    # keeps rounded bounds strictly increasing
+    return tuple(float(f"{lo * 10 ** (i / per_decade):.3g}")
+                 for i in range(n))
+
+
+# seconds: 100 us .. ~1000 s; bytes: 1 KiB .. ~64 GiB
+SECONDS_BUCKETS = log_buckets(1e-4, 1e3, per_decade=4)
+BYTES_BUCKETS = tuple(float(1 << (10 + 2 * i)) for i in range(14))
+
+
+class Histogram(_Family):
+    """Cumulative histogram over fixed bucket upper bounds (log-scale by
+    default). observe() is allocation-free: one bisect into the
+    precomputed bounds + integer adds."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets=SECONDS_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"histogram {name}: buckets must be "
+                             "strictly increasing")
+        # counts[i] = observations <= buckets[i]; counts[-1] = +Inf bucket
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+
+    def _make_child(self):
+        return Histogram(self.name, buckets=self.buckets)
+
+    def _reset_self(self):
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+
+    def observe(self, value):
+        self.counts[bisect_right(self.buckets, value)] += 1
+        self.sum += value
+
+    @property
+    def count(self):
+        return sum(self.counts)
+
+    def time(self, annotation=None):
+        """A Timer span feeding this histogram (and the XPlane trace)."""
+        return Timer(self, annotation or self.name)
+
+
+class Timer:
+    """Span context: wall-clock into a Histogram AND a
+    `jax.profiler.TraceAnnotation`, so the host span lands in XPlane
+    device traces (TensorBoard trace viewer) alongside the device ops it
+    covers. Reusable (one observation per with-block); also usable
+    standalone with histogram=None as a pure trace annotation."""
+
+    __slots__ = ("histogram", "name", "_t0", "_ann")
+
+    def __init__(self, histogram, name):
+        self.histogram = histogram
+        self.name = name
+        self._t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        try:
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:  # profiling unavailable: keep timing
+            self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if self.histogram is not None:
+            self.histogram.observe(dt)
+        return False
+
+
+def span(name):
+    """Pure TraceAnnotation span (no metric) — host-side phase marker
+    for XPlane traces."""
+    return Timer(None, name)
+
+
+# -- registry ----------------------------------------------------------------
+
+class MetricsRegistry:
+    """Name -> metric family. Re-registering an existing name returns
+    the existing family (and rejects a kind/labelnames mismatch), so
+    every module can declare its instruments idempotently."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        fam = self._metrics.get(name)
+        if fam is not None:
+            if not isinstance(fam, cls) or \
+                    fam.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{fam.kind} with labels {fam.labelnames}")
+            return fam
+        with self._lock:
+            fam = self._metrics.get(name)
+            if fam is None:
+                fam = cls(name, help, labelnames, **kw)
+                self._metrics[name] = fam
+            return fam
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=SECONDS_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def timer(self, name, help="", labelnames=(),
+              buckets=SECONDS_BUCKETS) -> Timer:
+        """Timer over a same-named histogram (seconds)."""
+        return self.histogram(name, help, labelnames, buckets).time()
+
+    def collect(self):
+        """Metric families, name-sorted (exporter entry point). Copied
+        under the lock so a concurrent first-time registration cannot
+        resize the dict mid-iteration."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self):
+        for fam in self.collect():
+            fam.reset()
+
+    # -- snapshot (the aggregation/exchange format) --------------------------
+    def snapshot(self) -> dict:
+        """Flat {sample_name: float} of every sample, histogram buckets
+        included — the unit of multi-host aggregation. Keys are
+        Prometheus sample names with sorted label sets, so identical
+        instrument sets on every host produce identical key order.
+        Families marked local (device-memory gauges) are skipped: their
+        per-host label sets would defeat cross-host aggregation."""
+        out = {}
+        for fam in self.collect():
+            if fam.local:
+                continue
+            for labels, child in fam.children():
+                base = _sample_name(fam.name, labels)
+                if fam.kind == "histogram":
+                    acc = 0
+                    for b, c in zip(child.buckets, child.counts):
+                        acc += c
+                        out[_sample_name(fam.name + "_bucket",
+                                         labels + (("le", fmt_float(b)),)
+                                         )] = float(acc)
+                    out[_sample_name(fam.name + "_bucket",
+                                     labels + (("le", "+Inf"),))] = \
+                        float(child.count)
+                    out[_sample_name(fam.name + "_sum", labels)] = \
+                        float(child.sum)
+                    out[_sample_name(fam.name + "_count", labels)] = \
+                        float(child.count)
+                else:
+                    out[base] = float(child.value)
+        return out
+
+
+def _sample_name(name, labels):
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+def fmt_float(v):
+    """Canonical number formatting shared by snapshot keys and the
+    Prometheus exposition (integers render bare, le bounds stay short)."""
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+# -- the standard instrument set for training loops --------------------------
+
+STEP_HELP = ("Training step wall time in seconds (host dispatch region; "
+             "equals device step time in steady state via dispatch-queue "
+             "backpressure — no extra sync is added to measure it)")
+ETL_HELP = "Seconds the training loop spent waiting for the next batch"
+EXAMPLES_HELP = "Examples consumed by training steps"
+
+
+class LoopInstruments:
+    """Bound instruments for one training loop. Obtained once per fit()
+    via loop_instruments(); None when telemetry is disabled, so the
+    disabled loop body performs zero registry calls."""
+
+    __slots__ = ("step", "etl", "examples", "loop")
+
+    def __init__(self, registry, loop):
+        self.loop = loop
+        self.step = registry.histogram(
+            "dl4j_step_seconds", STEP_HELP, ("loop",)).labels(loop=loop)
+        self.etl = registry.histogram(
+            "dl4j_etl_wait_seconds", ETL_HELP, ("loop",)).labels(loop=loop)
+        self.examples = registry.counter(
+            "dl4j_examples_total", EXAMPLES_HELP, ("loop",)).labels(
+                loop=loop)
+
+    def step_span(self):
+        """TraceAnnotation+timer around the step dispatch region."""
+        return Timer(self.step, f"dl4j_step/{self.loop}")
+
+    def record_step(self, seconds, examples=0):
+        self.step.observe(seconds)
+        if examples:
+            self.examples.inc(examples)
+
+    def record_etl_wait(self, seconds):
+        self.etl.observe(seconds)
+
+
+def loop_instruments(loop):
+    """The per-loop instrument bundle, or None when telemetry is
+    disabled. Call once before the hot loop and guard per-step recording
+    on the result — that keeps the disabled path at one module-flag
+    check per fit() and zero registry calls per step."""
+    if not _state["enabled"]:
+        return None
+    return LoopInstruments(get_registry(), loop)
+
+
+# -- compile visibility (jit-cache-miss hook) --------------------------------
+
+COMPILE_HELP = "XLA backend compiles observed in this process"
+
+
+def _install_compile_hook():
+    """Register a jax.monitoring listener once per process: every
+    backend compile (a jit cache miss reaching XLA) bumps
+    dl4j_compile_total / dl4j_compile_seconds_total. The listener checks
+    the enabled flag first, so disabling telemetry silences it."""
+    global _compile_hook_installed
+    if _compile_hook_installed:
+        return
+    with _lock:
+        if _compile_hook_installed:
+            return
+        _compile_hook_installed = True
+    try:
+        import jax.monitoring as monitoring
+    except Exception:
+        return
+
+    def _on_duration(key, seconds, **kw):
+        if not _state["enabled"]:
+            return
+        reg = _state["registry"]
+        if reg is None or not key.endswith("backend_compile_duration"):
+            return
+        try:
+            reg.counter("dl4j_compile_total", COMPILE_HELP).inc()
+            reg.counter("dl4j_compile_seconds_total",
+                        "Seconds spent in XLA backend compiles").inc(
+                            seconds)
+        except Exception:
+            pass  # stub registries without counter() must not break jit
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+# -- device memory (read on demand by exporters, never per step) -------------
+
+DEVICE_MEM_HELP = ("Device memory from device.memory_stats(), absent on "
+                   "backends that do not report it (e.g. CPU)")
+DEVICE_MEM_STATS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "largest_free_block_bytes")
+
+
+def collect_device_memory(registry=None):
+    """Refresh dl4j_device_mem_bytes from each local device's
+    memory_stats(). The family is registered even when no device reports
+    stats (CPU), so the metric name is always present in the exposition;
+    samples appear only where the backend provides them."""
+    if not _state["enabled"]:
+        return
+    reg = registry or get_registry()
+    gauge = reg.gauge("dl4j_device_mem_bytes", DEVICE_MEM_HELP,
+                      ("device", "stat"))
+    gauge.local = True  # device ids are host-specific: scrape-only
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key in DEVICE_MEM_STATS:
+            if key in stats:
+                gauge.labels(device=f"{d.platform}:{d.id}",
+                             stat=key).set(stats[key])

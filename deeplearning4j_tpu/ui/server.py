@@ -4,7 +4,9 @@ Reference capability: deeplearning4j-ui-parent's vertx dashboard
 (`UIServer.getInstance().attach(statsStorage)`, SURVEY.md §2.7) — score
 curves for attached training sessions in a browser. Implemented on the
 stdlib http.server (no vertx, no js deps): "/" renders an auto-refreshing
-SVG score chart, "/data" serves the attached storages' records as JSON."""
+SVG score chart, "/data" serves the attached storages' records as JSON,
+"/metrics" serves the telemetry registry in Prometheus text exposition
+(ISSUE 1: the scrape endpoint)."""
 
 from __future__ import annotations
 
@@ -65,6 +67,11 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/data":
             body = json.dumps(self.server.ui._sessions()).encode()
             ctype = "application/json"
+        elif self.path == "/metrics":
+            from deeplearning4j_tpu.telemetry import prometheus
+
+            body = prometheus.render().encode()
+            ctype = prometheus.CONTENT_TYPE
         elif self.path == "/":
             body = _PAGE.encode()
             ctype = "text/html; charset=utf-8"

@@ -67,6 +67,22 @@ class PerformanceListener(TrainingListener):
             if self.reportScore:
                 msg += f", score {model.score()}"
             log.info(msg)
+            from deeplearning4j_tpu import telemetry
+
+            if telemetry.enabled() and its > 0:
+                # route through the registry (ISSUE 1): iteration-to-
+                # iteration wall time is the steady-state step time, so
+                # feed the shared histogram under its own loop label
+                reg = telemetry.get_registry()
+                reg.histogram("dl4j_step_seconds", telemetry.STEP_HELP,
+                              ("loop",)).labels(
+                    loop="listener").observe(1.0 / its)
+                if self.batchSize:
+                    reg.gauge("dl4j_examples_per_second",
+                              "Instantaneous training throughput",
+                              ("source",)).labels(
+                        source="performance_listener").set(
+                            its * self.batchSize)
             self._last_time = now
             self._last_iter = iteration
         elif self._last_time is None:

@@ -83,6 +83,14 @@ class StepTimer:
         self.times.append(dt)
         if len(self.times) > self.window:
             self.times.pop(0)
+        from deeplearning4j_tpu import telemetry
+
+        if telemetry.enabled():
+            # route through the shared registry (ISSUE 1) under this
+            # module's own loop label — synced timings, true step time
+            telemetry.get_registry().histogram(
+                "dl4j_step_seconds", telemetry.STEP_HELP,
+                ("loop",)).labels(loop="step_timer").observe(dt)
         return dt
 
     def mean_step_time(self) -> float:

@@ -90,6 +90,17 @@ class ModelSerializer:
                     _put(named, "p", str(i), p)
                 for i, s in enumerate(model._states):
                     _put(named, "s", str(i), s)
+            # non-native dtypes (ml_dtypes bf16/fp8) would silently hit
+            # npz as raw void and come back unrestorable (ADVICE r5):
+            # store a same-width uint view + a dtype sidecar to view back
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                encode_for_npz)
+
+            dtype_map = {k: str(v.dtype) for k, v in named.items()
+                         if v.dtype.kind == "V"}
+            if dtype_map:
+                named = {k: encode_for_npz(v) for k, v in named.items()}
+                zf.writestr("paramDtypes.json", json.dumps(dtype_map))
             buf = io.BytesIO()
             np.savez(buf, **named)
             zf.writestr("params.npz", buf.getvalue())
@@ -97,9 +108,17 @@ class ModelSerializer:
                 import jax
 
                 leaves, _ = jax.tree_util.tree_flatten(model._opt_states)
+                uarrs = {str(i): np.asarray(l)
+                         for i, l in enumerate(leaves)}
+                u_dtypes = {k: str(v.dtype) for k, v in uarrs.items()
+                            if v.dtype.kind == "V"}
+                if u_dtypes:
+                    uarrs = {k: encode_for_npz(v)
+                             for k, v in uarrs.items()}
+                    zf.writestr("updaterDtypes.json",
+                                json.dumps(u_dtypes))
                 ubuf = io.BytesIO()
-                np.savez(ubuf, **{str(i): np.asarray(l)
-                                  for i, l in enumerate(leaves)})
+                np.savez(ubuf, **uarrs)
                 zf.writestr("updaterState.npz", ubuf.getvalue())
                 zf.writestr("trainingState.json", json.dumps({
                     "iteration": model._iteration, "epoch": model._epoch}))
@@ -132,11 +151,20 @@ class ModelSerializer:
                 model = MultiLayerNetwork(
                     MultiLayerConfiguration.from_json(conf_json))
             model.init()
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                decode_npz_view, resolve_dtype)
+
+            dtype_map = (json.loads(zf.read("paramDtypes.json"))
+                         if "paramDtypes.json" in zf.namelist() else {})
             named = np.load(io.BytesIO(zf.read("params.npz")))
             for key in named.files:
                 parts = key.split(_SEP)
                 kind, idx, pname = parts[0], parts[1], parts[2]
-                arr = jnp.asarray(named[key])
+                raw = named[key]
+                if key in dtype_map:
+                    raw = decode_npz_view(raw,
+                                          resolve_dtype(dtype_map[key]))
+                arr = jnp.asarray(raw)
                 target = model._params if kind == "p" else model._states
                 slot = target[idx if mtype == "ComputationGraph"
                               else int(idx)]
@@ -150,8 +178,14 @@ class ModelSerializer:
             if loadUpdater and "updaterState.npz" in zf.namelist():
                 proto_leaves, treedef = jax.tree_util.tree_flatten(
                     model._opt_states)
+                u_dtypes = (json.loads(zf.read("updaterDtypes.json"))
+                            if "updaterDtypes.json" in zf.namelist()
+                            else {})
                 data = np.load(io.BytesIO(zf.read("updaterState.npz")))
-                leaves = [jnp.asarray(data[str(i)])
+                leaves = [jnp.asarray(
+                    decode_npz_view(data[str(i)],
+                                    resolve_dtype(u_dtypes[str(i)]))
+                    if str(i) in u_dtypes else data[str(i)])
                           for i in range(len(proto_leaves))]
                 model._opt_states = jax.tree_util.tree_unflatten(
                     treedef, leaves)

@@ -27,10 +27,52 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import numpy as np
 
 MANIFEST = "manifest.json"
+
+# -- non-native dtype codec (ADVICE r5 medium) -------------------------------
+# np.savez silently stores ml_dtypes arrays (bfloat16, float8_*) as raw
+# void records ('V2'), which load back as void and cannot be assigned
+# into a typed buffer — a checkpoint that saves cleanly but is
+# unrestorable. Fix: store such arrays as a same-width uint VIEW (a
+# bitcast, no copy of semantics) and view back to the manifest-recorded
+# dtype on load.
+
+_UINT_BY_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def encode_for_npz(arr):
+    """Bitcast non-native dtypes to a same-width uint for npz storage."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind == "V" and arr.dtype.itemsize in _UINT_BY_ITEMSIZE:
+        return arr.view(_UINT_BY_ITEMSIZE[arr.dtype.itemsize])
+    return arr
+
+
+def resolve_dtype(name):
+    """np.dtype for a manifest dtype string, including ml_dtypes names
+    ('bfloat16', 'float8_e4m3fn', ...) numpy itself cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        try:
+            return np.dtype(getattr(ml_dtypes, str(name)))
+        except AttributeError:
+            raise TypeError(f"unknown checkpoint dtype {name!r}")
+
+
+def decode_npz_view(arr, dtype):
+    """Undo encode_for_npz: view a stored uint array back to `dtype`."""
+    if arr.dtype != dtype and dtype.kind == "V" \
+            and arr.dtype.kind == "u" \
+            and arr.dtype.itemsize == dtype.itemsize:
+        return arr.view(dtype)
+    return arr
 
 
 def _sync(tag="dl4j_tpu_sharded_ckpt"):
@@ -62,11 +104,30 @@ def _flatten_with_names(tree):
             for path, leaf in flat], treedef
 
 
+def _record_checkpoint(op, t0, nbytes):
+    """Checkpoint telemetry (ISSUE 1: checkpoint save/restore records
+    bytes and duration); no-op when telemetry is disabled."""
+    from deeplearning4j_tpu import telemetry
+
+    if not telemetry.enabled():
+        return
+    reg = telemetry.get_registry()
+    reg.counter("dl4j_checkpoint_total", "Checkpoints written/restored",
+                ("op",)).labels(op=op).inc()
+    reg.histogram("dl4j_checkpoint_seconds",
+                  "Checkpoint save/restore wall time",
+                  ("op",)).labels(op=op).observe(time.perf_counter() - t0)
+    reg.counter("dl4j_checkpoint_bytes_total",
+                "Bytes written/read by checkpoints",
+                ("op",)).labels(op=op).inc(nbytes)
+
+
 def save_sharded(directory, tree, step=0, meta=None):
     """Write this process's chunks of `tree` (a pytree of jax/numpy
     arrays) under `directory`; process 0 also writes the manifest."""
     import jax
 
+    t0 = time.perf_counter()
     pid = jax.process_index()
     os.makedirs(directory, exist_ok=True)
     named, _ = _flatten_with_names(tree)
@@ -91,7 +152,7 @@ def save_sharded(directory, tree, step=0, meta=None):
                     "file": f"shard_{dev.process_index}.npz",
                     "key": npz_key})
                 if dev.process_index == pid:
-                    payload[npz_key] = np.asarray(local[k])
+                    payload[npz_key] = encode_for_npz(local[k])
         else:  # host value: single chunk owned by process 0
             arr = np.asarray(leaf)
             shape, dtype = arr.shape, arr.dtype
@@ -99,14 +160,16 @@ def save_sharded(directory, tree, step=0, meta=None):
             chunks = [{"slices": [[0, d] for d in shape],
                        "file": "shard_0.npz", "key": npz_key}]
             if pid == 0:
-                payload[npz_key] = arr
+                payload[npz_key] = encode_for_npz(arr)
         leaves_spec[name] = {"shape": list(shape), "dtype": str(dtype),
                              "host": not isinstance(leaf, jax.Array),
                              "chunks": chunks}
     tmp = os.path.join(directory, f"shard_{pid}.tmp.npz")
     np.savez(tmp, **payload)
-    os.replace(tmp, os.path.join(directory, f"shard_{pid}.npz"))
+    shard_path = os.path.join(directory, f"shard_{pid}.npz")
+    os.replace(tmp, shard_path)
     _sync("shards_written")
+    _record_checkpoint("save", t0, os.path.getsize(shard_path))
     if pid == 0:
         man = {"step": int(step), "process_count": jax.process_count(),
                "leaves": leaves_spec, "meta": meta or {}}
@@ -133,7 +196,7 @@ class _ChunkReader:
         whole array) of leaf `name` from its overlapping chunks."""
         spec = self.man["leaves"][name]
         shape = tuple(spec["shape"])
-        dtype = np.dtype(spec["dtype"])
+        dtype = resolve_dtype(spec["dtype"])
         want = _norm_index(index, shape) if index is not None else \
             [[0, d] for d in shape]
         out = np.empty([e - s for s, e in want], dtype)
@@ -143,7 +206,7 @@ class _ChunkReader:
                      in zip(want, ch["slices"])]
             if any(s >= e for s, e in inter):
                 continue
-            src = self._npz(ch["file"])[ch["key"]]
+            src = decode_npz_view(self._npz(ch["file"])[ch["key"]], dtype)
             src_sl = tuple(slice(s - cs, e - cs) for (s, e), (cs, _ce)
                            in zip(inter, ch["slices"]))
             dst_sl = tuple(slice(s - ws, e - ws) for (s, e), (ws, _we)
@@ -174,6 +237,7 @@ def load_sharded(directory, template=None, shardings=None):
     Returns (tree, step, meta)."""
     import jax
 
+    t0 = time.perf_counter()
     with open(os.path.join(directory, MANIFEST)) as f:
         man = json.load(f)
     reader = _ChunkReader(directory, man)
@@ -208,7 +272,11 @@ def load_sharded(directory, template=None, shardings=None):
         else:  # host-saved leaves come back as numpy (dtype-exact)
             arr = reader.region(name)
         out.append(arr)
+    read_bytes = sum(
+        os.path.getsize(os.path.join(directory, f))
+        for f in reader._files)
     reader.close()
+    _record_checkpoint("restore", t0, read_bytes)
     if template is not None:
         import jax as _jax
 

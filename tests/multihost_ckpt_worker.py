@@ -46,6 +46,10 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
+    try:  # CPU collectives need gloo (see parallel/multihost.py)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=n_proc, process_id=pid)
     import numpy as np

@@ -1,0 +1,81 @@
+"""Worker for the two-process telemetry aggregation test (spawned by
+tests/test_telemetry_multiprocess.py, one per simulated host).
+
+Each process records host-distinct counter values plus a short
+ShardedTrainer fit over the 4-device global mesh, then calls
+telemetry.aggregate_snapshot() — ONE process_allgather — and prints the
+aggregate rows the parent asserts on (hosts=2 and the correct
+min/max/sum for the known per-host values)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    coord, n_proc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from deeplearning4j_tpu.parallel.multihost import (
+        MultiHost, VoidConfiguration)
+
+    MultiHost.initialize(VoidConfiguration(controllerAddress=coord),
+                         num_processes=n_proc, process_id=pid)
+
+    import numpy as np
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+    reg = telemetry.MetricsRegistry()
+    telemetry.set_registry(reg)
+    telemetry.enable()
+
+    # host-distinct value: proves the gather really spans processes
+    reg.gauge("host_rank").set(pid)
+    reg.counter("host_units_total").inc(10 * (pid + 1))  # 10 and 20
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(5e-2))
+            .list()
+            .layer(DenseLayer.Builder(nOut=8, activation="tanh").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    ShardedTrainer(net, MeshConfig.data_parallel()).fit(
+        [DataSet(X, y)], epochs=3)
+
+    agg = telemetry.aggregate_snapshot(registry=reg)
+    rows = {
+        "host_rank": agg["host_rank"],
+        "host_units_total": agg["host_units_total"],
+        "steps": agg['dl4j_step_seconds_count{loop="sharded"}'],
+        "examples": agg['dl4j_examples_total{loop="sharded"}'],
+    }
+    print("AGG " + json.dumps(rows), flush=True)
+    print("WORKER_OK", flush=True)
+    MultiHost.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,325 @@
+"""Telemetry subsystem tests (ISSUE 1): registry semantics, Prometheus
+exposition round-trip, the /metrics route, trainer integration,
+disabled-mode zero-overhead, and multi-host aggregation (local fallback
+here; the subprocess-based two-process test is
+test_telemetry_multiprocess.py)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import (
+    MetricsListener, MetricsRegistry, aggregate_snapshot, prometheus)
+
+
+@pytest.fixture
+def fresh_registry():
+    """Swap a clean registry into the process slot and restore after."""
+    reg = MetricsRegistry()
+    prev = telemetry.set_registry(reg)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    yield reg
+    telemetry.set_registry(prev)
+    (telemetry.enable if was_enabled else telemetry.disable)()
+
+
+def _tiny_net(seed=1):
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+
+    conf = (NeuralNetConfiguration.Builder().seed(seed).list()
+            .layer(DenseLayer.Builder().nIn(4).nOut(8)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_data(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return X, y
+
+
+class TestRegistrySemantics:
+    def test_counter(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # idempotent re-registration returns the same family
+        assert reg.counter("c_total") is c
+
+    def test_gauge(self):
+        g = MetricsRegistry().gauge("g")
+        g.set(4.0)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3.0
+
+    def test_histogram_buckets_and_reset(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.counts == [1, 1, 1, 1]  # one per bucket + one overflow
+        assert h.count == 4
+        assert h.sum == pytest.approx(55.55)
+        reg.reset()
+        assert h.count == 0 and h.sum == 0.0
+
+    def test_histogram_rejects_unsorted_buckets(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().histogram("h", buckets=(1.0, 0.5))
+
+    def test_log_buckets_increasing(self):
+        bs = telemetry.log_buckets(1e-4, 1e3)
+        assert list(bs) == sorted(set(bs))
+        assert bs[0] == pytest.approx(1e-4) and bs[-1] >= 1e3
+
+    def test_labels(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("req_total", "", ("loop", "kind"))
+        a = fam.labels(loop="fit", kind="x")
+        b = fam.labels(kind="x", loop="fit")  # order-insensitive
+        assert a is b
+        a.inc(2)
+        assert fam.labels(loop="other", kind="x").value == 0
+        with pytest.raises(ValueError):
+            fam.labels(loop="fit")  # missing label
+        assert fam.children()[0][0] == (("loop", "fit"), ("kind", "x"))
+
+    def test_kind_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("m")
+        with pytest.raises(ValueError):
+            reg.gauge("m")
+        with pytest.raises(ValueError):
+            reg.counter("m", labelnames=("x",))
+
+    def test_timer_observes_and_is_reusable(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("span_seconds")
+        t = h.time()
+        with t:
+            pass
+        with t:
+            pass
+        assert h.count == 2
+        assert h.sum > 0
+        # standalone trace-only span: no metric involved
+        with telemetry.span("phase"):
+            pass
+
+    def test_snapshot_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc(2)
+        reg.histogram("h", buckets=(1.0,)).observe(0.5)
+        snap = reg.snapshot()
+        assert snap["a_total"] == 2.0
+        assert snap['h_bucket{le="1"}'] == 1.0
+        assert snap['h_bucket{le="+Inf"}'] == 1.0
+        assert snap["h_count"] == 1.0 and snap["h_sum"] == 0.5
+
+
+class TestPrometheusExposition:
+    def test_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "a help").inc(7)
+        reg.gauge("g", "", ("dev",)).labels(dev="tpu:0").set(1.5)
+        h = reg.histogram("h_seconds", "", ("loop",), buckets=(0.1, 1.0))
+        h.labels(loop="fit").observe(0.05)
+        h.labels(loop="fit").observe(0.5)
+        text = prometheus.render(reg, collect_system=False)
+        assert "# TYPE a_total counter" in text
+        assert "# TYPE h_seconds histogram" in text
+        parsed = prometheus.parse(text)
+        assert parsed["a_total"] == 7
+        assert parsed['g{dev="tpu:0"}'] == 1.5
+        assert parsed['h_seconds_bucket{loop="fit",le="0.1"}'] == 1
+        assert parsed['h_seconds_bucket{loop="fit",le="+Inf"}'] == 2
+        assert parsed['h_seconds_count{loop="fit"}'] == 2
+        # every non-comment line is "name value"
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert len(line.rsplit(" ", 1)) == 2
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", "", ("p",)).labels(p='a"b\\c').set(1)
+        text = prometheus.render(reg, collect_system=False)
+        assert 'p="a\\"b\\\\c"' in text
+
+    def test_snapshot_matches_exposition_values(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(3)
+        reg.histogram("h", buckets=(1.0, 2.0)).observe(1.5)
+        parsed = prometheus.parse(prometheus.render(
+            reg, collect_system=False))
+        snap = reg.snapshot()
+        for k, v in snap.items():
+            assert parsed[k] == v, k
+
+
+class TestMetricsRoute:
+    def test_metrics_route_after_fit(self, fresh_registry):
+        """ISSUE 1 acceptance: GET /metrics returns valid exposition
+        including the step/compile/etl/device-memory families after a
+        short fit() run."""
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        net = _tiny_net(seed=2)
+        X, y = _tiny_data()
+        net.fit([(X, y)], 3)
+        ui = UIServer.getInstance().start(port=0)
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+            for name in ("dl4j_step_seconds", "dl4j_compile_total",
+                         "dl4j_etl_wait_seconds", "dl4j_device_mem_bytes"):
+                assert name in body, name
+            parsed = prometheus.parse(body)
+            assert parsed['dl4j_step_seconds_count{loop="fit"}'] == 3
+            assert parsed["dl4j_compile_total"] >= 1
+            # histogram exposition is internally consistent
+            assert parsed['dl4j_step_seconds_bucket{loop="fit",le="+Inf"}'] \
+                == parsed['dl4j_step_seconds_count{loop="fit"}']
+        finally:
+            ui.stop()
+
+
+class TestTrainerIntegration:
+    def test_three_step_fit_populates_metrics(self, fresh_registry):
+        net = _tiny_net()
+        X, y = _tiny_data()
+        net.fit([(X, y)], 3)
+        text = prometheus.render(fresh_registry)
+        parsed = prometheus.parse(text)
+        assert parsed['dl4j_step_seconds_count{loop="fit"}'] == 3
+        assert parsed['dl4j_step_seconds_sum{loop="fit"}'] > 0
+        assert parsed['dl4j_etl_wait_seconds_count{loop="fit"}'] == 3
+        assert parsed['dl4j_examples_total{loop="fit"}'] == 48
+        # the jit-cache-miss hook saw the train-step compile
+        assert parsed["dl4j_compile_total"] >= 1
+        assert parsed["dl4j_compile_seconds_total"] > 0
+        assert "dl4j_device_mem_bytes" in text
+
+    def test_sharded_trainer_populates_metrics(self, fresh_registry):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+        net = _tiny_net(seed=3)
+        X, y = _tiny_data()
+        ShardedTrainer(net).fit([DataSet(X, y)], epochs=2)
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_step_seconds_count{loop="sharded"}'] == 2
+        assert snap['dl4j_examples_total{loop="sharded"}'] == 32
+
+    def test_checkpoint_metrics(self, fresh_registry, tmp_path):
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            load_sharded, save_sharded)
+
+        tree = {"w": np.arange(6, dtype=np.float32)}
+        save_sharded(str(tmp_path / "ck"), tree, step=1)
+        load_sharded(str(tmp_path / "ck"), template=tree)
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_checkpoint_total{op="save"}'] == 1
+        assert snap['dl4j_checkpoint_total{op="restore"}'] == 1
+        assert snap['dl4j_checkpoint_bytes_total{op="save"}'] > 0
+        assert snap['dl4j_checkpoint_bytes_total{op="restore"}'] > 0
+
+
+class TestDisabledModeZeroOverhead:
+    def test_fit_makes_zero_registry_calls(self):
+        class CountingStub:
+            calls = 0
+
+            def __getattr__(self, name):
+                CountingStub.calls += 1
+                raise AssertionError(
+                    f"registry.{name} touched while disabled")
+
+        net = _tiny_net(seed=5)
+        X, y = _tiny_data()
+        prev = telemetry.set_registry(CountingStub())
+        was_enabled = telemetry.enabled()
+        telemetry.disable()
+        try:
+            net.fit([(X, y)], 3)
+
+            from deeplearning4j_tpu.datasets import DataSet
+            from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+            net2 = _tiny_net(seed=6)
+            ShardedTrainer(net2).fit([DataSet(X, y)], epochs=2)
+            assert CountingStub.calls == 0
+        finally:
+            telemetry.set_registry(prev)
+            if was_enabled:
+                telemetry.enable()
+
+    def test_loop_instruments_none_when_disabled(self):
+        was_enabled = telemetry.enabled()
+        telemetry.disable()
+        try:
+            assert telemetry.loop_instruments("x") is None
+        finally:
+            if was_enabled:
+                telemetry.enable()
+
+
+class TestAggregation:
+    def test_local_fallback_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(5)
+        reg.gauge("g").set(-2)
+        agg = aggregate_snapshot(registry=reg)
+        assert agg["c_total"] == {"min": 5.0, "max": 5.0, "mean": 5.0,
+                                  "sum": 5.0, "hosts": 1}
+        assert agg["g"]["min"] == -2.0
+
+    def test_explicit_snapshot(self):
+        agg = aggregate_snapshot(snapshot={"a": 1.0, "b": 2.0})
+        assert agg["b"]["sum"] == 2.0 and agg["a"]["hosts"] == 1
+
+
+class TestMetricsListener:
+    def test_bridges_registry_into_stats_storage(self, fresh_registry):
+        from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+
+        storage = InMemoryStatsStorage()
+        net = _tiny_net(seed=7)
+        X, y = _tiny_data()
+        net.setListeners(MetricsListener(storage, frequency=1,
+                                         sessionId="tele"))
+        net.fit([(X, y)], 2)
+        recs = storage.getRecords("tele")
+        assert len(recs) == 2
+        assert all(np.isfinite(r["score"]) for r in recs)
+        # the registry snapshot rides along for existing dashboards
+        assert recs[-1]["metrics"][
+            'dl4j_step_seconds_count{loop="fit"}'] >= 1
+        # and the UI /data route still understands the records
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        ui = UIServer.getInstance().attach(storage).start(port=0)
+        try:
+            data = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/data").read())
+            assert [r["iteration"] for r in data["tele"]] == [1, 2]
+        finally:
+            ui.stop()
+            ui.detach(storage)

@@ -1,0 +1,62 @@
+"""Two-process telemetry aggregation on the CPU backend (ISSUE 1
+acceptance: a subprocess-based multi-process test shows one aggregated
+snapshot spanning all hosts). Mirrors the test_multihost.py harness:
+coordinator + worker subprocesses over jax.distributed, 2 virtual CPU
+devices each."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_aggregated_snapshot():
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_telemetry_worker.py")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(worker)))
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        assert "WORKER_OK" in out
+        outs.append(out)
+
+    aggs = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if ln.startswith("AGG "))
+        aggs.append(json.loads(line[4:]))
+
+    # both processes computed the identical aggregate (one allgather)
+    assert aggs[0] == aggs[1]
+    agg = aggs[0]
+
+    # the snapshot spans both hosts...
+    assert agg["host_rank"]["hosts"] == 2
+    # ...with per-host values visible through min/max/sum
+    assert agg["host_rank"]["min"] == 0.0
+    assert agg["host_rank"]["max"] == 1.0
+    assert agg["host_units_total"]["sum"] == 30.0  # 10 + 20
+    assert agg["host_units_total"]["mean"] == 15.0
+    # both hosts ran the same 3 SPMD steps over the global batch
+    assert agg["steps"]["min"] == 3.0 and agg["steps"]["max"] == 3.0
+    assert agg["examples"]["sum"] == 2 * 3 * 16
