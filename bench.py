@@ -5,9 +5,12 @@ prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
 `python bench.py --all` additionally measures LeNet-MNIST images/sec,
 ResNet-50 images/sec + MFU (the BASELINE.json north star), GravesLSTM
-char-RNN tokens/sec and Word2Vec SkipGram words/sec, writing all results
-to BENCH_ALL.json (one JSON object per config) — VERDICT.md round-1
-item 3: every BASELINE.md row gets a measured number.
+char-RNN tokens/sec, Word2Vec SkipGram words/sec and the serving-latency
+smoke, MERGING all results into BENCH_ALL.json (one JSON object per
+config) — VERDICT.md round-1 item 3: every BASELINE.md row gets a
+measured number. `--only name[,name]` re-records a subset (off-TPU runs
+land under platform-suffixed keys and never displace chip rows);
+`--words N` sizes the Word2Vec corpus.
 
 Baseline note (BASELINE.md): the reference publishes no in-tree numbers
 (`published: {}`), so vs_baseline is reported against BASELINE.json's
@@ -352,6 +355,21 @@ def bench_word2vec(total_words=10_000_000):
     k_neg, pairs_per_word = 5, 3.8
     rows_per_word = pairs_per_word * 2 * (2 + k_neg)
     roof_wps = 125e6 / rows_per_word
+    import jax
+
+    if jax.default_backend() != "tpu":
+        # the bound analysis below describes the chip; an off-TPU row
+        # (bench.py --only word2vec on this host) must not carry it
+        return {
+            "metric": "word2vec_skipgram_words_per_sec",
+            "value": round(wps, 1),
+            "unit": "words/sec",
+            "vs_baseline": None,
+            "corpus_words": total_words,
+            "bound": (f"{jax.default_backend()} fallback run (XLA host "
+                      "scan); the TPU roofline analysis applies only on "
+                      "the chip"),
+        }
     return {
         "metric": "word2vec_skipgram_words_per_sec",
         "value": round(wps, 1),
@@ -376,21 +394,138 @@ def bench_word2vec(total_words=10_000_000):
     }
 
 
+def bench_serving_latency(n_requests=300):
+    """ISSUE 2 serving smoke: p50/p99 sync predict latency through the
+    DynamicBatcher on a warmed AOT bucket ladder, at batch 1 and batch
+    32. Single-client, so batch-1 latency INCLUDES the max-latency flush
+    window (1 ms here) the batcher holds open for co-travelers — that
+    window is the price of coalescing and belongs in the number."""
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).list()
+            .layer(DenseLayer.Builder().nIn(128).nOut(256)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(10).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    session = InferenceSession(max_latency=0.001)
+    session.register("bench", net, example_shape=(128,),
+                     ladder=BucketLadder((1, 8, 32)), warmup=True)
+    rng = np.random.default_rng(0)
+    x1 = rng.normal(size=(128,)).astype(np.float32)
+    x32 = rng.normal(size=(32, 128)).astype(np.float32)
+
+    def percentiles(x, n):
+        for _ in range(10):         # settle the queue/thread path
+            session.predict("bench", x)
+        lat = np.empty(n)
+        for i in range(n):
+            t0 = time.perf_counter()
+            session.predict("bench", x)
+            lat[i] = time.perf_counter() - t0
+        return np.percentile(lat * 1e3, [50, 99])
+
+    p50_1, p99_1 = percentiles(x1, n_requests)
+    p50_32, p99_32 = percentiles(x32, max(50, n_requests // 4))
+    session.close()
+    return {
+        "metric": "serving_latency_p50_ms_batch1",
+        "value": round(float(p50_1), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "p99_batch1_ms": round(float(p99_1), 3),
+        "p50_batch32_ms": round(float(p50_32), 3),
+        "p99_batch32_ms": round(float(p99_32), 3),
+        "requests": n_requests,
+        "note": ("single-client sync predict through DynamicBatcher on a "
+                 "warmed (1,8,32) AOT ladder; batch-1 includes the 1 ms "
+                 "coalescing flush window"),
+    }
+
+
+ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
+               ("resnet50", bench_resnet50),
+               ("resnet50_etl", bench_resnet_etl),
+               ("graves_lstm", bench_graves_lstm),
+               ("word2vec", bench_word2vec),
+               ("serving_latency", bench_serving_latency)]
+
+
+def _merge_bench_all(results, path="BENCH_ALL.json"):
+    """Merge measured rows into BENCH_ALL.json instead of clobbering it.
+    README calls this file the authoritative record of TPU-chip numbers
+    (VERDICT r5 item 2: headline claims must exist as recorded rows), so
+    rows measured on another backend land under a platform-suffixed key
+    ('word2vec_cpu') and never displace a chip row. Every new row is
+    stamped with its platform."""
+    import jax
+
+    backend = jax.default_backend()
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = {}
+    for name, rec in results.items():
+        rec = dict(rec)
+        rec.setdefault("platform", backend)
+        key = name if backend == "tpu" else f"{name}_{backend}"
+        if "error" in rec and "error" not in existing.get(key, {"error": 1}):
+            # a transient bench failure must not destroy a previously
+            # measured row; record the failure beside it instead
+            existing[key + "_error"] = rec
+            continue
+        existing[key] = rec
+    with open(path, "w") as f:
+        json.dump(existing, f, indent=1)
+    return existing
+
+
+def _flag_value(argv, flag, default=None, cast=str):
+    if flag in argv:
+        i = argv.index(flag) + 1
+        if i >= len(argv):
+            raise SystemExit(f"{flag} needs a value")
+        return cast(argv[i])
+    return default
+
+
 def main():
-    if "--all" in sys.argv:
+    argv = sys.argv[1:]
+    words = _flag_value(argv, "--words", 10_000_000, int)
+    benches = dict(ALL_BENCHES)
+    benches["word2vec"] = lambda: bench_word2vec(words)
+    if "--only" in argv:
+        # subset run that MERGES into BENCH_ALL.json, e.g.
+        #   python bench.py --only word2vec,serving_latency [--words N]
+        names = _flag_value(argv, "--only").split(",")
+        unknown = [n for n in names if n not in benches]
+        if unknown:
+            raise SystemExit(f"unknown bench {unknown}; "
+                             f"choose from {sorted(benches)}")
         results = {}
-        for name, fn in [("bert", bench_bert), ("lenet", bench_lenet),
-                         ("resnet50", bench_resnet50),
-                         ("resnet50_etl", bench_resnet_etl),
-                         ("graves_lstm", bench_graves_lstm),
-                         ("word2vec", bench_word2vec)]:
+        for name in names:
+            try:
+                results[name] = benches[name]()
+            except Exception as e:
+                results[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(json.dumps({name: results[name]}))
+        _merge_bench_all(results)
+        return
+    if "--all" in argv:
+        results = {}
+        for name, _ in ALL_BENCHES:
+            fn = benches[name]
             try:
                 results[name] = fn()
             except Exception as e:  # record, keep measuring the rest
                 results[name] = {"error": f"{type(e).__name__}: {e}"}
             print(json.dumps({name: results[name]}))
-        with open("BENCH_ALL.json", "w") as f:
-            json.dump(results, f, indent=1)
+        _merge_bench_all(results)
         # driver line last: the flagship result, exactly the 4 contract
         # keys (and a valid record even if the bert bench errored)
         bert = results["bert"]

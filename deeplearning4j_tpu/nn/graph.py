@@ -450,12 +450,21 @@ class ComputationGraph:
         return float(loss)
 
     def evaluate(self, iterator, numClasses=None) -> Evaluation:
+        """Ragged final batches pad up to the running bucket (serving
+        `pad_rows`) and slice back, so eval compiles ONE executable."""
+        from deeplearning4j_tpu.serving.buckets import pad_rows
+
         self._check_init()
         ev = Evaluation(numClasses)
+        bucket = None
         for ds in _as_batches(iterator):
             feats, labels, _, lmasks = _split_dataset_full(ds)
-            out = self.output(*feats)[0]
-            ev.eval(labels[0], out, mask=lmasks[0])
+            fs = [_host_array(f) for f in feats]
+            n = fs[0].shape[0]
+            if bucket is None or n > bucket:
+                bucket = n
+            out = self.output(*[pad_rows(f, bucket) for f in fs])[0]
+            ev.eval(labels[0], out.toNumpy()[:n], mask=lmasks[0])
         return ev
 
     def numParams(self) -> int:

@@ -592,21 +592,38 @@ class MultiLayerNetwork:
                                   False, None, mask=lmask)
         return float(loss)
 
+    def _eval_outputs(self, iterator):
+        """Yield (labels, predictions, mask) per batch with the ragged
+        final batch padded UP to the running batch-size bucket (the
+        serving-side `pad_rows`), so an eval pass compiles ONE inference
+        executable instead of one per distinct tail size. Padding rows
+        are sliced back off before scoring — masks stay untouched and
+        results are bit-identical to unpadded inference (row-wise
+        networks)."""
+        from deeplearning4j_tpu.serving.buckets import pad_rows
+
+        bucket = None
+        for ds in _as_batches(iterator):
+            feats, labels, _, lmasks = _split_dataset_full(ds)
+            f = _host_array(feats[0])
+            n = f.shape[0]
+            if bucket is None or n > bucket:
+                bucket = n
+            out = self.output(pad_rows(f, bucket))
+            yield labels[0], out.toNumpy()[:n], lmasks[0]
+
     def evaluate(self, iterator, numClasses=None) -> Evaluation:
         self._check_init()
         ev = Evaluation(numClasses)
-        for ds in _as_batches(iterator):
-            feats, labels, _, lmasks = _split_dataset_full(ds)
-            out = self.output(feats[0])
-            ev.eval(labels[0], out, mask=lmasks[0])
+        for labels, out, mask in self._eval_outputs(iterator):
+            ev.eval(labels, out, mask=mask)
         return ev
 
     def evaluateRegression(self, iterator) -> RegressionEvaluation:
+        self._check_init()
         ev = RegressionEvaluation()
-        for ds in _as_batches(iterator):
-            feats, labels, _, lmasks = _split_dataset_full(ds)
-            out = self.output(feats[0])
-            ev.eval(labels[0], out, mask=lmasks[0])
+        for labels, out, mask in self._eval_outputs(iterator):
+            ev.eval(labels, out, mask=mask)
         return ev
 
     # -- params --------------------------------------------------------------

@@ -1,0 +1,42 @@
+"""Inference serving subsystem (ISSUE 2 tentpole).
+
+The repo's training side compiles once and executes many; this package
+gives the INFERENCE side the same contract under concurrent traffic:
+
+- `BucketLadder` / `buckets`: pad request batches into a fixed shape
+  ladder so XLA never sees a new shape after warmup;
+- `ModelRegistry`: named, versioned servables (MultiLayerNetwork,
+  ComputationGraph, SameDiff, plain fns) with
+  `jax.jit(...).lower().compile()` AOT warmup over the ladder;
+- `DynamicBatcher`: bounded-queue worker that coalesces concurrent
+  predict() calls into one padded device dispatch (max-latency flush,
+  backpressure, per-request timeouts, graceful shutdown);
+- `InferenceSession`: the sync/async facade, instrumented through the
+  PR-1 telemetry registry (`dl4j_serving_*`);
+- HTTP: `UIServer.serveModels(session)` exposes
+  `POST /serving/v1/models/<name>:predict` and
+  `GET /serving/v1/models` beside `/metrics`.
+
+See docs/SERVING.md.
+"""
+
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher, QueueFullError, ServingShutdown, ServingTimeout,
+    execute_plan)
+from deeplearning4j_tpu.serving.buckets import (
+    BucketLadder, DEFAULT_BATCH_BUCKETS, pad_batch, pad_rows, pad_time,
+    unpad)
+from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
+from deeplearning4j_tpu.serving.servable import (
+    FnServable, GraphServable, NetworkServable, SameDiffServable, Servable,
+    as_servable)
+from deeplearning4j_tpu.serving.session import InferenceSession
+
+__all__ = [
+    "BucketLadder", "DEFAULT_BATCH_BUCKETS", "DynamicBatcher",
+    "FnServable", "GraphServable", "InferenceSession", "ModelNotFound",
+    "ModelRegistry", "NetworkServable", "QueueFullError",
+    "SameDiffServable", "Servable", "ServingShutdown", "ServingTimeout",
+    "as_servable", "execute_plan", "pad_batch", "pad_rows", "pad_time",
+    "unpad",
+]

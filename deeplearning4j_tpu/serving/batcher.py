@@ -1,0 +1,304 @@
+"""DynamicBatcher: coalesce concurrent predict() calls into bucketed
+device dispatches.
+
+The economics (PAPERS.md "Towards High Performance Java-based Deep
+Learning Frameworks", and the cuDNN paper's fixed-shape lesson): per-
+request dispatch overhead dominates small-batch inference, so N
+concurrent single-example requests should cost ~1 device dispatch, not
+N. The worker thread drains a bounded queue, packs requests into the
+smallest covering bucket (padding the remainder), executes ONE warmed
+executable, and splits the result rows back to each caller's Future.
+
+Semantics:
+- max-latency flush: the first request in a batch waits at most
+  `max_latency` seconds for co-travelers, then the batch executes;
+- backpressure: the queue is bounded; `submit()` on a full queue raises
+  QueueFullError immediately (callers see HTTP 429) instead of letting
+  latency grow without bound;
+- per-request timeout: a request that exceeds its deadline while still
+  QUEUED fails with ServingTimeout and never reaches the device; one
+  already executing completes (the result is simply discarded by the
+  caller that stopped waiting);
+- graceful shutdown: close() stops the worker and fails queued requests
+  with ServingShutdown rather than hanging their futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.buckets import pad_rows, pad_time
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the batching queue is at capacity."""
+
+
+class ServingTimeout(TimeoutError):
+    """The request's deadline passed before it reached the device."""
+
+
+class ServingShutdown(RuntimeError):
+    """The batcher shut down with this request still queued."""
+
+
+class _Request:
+    __slots__ = ("x", "n", "t", "future", "t_enqueue", "deadline")
+
+    def __init__(self, x, deadline):
+        self.x = x
+        self.n = x.shape[0]
+        # real trailing time length of sequence inputs: results slice
+        # back to it after bucket padding
+        self.t = x.shape[-1] if x.ndim >= 3 else None
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline
+
+    def expired(self, now):
+        return self.deadline is not None and now > self.deadline
+
+    def fail(self, exc, instruments, outcome):
+        if self.future.set_running_or_notify_cancel():
+            self.future.set_exception(exc)
+        if instruments is not None:
+            instruments.request(outcome)
+
+
+def execute_plan(entry, xs):
+    """Execute already-coalesced rows through the entry's bucketed
+    executables: pad the time axis to its covering bucket ONCE, chunk
+    rows by ladder.plan, pad each chunk to its bucket, run, and slice
+    the padding rows back off. The ONE ladder-execution algorithm,
+    shared by the batcher worker and the session's direct path. Returns
+    (y_real_rows_time_padded, device_dispatch_count, padded_row_count).
+    """
+    ladder = entry.ladder
+    if xs.ndim >= 3:
+        xs = pad_time(xs, ladder.covering_seq(xs.shape[-1]))
+    n = xs.shape[0]
+    outs, n_padded, off = [], 0, 0
+    plan = ladder.plan(n)
+    for bucket in plan:
+        take = min(bucket, n - off)
+        chunk = pad_rows(xs[off:off + take], bucket)
+        outs.append(entry.servable.infer(chunk)[:take])
+        off += take
+        n_padded += bucket
+    y = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+    return y, len(plan), n_padded
+
+
+class DynamicBatcher:
+    """One worker thread per served model.
+
+    `entry` is a ModelRegistry entry (servable + ladder); `instruments`
+    a telemetry.ServingInstruments, a zero-arg callable returning one
+    (or None) — re-resolved per use so telemetry toggled mid-flight is
+    honored — or None.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, entry, max_latency=0.002, queue_size=256,
+                 default_timeout=30.0, instruments=None):
+        self.entry = entry
+        self.max_latency = float(max_latency)
+        self.default_timeout = default_timeout
+        self._instruments_fn = (instruments if callable(instruments)
+                                else lambda: instruments)
+        self._accepting = True
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._carry = None   # dequeued but didn't fit the closing batch
+        self._closed = False
+        # serializes submit-enqueue against close-drain: without it a
+        # request enqueued between close()'s drain and the closed check
+        # would never be completed nor failed
+        self._submit_lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._run, name=f"dl4j-batcher-{entry.name}",
+            daemon=True)
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(self, x, timeout=None) -> Future:
+        """Enqueue one request batch [n, ...]; returns its Future.
+        Raises QueueFullError when the bounded queue is at capacity."""
+        x = np.asarray(x)
+        if timeout is None:
+            timeout = self.default_timeout
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        req = _Request(x, deadline)
+        inst = self._instruments_fn()
+        try:
+            with self._submit_lock:
+                if self._closed or not self._accepting:
+                    raise ServingShutdown(
+                        f"batcher for {self.entry.name!r} closed")
+                self._q.put_nowait(req)
+        except queue.Full:
+            if inst is not None:
+                inst.request("rejected")
+            raise QueueFullError(
+                f"serving queue for {self.entry.name!r} is full "
+                f"({self._q.maxsize} requests)") from None
+        if inst is not None:
+            inst.depth.set(self._q.qsize())
+        return req.future
+
+    def queue_depth(self) -> int:
+        return self._q.qsize() + (1 if self._carry is not None else 0)
+
+    def retire(self, timeout=30.0):
+        """Rolling-update shutdown: stop ACCEPTING, let the worker
+        finish everything already queued, then stop. (close() is the
+        fail-fast path.)"""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._accepting = False
+        self._q.put(self._SENTINEL)   # FIFO: drains the queue first
+        self._worker.join(timeout)
+        self._closed = True
+
+    def close(self, timeout=5.0):
+        """Stop the worker; queued requests fail with ServingShutdown."""
+        if self._closed:
+            return
+        self._closed = True
+        self._accepting = False
+        self._q.put(self._SENTINEL)   # may block briefly if full: bounded
+        self._worker.join(timeout)
+        inst = self._instruments_fn()
+        with self._submit_lock:       # no submit can enqueue after this
+            leftovers = [] if self._carry is None else [self._carry]
+            self._carry = None
+            while True:
+                try:
+                    r = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if r is not self._SENTINEL:
+                    leftovers.append(r)
+            if self._worker.is_alive():
+                # join timed out mid-dispatch and the drain may have
+                # consumed the sentinel: re-arm it so the worker exits
+                # instead of polling forever
+                self._q.put(self._SENTINEL)
+        for r in leftovers:
+            r.fail(ServingShutdown("batcher closed"), inst, "shutdown")
+
+    # -- worker side --------------------------------------------------------
+    def _next(self, timeout):
+        if self._carry is not None:
+            r, self._carry = self._carry, None
+            return r
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _run(self):
+        max_batch = self.entry.ladder.max_batch
+        while True:
+            head = self._next(timeout=0.1)
+            if head is None:
+                continue
+            if head is self._SENTINEL:
+                return
+            if self._closed:
+                # graceful shutdown: in-flight work completed, queued
+                # requests fail fast instead of executing
+                head.fail(ServingShutdown("batcher closed"),
+                          self._instruments_fn(), "shutdown")
+                continue
+            batch, total = [head], head.n
+            flush_at = time.perf_counter() + self.max_latency
+            while total < max_batch:
+                wait = flush_at - time.perf_counter()
+                if wait <= 0:
+                    break
+                nxt = self._next(timeout=wait)
+                if nxt is None:
+                    break
+                if nxt is self._SENTINEL:
+                    self._execute(batch, total)
+                    return
+                if nxt.expired(time.perf_counter()):
+                    nxt.fail(ServingTimeout("timed out in queue"),
+                             self._instruments_fn(), "timeout")
+                    continue
+                if total + nxt.n > max_batch and nxt.n <= max_batch:
+                    # would overflow the largest bucket: hold it for the
+                    # next batch (oversized requests pass through and get
+                    # chunked by the ladder plan)
+                    self._carry = nxt
+                    break
+                batch.append(nxt)
+                total += nxt.n
+            self._execute(batch, total)
+
+    def _execute(self, batch, total):
+        inst = self._instruments_fn()
+        now = time.perf_counter()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                r.fail(ServingTimeout("timed out in queue"), inst,
+                       "timeout")
+            elif r.future.set_running_or_notify_cancel():
+                live.append(r)
+            elif inst is not None:
+                inst.request("rejected")   # caller cancelled the future
+        if not live:
+            return
+        total = sum(r.n for r in live)
+        if inst is not None:
+            inst.depth.set(self._q.qsize())
+            for r in live:
+                inst.queue_wait.observe(now - r.t_enqueue)
+        try:
+            if live[0].t is not None:
+                # sequence inputs may differ in trailing length within
+                # one coalesced batch: pad each to the covering seq
+                # bucket of the longest BEFORE concatenating (results
+                # slice back to each request's own real length)
+                t_bucket = self.entry.ladder.covering_seq(
+                    max(r.t for r in live))
+                parts = [pad_time(r.x, t_bucket) for r in live]
+            else:
+                parts = [r.x for r in live]
+            xs = (np.concatenate(parts, axis=0)
+                  if len(parts) > 1 else parts[0])
+            t0 = time.perf_counter()
+            y, n_dispatch, n_padded = self._dispatch(xs)
+            dt = time.perf_counter() - t0
+            if inst is not None:
+                inst.execute.observe(dt)
+                inst.dispatch.inc(n_dispatch)
+                inst.occupancy.set(total / max(n_padded, 1))
+            off = 0
+            for r in live:
+                seg = y[off:off + r.n]
+                if r.t is not None and seg.ndim >= 3 and \
+                        seg.shape[-1] != r.t:
+                    seg = seg[..., :r.t]
+                r.future.set_result(seg)
+                off += r.n
+                if inst is not None:
+                    inst.request("ok")
+        except Exception as e:  # surface the device error to every caller
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                if inst is not None:
+                    inst.request("error")
+
+    def _dispatch(self, xs) -> tuple:
+        return execute_plan(self.entry, xs)

@@ -1,0 +1,99 @@
+"""JSON request/response codec for the serving HTTP routes.
+
+Kept transport-free so ui/server.py (stdlib http.server) stays a thin
+dispatcher: this module turns a request body into a numpy batch, runs it
+through an InferenceSession, and maps serving errors onto HTTP statuses:
+
+    400  malformed JSON / wrong shape or dtype
+    404  unknown model (or no serving session attached)
+    429  queue full (backpressure — retry with backoff)
+    504  request timed out before execution
+    503  session shut down
+    500  device/runtime error
+
+Wire format (TF-Serving-style):
+
+    POST /serving/v1/models/<name>:predict
+    {"instances": [[...], ...]}             -> {"predictions": [[...], ...]}
+    {"instances": [...], "version": 2, "timeout_ms": 100}
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import (
+    QueueFullError, ServingShutdown, ServingTimeout)
+from deeplearning4j_tpu.serving.registry import ModelNotFound
+
+PREDICT_SUFFIX = ":predict"
+MODELS_PATH = "/serving/v1/models"
+
+
+class HttpError(Exception):
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def parse_predict_path(path: str):
+    """'/serving/v1/models/<name>:predict' -> name, or None when the
+    path is not a predict route."""
+    if not path.startswith(MODELS_PATH + "/") or \
+            not path.endswith(PREDICT_SUFFIX):
+        return None
+    name = path[len(MODELS_PATH) + 1:-len(PREDICT_SUFFIX)]
+    return name or None
+
+
+def handle_models(session) -> bytes:
+    """GET /serving/v1/models payload."""
+    if session is None:
+        raise HttpError(404, "no serving session attached "
+                             "(UIServer.serveModels(session))")
+    return json.dumps({"models": session.models()}).encode()
+
+
+def handle_predict(session, name: str, body: bytes) -> bytes:
+    if session is None:
+        raise HttpError(404, "no serving session attached "
+                             "(UIServer.serveModels(session))")
+    try:
+        payload = json.loads(body or b"")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HttpError(400, f"malformed JSON body: {e}") from None
+    if not isinstance(payload, dict) or "instances" not in payload:
+        raise HttpError(400, 'body must be {"instances": [...]}')
+    timeout = payload.get("timeout_ms")
+    try:
+        timeout = float(timeout) / 1e3 if timeout is not None else None
+    except (TypeError, ValueError):
+        raise HttpError(400, f"timeout_ms must be a number, "
+                             f"got {timeout!r}") from None
+    version = payload.get("version")
+    try:
+        entry = session.registry.get(name, version)
+        x = np.asarray(payload["instances"],
+                       dtype=entry.servable.dtype)
+        y = session.predict(name, x, timeout=timeout, version=version)
+    except ModelNotFound as e:
+        raise HttpError(404, f"unknown model: {e}") from None
+    except QueueFullError as e:
+        raise HttpError(429, str(e)) from None
+    except (ServingTimeout, TimeoutError) as e:
+        raise HttpError(504, f"timed out: {e}") from None
+    except ServingShutdown as e:
+        raise HttpError(503, str(e)) from None
+    except ValueError as e:
+        raise HttpError(400, str(e)) from None
+    except Exception as e:
+        raise HttpError(500, f"{type(e).__name__}: {e}") from None
+    return json.dumps({"model": name, "version": entry.version,
+                       "predictions": np.asarray(y).tolist()}).encode()
+
+
+def error_body(exc: HttpError) -> bytes:
+    return json.dumps({"error": exc.message, "status": exc.status}).encode()

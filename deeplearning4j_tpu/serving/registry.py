@@ -1,0 +1,137 @@
+"""ModelRegistry: named, versioned servables with bucket-ladder warmup.
+
+Reference capability: the model-zoo/serving side of the upstream project
+(DL4J models exported to production and served from Java). Here a
+registry row is (name, version) -> Servable + BucketLadder; `warmup()`
+AOT-compiles the ladder and `describe()` feeds the
+`GET /serving/v1/models` endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.buckets import BucketLadder
+from deeplearning4j_tpu.serving.servable import Servable, as_servable
+
+
+class ModelNotFound(KeyError):
+    pass
+
+
+class _Entry:
+    __slots__ = ("name", "version", "servable", "ladder", "registered_at",
+                 "warmed", "warmup_seconds")
+
+    def __init__(self, name, version, servable, ladder):
+        self.name = name
+        self.version = int(version)
+        self.servable = servable
+        self.ladder = ladder
+        self.registered_at = time.time()
+        self.warmed = False
+        self.warmup_seconds = None
+
+    def warmup(self):
+        t0 = time.perf_counter()
+        self.servable.warmup(self.ladder)
+        self.warmup_seconds = time.perf_counter() - t0
+        self.warmed = True
+        return self
+
+    def describe(self) -> dict:
+        sv = self.servable
+        return {
+            "name": self.name,
+            "version": self.version,
+            "type": type(sv).__name__,
+            "example_shape": list(sv.example_shape),
+            "dtype": str(sv.dtype),
+            "ladder": self.ladder.describe(),
+            "warmed": self.warmed,
+            "warmed_shapes": [list(s) for s in sv.warmed_shapes],
+            "warmup_seconds": self.warmup_seconds,
+        }
+
+
+class ModelRegistry:
+    """name -> {version -> entry}; lookups default to the newest
+    version. Registration is idempotent per (name, version): re-register
+    to replace (rolling update — in-flight requests on the old entry
+    finish on the old servable)."""
+
+    def __init__(self, ladder: BucketLadder | None = None):
+        self.default_ladder = ladder or BucketLadder()
+        self._models: dict[str, dict[int, _Entry]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name, model, version=1, example_shape=None,
+                 dtype=np.float32, ladder=None, input_name=None,
+                 output_name=None, warmup=False) -> _Entry:
+        sv = (model if isinstance(model, Servable)
+              else as_servable(model, example_shape, dtype,
+                               input_name=input_name,
+                               output_name=output_name))
+        ladder = ladder if ladder is not None else self.default_ladder
+        if isinstance(ladder, (list, tuple)):
+            ladder = BucketLadder(ladder)
+        entry = _Entry(name, version, sv, ladder)
+        with self._lock:
+            self._models.setdefault(name, {})[entry.version] = entry
+        if warmup:
+            entry.warmup()
+        return entry
+
+    def unregister(self, name, version=None):
+        with self._lock:
+            if name not in self._models:
+                raise ModelNotFound(name)
+            if version is None:
+                del self._models[name]
+            else:
+                del self._models[name][int(version)]
+                if not self._models[name]:
+                    del self._models[name]
+
+    def get(self, name, version=None) -> _Entry:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound(name)
+            if version is None:
+                return versions[max(versions)]
+            try:
+                return versions[int(version)]
+            except KeyError:
+                raise ModelNotFound(f"{name}:{version}") from None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def warmup(self, name=None, version=None):
+        """AOT-compile the ladder for one model (or EVERY version of
+        every registered model — pinned-version traffic must not hit a
+        cold executable). Compiles show up in dl4j_compile_total DURING
+        this call; a warmed steady state adds none."""
+        if name is not None:
+            entries = [self.get(name, version)]
+        else:
+            with self._lock:
+                entries = [e for vs in self._models.values()
+                           for e in vs.values()]
+        for e in entries:
+            e.warmup()
+        return self
+
+    def describe(self) -> list[dict]:
+        """Every (name, version) row, newest version first per name —
+        the GET /serving/v1/models payload."""
+        with self._lock:
+            entries = [e for vs in self._models.values()
+                       for e in vs.values()]
+        return [e.describe() for e in
+                sorted(entries, key=lambda e: (e.name, -e.version))]

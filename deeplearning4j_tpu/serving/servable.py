@@ -1,0 +1,240 @@
+"""Servable adapters: one uniform `infer(batch) -> batch` face over
+MultiLayerNetwork, ComputationGraph, SameDiff, and plain callables, with
+shape-bucketed AOT compilation.
+
+Warmup lowers the model's pure inference function once per ladder shape
+via `jax.jit(fn).lower(...).compile()` and keeps the compiled
+executables keyed by input shape. The serving hot path calls those
+executables DIRECTLY — the jit dispatch cache is a separate cache, so
+routing a warmed shape back through `jax.jit` would re-trace and
+re-compile (measured on this jax build); going straight to the
+executable is what makes "zero recompiles after warmup" a guarantee the
+`dl4j_compile_total` counter can assert, not a hope.
+
+Parameters are read from the live network at call time, never captured:
+`fit()` DONATES its buffers and rebinds, so a captured reference would
+go stale after interleaved training. Shapes don't change, so warmed
+executables stay valid across training steps (continuous
+train-and-serve).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.buckets import BucketLadder
+
+
+def _np(y):
+    return np.asarray(y)
+
+
+class Servable:
+    """Base: shape-keyed AOT executable cache + jitted fallback.
+
+    Subclasses provide `_jit_fn()` (the jax.jit-wrapped pure function)
+    and `_call_args()` (the non-input arguments, read fresh per call).
+    """
+
+    def __init__(self, example_shape, dtype=np.float32):
+        if example_shape is None:
+            raise ValueError(
+                "serving needs the per-example input shape (no batch "
+                "axis), e.g. example_shape=(784,)")
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self.dtype = np.dtype(dtype)
+        self._compiled = {}
+        self._lock = threading.Lock()
+
+    # -- subclass surface ---------------------------------------------------
+    def _jit_fn(self):
+        raise NotImplementedError
+
+    def _call_args(self) -> tuple:
+        raise NotImplementedError
+
+    def _input(self, x):
+        """Adapt the raw batch into the traced function's input pytree."""
+        return x
+
+    def _output(self, y):
+        """Adapt the traced function's output back to one array."""
+        return _np(y)
+
+    # -- AOT warmup ---------------------------------------------------------
+    def compile_shape(self, shape: tuple):
+        """Lower + compile the inference function for one concrete input
+        shape (idempotent)."""
+        import jax
+
+        shape = tuple(shape)
+        if shape in self._compiled:
+            return self._compiled[shape]
+        spec = self._input(jax.ShapeDtypeStruct(shape, self.dtype))
+        exe = self._jit_fn().lower(*self._call_args(), spec).compile()
+        with self._lock:
+            self._compiled.setdefault(shape, exe)
+        return self._compiled[shape]
+
+    def warmup(self, ladder: BucketLadder) -> list[tuple]:
+        """AOT-compile every ladder shape; returns the warmed shapes."""
+        shapes = ladder.shapes(self.example_shape)
+        for s in shapes:
+            self.compile_shape(s)
+        return shapes
+
+    @property
+    def warmed_shapes(self) -> list[tuple]:
+        return sorted(self._compiled)
+
+    # -- hot path -----------------------------------------------------------
+    def infer(self, x) -> np.ndarray:
+        """Run one already-bucketed batch. Warmed shapes execute the AOT
+        executable (zero compiles); unwarmed shapes fall through to the
+        jitted function (compiles once, visible in dl4j_compile_total)."""
+        x = np.ascontiguousarray(x, dtype=self.dtype)
+        exe = self._compiled.get(x.shape)
+        if exe is not None:
+            y = exe(*self._call_args(), self._input(x))
+        else:
+            y = self._jit_fn()(*self._call_args(), self._input(x))
+        return self._output(y)
+
+
+class NetworkServable(Servable):
+    """MultiLayerNetwork: reuses the network's own jitted inference
+    function, so direct `net.output()` calls and serving share one jit
+    cache (and produce bit-identical results)."""
+
+    def __init__(self, net, example_shape, dtype=np.float32):
+        super().__init__(example_shape, dtype)
+        self.net = net
+
+    def _jit_fn(self):
+        return self.net._infer_fn(False)
+
+    def _call_args(self):
+        return (self.net._params, self.net._states)
+
+
+class GraphServable(Servable):
+    """ComputationGraph (single input / single output)."""
+
+    def __init__(self, graph, example_shape, dtype=np.float32):
+        super().__init__(example_shape, dtype)
+        if len(graph.conf.inputs) != 1 or len(graph.conf.outputs) != 1:
+            raise ValueError(
+                f"serving supports single-input/single-output graphs; "
+                f"got inputs={graph.conf.inputs} "
+                f"outputs={graph.conf.outputs}")
+        self.graph = graph
+        self._in = graph.conf.inputs[0]
+        self._out = graph.conf.outputs[0]
+        self._jitted = None
+
+    def _jit_fn(self):
+        if self._jitted is None:
+            import jax
+
+            g, out = self.graph, self._out
+
+            def fn(params, states, inputs):
+                env, _ = g._forward(params, states, inputs, False, None)
+                return env[out]
+
+            self._jitted = jax.jit(fn)
+        return self._jitted
+
+    def _call_args(self):
+        return (self.graph._params, self.graph._states)
+
+    def _input(self, x):
+        return {self._in: x}
+
+
+class SameDiffServable(Servable):
+    """SameDiff graph: serve one placeholder -> one output variable."""
+
+    def __init__(self, sd, input_name, output_name, example_shape,
+                 dtype=np.float32):
+        super().__init__(example_shape, dtype)
+        import jax
+
+        self.sd = sd
+        self.input_name = (input_name.name()
+                           if hasattr(input_name, "name") else input_name)
+        self.output_name = (output_name.name()
+                            if hasattr(output_name, "name") else output_name)
+        self._rng = jax.random.key(sd._seed)
+
+    def _jit_fn(self):
+        return self.sd._jitted((self.output_name,), False)
+
+    def _call_args(self):
+        params, consts = self.sd._split_values()
+        return (params, consts, self._rng)
+
+    def _input(self, x):
+        return {self.input_name: x}
+
+    def _output(self, y):
+        return _np(y[self.output_name])
+
+    def compile_shape(self, shape):
+        import jax
+
+        shape = tuple(shape)
+        if shape in self._compiled:
+            return self._compiled[shape]
+        params, consts, rng = self._call_args()
+        spec = self._input(jax.ShapeDtypeStruct(shape, self.dtype))
+        exe = self._jit_fn().lower(spec, params, consts, rng).compile()
+        with self._lock:
+            self._compiled.setdefault(shape, exe)
+        return self._compiled[shape]
+
+    def infer(self, x):
+        x = np.ascontiguousarray(x, dtype=self.dtype)
+        exe = self._compiled.get(x.shape)
+        fn = exe if exe is not None else self._jit_fn()
+        return self._output(fn(self._input(x), *self._call_args()))
+
+
+class FnServable(Servable):
+    """A plain `fn(x) -> y` (jax-traceable), jitted and bucket-compiled
+    like any network — the escape hatch for custom pipelines."""
+
+    def __init__(self, fn, example_shape, dtype=np.float32):
+        super().__init__(example_shape, dtype)
+        import jax
+
+        self._jitted = jax.jit(fn)
+
+    def _jit_fn(self):
+        return self._jitted
+
+    def _call_args(self):
+        return ()
+
+
+def as_servable(model, example_shape=None, dtype=np.float32,
+                input_name=None, output_name=None) -> Servable:
+    """Wrap any supported model type in its Servable adapter."""
+    if isinstance(model, Servable):
+        return model
+    kind = type(model).__name__
+    if kind == "MultiLayerNetwork":
+        return NetworkServable(model, example_shape, dtype)
+    if kind == "ComputationGraph":
+        return GraphServable(model, example_shape, dtype)
+    if kind == "SameDiff":
+        if input_name is None or output_name is None:
+            raise ValueError(
+                "SameDiff serving needs input_name= and output_name=")
+        return SameDiffServable(model, input_name, output_name,
+                                example_shape, dtype)
+    if callable(model):
+        return FnServable(model, example_shape, dtype)
+    raise TypeError(f"cannot serve a {kind}")

@@ -1,0 +1,202 @@
+"""InferenceSession: the one-call serving facade.
+
+    from deeplearning4j_tpu.serving import InferenceSession
+
+    session = InferenceSession()
+    session.register("mnist", net, example_shape=(784,), warmup=True)
+    y = session.predict("mnist", x)            # sync, batched, bucketed
+    f = session.predict_async("mnist", x)      # concurrent callers coalesce
+
+Every model gets its own DynamicBatcher (worker thread) created lazily
+on first predict; `batching=False` (or per-call `batched=False`) runs
+the caller's thread straight through the bucketed servable — same
+padding, no queue — which is what evaluation loops and single-tenant
+batch jobs want. Telemetry (`dl4j_serving_*`) records through the PR-1
+MetricsRegistry either way.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+import numpy as np
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher, ServingTimeout, execute_plan)
+from deeplearning4j_tpu.serving.buckets import BucketLadder, unpad
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+
+class InferenceSession:
+    def __init__(self, registry: ModelRegistry | None = None,
+                 max_latency=0.002, queue_size=256, default_timeout=30.0,
+                 batching=True):
+        self.registry = registry or ModelRegistry()
+        self.max_latency = max_latency
+        self.queue_size = queue_size
+        self.default_timeout = default_timeout
+        self.batching = batching
+        self._batchers: dict[str, DynamicBatcher] = {}
+        self._instruments: dict = {}   # per-model bundle, built once
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- registry passthrough ------------------------------------------------
+    def register(self, name, model, **kw):
+        """See ModelRegistry.register. Re-registering retires the
+        model's old batchers: new predicts bind the new entry while
+        already-queued requests finish on the old servable (rolling
+        update)."""
+        entry = self.registry.register(name, model, **kw)
+        with self._lock:
+            stale = [k for k in self._batchers if k[0] == name]
+            dropped = [self._batchers.pop(k) for k in stale]
+        for b in dropped:
+            b.retire()
+        return entry
+
+    def warmup(self, name=None, version=None):
+        self.registry.warmup(name, version)
+        return self
+
+    def models(self):
+        return self.registry.describe()
+
+    # -- predict -------------------------------------------------------------
+    def _inst(self, name):
+        """Per-model ServingInstruments: None whenever telemetry is
+        disabled (the flag is re-checked on every call so toggling
+        mid-flight is honored); the bound bundle itself is built once."""
+        if not telemetry.enabled():
+            return None
+        inst = self._instruments.get(name)
+        if inst is None:
+            inst = telemetry.serving_instruments(name)
+            self._instruments[name] = inst
+        return inst
+
+    def _batcher(self, name, entry) -> DynamicBatcher:
+        """One batcher per served (name, version): pinned-version
+        requests coalesce among themselves, never across versions."""
+        key = (name, entry.version)
+        b = self._batchers.get(key)
+        if b is None:
+            with self._lock:
+                b = self._batchers.get(key)
+                if b is None:
+                    b = DynamicBatcher(
+                        entry,
+                        max_latency=self.max_latency,
+                        queue_size=self.queue_size,
+                        default_timeout=self.default_timeout,
+                        instruments=lambda: self._inst(name))
+                    self._batchers[key] = b
+        return b
+
+    def _prep(self, name, features, version=None):
+        entry = self.registry.get(name, version)
+        shape = entry.servable.example_shape
+        x = np.asarray(features)
+        single = x.ndim == len(shape)
+        if single:
+            x = x[None]
+        got = tuple(x.shape[1:])
+        # sequence models ([N, C, T]) may vary the trailing time axis —
+        # it pads to a seq bucket; every other axis must match exactly
+        ok = (got[:-1] == shape[:-1] if x.ndim >= 3 and len(got) == len(shape)
+              else got == shape)
+        if not ok:
+            raise ValueError(
+                f"model {name!r} expects examples of shape {shape}, "
+                f"got {got}")
+        return entry, x, single
+
+    def predict_async(self, name, features, timeout=None, version=None):
+        """Future of the prediction batch. Concurrent callers of the
+        same model (and version) coalesce into shared device
+        dispatches."""
+        if self._closed:
+            raise RuntimeError("session closed")
+        entry, x, single = self._prep(name, features, version)
+        future = self._batcher(name, entry).submit(x, timeout=timeout)
+        if not single:
+            return future
+        from concurrent.futures import Future
+
+        out = Future()
+        out.set_running_or_notify_cancel()
+
+        def _done(f):
+            e = f.exception()
+            if e is not None:
+                out.set_exception(e)
+            else:
+                out.set_result(f.result()[0])
+
+        future.add_done_callback(_done)
+        return out
+
+    def predict(self, name, features, timeout=None, batched=None,
+                version=None):
+        """Synchronous predict. `batched=False` bypasses the queue and
+        runs the bucketed servable on the calling thread."""
+        if timeout is None:
+            timeout = self.default_timeout
+        use_batcher = self.batching if batched is None else batched
+        if not use_batcher:
+            return self._direct(name, features, version)
+        t0 = time.perf_counter()
+        future = self.predict_async(name, features, timeout=timeout,
+                                    version=version)
+        budget = (None if timeout is None
+                  else max(0.0, timeout - (time.perf_counter() - t0)) + 0.25)
+        try:
+            return future.result(timeout=budget)
+        except _FutureTimeout:
+            # concurrent.futures.TimeoutError is NOT the builtin
+            # TimeoutError before py3.11 — normalize so callers (and the
+            # HTTP 504 mapping) see one exception type
+            raise ServingTimeout(
+                f"request to {name!r} timed out after {timeout}s"
+            ) from None
+
+    def _direct(self, name, features, version=None):
+        entry, x, single = self._prep(name, features, version)
+        inst = self._inst(name)
+        t = x.shape[-1] if x.ndim >= 3 else None
+        t0 = time.perf_counter()
+        try:
+            y, n_dispatch, _ = execute_plan(entry, x)
+        except Exception:
+            if inst is not None:
+                inst.request("error")
+            raise
+        if inst is not None:
+            inst.execute.observe(time.perf_counter() - t0)
+            inst.dispatch.inc(n_dispatch)
+            inst.request("ok")
+        y = unpad(y, y.shape[0], t)
+        return y[0] if single else y
+
+    # -- introspection / lifecycle -------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {f"{name}:v{version}": {"queue_depth": b.queue_depth()}
+                    for (name, version), b in self._batchers.items()}
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            batchers, self._batchers = list(self._batchers.values()), {}
+        for b in batchers:
+            b.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -19,15 +19,15 @@ from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
 from deeplearning4j_tpu.telemetry.listener import MetricsListener
 from deeplearning4j_tpu.telemetry.registry import (
     BYTES_BUCKETS, Counter, ETL_HELP, Gauge, Histogram, LoopInstruments,
-    MetricsRegistry, SECONDS_BUCKETS, STEP_HELP, Timer,
+    MetricsRegistry, SECONDS_BUCKETS, STEP_HELP, ServingInstruments, Timer,
     collect_device_memory, disable, enable, enabled, get_registry,
-    log_buckets, loop_instruments, set_registry, span)
+    log_buckets, loop_instruments, serving_instruments, set_registry, span)
 
 __all__ = [
     "BYTES_BUCKETS", "Counter", "ETL_HELP", "Gauge", "Histogram",
     "LoopInstruments", "MetricsListener", "MetricsRegistry",
-    "SECONDS_BUCKETS", "STEP_HELP", "Timer", "aggregate",
-    "aggregate_snapshot", "collect_device_memory", "disable", "enable",
-    "enabled", "get_registry", "log_buckets", "loop_instruments",
-    "prometheus", "set_registry", "span",
+    "SECONDS_BUCKETS", "STEP_HELP", "ServingInstruments", "Timer",
+    "aggregate", "aggregate_snapshot", "collect_device_memory", "disable",
+    "enable", "enabled", "get_registry", "log_buckets", "loop_instruments",
+    "prometheus", "serving_instruments", "set_registry", "span",
 ]

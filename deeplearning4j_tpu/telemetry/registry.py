@@ -425,6 +425,59 @@ def loop_instruments(loop):
     return LoopInstruments(get_registry(), loop)
 
 
+# -- the standard instrument set for inference serving (ISSUE 2) -------------
+
+SERVING_REQUESTS_HELP = ("Inference requests by terminal outcome "
+                         "(ok|timeout|rejected|error|shutdown)")
+SERVING_QUEUE_HELP = "Seconds a request waited in the batching queue"
+SERVING_EXECUTE_HELP = ("Seconds per coalesced device dispatch (pad + "
+                        "execute + split, host-visible)")
+SERVING_OCCUPANCY_HELP = ("Real rows / bucket rows of the last coalesced "
+                          "dispatch (1.0 = perfectly filled bucket)")
+SERVING_DISPATCH_HELP = "Coalesced device dispatches executed"
+SERVING_DEPTH_HELP = "Requests currently queued for batching"
+
+
+class ServingInstruments:
+    """Bound per-model serving instruments (mirrors LoopInstruments:
+    obtained once per batcher, None when telemetry is disabled, so a
+    disabled serving path performs zero registry calls per request)."""
+
+    __slots__ = ("model", "_requests", "queue_wait", "execute",
+                 "occupancy", "dispatch", "depth")
+
+    def __init__(self, registry, model):
+        self.model = model
+        self._requests = registry.counter(
+            "dl4j_serving_requests_total", SERVING_REQUESTS_HELP,
+            ("model", "outcome"))
+        self.queue_wait = registry.histogram(
+            "dl4j_serving_queue_wait_seconds", SERVING_QUEUE_HELP,
+            ("model",)).labels(model=model)
+        self.execute = registry.histogram(
+            "dl4j_serving_execute_seconds", SERVING_EXECUTE_HELP,
+            ("model",)).labels(model=model)
+        self.occupancy = registry.gauge(
+            "dl4j_serving_batch_occupancy", SERVING_OCCUPANCY_HELP,
+            ("model",)).labels(model=model)
+        self.dispatch = registry.counter(
+            "dl4j_serving_dispatch_total", SERVING_DISPATCH_HELP,
+            ("model",)).labels(model=model)
+        self.depth = registry.gauge(
+            "dl4j_serving_queue_depth", SERVING_DEPTH_HELP,
+            ("model",)).labels(model=model)
+
+    def request(self, outcome):
+        self._requests.labels(model=self.model, outcome=outcome).inc()
+
+
+def serving_instruments(model):
+    """Per-model serving instrument bundle, or None when disabled."""
+    if not _state["enabled"]:
+        return None
+    return ServingInstruments(get_registry(), model)
+
+
 # -- compile visibility (jit-cache-miss hook) --------------------------------
 
 COMPILE_HELP = "XLA backend compiles observed in this process"

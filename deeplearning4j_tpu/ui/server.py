@@ -6,13 +6,20 @@ curves for attached training sessions in a browser. Implemented on the
 stdlib http.server (no vertx, no js deps): "/" renders an auto-refreshing
 SVG score chart, "/data" serves the attached storages' records as JSON,
 "/metrics" serves the telemetry registry in Prometheus text exposition
-(ISSUE 1: the scrape endpoint)."""
+(ISSUE 1: the scrape endpoint), and — with an InferenceSession attached
+via serveModels() — "/serving/v1/models" lists registered models and
+"POST /serving/v1/models/<name>:predict" serves JSON inference
+(ISSUE 2: the serving endpoint)."""
 
 from __future__ import annotations
 
+import errno
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger(__name__)
 
 _PAGE = """<!doctype html>
 <html><head><title>dl4j-tpu training UI</title>
@@ -63,6 +70,13 @@ setInterval(draw, 2000);
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpuUI/1.0"
 
+    def _respond(self, body, ctype="application/json", status=200):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         if self.path == "/data":
             body = json.dumps(self.server.ui._sessions()).encode()
@@ -72,6 +86,18 @@ class _Handler(BaseHTTPRequestHandler):
 
             body = prometheus.render().encode()
             ctype = prometheus.CONTENT_TYPE
+        elif self.path.startswith("/serving/"):
+            from deeplearning4j_tpu.serving import http as shttp
+
+            if self.path.rstrip("/") != shttp.MODELS_PATH:
+                self._respond(b'{"error": "not found"}', status=404)
+                return
+            try:
+                body = shttp.handle_models(self.server.ui._serving)
+            except shttp.HttpError as e:
+                self._respond(shttp.error_body(e), status=e.status)
+                return
+            ctype = "application/json"
         elif self.path == "/":
             body = _PAGE.encode()
             ctype = "text/html; charset=utf-8"
@@ -79,11 +105,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._respond(body, ctype)
+
+    def do_POST(self):
+        from deeplearning4j_tpu.serving import http as shttp
+
+        name = shttp.parse_predict_path(self.path)
+        if name is None:
+            self._respond(b'{"error": "not found"}', status=404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            out = shttp.handle_predict(self.server.ui._serving, name, body)
+        except shttp.HttpError as e:
+            self._respond(shttp.error_body(e), status=e.status)
+            return
+        self._respond(out)
 
     def log_message(self, *args):  # quiet
         pass
@@ -98,6 +136,7 @@ class UIServer:
         self._storages = []
         self._httpd = None
         self._thread = None
+        self._serving = None
         self.port = None
 
     @classmethod
@@ -127,12 +166,39 @@ class UIServer:
     def enableRemoteListener(self):  # API parity no-op (single-process)
         return self
 
-    def start(self, port=9000):
+    def serveModels(self, session):
+        """Attach an InferenceSession: enables POST
+        /serving/v1/models/<name>:predict and GET /serving/v1/models."""
+        self._serving = session
+        return self
+
+    def start(self, port=9000, max_port_retries=16):
+        """Bind and serve in a daemon thread. A port already in use is
+        not fatal (a serving smoke test and a dangling stats UI must
+        coexist): retry the next ports, then fall back to an
+        OS-assigned one; the port actually bound is logged and stored
+        in `self.port`."""
         if self._httpd is not None:
             return self
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        candidates = ([port] if port == 0 else
+                      list(range(port, port + max_port_retries)) + [0])
+        for p in candidates:
+            try:
+                self._httpd = ThreadingHTTPServer(("127.0.0.1", p), _Handler)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EADDRINUSE, errno.EACCES):
+                    raise
+                log.warning("UI server port %d in use, trying next", p)
+        else:
+            raise OSError(
+                f"UI server could not bind any port in {candidates}")
         self._httpd.ui = self
         self.port = self._httpd.server_address[1]
+        if port and self.port != port:
+            log.warning("UI server requested port %d but bound %d",
+                        port, self.port)
+        log.info("UI server listening on http://127.0.0.1:%d", self.port)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

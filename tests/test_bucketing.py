@@ -67,3 +67,47 @@ class TestRaggedBatchBucketing:
         net = ComputationGraph(conf).init()
         net.fit(_batches(), 3)
         assert net._train_step._cache_size() == 1
+
+
+class TestEvalBucketing:
+    """ISSUE 2 satellite: evaluate()/evaluateRegression() pad the ragged
+    final batch up to the running bucket (serving pad_rows), so an eval
+    pass compiles ONE inference executable instead of two."""
+
+    def test_evaluate_single_executable_and_same_metrics(self):
+        net = MultiLayerNetwork(_conf()).init()
+        batches = _batches()                      # 8, 8, 6 — ragged tail
+        ev = net.evaluate(batches)
+        assert net._infer_fns[("out", False)]._cache_size() == 1
+        # metrics identical to unpadded per-batch evaluation
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ref = Evaluation()
+        for f, l in batches:
+            ref.eval(l, net.output(f).toNumpy())
+        assert ev.accuracy() == ref.accuracy()
+        assert np.array_equal(ev.confusionMatrix(), ref.confusionMatrix())
+
+    def test_evaluate_regression_single_executable(self):
+        net = MultiLayerNetwork(_conf()).init()
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(22, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 22)]
+        batches = [(X[i:i + 8], y[i:i + 8]) for i in range(0, 22, 8)]
+        net.evaluateRegression(batches)
+        assert net._infer_fns[("out", False)]._cache_size() == 1
+
+    def test_graph_evaluate_single_executable(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .updater(Sgd(1e-1))
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer.Builder().nIn(6).nOut(8)
+                          .activation("tanh").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(8).nOut(3)
+                          .lossFunction(LossFunction.MCXENT).build(), "d")
+                .setOutputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        net.evaluate(_batches())
+        assert net._infer_fn_cache[("out", False)]._cache_size() == 1

@@ -93,3 +93,21 @@ class TestUIServer:
                 assert e.code == 404
         finally:
             ui.stop()
+
+    def test_port_in_use_retries_next_free_port(self):
+        """ISSUE 2 satellite: a second server on an occupied port must
+        bind the next free one instead of crashing, so a serving smoke
+        test and a dangling stats UI can coexist."""
+        first = UIServer().start(port=0)
+        second = UIServer()
+        try:
+            second.start(port=first.port)
+            assert second.port is not None and second.port != first.port
+            # both serve
+            for ui in (first, second):
+                page = urllib.request.urlopen(
+                    f"http://127.0.0.1:{ui.port}/").read().decode()
+                assert "Training score" in page
+        finally:
+            second.stop()
+            first.stop()
