@@ -447,12 +447,86 @@ def bench_serving_latency(n_requests=300):
     }
 
 
+def bench_health_overhead(steps=80, repeats=3):
+    """ISSUE 3 smoke: per-step cost of the in-step health stats + host
+    publication. Three modes on the SAME architecture (fresh net each,
+    jit warmed outside the timed region): health on (telemetry enabled),
+    health off (`telemetry.health.configure(enabled=False)` — the stats
+    are compiled out of the step), telemetry disabled entirely.
+    Acceptance: on-vs-off overhead <= 10%."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.telemetry import health
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 256)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)]
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder().seed(11)
+                .updater(Adam(1e-3)).list()
+                .layer(DenseLayer.Builder().nIn(256).nOut(256)
+                       .activation("relu").build())
+                .layer(DenseLayer.Builder().nOut(256)
+                       .activation("relu").build())
+                .layer(DenseLayer.Builder().nOut(256)
+                       .activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(10)
+                       .activation("softmax")
+                       .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def time_mode(setup, teardown):
+        setup()
+        try:
+            net = build()
+            net.fit([(X, y)] * 5)                 # compile + settle
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                net.fit([(X, y)] * steps)
+                _ = float(np.asarray(net._params[0]["W"]).sum())  # sync
+                best = min(best, time.perf_counter() - t0)
+            return best / steps * 1e3             # ms/step
+        finally:
+            teardown()
+
+    was_enabled = telemetry.enabled()
+    on_ms = time_mode(telemetry.enable, lambda: None)
+    off_ms = time_mode(lambda: health.configure(enabled=False),
+                       lambda: health.configure(enabled=True))
+    dis_ms = time_mode(telemetry.disable,
+                       telemetry.enable if was_enabled
+                       else (lambda: None))
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0
+    return {
+        "metric": "health_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "step_ms_health_on": round(on_ms, 4),
+        "step_ms_health_off": round(off_ms, 4),
+        "step_ms_telemetry_disabled": round(dis_ms, 4),
+        "steps": steps,
+        "note": ("min-of-3 mean step time over {n} steps of a 4-layer "
+                 "256-wide MLP, batch 128; health on = per-layer fused "
+                 "stats in-step + one-behind host publication; off = "
+                 "stats compiled out; disabled = no telemetry at "
+                 "all".format(n=steps)),
+    }
+
+
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
                ("graves_lstm", bench_graves_lstm),
                ("word2vec", bench_word2vec),
-               ("serving_latency", bench_serving_latency)]
+               ("serving_latency", bench_serving_latency),
+               ("health_overhead", bench_health_overhead)]
 
 
 def _merge_bench_all(results, path="BENCH_ALL.json"):

@@ -591,13 +591,25 @@ class OCNNOutputLayer(LossLayer):
     org.deeplearning4j.nn.conf.ocnn.OCNNOutputLayer — hiddenSize, nu,
     windowSize, rUpdate schedule).
 
-    Score y = w . sigmoid(V x); training minimizes
-      0.5(||V||^2 + ||w||^2) + mean(relu(r - y)) / nu - r
-    with r tracked in the compiled step (like batch-norm statistics) as
-    an exponentially-smoothed nu-quantile of the batch scores; the
-    smoothing horizon is windowSize EXAMPLES, the analog of the
-    reference's every-windowSize r refresh. At inference, examples with
-    y < r are anomalies.
+    Score y = w . act(V x); training minimizes the OC-SVM-style objective
+      mean(relu(q - y)) / nu - q,   q = nu-quantile of the BATCH scores
+    (the paper's alternating scheme: refresh r from the scores, then one
+    gradient step at fixed r). The state keeps r as an exponentially-
+    smoothed nu-quantile — the INFERENCE threshold; the smoothing
+    horizon is windowSize EXAMPLES, the analog of the reference's
+    every-windowSize r refresh. At inference, examples with y < r are
+    anomalies.
+
+    Two deliberate choices that keep training non-degenerate (seed-era
+    collapse: all weights decayed to 0 and scores lost all input
+    dependence):
+    - weight decay is NOT hardcoded into the loss; like the reference,
+      ||V||/||w|| regularization comes from the layer's configured
+      l1/l2 (a hardcoded 0.5||.||^2 dominates the bounded hinge force
+      and collapses V and w to zero);
+    - the default hidden activation is relu: an activation with
+      f(0) != 0 (sigmoid) admits a constant-score solution through w
+      alone with V = 0, i.e. an anomaly score that ignores the input.
     """
 
     LOSS_UPDATES_STATE = True
@@ -605,7 +617,7 @@ class OCNNOutputLayer(LossLayer):
     def __init__(self, nIn=None, hiddenSize=10, nu=0.04, windowSize=10000,
                  activation=None, lossFunction="ocnn", **kw):
         super().__init__(lossFunction=lossFunction,
-                         activation=activation or "sigmoid", **kw)
+                         activation=activation or "relu", **kw)
         self.nIn = nIn
         self.hiddenSize = int(hiddenSize)
         self.nu = float(nu)
@@ -633,13 +645,14 @@ class OCNNOutputLayer(LossLayer):
         return self._score(params, x)[:, None], state
 
     def _smoothed_r(self, y, state):
+        """(batch nu-quantile q, new state with the smoothed r)."""
         q = jnp.quantile(jax.lax.stop_gradient(y), self.nu)
         n = y.shape[0]
         alpha = min(1.0, n / max(self.windowSize, 1))
         seen = state.get("seen", jnp.zeros((), jnp.int32))
         r = jnp.where(seen > 0,
                       (1.0 - alpha) * state["r"] + alpha * q, q)
-        return r, {"r": r.astype(state["r"].dtype), "seen": seen + 1}
+        return q, {"r": r.astype(state["r"].dtype), "seen": seen + 1}
 
     def init_state(self, dtype=jnp.float32):
         return {"r": jnp.zeros((), dtype),
@@ -648,15 +661,16 @@ class OCNNOutputLayer(LossLayer):
     def compute_loss_with_state(self, params, x, labels, mask=None,
                                 state=None):
         """labels are IGNORED (one-class training trains on normal data
-        only, reference semantics)."""
+        only, reference semantics). The hinge uses the CURRENT batch's
+        quantile q — with the lagging smoothed r the hinge goes quiet
+        and nothing counteracts collapse; the smoothed r stays in the
+        state as the inference threshold."""
         y = self._score(params, x)
-        r, new_state = self._smoothed_r(y, state or self.init_state())
-        reg = 0.5 * (jnp.sum(jnp.square(params["V"]))
-                     + jnp.sum(jnp.square(params["w"])))
-        hinge = jnp.maximum(0.0, r - y)
+        q, new_state = self._smoothed_r(y, state or self.init_state())
+        hinge = jnp.maximum(0.0, q - y)
         if mask is not None and mask.ndim == 1:
             hinge = hinge * mask
-        return reg + jnp.mean(hinge) / self.nu - r, new_state
+        return jnp.mean(hinge) / self.nu - q, new_state
 
     def compute_loss(self, params, x, labels, mask=None):
         loss, _ = self.compute_loss_with_state(params, x, labels, mask)

@@ -37,6 +37,7 @@ class ComputationGraph:
         self._opt_states: dict = {}
         self._listeners: list = []
         self._train_step = None
+        self._train_step_plan = None  # health BuildPlan compiled into it
         self._multi_step = None
         self._bucket = None  # fit batch-size bucket (pad ragged tail)
         self._infer_fn_cache = {}
@@ -139,22 +140,40 @@ class ComputationGraph:
         return loss, new_states
 
     # -- training ------------------------------------------------------------
+    def _layer_labels(self):
+        """Health-row labels (one per node + the trailing loss row),
+        row-aligned with the health array the step returns (same
+        iteration order as _step_math)."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        return _health.with_loss_row(
+            f"{name}:{type(node).__name__}"
+            for name, (node, _) in self.conf.nodes.items())
+
     def _step_math(self, params, states, opt_states, inputs, labels, masks,
-                   rng, it):
+                   rng, it, health_plan=None):
         """One optimizer step as a pure traced function (shared by the
-        single-step jit and the scan-of-K-steps jit)."""
+        single-step jit and the scan-of-K-steps jit). Health stats ride
+        along per node when the plan collects (see
+        MultiLayerNetwork._step_math)."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
+
         def loss_fn(p):
             return self._loss_from(p, states, inputs, labels, True, rng,
                                    masks)
 
         (loss, new_states), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        new_params, new_opts = {}, {}
+        new_params, new_opts, stats = {}, {}, []
         for name, (node, _) in self.conf.nodes.items():
             g = grads.get(name)
             if not g:
                 new_params[name] = params[name]
                 new_opts[name] = opt_states[name]
+                if plan.collect:
+                    stats.append(_health.zero_stats())
                 continue
             g = _normalize_grads(
                 g, getattr(node, "gradientNormalization", None),
@@ -165,25 +184,54 @@ class ComputationGraph:
             new_params[name] = jax.tree_util.tree_map(
                 lambda p, u: p - u, params[name], upd)
             new_opts[name] = new_opt
-        return loss, new_params, new_states, new_opts
+            if plan.collect:
+                stats.append(_health.layer_stats(g, upd, new_params[name]))
+        if plan.collect:
+            stats.append(_health.loss_stats(loss))
+        health = _health.stack_stats(stats) if plan.collect else None
+        if plan.skip:
+            ok = _health.step_ok(health)
+            new_params = _health.keep_if(ok, new_params, params)
+            new_opts = _health.keep_if(ok, new_opts, opt_states)
+            new_states = _health.keep_if(ok, new_states, states)
+        return loss, new_params, new_states, new_opts, health
 
-    def _build_train_step(self):
+    def _build_train_step(self, health_plan=None):
         def step(params, states, opt_states, inputs, labels, masks, rng, it):
             return self._step_math(params, states, opt_states, inputs,
-                                   labels, masks, rng, it)
+                                   labels, masks, rng, it,
+                                   health_plan=health_plan)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _build_multi_step(self, repeats=1):
+    def _refresh_train_step(self):
+        """(re)build the compiled step when missing or when the health
+        build plan changed (see MultiLayerNetwork._refresh_train_step)."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = _health.build_plan(self._listeners)
+        if self._train_step is None or \
+                getattr(self, "_train_step_plan", None) != plan:
+            self._train_step = self._build_train_step(plan)
+            self._train_step_plan = plan
+        return plan
+
+    def _build_multi_step(self, repeats=1, health_plan=None):
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
+
         def many(params, states, opts, inputs_k, labels_k, masks_k, rng0,
                  it0):
             def body(carry, xs):
                 params, states, opts, it = carry
                 inputs, labels, masks = xs
                 rng = jax.random.fold_in(rng0, it)
-                loss, params, states, opts = self._step_math(
-                    params, states, opts, inputs, labels, masks, rng, it)
-                return (params, states, opts, it + 1), loss
+                loss, params, states, opts, health = self._step_math(
+                    params, states, opts, inputs, labels, masks, rng, it,
+                    health_plan=plan)
+                ys = (loss, health) if plan.collect else loss
+                return (params, states, opts, it + 1), ys
 
             def scan_once(carry, _):
                 return jax.lax.scan(body, carry,
@@ -191,13 +239,14 @@ class ComputationGraph:
 
             carry = (params, states, opts, it0)
             if repeats == 1:
-                carry, losses = scan_once(carry, None)
+                carry, ys = scan_once(carry, None)
             else:
-                carry, losses_r = jax.lax.scan(scan_once, carry, None,
-                                               length=repeats)
-                losses = losses_r[-1]
+                carry, ys_r = jax.lax.scan(scan_once, carry, None,
+                                           length=repeats)
+                ys = jax.tree_util.tree_map(lambda a: a[-1], ys_r)
+            losses, healths = ys if plan.collect else (ys, None)
             params, states, opts, _ = carry
-            return losses, params, states, opts
+            return losses, params, states, opts, healths
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
@@ -208,10 +257,14 @@ class ComputationGraph:
         the launch). Single-input single-output graphs only. Returns the
         [K] losses (last pass)."""
         self._check_init()
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = _health.build_plan(self._listeners)
         if not isinstance(getattr(self, "_multi_step", None), dict):
             self._multi_step = {}
-        if repeats not in self._multi_step:
-            self._multi_step[repeats] = self._build_multi_step(repeats)
+        key = (repeats, plan)
+        if key not in self._multi_step:
+            self._multi_step[key] = self._build_multi_step(repeats, plan)
         # keep device-resident stacks on device (a _host_array bounce
         # would round-trip the whole [K,B,...] block D2H then H2D)
         f_k = _unwrap(features_k) if isinstance(
@@ -223,13 +276,22 @@ class ComputationGraph:
         masks_k = {self.conf.outputs[0]: np.ones(
             (l_k.shape[0],) + _ones_mask(l_k[0]).shape, np.float32)}
         rng0 = jax.random.key(self.conf.seed + 1)
-        losses, self._params, self._states, self._opt_states = \
-            self._multi_step[repeats](
+        it0 = self._iteration
+        losses, self._params, self._states, self._opt_states, healths = \
+            self._multi_step[key](
                 self._params, self._states, self._opt_states,
                 inputs_k, labels_k, masks_k, rng0,
                 jnp.asarray(self._iteration, jnp.int32))
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
+        if healths is not None:
+            hm = _health.monitor_for("graph", self._layer_labels(),
+                                     self._listeners)
+            if hm is not None:
+                base = it0 + (repeats - 1) * int(f_k.shape[0])
+                for k in range(int(f_k.shape[0])):
+                    hm.on_step(base + k, healths[k])
+                hm.flush()
         return losses
 
     def _feeds(self, ds, with_ones_masks=False):
@@ -285,7 +347,7 @@ class ComputationGraph:
         return out
 
     def _fit_tbptt(self, params, states, opts, inputs, labels, masks,
-                   base_key):
+                   base_key, hm=None):
         from deeplearning4j_tpu.nn.conf.configuration import BackpropType
 
         assert self.conf.backpropType == BackpropType.TruncatedBPTT
@@ -318,10 +380,17 @@ class ComputationGraph:
                 mc = {k: (np.concatenate(
                     [v, np.zeros((v.shape[0], pad), v.dtype)], axis=1)
                     if v.ndim == 2 else v) for k, v in mc.items()}
-            rng = jax.random.fold_in(base_key, self._iteration)
-            loss, params, states, opts = self._train_step(
-                params, states, opts, ic, lc, mc, rng, self._iteration)
+            it_used = self._iteration
+            rng = jax.random.fold_in(base_key, it_used)
+            loss, params, states, opts, health = self._train_step(
+                params, states, opts, ic, lc, mc, rng, it_used)
             self._iteration += 1
+            if hm is not None:
+                # rebind first: on_step may raise (HALT) and the caller
+                # must not be left holding this step's donated buffers
+                self._params, self._states, self._opt_states = (
+                    params, self._strip_rnn_states(states), opts)
+                hm.on_step(it_used, health)
         return loss, params, self._strip_rnn_states(states), opts
 
     def rnnTimeStep(self, *xs):
@@ -366,11 +435,20 @@ class ComputationGraph:
 
     def fit(self, data, epochs: int = 1):
         self._check_init()
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+        import time as _time
+
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        self._refresh_train_step()
         params, states, opts = self._params, self._states, self._opt_states
         base_key = jax.random.key(self.conf.seed + 1)
         last = None
+        # one flag check per fit(): with telemetry disabled both are
+        # None and the loop body makes zero registry calls per step
+        tele = telemetry.loop_instruments("graph")
+        hm = _health.monitor_for("graph", self._layer_labels(),
+                                 self._listeners)
         for epoch_i in range(epochs):
             batches, data = _prepare_batches(data, epoch_i, epochs)
             for ds in batches:
@@ -396,18 +474,28 @@ class ComputationGraph:
                          and any(v.ndim == 3
                                  and v.shape[2] > self.conf.tbpttLength
                                  for v in inputs.values()))
+                if tele is not None:
+                    t_step = _time.perf_counter()
                 if tbptt:
                     loss, params, states, opts = self._fit_tbptt(
                         params, states, opts, inputs, labels, masks,
-                        base_key)
+                        base_key, hm=hm)
                 else:
-                    rng = jax.random.fold_in(base_key, self._iteration)
-                    loss, params, states, opts = self._train_step(
+                    it_used = self._iteration
+                    rng = jax.random.fold_in(base_key, it_used)
+                    loss, params, states, opts, health = self._train_step(
                         params, states, opts, inputs, labels, masks, rng,
-                        self._iteration)
+                        it_used)
                     self._iteration += 1
+                if tele is not None:
+                    tele.record_step(_time.perf_counter() - t_step, n)
+                # rebind BEFORE the health monitor runs: its HALT policy
+                # raises out of fit() and the caller must find live
+                # params, not the buffers this step donated
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
+                if not tbptt and hm is not None:
+                    hm.on_step(it_used, health)
                 last = loss
                 if self._listeners:
                     self._score = float(loss)
@@ -415,6 +503,8 @@ class ComputationGraph:
                         listener.iterationDone(self, self._iteration,
                                                self._epoch)
             self._epoch += 1
+        if hm is not None:
+            hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last is not None:
             self._score = float(last)
         return self

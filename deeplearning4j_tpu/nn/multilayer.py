@@ -68,6 +68,7 @@ class MultiLayerNetwork:
         self._opt_states: list = []
         self._listeners: list = []
         self._train_step = None
+        self._train_step_plan = None  # health BuildPlan compiled into it
         self._multi_step = None
         self._bucket = None  # fit batch-size bucket (pad ragged tail to it)
         self._infer_fns: dict = {}
@@ -167,10 +168,28 @@ class MultiLayerNetwork:
         return loss + reg, new_states
 
     # -- compiled train step -------------------------------------------------
+    def _layer_labels(self):
+        """Health-row labels (one per layer + the trailing loss row),
+        row-aligned with the health array the step returns
+        (telemetry.health, ISSUE 3)."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        return _health.with_loss_row(
+            f"{i}:{type(lr).__name__}"
+            for i, lr in enumerate(self.layers))
+
     def _step_math(self, updaters, params, states, opt_states, f, l, lmask,
-                   rng, it):
+                   rng, it, health_plan=None):
         """One optimizer step as a pure traced function (shared by the
-        single-step jit and the scan-of-K-steps jit)."""
+        single-step jit and the scan-of-K-steps jit). When the health
+        plan collects, per-layer stats ride along as one small [L, 5]
+        array (fused reductions — no extra dispatch); with the
+        SKIP_BATCH policy a non-finite step keeps the old
+        params/states/opts via an in-graph select."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
+
         def loss_fn(p):
             loss, ns = self._loss_from(p, states, f, l, True, rng,
                                        mask=lmask)
@@ -178,12 +197,14 @@ class MultiLayerNetwork:
 
         (loss, new_states), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        new_params, new_opts = [], []
+        new_params, new_opts, stats = [], [], []
         for i, lr in enumerate(self.layers):
             g = grads[i]
             if not g:
                 new_params.append(params[i])
                 new_opts.append(opt_states[i])
+                if plan.collect:
+                    stats.append(_health.zero_stats())
                 continue
             g = _normalize_grads(g, lr.gradientNormalization,
                                  lr.gradientNormalizationThreshold or 1.0)
@@ -192,18 +213,45 @@ class MultiLayerNetwork:
             new_params.append(jax.tree_util.tree_map(
                 lambda p, u: p - u, params[i], upd))
             new_opts.append(new_opt)
-        return loss, new_params, new_states, new_opts
+            if plan.collect:
+                stats.append(_health.layer_stats(g, upd, new_params[-1]))
+        if plan.collect:
+            stats.append(_health.loss_stats(loss))
+        health = _health.stack_stats(stats) if plan.collect else None
+        if plan.skip:
+            ok = _health.step_ok(health)
+            new_params = _health.keep_if(ok, new_params, params)
+            new_opts = _health.keep_if(ok, new_opts, opt_states)
+            new_states = _health.keep_if(ok, new_states, states)
+        return loss, new_params, new_states, new_opts, health
 
-    def _build_train_step(self):
+    def _build_train_step(self, health_plan=None):
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
         def step(params, states, opt_states, f, l, lmask, rng, it):
             return self._step_math(updaters, params, states, opt_states, f,
-                                   l, lmask, rng, it)
+                                   l, lmask, rng, it,
+                                   health_plan=health_plan)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _build_multi_step(self, repeats=1):
+    def _refresh_train_step(self):
+        """(re)build the compiled step when missing or when the health
+        build plan changed (telemetry/health toggled, policy changed) —
+        the plan is compiled into the step, so it must invalidate."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = _health.build_plan(self._listeners)
+        if self._train_step is None or \
+                getattr(self, "_train_step_plan", None) != plan:
+            self._train_step = self._build_train_step(plan)
+            self._train_step_plan = plan
+        return plan
+
+    def _build_multi_step(self, repeats=1, health_plan=None):
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
         def many(params, states, opts, f_k, l_k, m_k, rng0, it0):
@@ -211,25 +259,28 @@ class MultiLayerNetwork:
                 params, states, opts, it = carry
                 f, l, m = xs
                 rng = jax.random.fold_in(rng0, it)
-                loss, params, states, opts = self._step_math(
-                    updaters, params, states, opts, f, l, m, rng, it)
-                return (params, states, opts, it + 1), loss
+                loss, params, states, opts, health = self._step_math(
+                    updaters, params, states, opts, f, l, m, rng, it,
+                    health_plan=plan)
+                ys = (loss, health) if plan.collect else loss
+                return (params, states, opts, it + 1), ys
 
             def scan_once(carry, _):
                 return jax.lax.scan(body, carry, (f_k, l_k, m_k))
 
             carry = (params, states, opts, it0)
             if repeats == 1:
-                carry, losses = scan_once(carry, None)
+                carry, ys = scan_once(carry, None)
             else:
                 # R passes over the same K batches in one launch (used by
                 # slope-based benchmarking; also a legit small-dataset
                 # multi-epoch fit) — only the last pass's losses return
-                carry, losses_r = jax.lax.scan(scan_once, carry,
-                                               None, length=repeats)
-                losses = losses_r[-1]
+                carry, ys_r = jax.lax.scan(scan_once, carry,
+                                           None, length=repeats)
+                ys = jax.tree_util.tree_map(lambda a: a[-1], ys_r)
+            losses, healths = ys if plan.collect else (ys, None)
             params, states, opts, _ = carry
-            return losses, params, states, opts
+            return losses, params, states, opts, healths
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
@@ -242,10 +293,14 @@ class MultiLayerNetwork:
         successive fit() calls on the K slices. Returns the [K] losses
         (of the last pass when repeats > 1)."""
         self._check_init()
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = _health.build_plan(self._listeners)
         if not isinstance(self._multi_step, dict):
             self._multi_step = {}
-        if repeats not in self._multi_step:
-            self._multi_step[repeats] = self._build_multi_step(repeats)
+        key = (repeats, plan)
+        if key not in self._multi_step:
+            self._multi_step[key] = self._build_multi_step(repeats, plan)
         # keep device-resident stacks on device (a _host_array bounce
         # would round-trip the whole [K,B,...] block D2H then H2D)
         f_k = _unwrap(features_k) if isinstance(
@@ -255,13 +310,24 @@ class MultiLayerNetwork:
         m_k = np.ones((l_k.shape[0],) + _ones_mask(l_k[0]).shape,
                       np.float32)
         rng0 = jax.random.key(self.conf.seed + 1)
-        losses, self._params, self._states, self._opt_states = \
-            self._multi_step[repeats](
+        it0 = self._iteration
+        losses, self._params, self._states, self._opt_states, healths = \
+            self._multi_step[key](
                 self._params, self._states, self._opt_states,
                 f_k, l_k, m_k, rng0,
                 jnp.asarray(self._iteration, jnp.int32))
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
+        if healths is not None:
+            hm = _health.monitor_for("fit", self._layer_labels(),
+                                     self._listeners)
+            if hm is not None:
+                # the [K, L, 5] stack is already materialized (we just
+                # read losses), so processing here adds no sync
+                base = it0 + (repeats - 1) * int(f_k.shape[0])
+                for k in range(int(f_k.shape[0])):
+                    hm.on_step(base + k, healths[k])
+                hm.flush()
         return losses
 
     def fit(self, data, epochs: int | None = None):
@@ -272,19 +338,23 @@ class MultiLayerNetwork:
             # fit(features, labels)
             data, epochs = (data, epochs), 1
         epochs = epochs or 1
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
 
         import time as _time
 
         from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import health as _health
 
+        self._refresh_train_step()
         params, states, opts = self._params, self._states, self._opt_states
         base_key = jax.random.key(self.conf.seed + 1)
         last_loss = None
         # one flag check per fit(): with telemetry disabled tele is None
         # and the loop body makes zero registry calls per step
         tele = telemetry.loop_instruments("fit")
+        # same contract for health: hm is None when health/telemetry is
+        # off, and the jitted step then returns no health array at all
+        hm = _health.monitor_for("fit", self._layer_labels(),
+                                 self._listeners)
         for epoch_i in range(epochs):
             batches, data = _prepare_batches(data, epoch_i, epochs)
             batch_iter = iter(batches)
@@ -316,19 +386,27 @@ class MultiLayerNetwork:
                     t_step = _time.perf_counter()
                 if tbptt:
                     loss, params, states, opts = self._fit_tbptt(
-                        params, states, opts, f, l, lmask, base_key)
+                        params, states, opts, f, l, lmask, base_key,
+                        hm=hm)
                 else:
-                    rng = jax.random.fold_in(base_key, self._iteration)
-                    loss, params, states, opts = self._train_step(
-                        params, states, opts, f, l, lmask, rng,
-                        self._iteration)
+                    it_used = self._iteration
+                    rng = jax.random.fold_in(base_key, it_used)
+                    loss, params, states, opts, health = self._train_step(
+                        params, states, opts, f, l, lmask, rng, it_used)
                     self._iteration += 1
                 if tele is not None:
                     tele.record_step(_time.perf_counter() - t_step,
                                      f.shape[0])
-                # rebind before anything can observe donated buffers
+                # rebind before anything can observe donated buffers —
+                # including the health monitor, whose HALT policy raises
+                # out of fit(): the caller must find live params to
+                # checkpoint/inspect, not the buffers this step donated
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
+                if not tbptt and hm is not None:
+                    # one step behind: processes the PREVIOUS step's
+                    # (already materialized) stats — no added sync
+                    hm.on_step(it_used, health)
                 last_loss = loss
                 if self._profiler_cfg is not None:
                     from deeplearning4j_tpu.utils.profiler import (
@@ -344,6 +422,8 @@ class MultiLayerNetwork:
                         listener.iterationDone(self, self._iteration,
                                                self._epoch)
             self._epoch += 1
+        if hm is not None:
+            hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last_loss is not None:
             self._score = float(last_loss)
         return self
@@ -461,7 +541,8 @@ class MultiLayerNetwork:
             out[i] = {}
         return out
 
-    def _fit_tbptt(self, params, states, opts, f, l, lmask, base_key):
+    def _fit_tbptt(self, params, states, opts, f, l, lmask, base_key,
+                   hm=None):
         L = self.conf.tbpttLength
         T = f.shape[2]
         self._recurrent_indices(forbid_bidirectional=True)
@@ -484,10 +565,17 @@ class MultiLayerNetwork:
                 if mc.ndim == 2:
                     mc = np.concatenate(
                         [mc, np.zeros((mc.shape[0], pad), mc.dtype)], axis=1)
-            rng = jax.random.fold_in(base_key, self._iteration)
-            loss, params, states, opts = self._train_step(
-                params, states, opts, fc, lc, mc, rng, self._iteration)
+            it_used = self._iteration
+            rng = jax.random.fold_in(base_key, it_used)
+            loss, params, states, opts, health = self._train_step(
+                params, states, opts, fc, lc, mc, rng, it_used)
             self._iteration += 1
+            if hm is not None:
+                # rebind first: on_step may raise (HALT) and the caller
+                # must not be left holding this step's donated buffers
+                self._params, self._states, self._opt_states = (
+                    params, self._strip_rnn_states(states), opts)
+                hm.on_step(it_used, health)
         return loss, params, self._strip_rnn_states(states), opts
 
     # -- streaming inference (reference: rnnTimeStep / rnnClearPreviousState,

@@ -165,6 +165,9 @@ class PipelineParallelTrainer:
         self.opt = jax.device_put(self.opt, self.o_sh)
         self._flat_sh = NamedSharding(mesh, spec_for(mesh, DATA_AXIS))
         self._step_fn = None
+        self._step_plan = None   # health BuildPlan compiled into it
+        self._monitor = None     # cached for standalone train_step calls
+        self._monitor_plan = None
         self._it = 0
         self.lossCurve: list = []
 
@@ -291,51 +294,105 @@ class PipelineParallelTrainer:
         return loss + reg
 
     # -- one donated compiled step ------------------------------------------
-    def _build(self):
+    def health_labels(self):
+        """Health-row labels: the outer layers (original indices, in
+        order), the stage-stacked run as ONE aggregated row, then the
+        loss row."""
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        net, lo, hi = self.net, self.lo, self.hi
+        labels = [f"{i}:{type(net.layers[i]).__name__}"
+                  for i in range(len(net.layers)) if not (lo <= i < hi)]
+        labels.append(f"run[{lo}:{hi}]:{type(net.layers[lo]).__name__}")
+        return _health.with_loss_row(labels)
+
+    def _build(self, health_plan=None):
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
         repl = NamedSharding(self.mesh, P())
         upds = self._updaters()
 
         def step(params, opt, f, l, lmask, it):
             loss, grads = jax.value_and_grad(self._loss)(params, f, l,
                                                          lmask)
-            new_outer_p, new_outer_o = [], []
+            new_outer_p, new_outer_o, stats = [], [], []
             for u, p, g, o in zip(upds["outer"], params["outer"],
                                   grads["outer"], opt["outer"]):
                 if not p:
                     new_outer_p.append(p)
                     new_outer_o.append(o)
+                    if plan.collect:
+                        stats.append(_health.zero_stats())
                     continue
                 upd, o2 = u.apply(g, o, p, it)
                 new_outer_p.append(jax.tree_util.tree_map(
                     lambda a, b: a - b, p, upd))
                 new_outer_o.append(o2)
+                if plan.collect:
+                    stats.append(_health.layer_stats(g, upd,
+                                                     new_outer_p[-1]))
             upd, run_o = upds["run"].apply(grads["run"], opt["run"],
                                            params["run"], it)
             new_run = jax.tree_util.tree_map(lambda a, b: a - b,
                                              params["run"], upd)
-            return (loss, {"outer": new_outer_p, "run": new_run},
-                    {"outer": new_outer_o, "run": run_o})
+            new_params = {"outer": new_outer_p, "run": new_run}
+            new_opt = {"outer": new_outer_o, "run": run_o}
+            health = None
+            if plan.collect:
+                stats.append(_health.layer_stats(grads["run"], upd,
+                                                 new_run))
+                stats.append(_health.loss_stats(loss))
+                health = _health.stack_stats(stats)
+            if plan.skip:
+                ok = _health.step_ok(health)
+                new_params = _health.keep_if(ok, new_params, params)
+                new_opt = _health.keep_if(ok, new_opt, opt)
+            return loss, new_params, new_opt, health
 
+        out_health = (repl,) if plan.collect else (None,)
         return jax.jit(
             step,
             in_shardings=(self.p_sh, self.o_sh, self._flat_sh,
                           self._flat_sh, repl, repl),
-            out_shardings=(repl, self.p_sh, self.o_sh),
+            out_shardings=(repl, self.p_sh, self.o_sh) + out_health,
             donate_argnums=(0, 1),
         )
 
+    def _refresh_step(self):
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = _health.build_plan(self.net._listeners)
+        if self._step_fn is None or self._step_plan != plan:
+            self._step_fn = self._build(plan)
+            self._step_plan = plan
+        return plan
+
     def train_step(self, features, labels, labels_mask=None,
-                   _tele=None) -> float:
+                   _tele=None, _hm=None) -> float:
         import time
 
         from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import health as _health
 
-        if self._step_fn is None:
-            self._step_fn = self._build()
+        plan = self._refresh_step()
         # fit() passes its per-loop instruments; standalone calls do one
         # flag check (None when telemetry is disabled: no registry calls)
         tele = _tele if _tele is not None else \
             telemetry.loop_instruments("pipeline")
+        if _hm is not None:
+            hm = _hm
+        else:
+            # cache the monitor across standalone calls (keyed on the
+            # plan, so a cached None for the disabled case also sticks):
+            # building the per-layer instrument bindings per step would
+            # defeat the list-indexing publish path
+            if self._monitor_plan != plan:
+                self._monitor = _health.monitor_for(
+                    "pipeline", self.health_labels(),
+                    self.net._listeners)
+                self._monitor_plan = plan
+            hm = self._monitor
         f = np.asarray(features)
         if f.shape[0] % self.microbatches:
             raise ValueError(
@@ -343,17 +400,24 @@ class PipelineParallelTrainer:
                 f"{self.microbatches}")
         if tele is not None:
             t0 = time.perf_counter()
-        loss, self.params, self.opt = self._step_fn(
+        it_used = self._it
+        loss, self.params, self.opt, health = self._step_fn(
             self.params, self.opt, jnp.asarray(f),
             jnp.asarray(np.asarray(labels)),
             None if labels_mask is None else jnp.asarray(labels_mask),
-            jnp.asarray(self._it, jnp.int32))
+            jnp.asarray(it_used, jnp.int32))
         self._it += 1
         val = float(loss)
         if tele is not None:
             # float(loss) above synced, so this span is the TRUE device
             # step time for the pipeline schedule
             tele.record_step(time.perf_counter() - t0, f.shape[0])
+        if hm is not None:
+            hm.on_step(it_used, health)
+            if _hm is None:
+                # standalone call: float(loss) above already synced the
+                # step, so draining the pending slot costs no extra sync
+                hm.flush()
         self.lossCurve.append(val)
         return val
 
@@ -362,8 +426,11 @@ class PipelineParallelTrainer:
         import time
 
         from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import health as _health
 
         tele = telemetry.loop_instruments("pipeline")
+        hm = _health.monitor_for("pipeline", self.health_labels(),
+                                 self.net._listeners)
         for _ in range(epochs):
             it = iter(data)
             while True:
@@ -381,11 +448,13 @@ class PipelineParallelTrainer:
                         lm = None if lm is None else np.asarray(lm)
                     self.train_step(np.asarray(d.getFeatures()),
                                     np.asarray(d.getLabels()),
-                                    labels_mask=lm, _tele=tele)
+                                    labels_mask=lm, _tele=tele, _hm=hm)
                 else:
-                    self.train_step(*d, _tele=tele)
+                    self.train_step(*d, _tele=tele, _hm=hm)
             if hasattr(data, "reset"):
                 data.reset()
+        if hm is not None:
+            hm.flush()   # drain the one-behind slot (HALT may raise here)
         return self
 
     def sync_to_net(self):

@@ -62,6 +62,7 @@ class ShardedTrainer:
         self.mesh = mesh or MeshConfig.data_parallel()
         self.param_specs = param_specs
         self._step_fn = None
+        self._step_plan = None   # health BuildPlan compiled into it
         self._n_data = self.mesh.shape.get(DATA_AXIS, 1)
 
     def _shardings(self):
@@ -93,12 +94,15 @@ class ShardedTrainer:
         batch = NamedSharding(mesh, spec_for(mesh, DATA_AXIS))
         return p_shard, s_shard, o_shard, batch, repl
 
-    def _build_step(self):
+    def _build_step(self, health_plan=None):
         net = self.net
         updaters = [net._layer_updater(i) for i in range(len(net.layers))]
         p_sh, s_sh, o_sh, b_sh, repl = self._shardings()
 
         from deeplearning4j_tpu.nn.multilayer import _normalize_grads
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        plan = health_plan or _health.INACTIVE
 
         def step(params, states, opt_states, f, l, mask, rng, it):
             def loss_fn(p):
@@ -108,12 +112,14 @@ class ShardedTrainer:
 
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_opts = [], []
+            new_params, new_opts, stats = [], [], []
             for i, lr in enumerate(net.layers):
                 g = grads[i]
                 if not g:
                     new_params.append(params[i])
                     new_opts.append(opt_states[i])
+                    if plan.collect:
+                        stats.append(_health.zero_stats())
                     continue
                 g = _normalize_grads(g, lr.gradientNormalization,
                                      lr.gradientNormalizationThreshold
@@ -123,12 +129,26 @@ class ShardedTrainer:
                 new_params.append(jax.tree_util.tree_map(
                     lambda p, u: p - u, params[i], upd))
                 new_opts.append(new_opt)
-            return loss, new_params, new_states, new_opts
+                if plan.collect:
+                    # fused reductions over the SHARDED grads/params —
+                    # XLA inserts the cross-device psum inside the step
+                    stats.append(_health.layer_stats(g, upd,
+                                                     new_params[-1]))
+            if plan.collect:
+                stats.append(_health.loss_stats(loss))
+            health = _health.stack_stats(stats) if plan.collect else None
+            if plan.skip:
+                ok = _health.step_ok(health)
+                new_params = _health.keep_if(ok, new_params, params)
+                new_opts = _health.keep_if(ok, new_opts, opt_states)
+                new_states = _health.keep_if(ok, new_states, states)
+            return loss, new_params, new_states, new_opts, health
 
+        out_health = (repl,) if plan.collect else (None,)
         return jax.jit(
             step,
             in_shardings=(p_sh, s_sh, o_sh, b_sh, b_sh, b_sh, repl, repl),
-            out_shardings=(repl, p_sh, s_sh, o_sh),
+            out_shardings=(repl, p_sh, s_sh, o_sh) + out_health,
             donate_argnums=(0, 1, 2),
         )
 
@@ -157,17 +177,23 @@ class ShardedTrainer:
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.autodiff.samediff import (
             _as_batches, _split_dataset)
+        from deeplearning4j_tpu.telemetry import health as _health
 
         net = self.net
         if self._step_fn is None:
             self.place_params()
-            self._step_fn = self._build_step()
+        plan = _health.build_plan(net._listeners)
+        if self._step_fn is None or self._step_plan != plan:
+            self._step_fn = self._build_step(plan)
+            self._step_plan = plan
         params, states, opts = net._params, net._states, net._opt_states
         base_key = jax.random.key(net.conf.seed + 1)
         last = None
         # one flag check per fit(): tele is None when telemetry is
         # disabled, and the loop body then makes zero registry calls
         tele = telemetry.loop_instruments("sharded")
+        hm = _health.monitor_for("sharded", net._layer_labels(),
+                                 net._listeners)
         for _ in range(epochs):
             batch_iter = iter(_as_batches(data))
             while True:
@@ -195,11 +221,11 @@ class ShardedTrainer:
                     f = global_batch(self.mesh, f)
                     l = global_batch(self.mesh, l)
                     mask = global_batch(self.mesh, mask)
-                rng = jax.random.fold_in(base_key, net._iteration)
+                it_used = net._iteration
+                rng = jax.random.fold_in(base_key, it_used)
                 if tele is None:
-                    loss, params, states, opts = self._step_fn(
-                        params, states, opts, f, l, mask, rng,
-                        net._iteration)
+                    loss, params, states, opts, health = self._step_fn(
+                        params, states, opts, f, l, mask, rng, it_used)
                 else:
                     # the span is also a TraceAnnotation, so the host
                     # step region lines up with XPlane device traces;
@@ -207,12 +233,17 @@ class ShardedTrainer:
                     # equal the device step time in steady state (no
                     # sync added)
                     with tele.step_span():
-                        loss, params, states, opts = self._step_fn(
-                            params, states, opts, f, l, mask, rng,
-                            net._iteration)
+                        loss, params, states, opts, health = \
+                            self._step_fn(params, states, opts, f, l,
+                                          mask, rng, it_used)
                     tele.examples.inc(real)
+                # rebind BEFORE the health monitor runs: its HALT policy
+                # raises out of fit() and the caller must find live
+                # params, not the buffers this step donated
                 net._params, net._states, net._opt_states = (
                     params, states, opts)
+                if hm is not None:
+                    hm.on_step(it_used, health)
                 net._iteration += 1
                 last = loss
                 if net._listeners:
@@ -221,6 +252,8 @@ class ShardedTrainer:
                         listener.iterationDone(net, net._iteration,
                                                net._epoch)
             net._epoch += 1
+        if hm is not None:
+            hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last is not None:
             net._score = _host_scalar(last)
         return net

@@ -25,6 +25,7 @@ Semantics:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -33,6 +34,11 @@ from concurrent.futures import Future
 import numpy as np
 
 from deeplearning4j_tpu.serving.buckets import pad_rows, pad_time
+from deeplearning4j_tpu.telemetry import flight
+
+# process-wide request ids: every request carries one so flight-recorder
+# serving summaries (ISSUE 3) correlate with client-side logs
+_REQ_IDS = itertools.count(1)
 
 
 class QueueFullError(RuntimeError):
@@ -48,9 +54,10 @@ class ServingShutdown(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "n", "t", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "n", "t", "future", "t_enqueue", "deadline",
+                 "req_id", "model")
 
-    def __init__(self, x, deadline):
+    def __init__(self, x, deadline, model=None):
         self.x = x
         self.n = x.shape[0]
         # real trailing time length of sequence inputs: results slice
@@ -59,15 +66,28 @@ class _Request:
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline
+        self.req_id = next(_REQ_IDS)
+        self.model = model
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
+
+    def summary(self, outcome, queue_s=None, **extra):
+        """Flight-recorder serving summary (one ring-buffer append).
+        Pass queue_s when dispatch already happened — measuring it here
+        would fold the execute time into the queue wait."""
+        if queue_s is None:
+            queue_s = time.perf_counter() - self.t_enqueue
+        flight.record("serving", req_id=self.req_id, model=self.model,
+                      outcome=outcome, rows=self.n,
+                      queue_s=round(queue_s, 6), **extra)
 
     def fail(self, exc, instruments, outcome):
         if self.future.set_running_or_notify_cancel():
             self.future.set_exception(exc)
         if instruments is not None:
             instruments.request(outcome)
+        self.summary(outcome)
 
 
 def execute_plan(entry, xs):
@@ -134,7 +154,7 @@ class DynamicBatcher:
             timeout = self.default_timeout
         deadline = (time.perf_counter() + timeout
                     if timeout is not None else None)
-        req = _Request(x, deadline)
+        req = _Request(x, deadline, model=self.entry.name)
         inst = self._instruments_fn()
         try:
             with self._submit_lock:
@@ -145,6 +165,7 @@ class DynamicBatcher:
         except queue.Full:
             if inst is not None:
                 inst.request("rejected")
+            req.summary("rejected")
             raise QueueFullError(
                 f"serving queue for {self.entry.name!r} is full "
                 f"({self._q.maxsize} requests)") from None
@@ -254,8 +275,10 @@ class DynamicBatcher:
                        "timeout")
             elif r.future.set_running_or_notify_cancel():
                 live.append(r)
-            elif inst is not None:
-                inst.request("rejected")   # caller cancelled the future
+            else:
+                if inst is not None:
+                    inst.request("rejected")  # caller cancelled the future
+                r.summary("cancelled")
         if not live:
             return
         total = sum(r.n for r in live)
@@ -293,12 +316,17 @@ class DynamicBatcher:
                 off += r.n
                 if inst is not None:
                     inst.request("ok")
+                r.summary("ok", queue_s=now - r.t_enqueue,
+                          batch_rows=total, dispatches=n_dispatch,
+                          execute_s=round(dt, 6))
         except Exception as e:  # surface the device error to every caller
             for r in live:
                 if not r.future.done():
                     r.future.set_exception(e)
                 if inst is not None:
                     inst.request("error")
+                r.summary("error", queue_s=now - r.t_enqueue,
+                          error=f"{type(e).__name__}: {e}")
 
     def _dispatch(self, xs) -> tuple:
         return execute_plan(self.entry, xs)

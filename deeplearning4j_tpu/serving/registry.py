@@ -40,6 +40,11 @@ class _Entry:
         self.servable.warmup(self.ladder)
         self.warmup_seconds = time.perf_counter() - t0
         self.warmed = True
+        from deeplearning4j_tpu.telemetry import flight
+
+        flight.record("model_warmup", model=self.name,
+                      version=self.version,
+                      seconds=round(self.warmup_seconds, 6))
         return self
 
     def describe(self) -> dict:

@@ -56,7 +56,17 @@ class InferenceSession:
             dropped = [self._batchers.pop(k) for k in stale]
         for b in dropped:
             b.retire()
+        from deeplearning4j_tpu.telemetry import flight
+
+        flight.record("model_registered", model=name,
+                      version=entry.version, warmed=entry.warmed)
         return entry
+
+    def ready(self) -> bool:
+        """Readiness for /healthz: every registered model's bucket
+        ladder is AOT-warmed (no cold-compile stall on first traffic)."""
+        models = self.registry.describe()
+        return all(m["warmed"] for m in models) if models else True
 
     def warmup(self, name=None, version=None):
         self.registry.warmup(name, version)

@@ -1,21 +1,32 @@
 """Unified telemetry: process-wide metrics registry, phase tracing,
 multi-host aggregation, Prometheus exposition (ISSUE 1 tentpole;
 SURVEY.md §5 observability — the TPU-native OpProfiler /
-PerformanceTracker / StatsListener replacement).
+PerformanceTracker / StatsListener replacement), plus training-health
+diagnostics and the flight recorder (ISSUE 3): per-layer stats computed
+inside the jitted step, divergence policies (WARN / HALT raising
+DivergenceError / SKIP_BATCH), a bounded event ring dumped on
+divergence or via GET /debug/flightrecorder, and GET /healthz.
 
 Quick use::
 
     from deeplearning4j_tpu import telemetry
     telemetry.enable()                       # on by default
+    telemetry.health.configure(policy="halt", ratio_max=10.0)
     net.fit(data, 3)                         # hot loops self-instrument
     print(telemetry.prometheus.render())     # or GET /metrics on UIServer
     agg = telemetry.aggregate_snapshot()     # cross-host min/max/mean/sum
+    telemetry.flight.dump("/tmp/flight.jsonl")
 
 Disabling (`telemetry.disable()`) removes every per-step registry call
-from the training loops — they check the flag once per fit()."""
+from the training loops — they check the flag once per fit() — and
+compiles the health stats OUT of the jitted step (pre-health output
+structure, bit-identical math)."""
 
-from deeplearning4j_tpu.telemetry import aggregate, prometheus
+from deeplearning4j_tpu.telemetry import aggregate, flight, health, prometheus
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
+from deeplearning4j_tpu.telemetry.flight import FlightRecorder
+from deeplearning4j_tpu.telemetry.health import (
+    DivergenceError, HealthConfig, HealthMonitor)
 from deeplearning4j_tpu.telemetry.listener import MetricsListener
 from deeplearning4j_tpu.telemetry.registry import (
     BYTES_BUCKETS, Counter, ETL_HELP, Gauge, Histogram, LoopInstruments,
@@ -24,10 +35,12 @@ from deeplearning4j_tpu.telemetry.registry import (
     log_buckets, loop_instruments, serving_instruments, set_registry, span)
 
 __all__ = [
-    "BYTES_BUCKETS", "Counter", "ETL_HELP", "Gauge", "Histogram",
+    "BYTES_BUCKETS", "Counter", "DivergenceError", "ETL_HELP",
+    "FlightRecorder", "Gauge", "HealthConfig", "HealthMonitor", "Histogram",
     "LoopInstruments", "MetricsListener", "MetricsRegistry",
     "SECONDS_BUCKETS", "STEP_HELP", "ServingInstruments", "Timer",
     "aggregate", "aggregate_snapshot", "collect_device_memory", "disable",
-    "enable", "enabled", "get_registry", "log_buckets", "loop_instruments",
-    "prometheus", "serving_instruments", "set_registry", "span",
+    "enable", "enabled", "flight", "get_registry", "health", "log_buckets",
+    "loop_instruments", "prometheus", "serving_instruments", "set_registry",
+    "span",
 ]

@@ -1,0 +1,524 @@
+"""Per-layer training-health diagnostics (ISSUE 3 tentpole).
+
+Reference capability: the DL4J training UI's signature per-layer
+diagnostics — activation/gradient/update magnitudes and the classic
+update:parameter-ratio tuning signal (SURVEY.md §2.5 listeners, §5
+observability) — rebuilt for a jitted TPU training stack where a silent
+NaN or exploding layer wastes whole pod-hours (arxiv 2001.04206 /
+2305.08819: tuning a JIT-compiled stack is blind guesswork without
+per-layer numeric health).
+
+Design:
+
+- the statistics are computed INSIDE the already-jitted train step: one
+  fused reduction set per layer (grad L2, update L2, new-param L2,
+  update:param ratio, non-finite count) riding along with the loss,
+  returned as one small ``[L, N_STATS]`` float32 array — no extra
+  device dispatch, no added sync;
+- the host reads that array ONE STEP BEHIND (``HealthMonitor`` keeps a
+  one-deep pending slot): in steady state the previous step's array is
+  already materialized, so reading it never stalls the dispatch queue;
+- publication goes through the PR-1 MetricsRegistry as ``dl4j_health_*``
+  gauges/histograms; with ``telemetry.disable()`` the whole subsystem is
+  compiled OUT of the step (``build_plan().collect`` is False), the fit
+  loop makes zero registry calls per step, and the jitted step returns
+  exactly its pre-health outputs;
+- divergence policies: WARN logs + records, HALT raises
+  ``DivergenceError`` (after dumping the flight recorder, naming the
+  offending layer and step), SKIP_BATCH compiles a keep-old-params gate
+  into the step itself (``jnp.where`` on the donated buffers — the skip
+  happens on device with zero sync).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import namedtuple
+from dataclasses import dataclass, replace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.telemetry.registry import get_registry, log_buckets
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# -- policies ----------------------------------------------------------------
+
+WARN = "warn"
+HALT = "halt"
+SKIP_BATCH = "skip_batch"
+POLICIES = (WARN, HALT, SKIP_BATCH)
+
+STAT_NAMES = ("grad_norm", "update_norm", "param_norm",
+              "update_param_ratio", "nonfinite")
+N_STATS = len(STAT_NAMES)
+
+
+class DivergenceError(RuntimeError):
+    """Raised by the HALT policy when a step produces non-finite
+    gradients (or trips a ratio threshold). Carries the offending step,
+    layer names, and the flight-recorder dump path."""
+
+    def __init__(self, message, step=None, layers=(), dump_path=None):
+        super().__init__(message)
+        self.step = step
+        self.layers = tuple(layers)
+        self.dump_path = dump_path
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Divergence-policy configuration.
+
+    policy: WARN (log + record), HALT (raise DivergenceError), or
+        SKIP_BATCH (discard the diverged update on device);
+    ratio_max/ratio_min: optional update:param-ratio thresholds (the
+        DL4J tuning heuristic says healthy layers sit around 1e-3;
+        ``None`` disables the check);
+    check_every: process/publish every Nth step (violation latency
+        trades against host work on very fast steps);
+    dump_dir: where HALT writes the flight-recorder JSONL (default:
+        the system temp dir)."""
+
+    policy: str = WARN
+    ratio_max: float | None = None
+    ratio_min: float | None = None
+    check_every: int = 1
+    dump_dir: str | None = None
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {self.policy!r}")
+        if self.check_every < 1:
+            raise ValueError("check_every must be >= 1")
+
+
+_lock = threading.Lock()
+_state = {"enabled": True, "config": HealthConfig()}
+_status: dict = {"divergence": None, "loops": {}}
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def enable():
+    _state["enabled"] = True
+
+
+def disable():
+    _state["enabled"] = False
+
+
+def configure(**kw) -> HealthConfig:
+    """Update the process-default HealthConfig (and/or the enabled
+    flag): ``configure(policy=HALT, ratio_max=10.0)``."""
+    if "enabled" in kw:
+        _state["enabled"] = bool(kw.pop("enabled"))
+    if kw:
+        _state["config"] = replace(_state["config"], **kw)
+    return _state["config"]
+
+
+def get_config() -> HealthConfig:
+    return _state["config"]
+
+
+def reset_status():
+    """Clear divergence/last-step state (tests, or a supervised restart
+    after a diverged run was rolled back)."""
+    with _lock:
+        _status["divergence"] = None
+        _status["loops"] = {}
+
+
+def note_step(loop, step):
+    # under the lock: healthz() serves from the UI-server thread while
+    # the fit loop writes here
+    with _lock:
+        _status["loops"][loop] = {"step": int(step), "ts": time.time()}
+
+
+# -- build plan (what gets compiled into the step) ---------------------------
+
+BuildPlan = namedtuple("BuildPlan", ("collect", "skip"))
+INACTIVE = BuildPlan(False, False)
+
+
+def _listener_config(listeners):
+    """(config, listener) from the first DL4J-style HealthListener among
+    ``listeners`` (duck-typed via HEALTH_LISTENER to avoid an import
+    cycle with utils.listeners), else the process default."""
+    for li in listeners or ():
+        if getattr(li, "HEALTH_LISTENER", False):
+            return li.config, li
+    return _state["config"], None
+
+
+def build_plan(listeners=()) -> BuildPlan:
+    """What the jitted step should compile in. ``collect`` is False
+    whenever telemetry or health is disabled — the step then returns
+    exactly its pre-health outputs (unchanged signature, zero registry
+    calls per step)."""
+    collect = _state["enabled"] and _registry.enabled()
+    if not collect:
+        return INACTIVE
+    cfg, _ = _listener_config(listeners)
+    return BuildPlan(True, cfg.policy == SKIP_BATCH)
+
+
+# -- traced statistics (called while building the step HLO) ------------------
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _sumsq(tree):
+    leaves = _leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+
+
+def _nonfinite_count(tree):
+    leaves = _leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum((~jnp.isfinite(x)).astype(jnp.float32))
+               for x in leaves)
+
+
+def layer_stats(grad, update, new_param):
+    """One fused reduction set for one layer -> [N_STATS] float32:
+    grad L2, update L2, new-param L2, update:param ratio, non-finite
+    count over grad+update+new params (params included so a layer whose
+    WEIGHTS went NaN is named even when the relu backprop mask zeroes
+    its own gradient). XLA fuses these with the backward pass — they
+    add reductions, never a dispatch."""
+    g = jnp.sqrt(_sumsq(grad))
+    u = jnp.sqrt(_sumsq(update))
+    p = jnp.sqrt(_sumsq(new_param))
+    ratio = u / jnp.maximum(p, jnp.float32(1e-12))
+    bad = (_nonfinite_count(grad) + _nonfinite_count(update)
+           + _nonfinite_count(new_param))
+    return jnp.stack([g, u, p, ratio, bad])
+
+
+def zero_stats():
+    """Row for a parameter-less layer (keeps row index == layer index)."""
+    return jnp.zeros((N_STATS,), jnp.float32)
+
+
+def loss_stats(loss):
+    """The dedicated trailing "loss" row: only the nonfinite column is
+    populated. Folding the loss into the SAME array keeps the device
+    gate and the host-side accounting looking at one condition — a
+    non-finite loss with finite grads (fp32 overflow in the loss
+    reduction) is still named, counted, and policy-handled."""
+    bad = jnp.sum((~jnp.isfinite(jnp.asarray(loss))).astype(jnp.float32))
+    return jnp.stack([jnp.float32(0), jnp.float32(0), jnp.float32(0),
+                      jnp.float32(0), bad])
+
+
+LOSS_ROW_LABEL = "loss"
+
+
+def with_loss_row(layer_names):
+    """Health-row labels for a loop: per-layer labels + the loss row."""
+    return list(layer_names) + [LOSS_ROW_LABEL]
+
+
+def stack_stats(rows):
+    if not rows:
+        return jnp.zeros((0, N_STATS), jnp.float32)
+    return jnp.stack(rows)
+
+
+def step_ok(health):
+    """Traced scalar: True when nothing in the step went non-finite
+    (the SKIP_BATCH gate condition). Reads ONLY the health array — the
+    loss contributes via its own loss_stats row, so the host-side
+    monitor sees exactly the condition the device gated on."""
+    return jnp.sum(health[:, STAT_NAMES.index("nonfinite")]) == 0
+
+
+def keep_if(ok, new_tree, old_tree):
+    """SKIP_BATCH gate: keep the new tree where ok, else the old one.
+    Compiled into the step — a select per buffer, no host round trip."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
+# -- instruments -------------------------------------------------------------
+
+RATIO_BUCKETS = log_buckets(1e-8, 100, per_decade=2)
+
+RATIO_HELP = ("Per-layer update:parameter L2-norm ratio (the DL4J tuning "
+              "signal; healthy layers sit around 1e-3)")
+GRAD_HELP = "Per-layer gradient L2 norm of the last health-checked step"
+UPDATE_HELP = "Per-layer update L2 norm of the last health-checked step"
+PARAM_HELP = "Per-layer parameter L2 norm after the last checked step"
+NONFINITE_HELP = ("NaN/Inf values observed in per-layer grads, updates, "
+                  "and post-step params")
+VIOLATION_HELP = "Divergence-policy trips by loop, policy, and kind"
+SKIPPED_HELP = "Training steps discarded by the SKIP_BATCH policy"
+LAST_STEP_HELP = "Most recent health-checked step index per loop"
+
+
+class HealthInstruments:
+    """Per-(loop, layer) bound children, built once per monitor so the
+    per-step publish path is list indexing + observe/set — no label
+    dict lookups in the loop."""
+
+    __slots__ = ("loop", "ratio", "grad", "update", "param", "nonfinite",
+                 "violations", "skipped", "last_step")
+
+    def __init__(self, registry, loop, layer_names):
+        self.loop = loop
+        ratio_fam = registry.histogram(
+            "dl4j_health_update_param_ratio", RATIO_HELP,
+            ("loop", "layer"), buckets=RATIO_BUCKETS)
+        grad_fam = registry.gauge(
+            "dl4j_health_grad_norm", GRAD_HELP, ("loop", "layer"))
+        update_fam = registry.gauge(
+            "dl4j_health_update_norm", UPDATE_HELP, ("loop", "layer"))
+        param_fam = registry.gauge(
+            "dl4j_health_param_norm", PARAM_HELP, ("loop", "layer"))
+        nonfinite_fam = registry.counter(
+            "dl4j_health_nonfinite_total", NONFINITE_HELP,
+            ("loop", "layer"))
+        self.ratio = [ratio_fam.labels(loop=loop, layer=n)
+                      for n in layer_names]
+        self.grad = [grad_fam.labels(loop=loop, layer=n)
+                     for n in layer_names]
+        self.update = [update_fam.labels(loop=loop, layer=n)
+                       for n in layer_names]
+        self.param = [param_fam.labels(loop=loop, layer=n)
+                      for n in layer_names]
+        self.nonfinite = [nonfinite_fam.labels(loop=loop, layer=n)
+                          for n in layer_names]
+        self.violations = registry.counter(
+            "dl4j_health_violations_total", VIOLATION_HELP,
+            ("loop", "policy", "kind"))
+        self.skipped = registry.counter(
+            "dl4j_health_skipped_steps_total", SKIPPED_HELP,
+            ("loop",)).labels(loop=loop)
+        self.last_step = registry.gauge(
+            "dl4j_health_last_step", LAST_STEP_HELP,
+            ("loop",)).labels(loop=loop)
+
+
+def health_instruments(loop, layer_names):
+    """Bound instrument bundle, or None when telemetry is disabled (the
+    monitor then still enforces policies, without registry calls)."""
+    if not _registry.enabled():
+        return None
+    return HealthInstruments(get_registry(), loop, layer_names)
+
+
+# -- the monitor -------------------------------------------------------------
+
+def _host(arr) -> np.ndarray:
+    """Host copy that also works on multi-process replicated outputs
+    (read this process's shard — it holds the replicated value)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    return np.asarray(arr.addressable_data(0))
+
+
+class HealthMonitor:
+    """Host side of the health pipeline for one fit loop.
+
+    ``on_step(step, health)`` stores the new device array and processes
+    the PREVIOUS one (one step behind — already materialized in steady
+    state, so no dispatch-queue stall). ``flush()`` drains the pending
+    slot at the end of the loop; HALT may therefore raise from either.
+    """
+
+    def __init__(self, loop, layer_names, config=None, listener=None):
+        self.loop = loop
+        self.layer_names = list(layer_names)
+        self.config = config or _state["config"]
+        self.listener = listener
+        self.instruments = health_instruments(loop, self.layer_names)
+        self._pending = None
+        self._count = 0
+
+    # -- loop-facing ---------------------------------------------------------
+    def on_step(self, step, health):
+        if health is None:
+            return
+        prev, self._pending = self._pending, (step, health)
+        if prev is not None:
+            self._process(*prev)
+
+    def flush(self):
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._process(*prev)
+
+    # -- processing ----------------------------------------------------------
+    def _process(self, step, arr):
+        self._count += 1
+        if (self._count - 1) % self.config.check_every:
+            return
+        a = _host(arr)
+        note_step(self.loop, step)
+        inst = self.instruments
+        cfg = self.config
+        bad_layers, ratio_high, ratio_low = [], [], []
+        worst_ratio = 0.0
+        bad_total = 0.0
+        for i, name in enumerate(self.layer_names):
+            g, u, p, ratio, bad = (float(a[i, j]) for j in range(N_STATS))
+            if bad > 0:
+                # classify "nonfinite" ONLY by the device-side count —
+                # the same condition the SKIP_BATCH gate compiled in,
+                # so host reporting never contradicts what the device
+                # did (finite-but-huge grads can overflow the L2 sums
+                # to inf without any NaN/Inf in the values themselves)
+                bad_layers.append(name)
+                bad_total += bad
+                if inst is not None:
+                    inst.nonfinite[i].inc(bad)
+                continue
+            if p == 0.0 and g == 0.0 and u == 0.0:
+                continue  # parameter-less layer: zero row by construction
+            if inst is not None:
+                inst.grad[i].set(g)
+                inst.update[i].set(u)
+                inst.param[i].set(p)
+                if np.isfinite(ratio):
+                    inst.ratio[i].observe(ratio)
+            if not np.isfinite(ratio):
+                continue   # overflowed norms: no threshold verdict
+            worst_ratio = max(worst_ratio, ratio)
+            if cfg.ratio_max is not None and ratio > cfg.ratio_max:
+                ratio_high.append((name, ratio))
+            if cfg.ratio_min is not None and 0.0 < ratio < cfg.ratio_min:
+                ratio_low.append((name, ratio))
+        if inst is not None:
+            inst.last_step.set(step)
+        flight.record("step", loop=self.loop, step=step,
+                      worst_ratio=round(worst_ratio, 6),
+                      nonfinite=bad_total)
+        if self.listener is not None:
+            self.listener.onHealthStats(self.loop, step, {
+                name: dict(zip(STAT_NAMES, (float(v) for v in a[i])))
+                for i, name in enumerate(self.layer_names)})
+        if bad_layers:
+            self._violate(step, "nonfinite", bad_layers,
+                          {"nonfinite_values": bad_total})
+        if ratio_high:
+            self._violate(step, "ratio_high",
+                          [n for n, _ in ratio_high],
+                          {"ratios": {n: round(r, 6)
+                                      for n, r in ratio_high}})
+        if ratio_low:
+            self._violate(step, "ratio_low",
+                          [n for n, _ in ratio_low],
+                          {"ratios": {n: round(r, 9)
+                                      for n, r in ratio_low}})
+
+    def _violate(self, step, kind, layers, details):
+        cfg = self.config
+        inst = self.instruments
+        if inst is not None:
+            inst.violations.labels(loop=self.loop, policy=cfg.policy,
+                                   kind=kind).inc()
+        flight.record("health_violation", loop=self.loop, step=step,
+                      violation=kind, layers=list(layers),
+                      policy=cfg.policy, **details)
+        msg = (f"training health violation ({kind}) in loop "
+               f"{self.loop!r} at step {step}, layer(s) "
+               f"{', '.join(layers)}")
+        if cfg.policy == HALT:
+            with _lock:
+                _status["divergence"] = {
+                    "loop": self.loop, "step": int(step), "kind": kind,
+                    "layers": list(layers), "ts": time.time()}
+            flight.record("divergence", loop=self.loop, step=step,
+                          violation=kind, layers=list(layers))
+            path = None
+            try:
+                path = flight.get_recorder().dump(
+                    None if cfg.dump_dir is None else os.path.join(
+                        cfg.dump_dir,
+                        os.path.basename(flight.default_dump_path())))
+            except Exception:
+                log.exception("flight recorder dump failed")
+            raise DivergenceError(
+                f"{msg}; policy=HALT"
+                + (f"; flight recorder dumped to {path}" if path else ""),
+                step=step, layers=layers, dump_path=path)
+        if cfg.policy == SKIP_BATCH and kind == "nonfinite":
+            # the in-step gate already discarded the update on device
+            if inst is not None:
+                inst.skipped.inc()
+            log.warning("%s; policy=SKIP_BATCH — the diverged update was "
+                        "discarded on device, training continues", msg)
+            return
+        # WARN, or a ratio violation under SKIP_BATCH (ratio thresholds
+        # are host-side config, so there is nothing to skip on device)
+        log.warning("%s; policy=%s (warn-only)", msg, cfg.policy)
+
+
+def monitor_for(loop, layer_names, listeners=()):
+    """The per-fit HealthMonitor, or None when health collection is off
+    (health disabled, or telemetry disabled). Call once before the hot
+    loop — mirrors telemetry.loop_instruments."""
+    if not build_plan(listeners).collect:
+        return None
+    cfg, listener = _listener_config(listeners)
+    return HealthMonitor(loop, layer_names, cfg, listener)
+
+
+# -- /healthz ----------------------------------------------------------------
+
+def healthz(serving=None):
+    """(payload, http_status) for the liveness/readiness endpoint.
+
+    live: the process answers (always True if we got here);
+    ready: no recorded divergence AND (if a serving session is
+    attached) every registered model's bucket ladder is warmed.
+    """
+    now = time.time()
+    with _lock:   # the fit-loop thread mutates these as we read
+        loop_state = dict(_status["loops"])
+        div = _status["divergence"]
+    loops = {
+        loop: {"step": s["step"],
+               "last_step_age_seconds": round(now - s["ts"], 3)}
+        for loop, s in sorted(loop_state.items())}
+    serving_info = None
+    ready = div is None
+    if serving is not None:
+        try:
+            models = serving.models()
+        except Exception:
+            models = []
+        if hasattr(serving, "ready"):     # InferenceSession
+            warmed = bool(serving.ready())
+        else:                             # duck-typed session
+            warmed = (all(m.get("warmed") for m in models)
+                      if models else True)
+        serving_info = {
+            "attached": True,
+            "warmed": warmed,
+            "models": [{"name": m["name"], "version": m["version"],
+                        "warmed": m.get("warmed", False)}
+                       for m in models]}
+        ready = ready and warmed
+    status = "diverged" if div is not None else (
+        "ok" if ready else "warming")
+    payload = {"status": status, "live": True, "ready": ready,
+               "loops": loops, "divergence": div, "serving": serving_info}
+    return payload, (200 if ready else 503)

@@ -60,14 +60,75 @@ def render(registry=None, collect_system=True) -> str:
     return "\n".join(lines) + "\n"
 
 
+_UNESCAPE = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _scan_labels(line, start):
+    """Parse ``{k="v",...}`` starting at ``line[start] == '{'``.
+    Returns (labels_tuple_with_unescaped_values, index_after_brace).
+    Handles label values containing spaces, commas, braces, and the
+    exposition escapes (\\\\, \\", \\n)."""
+    labels = []
+    i = start + 1
+    n = len(line)
+    while i < n and line[i] != "}":
+        eq = line.index("=", i)
+        key = line[i:eq].strip()
+        i = eq + 1
+        if i >= n or line[i] != '"':
+            raise ValueError(f"malformed label value in {line!r}")
+        i += 1
+        buf = []
+        while i < n and line[i] != '"':
+            c = line[i]
+            if c == "\\" and i + 1 < n:
+                buf.append(_UNESCAPE.get(line[i + 1],
+                                         "\\" + line[i + 1]))
+                i += 2
+            else:
+                buf.append(c)
+                i += 1
+        if i >= n:
+            raise ValueError(f"unterminated label value in {line!r}")
+        labels.append((key, "".join(buf)))
+        i += 1                       # closing quote
+        if i < n and line[i] == ",":
+            i += 1
+    if i >= n:
+        raise ValueError(f"unterminated label set in {line!r}")
+    return tuple(labels), i + 1
+
+
 def parse(text) -> dict:
     """Parse a text exposition back to {sample_name: float} (tests /
-    round-trip verification; sample_name includes the label set)."""
+    round-trip verification; sample_name includes the label set, in the
+    same canonical form ``MetricsRegistry.snapshot()`` emits — label
+    values are unescaped).
+
+    Hardened against the cases a naive ``rpartition(" ")`` mis-handles:
+    label values containing spaces or escape sequences (the value/name
+    boundary is found by scanning the quoted label set, not by
+    splitting on the last space), multiple blanks between sample and
+    value, and an optional trailing timestamp."""
+    from deeplearning4j_tpu.telemetry.registry import _sample_name
+
     out = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        name, _, value = line.rpartition(" ")
-        out[name] = float(value)
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            name = line[:brace]
+            labels, end = _scan_labels(line, brace)
+            rest = line[end:].split()
+            key = _sample_name(name, labels)
+        else:
+            parts = line.split()
+            key, rest = parts[0], parts[1:]
+        if not rest:
+            raise ValueError(f"sample line has no value: {line!r}")
+        # rest may be [value] or [value, timestamp]
+        out[key] = float(rest[0])
     return out

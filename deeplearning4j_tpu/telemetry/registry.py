@@ -513,6 +513,12 @@ def _install_compile_hook():
                             seconds)
         except Exception:
             pass  # stub registries without counter() must not break jit
+        try:
+            from deeplearning4j_tpu.telemetry import flight
+
+            flight.record("compile", seconds=round(seconds, 6))
+        except Exception:
+            pass  # the flight recorder must never break jit either
 
     monitoring.register_event_duration_secs_listener(_on_duration)
 

@@ -9,7 +9,9 @@ SVG score chart, "/data" serves the attached storages' records as JSON,
 (ISSUE 1: the scrape endpoint), and — with an InferenceSession attached
 via serveModels() — "/serving/v1/models" lists registered models and
 "POST /serving/v1/models/<name>:predict" serves JSON inference
-(ISSUE 2: the serving endpoint)."""
+(ISSUE 2: the serving endpoint). ISSUE 3 adds "/healthz" (liveness +
+readiness: serving warmup done, last-step age, divergence state) and
+"/debug/flightrecorder" (the telemetry.flight ring buffer as JSONL)."""
 
 from __future__ import annotations
 
@@ -86,6 +88,21 @@ class _Handler(BaseHTTPRequestHandler):
 
             body = prometheus.render().encode()
             ctype = prometheus.CONTENT_TYPE
+        elif self.path == "/healthz":
+            # liveness + readiness: divergence state, last-step age,
+            # serving warmup (ISSUE 3) — 503 until ready, 503 again on
+            # divergence, so orchestrators stop routing traffic
+            from deeplearning4j_tpu.telemetry import health
+
+            payload, status = health.healthz(self.server.ui._serving)
+            self._respond(json.dumps(payload).encode(), status=status)
+            return
+        elif self.path == "/debug/flightrecorder":
+            from deeplearning4j_tpu.telemetry import flight
+
+            self._respond(flight.get_recorder().dump_jsonl().encode(),
+                          ctype="application/x-ndjson")
+            return
         elif self.path.startswith("/serving/"):
             from deeplearning4j_tpu.serving import http as shttp
 

@@ -180,3 +180,41 @@ class CollectScoresIterationListener(TrainingListener):
     def iterationDone(self, model, iteration, epoch):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, model.score()))
+
+
+class HealthListener(TrainingListener):
+    """DL4J-style per-layer training-health listener (ISSUE 3; reference:
+    the training UI's update:parameter-ratio / gradient-magnitude
+    diagnostics, SURVEY.md §2.5 listeners).
+
+    Attach with ``net.setListeners(HealthListener(policy="halt"))``:
+    the fit loop's HealthMonitor then uses THIS listener's divergence
+    config instead of the process default (telemetry.health.configure),
+    and pushes every checked step's per-layer stats into ``history``
+    for dashboards/tests — the stats themselves are computed inside the
+    jitted step, so attaching this listener adds no device work.
+
+    The monitor discovers the listener by the HEALTH_LISTENER marker
+    (duck-typed to keep telemetry.health import-cycle-free)."""
+
+    HEALTH_LISTENER = True
+
+    def __init__(self, policy="warn", ratio_max=None, ratio_min=None,
+                 check_every=1, history=200, dump_dir=None):
+        from collections import deque
+
+        from deeplearning4j_tpu.telemetry import health
+
+        self.config = health.HealthConfig(
+            policy=policy, ratio_max=ratio_max, ratio_min=ratio_min,
+            check_every=check_every, dump_dir=dump_dir)
+        # (step, {layer_label: {stat_name: value}}) per checked step
+        self.history = deque(maxlen=history)
+
+    def onHealthStats(self, loop, step, stats):
+        self.history.append((step, stats))
+
+    def lastStats(self) -> dict:
+        """{layer_label: {grad_norm, update_norm, param_norm,
+        update_param_ratio, nonfinite}} of the newest checked step."""
+        return self.history[-1][1] if self.history else {}

@@ -1,0 +1,535 @@
+"""Training-health diagnostics tests (ISSUE 3): in-step per-layer
+stats, divergence policies (WARN / HALT / SKIP_BATCH), the flight
+recorder, /healthz + /debug/flightrecorder routes, the disabled-path
+zero-overhead contract, and the metric-name drift check."""
+
+import json
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import MetricsRegistry, flight, health, prometheus
+from deeplearning4j_tpu.utils.listeners import HealthListener
+
+
+@pytest.fixture(autouse=True)
+def clean_health_state():
+    """Every test starts with default health config, clean divergence
+    status, and an empty flight ring; telemetry flag restored after."""
+    was_enabled = telemetry.enabled()
+    prev_cfg = health.get_config()
+    health.reset_status()
+    health.configure(enabled=True, policy=health.WARN, ratio_max=None,
+                     ratio_min=None, check_every=1, dump_dir=None)
+    flight.get_recorder().clear()
+    yield
+    health._state["config"] = prev_cfg
+    health._state["enabled"] = True
+    health.reset_status()
+    (telemetry.enable if was_enabled else telemetry.disable)()
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = telemetry.set_registry(reg)
+    telemetry.enable()
+    yield reg
+    telemetry.set_registry(prev)
+
+
+def _tiny_net(seed=1):
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+
+    conf = (NeuralNetConfiguration.Builder().seed(seed).list()
+            .layer(DenseLayer.Builder().nIn(4).nOut(8)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_data(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return X, y
+
+
+class TestLayerStats:
+    def test_stats_values(self):
+        import jax.numpy as jnp
+
+        grad = {"W": jnp.asarray([[3.0, 4.0]])}       # L2 = 5
+        upd = {"W": jnp.asarray([[0.0, 2.0]])}        # L2 = 2
+        par = {"W": jnp.asarray([[8.0, 6.0]])}        # L2 = 10
+        s = np.asarray(health.layer_stats(grad, upd, par))
+        assert s[0] == pytest.approx(5.0)
+        assert s[1] == pytest.approx(2.0)
+        assert s[2] == pytest.approx(10.0)
+        assert s[3] == pytest.approx(0.2)             # update:param
+        assert s[4] == 0.0
+
+    def test_nonfinite_counted(self):
+        import jax.numpy as jnp
+
+        grad = {"W": jnp.asarray([np.nan, 1.0, np.inf])}
+        s = np.asarray(health.layer_stats(grad, grad, grad))
+        assert s[4] == 6.0            # 2 each in grad, update, new params
+        # a NaN confined to the PARAMS still counts (relu backprop can
+        # zero the offending layer's own gradient)
+        fin = {"W": jnp.asarray([1.0, 2.0])}
+        nanp = {"W": jnp.asarray([np.nan, 2.0])}
+        assert np.asarray(health.layer_stats(fin, fin, nanp))[4] == 1.0
+
+    def test_step_ok_gate(self):
+        import jax.numpy as jnp
+
+        good = jnp.zeros((2, health.N_STATS), jnp.float32)
+        bad = good.at[1, health.STAT_NAMES.index("nonfinite")].set(1.0)
+        assert bool(health.step_ok(good))
+        assert not bool(health.step_ok(bad))
+        # a non-finite loss flows in via its own loss_stats row, so the
+        # gate and the host monitor read one condition
+        with_loss = jnp.concatenate(
+            [good, health.loss_stats(jnp.float32(np.nan))[None]])
+        assert not bool(health.step_ok(with_loss))
+        assert np.asarray(health.loss_stats(jnp.float32(1.0)))[4] == 0.0
+
+
+class TestWarnPolicy:
+    def test_ratio_metrics_in_exposition(self, fresh_registry):
+        net = _tiny_net()
+        X, y = _tiny_data()
+        net.fit([(X, y)], 3)
+        text = prometheus.render(fresh_registry, collect_system=False)
+        assert "dl4j_health_update_param_ratio" in text
+        assert 'loop="fit",layer="0:DenseLayer"' in text
+        assert 'layer="1:OutputLayer"' in text
+        # 3 steps, one-behind + flush => all 3 processed
+        parsed = prometheus.parse(text)
+        key = ('dl4j_health_update_param_ratio_count'
+               '{loop="fit",layer="0:DenseLayer"}')
+        assert parsed[key] == 3.0
+
+    def test_nan_warns_but_continues(self, fresh_registry):
+        net = _tiny_net(2)
+        X, y = _tiny_data()
+        Xnan = X.copy()
+        Xnan[0, 0] = np.nan
+        net.fit([(X, y), (Xnan, y), (X, y)], 1)   # no raise under WARN
+        snap = fresh_registry.snapshot()
+        viol = [k for k in snap
+                if k.startswith("dl4j_health_violations_total") and
+                'kind="nonfinite"' in k and snap[k] > 0]
+        assert viol
+        events = flight.get_recorder().events("health_violation")
+        assert events and events[-1]["violation"] == "nonfinite"
+
+    def test_ratio_threshold_trips(self, fresh_registry):
+        net = _tiny_net(3)
+        net.setListeners(HealthListener(policy="warn", ratio_max=1e-12))
+        X, y = _tiny_data()
+        net.fit([(X, y)], 2)
+        events = flight.get_recorder().events("health_violation")
+        assert any(e["violation"] == "ratio_high" for e in events)
+
+    def test_health_listener_receives_stats(self, fresh_registry):
+        net = _tiny_net(4)
+        listener = HealthListener()
+        net.setListeners(listener)
+        X, y = _tiny_data()
+        net.fit([(X, y)], 3)
+        assert len(listener.history) == 3
+        stats = listener.lastStats()
+        assert "0:DenseLayer" in stats
+        row = stats["0:DenseLayer"]
+        assert set(row) == set(health.STAT_NAMES)
+        assert row["grad_norm"] > 0 and row["param_norm"] > 0
+        assert row["nonfinite"] == 0.0
+
+
+class TestHaltPolicy:
+    def test_nan_gradient_halts_with_dump(self, fresh_registry, tmp_path):
+        net = _tiny_net(5)
+        net.setListeners(HealthListener(policy="halt",
+                                        dump_dir=str(tmp_path)))
+        # seed a NaN parameter -> NaN loss and NaN gradients on step 0
+        net.setParam(0, "W", np.full((4, 8), np.nan, np.float32))
+        X, y = _tiny_data()
+        with pytest.raises(telemetry.DivergenceError) as ei:
+            net.fit([(X, y)], 1)
+        err = ei.value
+        assert err.step == 0
+        assert "0:DenseLayer" in err.layers
+        assert "0:DenseLayer" in str(err)
+        # the JSONL dump exists and names the offending layer and step
+        assert err.dump_path and Path(err.dump_path).exists()
+        events = [json.loads(line)
+                  for line in Path(err.dump_path).read_text().splitlines()]
+        div = [e for e in events if e["kind"] == "divergence"]
+        assert div and div[-1]["step"] == 0
+        assert "0:DenseLayer" in div[-1]["layers"]
+        # /healthz payload reports the divergence with a 503
+        payload, status = health.healthz()
+        assert status == 503
+        assert payload["status"] == "diverged"
+        assert payload["divergence"]["step"] == 0
+        assert "0:DenseLayer" in payload["divergence"]["layers"]
+
+    def test_process_default_config_applies(self, fresh_registry):
+        health.configure(policy=health.HALT)
+        net = _tiny_net(6)
+        net.setParam(0, "W", np.full((4, 8), np.inf, np.float32))
+        X, y = _tiny_data()
+        with pytest.raises(telemetry.DivergenceError):
+            net.fit([(X, y)], 1)
+
+    def test_net_usable_after_midloop_halt(self, fresh_registry):
+        """HALT raising from the one-behind monitor mid-loop (while the
+        NEXT step already donated the old buffers) must leave the net
+        holding live params — callers catch DivergenceError to
+        checkpoint/inspect."""
+        health.configure(policy=health.HALT)
+        net = _tiny_net(22)
+        X, y = _tiny_data()
+        Xnan = X.copy()
+        Xnan[0, 0] = np.nan
+        # bad batch in the middle: its stats are processed during the
+        # following step's on_step call, after that step donated buffers
+        with pytest.raises(telemetry.DivergenceError) as ei:
+            net.fit([(X, y), (Xnan, y), (X, y)], 1)
+        assert ei.value.step == 1
+        w = net.getParam(0, "W").numpy()      # must not be deleted
+        assert w.shape == (4, 8)
+        out = net.output(X).numpy()           # net still drivable
+        assert out.shape == (16, 2)
+
+
+class TestSkipBatchPolicy:
+    def test_bad_batch_discarded_on_device(self, fresh_registry):
+        net = _tiny_net(7)
+        net.setListeners(HealthListener(policy="skip_batch"))
+        X, y = _tiny_data()
+        net.fit([(X, y)], 1)            # healthy step applies
+        before = net.getParam(0, "W").numpy().copy()
+        Xnan = X.copy()
+        Xnan[3, 1] = np.nan
+        net.fit([(Xnan, y)], 1)         # diverged step is discarded
+        after = net.getParam(0, "W").numpy()
+        assert np.array_equal(before, after)
+        net.fit([(X, y)], 1)            # training continues
+        assert not np.array_equal(after, net.getParam(0, "W").numpy())
+        assert np.isfinite(net.getParam(0, "W").numpy()).all()
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_health_skipped_steps_total{loop="fit"}'] == 1.0
+
+
+class TestDisabledModeZeroOverhead:
+    def test_zero_registry_calls_and_no_health_output(self):
+        class CountingStub:
+            calls = 0
+
+            def __getattr__(self, name):
+                CountingStub.calls += 1
+                raise AssertionError(
+                    f"registry.{name} touched while disabled")
+
+        net = _tiny_net(8)
+        X, y = _tiny_data()
+        prev = telemetry.set_registry(CountingStub())
+        telemetry.disable()
+        try:
+            net.fit([(X, y)], 3)
+            assert CountingStub.calls == 0
+        finally:
+            telemetry.set_registry(prev)
+            telemetry.enable()
+        # the step was compiled WITHOUT health: pre-PR output structure
+        assert net._train_step_plan == health.INACTIVE
+
+    def test_output_bit_identical_and_one_dispatch_per_step(
+            self, fresh_registry):
+        X, y = _tiny_data()
+        # same seed, health on vs telemetry disabled: params bit-equal
+        net_on = _tiny_net(9)
+        net_off = _tiny_net(9)
+        net_on.fit([(X, y)], 3)
+        telemetry.disable()
+        try:
+            net_off.fit([(X, y)], 3)
+        finally:
+            telemetry.enable()
+        for k in ("W", "b"):
+            assert np.array_equal(net_on.getParam(0, k).numpy(),
+                                  net_off.getParam(0, k).numpy())
+        # dispatch count: exactly one jitted-step call per batch
+        telemetry.disable()
+        try:
+            net = _tiny_net(10)
+            net.fit([(X, y)], 1)        # build + warm
+            inner = net._train_step
+            calls = []
+
+            def counting(*a, **kw):
+                calls.append(1)
+                return inner(*a, **kw)
+
+            net._train_step = counting
+            net.fit([(X, y)], 3)
+            assert len(calls) == 3
+        finally:
+            telemetry.enable()
+
+    def test_health_off_while_telemetry_on(self, fresh_registry):
+        health.configure(enabled=False)
+        net = _tiny_net(11)
+        X, y = _tiny_data()
+        net.fit([(X, y)], 2)
+        assert net._train_step_plan == health.INACTIVE
+        snap = fresh_registry.snapshot()
+        assert not any(k.startswith("dl4j_health") for k in snap)
+        # step timing still recorded by the loop instruments
+        assert snap['dl4j_step_seconds_count{loop="fit"}'] == 2.0
+
+
+class TestTrainerIntegration:
+    def test_sharded_trainer_health(self, fresh_registry):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+        net = _tiny_net(12)
+        X, y = _tiny_data()
+        ShardedTrainer(net).fit([DataSet(X, y)], epochs=3)
+        snap = fresh_registry.snapshot()
+        key = ('dl4j_health_update_param_ratio_count'
+               '{loop="sharded",layer="0:DenseLayer"}')
+        assert snap[key] == 3.0
+
+    def test_graph_fit_health_and_step_metrics(self, fresh_registry):
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, ComputationGraphConfiguration, DenseLayer,
+            LossFunction, NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(13)
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer.Builder().nIn(4).nOut(8)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(8).nOut(2)
+                          .activation("softmax")
+                          .lossFunction(LossFunction.MCXENT).build(), "d")
+                .setOutputs("out")
+                .build())
+        assert isinstance(conf, ComputationGraphConfiguration)
+        net = ComputationGraph(conf).init()
+        X, y = _tiny_data()
+        net.fit([(X, y)], 2)
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_step_seconds_count{loop="graph"}'] == 2.0
+        ratio_keys = [k for k in snap
+                      if k.startswith("dl4j_health_update_param_ratio_"
+                                      "count") and 'loop="graph"' in k
+                      and 'layer="d:DenseLayer"' in k]
+        assert ratio_keys and snap[ratio_keys[0]] == 2.0
+
+    def test_graph_halt_names_node(self, fresh_registry):
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, DenseLayer, LossFunction,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(14)
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("enc", DenseLayer.Builder().nIn(4).nOut(8)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(8).nOut(2)
+                          .activation("softmax")
+                          .lossFunction(LossFunction.MCXENT).build(),
+                          "enc")
+                .setOutputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        net._params["enc"]["W"] = np.full((4, 8), np.nan, np.float32)
+        health.configure(policy=health.HALT)
+        X, y = _tiny_data()
+        with pytest.raises(telemetry.DivergenceError) as ei:
+            net.fit([(X, y)], 1)
+        assert any("enc" in name for name in ei.value.layers)
+
+    def test_fit_multi_batch_health(self, fresh_registry):
+        net = _tiny_net(15)
+        X, y = _tiny_data()
+        net.fitMultiBatch(np.stack([X] * 4), np.stack([y] * 4))
+        snap = fresh_registry.snapshot()
+        key = ('dl4j_health_update_param_ratio_count'
+               '{loop="fit",layer="0:DenseLayer"}')
+        assert snap[key] == 4.0
+
+
+class TestFlightRecorder:
+    def test_ring_bound_and_dump(self, tmp_path):
+        rec = flight.FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("step", step=i)
+        events = rec.events()
+        assert len(events) == 8
+        assert events[0]["step"] == 12 and events[-1]["step"] == 19
+        path = rec.dump(str(tmp_path / "f.jsonl"))
+        lines = Path(path).read_text().splitlines()
+        assert len(lines) == 8
+        assert json.loads(lines[-1])["step"] == 19
+
+    def test_disabled_records_nothing(self):
+        rec = flight.get_recorder()
+        flight.disable()
+        try:
+            flight.record("step", step=1)
+            assert len(rec.events("step")) == 0
+        finally:
+            flight.enable()
+
+    def test_step_events_from_fit(self, fresh_registry):
+        net = _tiny_net(16)
+        X, y = _tiny_data()
+        net.fit([(X, y)], 3)
+        steps = [e for e in flight.get_recorder().events("step")
+                 if e["loop"] == "fit"]
+        assert [e["step"] for e in steps] == [0, 1, 2]
+        assert all(e["nonfinite"] == 0 for e in steps)
+
+    def test_serving_request_summaries(self, fresh_registry):
+        from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+
+        net = _tiny_net(17)
+        with InferenceSession(max_latency=0.001) as session:
+            session.register("m", net, example_shape=(4,),
+                             ladder=BucketLadder((1, 4)), warmup=True)
+            x = np.zeros((4,), np.float32)
+            session.predict("m", x)
+            session.predict("m", x)
+        warm = flight.get_recorder().events("model_warmup")
+        assert warm and warm[-1]["model"] == "m"
+        served = [e for e in flight.get_recorder().events("serving")
+                  if e["model"] == "m" and e["outcome"] == "ok"]
+        assert len(served) == 2
+        # request ids are unique and correlate the two predicts
+        assert served[0]["req_id"] != served[1]["req_id"]
+        assert all(e["queue_s"] >= 0 for e in served)
+
+
+class TestHealthzRoutes:
+    def _get(self, url):
+        try:
+            r = urllib.request.urlopen(url)
+            return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_healthz_ok_then_diverged(self, fresh_registry):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        ui = UIServer().start(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            net = _tiny_net(18)
+            X, y = _tiny_data()
+            net.fit([(X, y)], 2)
+            status, body = self._get(f"{base}/healthz")
+            payload = json.loads(body)
+            assert status == 200
+            assert payload["status"] == "ok" and payload["ready"]
+            assert payload["loops"]["fit"]["step"] == 1
+            assert payload["loops"]["fit"]["last_step_age_seconds"] >= 0
+            # acceptance: per-layer ratio samples served by GET /metrics
+            status, metrics = self._get(f"{base}/metrics")
+            assert status == 200
+            assert ('dl4j_health_update_param_ratio_count'
+                    '{loop="fit",layer="0:DenseLayer"}'
+                    in metrics.decode())
+            # now diverge under HALT
+            health.configure(policy=health.HALT)
+            net2 = _tiny_net(19)
+            net2.setParam(0, "W", np.full((4, 8), np.nan, np.float32))
+            with pytest.raises(telemetry.DivergenceError):
+                net2.fit([(X, y)], 1)
+            status, body = self._get(f"{base}/healthz")
+            payload = json.loads(body)
+            assert status == 503
+            assert payload["status"] == "diverged"
+            assert "0:DenseLayer" in payload["divergence"]["layers"]
+        finally:
+            ui.stop()
+
+    def test_healthz_serving_readiness(self, fresh_registry):
+        from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        net = _tiny_net(20)
+        with InferenceSession() as session:
+            session.register("m", net, example_shape=(4,),
+                             ladder=BucketLadder((1, 4)), warmup=False)
+            ui = UIServer()
+            ui.serveModels(session)
+            ui.start(port=0)
+            try:
+                base = f"http://127.0.0.1:{ui.port}"
+                status, body = self._get(f"{base}/healthz")
+                payload = json.loads(body)
+                assert status == 503
+                assert payload["status"] == "warming"
+                assert payload["serving"]["warmed"] is False
+                session.warmup()
+                status, body = self._get(f"{base}/healthz")
+                payload = json.loads(body)
+                assert status == 200 and payload["serving"]["warmed"]
+            finally:
+                ui.stop()
+
+    def test_flightrecorder_route(self, fresh_registry):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        net = _tiny_net(21)
+        X, y = _tiny_data()
+        net.fit([(X, y)], 2)
+        ui = UIServer().start(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            status, body = self._get(f"{base}/debug/flightrecorder")
+            assert status == 200
+            events = [json.loads(line)
+                      for line in body.decode().splitlines() if line]
+            assert any(e["kind"] == "step" for e in events)
+        finally:
+            ui.stop()
+
+
+class TestMetricNameDrift:
+    def test_tool_passes_on_current_tree(self):
+        tool = Path(__file__).resolve().parent.parent / "tools" / \
+            "check_metrics.py"
+        proc = subprocess.run([sys.executable, str(tool)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_tool_detects_drift(self):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        try:
+            import check_metrics
+        finally:
+            sys.path.pop(0)
+        problems = check_metrics.check(
+            names={"my_metric": ["x.py"],
+                   "dl4j_undocumented_total": ["y.py"]},
+            docs_text="nothing here")
+        assert len(problems) == 3  # bad prefix + 2 undocumented

@@ -170,6 +170,41 @@ class TestPrometheusExposition:
         for k, v in snap.items():
             assert parsed[k] == v, k
 
+    def test_parse_label_values_with_spaces(self):
+        """ISSUE 3 satellite regression: parse() must find the
+        name/value boundary by scanning the quoted label set — the old
+        rpartition(' ') mis-handled label values whose content
+        interacts with whitespace (spaces, trailing '\\ ' escapes), and
+        never unescaped values, so round-trip against snapshot() broke
+        for any escaped label."""
+        reg = MetricsRegistry()
+        fam = reg.counter("req_total", "", ("path", "note"))
+        fam.labels(path="a b c", note="plain").inc(1)
+        fam.labels(path="trailing\\ ", note='say "hi"').inc(2)
+        fam.labels(path="line\nbreak", note="back\\slash").inc(3)
+        h = reg.histogram("lat_seconds", "", ("op",), buckets=(0.1, 1.0))
+        h.labels(op="read write").observe(0.5)
+        text = prometheus.render(reg, collect_system=False)
+        parsed = prometheus.parse(text)
+        assert parsed == reg.snapshot()
+        assert parsed['req_total{path="a b c",note="plain"}'] == 1.0
+        assert parsed['req_total{path="trailing\\ ",note="say \"hi\""}'] \
+            == 2.0
+
+    def test_parse_blank_runs_and_timestamps(self):
+        """Exposition lines may separate sample and value with multiple
+        blanks and append a timestamp; both defeated rpartition."""
+        parsed = prometheus.parse(
+            'm 1 1700000000\n'
+            'm2   2.5\n'
+            'm3{l="a b"}  3 1700000000\n'
+            '# HELP m ignored\n')
+        assert parsed == {"m": 1.0, "m2": 2.5, 'm3{l="a b"}': 3.0}
+
+    def test_parse_rejects_malformed_labels(self):
+        with pytest.raises(ValueError):
+            prometheus.parse('m{l="unterminated 1')
+
 
 class TestMetricsRoute:
     def test_metrics_route_after_fit(self, fresh_registry):

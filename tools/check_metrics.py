@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Metric-name drift check (ISSUE 3 satellite).
+
+Every metric registered by instrumented code must (a) use the ``dl4j_``
+prefix and (b) be documented in docs/OBSERVABILITY.md — otherwise
+dashboards and alert rules silently drift from the code. Run standalone
+(``python tools/check_metrics.py``, exits non-zero on drift) or via
+tests/test_health.py::TestMetricNameDrift.
+
+Names are collected by scanning the package source for literal
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+registrations, so a new instrument cannot be added without either
+following the convention or updating this tool.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "deeplearning4j_tpu"
+DOCS = ROOT / "docs" / "OBSERVABILITY.md"
+
+# literal first argument of a registry registration call; re.S lets the
+# name sit on the line after the open paren (the prevailing style here)
+_REGISTRATION = re.compile(
+    r'\.\s*(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z_:][\w:]*)[\'"]',
+    re.S)
+
+# derived sample names the registry emits beside the family name — they
+# need no separate doc entry
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def collect_metric_names() -> dict:
+    """{metric_name: [files registering it]} across the package."""
+    names: dict = {}
+    for path in sorted(PACKAGE.rglob("*.py")):
+        text = path.read_text()
+        for name in _REGISTRATION.findall(text):
+            names.setdefault(name, []).append(
+                str(path.relative_to(ROOT)))
+    return names
+
+
+def check(names=None, docs_text=None) -> list:
+    """Drift findings as human-readable strings (empty = clean)."""
+    names = collect_metric_names() if names is None else names
+    docs_text = DOCS.read_text() if docs_text is None else docs_text
+    problems = []
+    for name, files in sorted(names.items()):
+        where = ", ".join(sorted(set(files)))
+        if not name.startswith("dl4j_"):
+            problems.append(
+                f"metric {name!r} ({where}) does not use the dl4j_ "
+                f"prefix")
+        # whole-name match: plain substring would let `dl4j_step` hide
+        # behind a documented `dl4j_step_seconds`
+        if not re.search(re.escape(name) + r"(?![\w])", docs_text):
+            problems.append(
+                f"metric {name!r} ({where}) is not documented in "
+                f"docs/OBSERVABILITY.md")
+    return problems
+
+
+def main() -> int:
+    names = collect_metric_names()
+    problems = check(names)
+    print(f"checked {len(names)} registered metric names")
+    for p in problems:
+        print(f"DRIFT: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("no metric-name drift")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
