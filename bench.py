@@ -520,13 +520,110 @@ def bench_health_overhead(steps=80, repeats=3):
     }
 
 
+def bench_precision(steps=60, repeats=3, n_requests=200):
+    """ISSUE 4 smoke: (a) fp32 vs bf16_mixed steady-state step time on
+    the same 4-layer MLP (master weights fp32 in both; the mixed run
+    adds the compute casts + the in-step loss scaler), and (b) int8-PTQ
+    vs fp32 serving p50/p99 through the DynamicBatcher on a warmed AOT
+    ladder. On TPU the bf16/int8 rows are the MXU payoff; on CPU they
+    mainly demonstrate the overhead side (bf16 is emulated), which is
+    why off-TPU rows land platform-suffixed in BENCH_ALL.json."""
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.precision import quantize
+    from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 256)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)]
+
+    def build(precision=None):
+        b = (NeuralNetConfiguration.Builder().seed(11).updater(Adam(1e-3)))
+        if precision:
+            b = b.precision(precision)
+        conf = (b.list()
+                .layer(DenseLayer.Builder().nIn(256).nOut(256)
+                       .activation("relu").build())
+                .layer(DenseLayer.Builder().nOut(256)
+                       .activation("relu").build())
+                .layer(DenseLayer.Builder().nOut(256)
+                       .activation("relu").build())
+                .layer(OutputLayer.Builder().nOut(10)
+                       .activation("softmax")
+                       .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def step_ms(precision):
+        net = build(precision)
+        net.fit([(X, y)] * 5)                     # compile + settle
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            net.fit([(X, y)] * steps)
+            _ = float(np.asarray(net._params[0]["W"]).sum())   # sync
+            best = min(best, time.perf_counter() - t0)
+        return best / steps * 1e3
+
+    fp32_ms = step_ms(None)
+    bf16_ms = step_ms("bf16_mixed")
+
+    # serving: fp32 servable vs int8 PTQ of the SAME trained net
+    net = build(None)
+    net.fit([(X, y)] * 10)
+    calib = [X[i * 32:(i + 1) * 32] for i in range(4)]
+    qsv = quantize(net, calib, example_shape=(256,))
+
+    def percentiles(session, name, x, n):
+        for _ in range(10):
+            session.predict(name, x)
+        lat = np.empty(n)
+        for i in range(n):
+            t0 = time.perf_counter()
+            session.predict(name, x)
+            lat[i] = time.perf_counter() - t0
+        return np.percentile(lat * 1e3, [50, 99])
+
+    x1 = X[0]
+    with InferenceSession(max_latency=0.001) as session:
+        ladder = BucketLadder((1, 8, 32))
+        session.register("fp32", net, example_shape=(256,), ladder=ladder,
+                         warmup=True)
+        session.register("int8", qsv, ladder=ladder, warmup=True)
+        p50_f, p99_f = percentiles(session, "fp32", x1, n_requests)
+        p50_q, p99_q = percentiles(session, "int8", x1, n_requests)
+
+    return {
+        "metric": "precision_bf16_vs_fp32_step_ratio",
+        "value": round(bf16_ms / fp32_ms, 4),
+        "unit": "x (bf16_mixed/fp32 step time; <1 is a speedup)",
+        "vs_baseline": None,
+        "step_ms_fp32": round(fp32_ms, 4),
+        "step_ms_bf16_mixed": round(bf16_ms, 4),
+        "serving_p50_ms_fp32": round(float(p50_f), 3),
+        "serving_p99_ms_fp32": round(float(p99_f), 3),
+        "serving_p50_ms_int8": round(float(p50_q), 3),
+        "serving_p99_ms_int8": round(float(p99_q), 3),
+        "ptq_calibration_max_err": qsv.calibration_max_err,
+        "steps": steps,
+        "note": ("4-layer 256-wide MLP batch 128; bf16_mixed = fp32 "
+                 "master + bf16 compute + dynamic loss scaling compiled "
+                 "into the step; serving p50/p99 at batch 1 through the "
+                 "DynamicBatcher on a warmed (1,8,32) ladder (includes "
+                 "the 1 ms coalescing window)"),
+    }
+
+
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
                ("graves_lstm", bench_graves_lstm),
                ("word2vec", bench_word2vec),
                ("serving_latency", bench_serving_latency),
-               ("health_overhead", bench_health_overhead)]
+               ("health_overhead", bench_health_overhead),
+               ("precision", bench_precision)]
 
 
 def _merge_bench_all(results, path="BENCH_ALL.json"):

@@ -16,6 +16,8 @@ Capability map (reference layer -> this package):
   Model zoo                          -> deeplearning4j_tpu.models
   Evaluation                         -> deeplearning4j_tpu.evaluation
   ModelSerializer / listeners / etc. -> deeplearning4j_tpu.utils
+  DataType knob                      -> deeplearning4j_tpu.precision
+                                        (policies, loss scaling, int8 PTQ)
 """
 
 __version__ = "0.1.0"

@@ -13,9 +13,14 @@ import numpy as np
 
 
 def _to_np(x):
-    if hasattr(x, "numpy"):
-        return np.asarray(x.numpy())
-    return np.asarray(x)
+    a = np.asarray(x.numpy()) if hasattr(x, "numpy") else np.asarray(x)
+    # upcast sub-fp32 floats (bfloat16 / float16 eval outputs) BEFORE any
+    # accumulation: ROC cumsums and binary-count sums lose counts past
+    # the narrow mantissa on long iterators (ISSUE 4 satellite)
+    # (ml_dtypes types report numpy kind 'V'; plain float16 is 'f'/2)
+    if a.dtype.itemsize < 4 and a.dtype.kind in ("f", "V"):
+        a = a.astype(np.float32)
+    return a
 
 
 def _class_indices(arr):

@@ -8,9 +8,14 @@ import numpy as np
 
 
 def _to_np(x):
-    if hasattr(x, "numpy"):
-        return np.asarray(x.numpy())
-    return np.asarray(x)
+    a = np.asarray(x.numpy()) if hasattr(x, "numpy") else np.asarray(x)
+    # upcast sub-fp32 floats (bfloat16/float16 outputs; ml_dtypes report
+    # numpy kind 'V') BEFORE the per-batch sums: squaring and summing in
+    # bf16 loses MSE precision on long iterators (ISSUE 4 satellite) —
+    # the cross-batch accumulators below are float64 already
+    if a.dtype.itemsize < 4 and a.dtype.kind in ("f", "V"):
+        a = a.astype(np.float32)
+    return a
 
 
 class RegressionEvaluation:

@@ -51,7 +51,7 @@ class BackpropType:
 class MultiLayerConfiguration:
     def __init__(self, layers, defaults=None, inputType=None, seed=12345,
                  dataType="float32", backpropType=BackpropType.Standard,
-                 tbpttLength=None):
+                 tbpttLength=None, precision=None):
         self.layers: list[BaseLayer] = layers
         self.defaults = defaults or {}
         self.inputType = inputType
@@ -59,6 +59,10 @@ class MultiLayerConfiguration:
         self.dataType = dataType
         self.backpropType = backpropType
         self.tbpttLength = tbpttLength
+        # precision policy name / Policy / None (ISSUE 4): resolved
+        # lazily by precision_policy so a bare dataType keeps behaving
+        # exactly as before
+        self.precision = precision
         self.preprocessors: list = [None] * len(layers)
         self.layer_input_types: list = [None] * len(layers)
         self._finalize()
@@ -130,6 +134,9 @@ class MultiLayerConfiguration:
             "dataType": self.dataType,
             "backpropType": self.backpropType,
             "tbpttLength": self.tbpttLength,
+            "precision": (self.precision.to_json()
+                          if hasattr(self.precision, "to_json")
+                          else self.precision),
         }, indent=1)
 
     toJson = to_json
@@ -146,13 +153,21 @@ class MultiLayerConfiguration:
             layers, defaults, it, d.get("seed", 12345),
             d.get("dataType", "float32"),
             d.get("backpropType", BackpropType.Standard),
-            d.get("tbpttLength"))
+            d.get("tbpttLength"), d.get("precision"))
 
     fromJson = from_json
 
     @property
     def dtype(self):
         return jnp.dtype(self.dataType)
+
+    @property
+    def precision_policy(self):
+        """The effective precision.Policy (uniform in dataType when no
+        policy is configured)."""
+        from deeplearning4j_tpu.precision import resolve_policy
+
+        return resolve_policy(self.precision, self.dataType)
 
 
 def _wants_conv(layer):
@@ -188,10 +203,11 @@ def _json_defaults(defaults):
 
 
 class ListBuilder:
-    def __init__(self, defaults, seed, dataType):
+    def __init__(self, defaults, seed, dataType, precision=None):
         self._defaults = defaults
         self._seed = seed
         self._dataType = dataType
+        self._precision = precision
         self._layers: list = []
         self._input_type = None
         self._backprop_type = BackpropType.Standard
@@ -239,7 +255,8 @@ class ListBuilder:
                                        self._input_type, self._seed,
                                        self._dataType,
                                        self._backprop_type,
-                                       self._tbptt_length)
+                                       self._tbptt_length,
+                                       self._precision)
 
 
 class NeuralNetConfiguration:
@@ -250,6 +267,7 @@ class NeuralNetConfiguration:
             self._defaults = {"updater": Sgd(1e-2)}
             self._seed = 12345
             self._dataType = "float32"
+            self._precision = None
 
         def seed(self, s):
             self._seed = int(s)
@@ -287,6 +305,18 @@ class NeuralNetConfiguration:
             self._dataType = str(jnp.dtype(dt))
             return self
 
+        def precision(self, policy):
+            """Precision policy (ISSUE 4): a name ("bf16_mixed", "bf16",
+            "fp16_mixed", "float32") or a precision.Policy. "bf16_mixed"
+            = fp32 master weights + bf16 compute + fp32 loss with
+            dynamic loss scaling compiled into the train step."""
+            from deeplearning4j_tpu.precision import resolve_policy
+
+            # validate eagerly so a typo fails at build, not first fit
+            resolve_policy(policy, self._dataType)
+            self._precision = policy
+            return self
+
         def gradientNormalization(self, gn, threshold=1.0):
             self._defaults["gradientNormalization"] = gn
             self._defaults["gradientNormalizationThreshold"] = threshold
@@ -305,9 +335,11 @@ class NeuralNetConfiguration:
             return self  # no cuDNN on the TPU path
 
         def list(self):
-            return ListBuilder(self._defaults, self._seed, self._dataType)
+            return ListBuilder(self._defaults, self._seed, self._dataType,
+                               self._precision)
 
         def graphBuilder(self):
             from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
 
-            return GraphBuilder(self._defaults, self._seed, self._dataType)
+            return GraphBuilder(self._defaults, self._seed, self._dataType,
+                                self._precision)

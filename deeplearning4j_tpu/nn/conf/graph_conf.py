@@ -166,7 +166,7 @@ class ReshapeVertex(GraphVertex):
 class ComputationGraphConfiguration:
     def __init__(self, inputs, nodes, outputs, defaults=None, seed=12345,
                  dataType="float32", input_types=None,
-                 backpropType="Standard", tbpttLength=None):
+                 backpropType="Standard", tbpttLength=None, precision=None):
         self.inputs = list(inputs)            # input names
         self.nodes = nodes                    # name -> (layer|vertex, [input names])
         self.outputs = list(outputs)          # output layer names
@@ -176,6 +176,7 @@ class ComputationGraphConfiguration:
         self.input_types = input_types or {}
         self.backpropType = backpropType
         self.tbpttLength = tbpttLength
+        self.precision = precision            # policy name / Policy / None
         self.topo_order: list[str] = []
         self._finalize()
 
@@ -223,6 +224,12 @@ class ComputationGraphConfiguration:
     def dtype(self):
         return jnp.dtype(self.dataType)
 
+    @property
+    def precision_policy(self):
+        from deeplearning4j_tpu.precision import resolve_policy
+
+        return resolve_policy(self.precision, self.dataType)
+
     def to_json(self):
         nodes = {}
         for name, (node, ins) in self.nodes.items():
@@ -242,6 +249,9 @@ class ComputationGraphConfiguration:
                            for k, v in self.input_types.items()},
             "backpropType": self.backpropType,
             "tbpttLength": self.tbpttLength,
+            "precision": (self.precision.to_json()
+                          if hasattr(self.precision, "to_json")
+                          else self.precision),
         }, indent=1)
 
     toJson = to_json
@@ -264,16 +274,18 @@ class ComputationGraphConfiguration:
         return ComputationGraphConfiguration(
             d["inputs"], nodes, d["outputs"], defaults, d.get("seed", 12345),
             d.get("dataType", "float32"), input_types,
-            d.get("backpropType", "Standard"), d.get("tbpttLength"))
+            d.get("backpropType", "Standard"), d.get("tbpttLength"),
+            d.get("precision"))
 
     fromJson = from_json
 
 
 class GraphBuilder:
-    def __init__(self, defaults, seed, dataType):
+    def __init__(self, defaults, seed, dataType, precision=None):
         self._defaults = defaults
         self._seed = seed
         self._dataType = dataType
+        self._precision = precision
         self._inputs: list[str] = []
         self._nodes: dict = {}
         self._outputs: list[str] = []
@@ -318,4 +330,4 @@ class GraphBuilder:
             self._inputs, self._nodes, self._outputs, dict(self._defaults),
             self._seed, self._dataType, self._input_types,
             getattr(self, "_backprop_type", "Standard"),
-            getattr(self, "_tbptt_length", None))
+            getattr(self, "_tbptt_length", None), self._precision)

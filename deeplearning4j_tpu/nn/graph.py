@@ -35,6 +35,7 @@ class ComputationGraph:
         self._params: dict[str, dict] = {}
         self._states: dict[str, dict] = {}
         self._opt_states: dict = {}
+        self._prec_state: dict = {}  # loss-scaler state (ISSUE 4); {} = off
         self._listeners: list = []
         self._train_step = None
         self._train_step_plan = None  # health BuildPlan compiled into it
@@ -47,7 +48,10 @@ class ComputationGraph:
         self._initialized = False
 
     def init(self):
-        dtype = self.conf.dtype
+        # master weights in the policy's param dtype (fp32 under any
+        # *_mixed policy); exactly conf.dtype without a policy
+        pol = self._precision_policy()
+        dtype = pol.param_jnp
         key = jax.random.key(self.conf.seed)
         for i, name in enumerate(self.conf.topo_order):
             node, _ = self.conf.nodes[name]
@@ -62,8 +66,21 @@ class ComputationGraph:
             name: (self._updater(name).init_state(p) if p else ())
             for name, p in self._params.items()
         }
+        scaler = self._loss_scaler()
+        self._prec_state = scaler.init_state() if scaler else {}
         self._initialized = True
         return self
+
+    def _precision_policy(self):
+        return self.conf.precision_policy
+
+    def _loss_scaler(self):
+        from deeplearning4j_tpu.precision import DynamicLossScaler
+
+        if not hasattr(self, "_scaler_cache"):
+            self._scaler_cache = DynamicLossScaler.for_policy(
+                self._precision_policy())
+        return self._scaler_cache
 
     def _updater(self, name):
         node, _ = self.conf.nodes[name]
@@ -77,10 +94,11 @@ class ComputationGraph:
     # -- pure forward over the DAG ------------------------------------------
     def _forward(self, params, states, inputs: dict, training, rng,
                  stop_before_output=False):
-        # float inputs follow the configured dataType (bf16 nets accept
-        # f32-fed batches); int inputs (embedding ids) pass through, and
-        # f64 is left alone — the gradient-check harness runs fp64
-        dt = self.conf.dtype
+        # float inputs follow the policy's compute dtype (== the
+        # configured dataType without a policy); int inputs (embedding
+        # ids) pass through, and f64 is left alone — the gradient-check
+        # harness runs fp64
+        dt = self._precision_policy().compute_jnp
         env = {}
         for k, v in inputs.items():
             v = jnp.asarray(v)
@@ -110,6 +128,13 @@ class ComputationGraph:
 
     def _loss_from(self, params, states, inputs, labels: dict, training, rng,
                    masks: dict | None = None):
+        from deeplearning4j_tpu.precision import cast_floating
+
+        pol = self._precision_policy()
+        if pol.is_mixed:
+            # cast INSIDE whatever is differentiated: the transpose
+            # upcasts gradients back to the master dtype
+            params = cast_floating(params, pol.compute_jnp)
         env, new_states = self._forward(params, states, inputs, training, rng,
                                         stop_before_output=True)
         loss = 0.0
@@ -150,22 +175,31 @@ class ComputationGraph:
             f"{name}:{type(node).__name__}"
             for name, (node, _) in self.conf.nodes.items())
 
-    def _step_math(self, params, states, opt_states, inputs, labels, masks,
-                   rng, it, health_plan=None):
+    def _step_math(self, params, states, opt_states, prec, inputs, labels,
+                   masks, rng, it, health_plan=None):
         """One optimizer step as a pure traced function (shared by the
         single-step jit and the scan-of-K-steps jit). Health stats ride
-        along per node when the plan collects (see
-        MultiLayerNetwork._step_math)."""
+        along per node when the plan collects, and the precision
+        policy's loss scaler (scale/unscale/finite-gate/state-advance)
+        compiles in exactly as in MultiLayerNetwork._step_math."""
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = health_plan or _health.INACTIVE
+        scaler = self._loss_scaler()
+        scaling = scaler is not None and bool(prec)
 
         def loss_fn(p):
-            return self._loss_from(p, states, inputs, labels, True, rng,
-                                   masks)
+            loss, ns = self._loss_from(p, states, inputs, labels, True,
+                                       rng, masks)
+            if scaling:
+                return scaler.scale_loss(loss, prec), (loss, ns)
+            return loss, (loss, ns)
 
-        (loss, new_states), grads = jax.value_and_grad(
+        (_, (loss, new_states)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
+        if scaling:
+            grads = scaler.unscale(grads, prec)
+            finite = scaler.all_finite(grads)
         new_params, new_opts, stats = {}, {}, []
         for name, (node, _) in self.conf.nodes.items():
             g = grads.get(name)
@@ -179,7 +213,7 @@ class ComputationGraph:
                 g, getattr(node, "gradientNormalization", None),
                 getattr(node, "gradientNormalizationThreshold", None)
                 or 1.0)
-            upd, new_opt = self._updater(name).apply(
+            upd, new_opt = self._updater(name).apply_mixed(
                 g, opt_states[name], params[name], it)
             new_params[name] = jax.tree_util.tree_map(
                 lambda p, u: p - u, params[name], upd)
@@ -189,17 +223,25 @@ class ComputationGraph:
         if plan.collect:
             stats.append(_health.loss_stats(loss))
         health = _health.stack_stats(stats) if plan.collect else None
+        if scaling:
+            new_params = _health.keep_if(finite, new_params, params)
+            new_opts = _health.keep_if(finite, new_opts, opt_states)
+            new_states = _health.keep_if(finite, new_states, states)
+            new_prec = scaler.next_state(prec, finite)
+        else:
+            new_prec = prec
         if plan.skip:
             ok = _health.step_ok(health)
             new_params = _health.keep_if(ok, new_params, params)
             new_opts = _health.keep_if(ok, new_opts, opt_states)
             new_states = _health.keep_if(ok, new_states, states)
-        return loss, new_params, new_states, new_opts, health
+        return loss, new_params, new_states, new_opts, health, new_prec
 
     def _build_train_step(self, health_plan=None):
-        def step(params, states, opt_states, inputs, labels, masks, rng, it):
-            return self._step_math(params, states, opt_states, inputs,
-                                   labels, masks, rng, it,
+        def step(params, states, opt_states, prec, inputs, labels, masks,
+                 rng, it):
+            return self._step_math(params, states, opt_states, prec,
+                                   inputs, labels, masks, rng, it,
                                    health_plan=health_plan)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -221,23 +263,23 @@ class ComputationGraph:
 
         plan = health_plan or _health.INACTIVE
 
-        def many(params, states, opts, inputs_k, labels_k, masks_k, rng0,
-                 it0):
+        def many(params, states, opts, prec, inputs_k, labels_k, masks_k,
+                 rng0, it0):
             def body(carry, xs):
-                params, states, opts, it = carry
+                params, states, opts, prec, it = carry
                 inputs, labels, masks = xs
                 rng = jax.random.fold_in(rng0, it)
-                loss, params, states, opts, health = self._step_math(
-                    params, states, opts, inputs, labels, masks, rng, it,
-                    health_plan=plan)
+                loss, params, states, opts, health, prec = self._step_math(
+                    params, states, opts, prec, inputs, labels, masks,
+                    rng, it, health_plan=plan)
                 ys = (loss, health) if plan.collect else loss
-                return (params, states, opts, it + 1), ys
+                return (params, states, opts, prec, it + 1), ys
 
             def scan_once(carry, _):
                 return jax.lax.scan(body, carry,
                                     (inputs_k, labels_k, masks_k))
 
-            carry = (params, states, opts, it0)
+            carry = (params, states, opts, prec, it0)
             if repeats == 1:
                 carry, ys = scan_once(carry, None)
             else:
@@ -245,8 +287,8 @@ class ComputationGraph:
                                            length=repeats)
                 ys = jax.tree_util.tree_map(lambda a: a[-1], ys_r)
             losses, healths = ys if plan.collect else (ys, None)
-            params, states, opts, _ = carry
-            return losses, params, states, opts, healths
+            params, states, opts, prec, _ = carry
+            return losses, params, states, opts, healths, prec
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
@@ -277,17 +319,25 @@ class ComputationGraph:
             (l_k.shape[0],) + _ones_mask(l_k[0]).shape, np.float32)}
         rng0 = jax.random.key(self.conf.seed + 1)
         it0 = self._iteration
-        losses, self._params, self._states, self._opt_states, healths = \
-            self._multi_step[key](
+        from deeplearning4j_tpu import precision as _precision
+
+        pm = _precision.monitor_for("graph", self._precision_policy())
+        if pm is not None:
+            pm.baseline_from(self._prec_state)
+        (losses, self._params, self._states, self._opt_states, healths,
+         self._prec_state) = self._multi_step[key](
                 self._params, self._states, self._opt_states,
-                inputs_k, labels_k, masks_k, rng0,
+                self._prec_state, inputs_k, labels_k, masks_k, rng0,
                 jnp.asarray(self._iteration, jnp.int32))
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
+        if pm is not None:
+            pm.on_launch(range(it0, self._iteration), self._prec_state)
         if healths is not None:
             hm = _health.monitor_for("graph", self._layer_labels(),
                                      self._listeners)
             if hm is not None:
+                hm.precision = pm
                 base = it0 + (repeats - 1) * int(f_k.shape[0])
                 for k in range(int(f_k.shape[0])):
                     hm.on_step(base + k, healths[k])
@@ -346,8 +396,8 @@ class ComputationGraph:
             out[name] = {}
         return out
 
-    def _fit_tbptt(self, params, states, opts, inputs, labels, masks,
-                   base_key, hm=None):
+    def _fit_tbptt(self, params, states, opts, prec, inputs, labels, masks,
+                   base_key, hm=None, pm=None):
         from deeplearning4j_tpu.nn.conf.configuration import BackpropType
 
         assert self.conf.backpropType == BackpropType.TruncatedBPTT
@@ -382,16 +432,20 @@ class ComputationGraph:
                     if v.ndim == 2 else v) for k, v in mc.items()}
             it_used = self._iteration
             rng = jax.random.fold_in(base_key, it_used)
-            loss, params, states, opts, health = self._train_step(
-                params, states, opts, ic, lc, mc, rng, it_used)
+            loss, params, states, opts, health, prec = self._train_step(
+                params, states, opts, prec, ic, lc, mc, rng, it_used)
             self._iteration += 1
-            if hm is not None:
+            if hm is not None or pm is not None:
                 # rebind first: on_step may raise (HALT) and the caller
                 # must not be left holding this step's donated buffers
                 self._params, self._states, self._opt_states = (
                     params, self._strip_rnn_states(states), opts)
-                hm.on_step(it_used, health)
-        return loss, params, self._strip_rnn_states(states), opts
+                self._prec_state = prec
+                if pm is not None:
+                    pm.on_step(it_used, prec)
+                if hm is not None:
+                    hm.on_step(it_used, health)
+        return loss, params, self._strip_rnn_states(states), opts, prec
 
     def rnnTimeStep(self, *xs):
         """Streaming inference with carried recurrent state; each x is
@@ -418,8 +472,10 @@ class ComputationGraph:
         key = "stream"
         if key not in self._infer_fn_cache:
             def fn(params, states, inputs):
+                params = self._cast_for_inference(params)
                 env, ns = self._forward(params, states, inputs, False, None)
-                return [env[o] for o in self.conf.outputs], ns
+                return [self._cast_output(env[o])
+                        for o in self.conf.outputs], ns
 
             self._infer_fn_cache[key] = jax.jit(fn)
         ys, new_states = self._infer_fn_cache[key](
@@ -442,6 +498,7 @@ class ComputationGraph:
 
         self._refresh_train_step()
         params, states, opts = self._params, self._states, self._opt_states
+        prec = self._prec_state
         base_key = jax.random.key(self.conf.seed + 1)
         last = None
         # one flag check per fit(): with telemetry disabled both are
@@ -449,6 +506,13 @@ class ComputationGraph:
         tele = telemetry.loop_instruments("graph")
         hm = _health.monitor_for("graph", self._layer_labels(),
                                  self._listeners)
+        from deeplearning4j_tpu import precision as _precision
+
+        pm = _precision.monitor_for("graph", self._precision_policy())
+        if pm is not None:
+            pm.baseline_from(prec)
+        if hm is not None:
+            hm.precision = pm
         for epoch_i in range(epochs):
             batches, data = _prepare_batches(data, epoch_i, epochs)
             for ds in batches:
@@ -477,15 +541,16 @@ class ComputationGraph:
                 if tele is not None:
                     t_step = _time.perf_counter()
                 if tbptt:
-                    loss, params, states, opts = self._fit_tbptt(
-                        params, states, opts, inputs, labels, masks,
-                        base_key, hm=hm)
+                    loss, params, states, opts, prec = self._fit_tbptt(
+                        params, states, opts, prec, inputs, labels, masks,
+                        base_key, hm=hm, pm=pm)
                 else:
                     it_used = self._iteration
                     rng = jax.random.fold_in(base_key, it_used)
-                    loss, params, states, opts, health = self._train_step(
-                        params, states, opts, inputs, labels, masks, rng,
-                        it_used)
+                    (loss, params, states, opts, health,
+                     prec) = self._train_step(
+                        params, states, opts, prec, inputs, labels, masks,
+                        rng, it_used)
                     self._iteration += 1
                 if tele is not None:
                     tele.record_step(_time.perf_counter() - t_step, n)
@@ -494,8 +559,12 @@ class ComputationGraph:
                 # params, not the buffers this step donated
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
-                if not tbptt and hm is not None:
-                    hm.on_step(it_used, health)
+                self._prec_state = prec
+                if not tbptt:
+                    if pm is not None:
+                        pm.on_step(it_used, prec)   # before hm (skip set)
+                    if hm is not None:
+                        hm.on_step(it_used, health)
                 last = loss
                 if self._listeners:
                     self._score = float(loss)
@@ -503,6 +572,8 @@ class ComputationGraph:
                         listener.iterationDone(self, self._iteration,
                                                self._epoch)
             self._epoch += 1
+        if pm is not None:
+            pm.flush()   # before hm.flush: same-step skip handshake
         if hm is not None:
             hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last is not None:
@@ -510,6 +581,23 @@ class ComputationGraph:
         return self
 
     # -- inference -----------------------------------------------------------
+    def _cast_for_inference(self, params):
+        """Mixed policy: inference runs in the compute dtype too (the
+        input cast in _forward already truncates, so casting the params
+        is what actually buys the bf16 matmuls); identity otherwise."""
+        from deeplearning4j_tpu.precision import cast_floating
+
+        pol = self._precision_policy()
+        return cast_floating(params, pol.compute_jnp) if pol.is_mixed \
+            else params
+
+    def _cast_output(self, y):
+        pol = self._precision_policy()
+        if jnp.issubdtype(y.dtype, jnp.floating) and \
+                y.dtype != pol.output_jnp:
+            return y.astype(pol.output_jnp)
+        return y
+
     def output(self, *xs, train=False):
         """output(x1, x2, ...) -> list of output arrays (one per configured
         output)."""
@@ -518,8 +606,10 @@ class ComputationGraph:
         key = ("out", train)
         if key not in self._infer_fn_cache:
             def fn(params, states, inputs):
+                params = self._cast_for_inference(params)
                 env, _ = self._forward(params, states, inputs, train, None)
-                return [env[o] for o in self.conf.outputs]
+                return [self._cast_output(env[o])
+                        for o in self.conf.outputs]
 
             self._infer_fn_cache[key] = jax.jit(fn)
         ys = self._infer_fn_cache[key](self._params, self._states, inputs)

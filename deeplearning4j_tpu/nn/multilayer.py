@@ -66,6 +66,7 @@ class MultiLayerNetwork:
         self._params: list[dict] = []
         self._states: list[dict] = []
         self._opt_states: list = []
+        self._prec_state: dict = {}  # loss-scaler state (ISSUE 4); {} = off
         self._listeners: list = []
         self._train_step = None
         self._train_step_plan = None  # health BuildPlan compiled into it
@@ -82,7 +83,11 @@ class MultiLayerNetwork:
 
     # -- init ----------------------------------------------------------------
     def init(self):
-        dtype = self.conf.dtype
+        # master weights follow the precision policy's param dtype (fp32
+        # under any *_mixed policy — the compute cast happens inside the
+        # step); without a policy this is exactly conf.dtype as before
+        pol = self._precision_policy()
+        dtype = pol.param_jnp
         key = jax.random.key(self.conf.seed)
         self._params, self._states = [], []
         for i, lr in enumerate(self.layers):
@@ -93,8 +98,22 @@ class MultiLayerNetwork:
             self._layer_updater(i).init_state(p) if p else ()
             for i, p in enumerate(self._params)
         ]
+        scaler = self._loss_scaler()
+        self._prec_state = scaler.init_state() if scaler else {}
         self._initialized = True
         return self
+
+    def _precision_policy(self):
+        return self.conf.precision_policy
+
+    def _loss_scaler(self):
+        """The policy's loss scaler (built once per net), or None."""
+        from deeplearning4j_tpu.precision import DynamicLossScaler
+
+        if not hasattr(self, "_scaler_cache"):
+            self._scaler_cache = DynamicLossScaler.for_policy(
+                self._precision_policy())
+        return self._scaler_cache
 
     def _layer_updater(self, i):
         u = self.layers[i].updater
@@ -105,12 +124,16 @@ class MultiLayerNetwork:
             raise RuntimeError("call init() first")
 
     # -- pure forward --------------------------------------------------------
-    def _forward(self, params, states, x, training, rng, upto=None):
-        # float inputs follow the configured dataType (bf16 nets accept
-        # f32-fed batches); int inputs (embedding ids) pass through, and
-        # f64 is left alone — the gradient-check harness runs the whole
-        # net in fp64
-        dt = self.conf.dtype
+    def _forward(self, params, states, x, training, rng, upto=None,
+                 compute_dtype=None):
+        # float inputs follow the policy's COMPUTE dtype (== the
+        # configured dataType without a policy, so bf16 nets accept
+        # f32-fed batches exactly as before); int inputs (embedding ids)
+        # pass through, and f64 is left alone — the gradient-check
+        # harness runs the whole net in fp64. compute_dtype overrides
+        # the policy for callers that pick their own activation dtype.
+        dt = compute_dtype if compute_dtype is not None \
+            else self._precision_policy().compute_jnp
         x = jnp.asarray(x)
         if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt \
                 and x.dtype != jnp.float64:
@@ -128,7 +151,16 @@ class MultiLayerNetwork:
 
     def _loss_from(self, params, states, f, l, training, rng, mask=None):
         """Forward to the last hidden activation, then the output layer's
-        fused pre-activation loss (stable logits path)."""
+        fused pre-activation loss (stable logits path). Under a mixed
+        precision policy the (master-dtype) params are cast to the
+        compute dtype HERE — inside whatever is being differentiated —
+        so the cast's transpose upcasts gradients back to the master
+        dtype and Adam/SGD moments stay fp32."""
+        from deeplearning4j_tpu.precision import cast_floating
+
+        pol = self._precision_policy()
+        if pol.is_mixed:
+            params = cast_floating(params, pol.compute_jnp)
         out_idx = len(self.layers) - 1
         h, new_states = self._forward(params, states, f, training, rng,
                                       upto=out_idx)
@@ -178,25 +210,38 @@ class MultiLayerNetwork:
             f"{i}:{type(lr).__name__}"
             for i, lr in enumerate(self.layers))
 
-    def _step_math(self, updaters, params, states, opt_states, f, l, lmask,
-                   rng, it, health_plan=None):
+    def _step_math(self, updaters, params, states, opt_states, prec, f, l,
+                   lmask, rng, it, health_plan=None):
         """One optimizer step as a pure traced function (shared by the
         single-step jit and the scan-of-K-steps jit). When the health
         plan collects, per-layer stats ride along as one small [L, 5]
         array (fused reductions — no extra dispatch); with the
         SKIP_BATCH policy a non-finite step keeps the old
-        params/states/opts via an in-graph select."""
+        params/states/opts via an in-graph select. When the precision
+        policy enables loss scaling, `prec` carries the scaler state:
+        the loss is scaled before the backward pass, gradients are
+        unscaled (exactly — powers of two), a fused finite check gates
+        the whole update through the same keep-old-params jnp.where,
+        and the scaler state advances — all on device, zero host syncs
+        for an overflow step."""
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = health_plan or _health.INACTIVE
+        scaler = self._loss_scaler()
+        scaling = scaler is not None and bool(prec)
 
         def loss_fn(p):
             loss, ns = self._loss_from(p, states, f, l, True, rng,
                                        mask=lmask)
-            return loss, ns
+            if scaling:
+                return scaler.scale_loss(loss, prec), (loss, ns)
+            return loss, (loss, ns)
 
-        (loss, new_states), grads = jax.value_and_grad(
+        (_, (loss, new_states)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
+        if scaling:
+            grads = scaler.unscale(grads, prec)
+            finite = scaler.all_finite(grads)
         new_params, new_opts, stats = [], [], []
         for i, lr in enumerate(self.layers):
             g = grads[i]
@@ -208,8 +253,8 @@ class MultiLayerNetwork:
                 continue
             g = _normalize_grads(g, lr.gradientNormalization,
                                  lr.gradientNormalizationThreshold or 1.0)
-            upd, new_opt = updaters[i].apply(g, opt_states[i], params[i],
-                                             it)
+            upd, new_opt = updaters[i].apply_mixed(g, opt_states[i],
+                                                   params[i], it)
             new_params.append(jax.tree_util.tree_map(
                 lambda p, u: p - u, params[i], upd))
             new_opts.append(new_opt)
@@ -218,19 +263,26 @@ class MultiLayerNetwork:
         if plan.collect:
             stats.append(_health.loss_stats(loss))
         health = _health.stack_stats(stats) if plan.collect else None
+        if scaling:
+            new_params = _health.keep_if(finite, new_params, params)
+            new_opts = _health.keep_if(finite, new_opts, opt_states)
+            new_states = _health.keep_if(finite, new_states, states)
+            new_prec = scaler.next_state(prec, finite)
+        else:
+            new_prec = prec
         if plan.skip:
             ok = _health.step_ok(health)
             new_params = _health.keep_if(ok, new_params, params)
             new_opts = _health.keep_if(ok, new_opts, opt_states)
             new_states = _health.keep_if(ok, new_states, states)
-        return loss, new_params, new_states, new_opts, health
+        return loss, new_params, new_states, new_opts, health, new_prec
 
     def _build_train_step(self, health_plan=None):
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
-        def step(params, states, opt_states, f, l, lmask, rng, it):
-            return self._step_math(updaters, params, states, opt_states, f,
-                                   l, lmask, rng, it,
+        def step(params, states, opt_states, prec, f, l, lmask, rng, it):
+            return self._step_math(updaters, params, states, opt_states,
+                                   prec, f, l, lmask, rng, it,
                                    health_plan=health_plan)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -254,21 +306,21 @@ class MultiLayerNetwork:
         plan = health_plan or _health.INACTIVE
         updaters = [self._layer_updater(i) for i in range(len(self.layers))]
 
-        def many(params, states, opts, f_k, l_k, m_k, rng0, it0):
+        def many(params, states, opts, prec, f_k, l_k, m_k, rng0, it0):
             def body(carry, xs):
-                params, states, opts, it = carry
+                params, states, opts, prec, it = carry
                 f, l, m = xs
                 rng = jax.random.fold_in(rng0, it)
-                loss, params, states, opts, health = self._step_math(
-                    updaters, params, states, opts, f, l, m, rng, it,
+                loss, params, states, opts, health, prec = self._step_math(
+                    updaters, params, states, opts, prec, f, l, m, rng, it,
                     health_plan=plan)
                 ys = (loss, health) if plan.collect else loss
-                return (params, states, opts, it + 1), ys
+                return (params, states, opts, prec, it + 1), ys
 
             def scan_once(carry, _):
                 return jax.lax.scan(body, carry, (f_k, l_k, m_k))
 
-            carry = (params, states, opts, it0)
+            carry = (params, states, opts, prec, it0)
             if repeats == 1:
                 carry, ys = scan_once(carry, None)
             else:
@@ -279,8 +331,8 @@ class MultiLayerNetwork:
                                            None, length=repeats)
                 ys = jax.tree_util.tree_map(lambda a: a[-1], ys_r)
             losses, healths = ys if plan.collect else (ys, None)
-            params, states, opts, _ = carry
-            return losses, params, states, opts, healths
+            params, states, opts, prec, _ = carry
+            return losses, params, states, opts, healths, prec
 
         return jax.jit(many, donate_argnums=(0, 1, 2))
 
@@ -311,17 +363,28 @@ class MultiLayerNetwork:
                       np.float32)
         rng0 = jax.random.key(self.conf.seed + 1)
         it0 = self._iteration
-        losses, self._params, self._states, self._opt_states, healths = \
-            self._multi_step[key](
+        from deeplearning4j_tpu import precision as _precision
+
+        pm = _precision.monitor_for("fit", self._precision_policy())
+        if pm is not None:
+            pm.baseline_from(self._prec_state)   # pre-launch count
+        (losses, self._params, self._states, self._opt_states, healths,
+         self._prec_state) = self._multi_step[key](
                 self._params, self._states, self._opt_states,
-                f_k, l_k, m_k, rng0,
+                self._prec_state, f_k, l_k, m_k, rng0,
                 jnp.asarray(self._iteration, jnp.int32))
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
+        if pm is not None:
+            # publish from the launch's FINAL scaler state (already
+            # materialized — we just read losses): scale gauge + the
+            # overflow-count delta accumulated across the K steps
+            pm.on_launch(range(it0, self._iteration), self._prec_state)
         if healths is not None:
             hm = _health.monitor_for("fit", self._layer_labels(),
                                      self._listeners)
             if hm is not None:
+                hm.precision = pm
                 # the [K, L, 5] stack is already materialized (we just
                 # read losses), so processing here adds no sync
                 base = it0 + (repeats - 1) * int(f_k.shape[0])
@@ -346,6 +409,7 @@ class MultiLayerNetwork:
 
         self._refresh_train_step()
         params, states, opts = self._params, self._states, self._opt_states
+        prec = self._prec_state
         base_key = jax.random.key(self.conf.seed + 1)
         last_loss = None
         # one flag check per fit(): with telemetry disabled tele is None
@@ -355,6 +419,17 @@ class MultiLayerNetwork:
         # off, and the jitted step then returns no health array at all
         hm = _health.monitor_for("fit", self._layer_labels(),
                                  self._listeners)
+        # loss-scaler publication (None unless the policy scales AND
+        # telemetry is on; the on-device gate runs regardless). The
+        # health monitor defers its SKIP_BATCH accounting to pm for
+        # steps the scaler already skipped (no double counting).
+        from deeplearning4j_tpu import precision as _precision
+
+        pm = _precision.monitor_for("fit", self._precision_policy())
+        if pm is not None:
+            pm.baseline_from(prec)
+        if hm is not None:
+            hm.precision = pm
         for epoch_i in range(epochs):
             batches, data = _prepare_batches(data, epoch_i, epochs)
             batch_iter = iter(batches)
@@ -385,14 +460,16 @@ class MultiLayerNetwork:
                 if tele is not None:
                     t_step = _time.perf_counter()
                 if tbptt:
-                    loss, params, states, opts = self._fit_tbptt(
-                        params, states, opts, f, l, lmask, base_key,
-                        hm=hm)
+                    loss, params, states, opts, prec = self._fit_tbptt(
+                        params, states, opts, prec, f, l, lmask, base_key,
+                        hm=hm, pm=pm)
                 else:
                     it_used = self._iteration
                     rng = jax.random.fold_in(base_key, it_used)
-                    loss, params, states, opts, health = self._train_step(
-                        params, states, opts, f, l, lmask, rng, it_used)
+                    (loss, params, states, opts, health,
+                     prec) = self._train_step(
+                        params, states, opts, prec, f, l, lmask, rng,
+                        it_used)
                     self._iteration += 1
                 if tele is not None:
                     tele.record_step(_time.perf_counter() - t_step,
@@ -403,10 +480,16 @@ class MultiLayerNetwork:
                 # checkpoint/inspect, not the buffers this step donated
                 self._params, self._states, self._opt_states = (
                     params, states, opts)
-                if not tbptt and hm is not None:
-                    # one step behind: processes the PREVIOUS step's
-                    # (already materialized) stats — no added sync
-                    hm.on_step(it_used, health)
+                self._prec_state = prec
+                if not tbptt:
+                    if pm is not None:
+                        # pm BEFORE hm: the skip set must be populated
+                        # when hm's SKIP_BATCH accounting asks
+                        pm.on_step(it_used, prec)
+                    if hm is not None:
+                        # one step behind: processes the PREVIOUS step's
+                        # (already materialized) stats — no added sync
+                        hm.on_step(it_used, health)
                 last_loss = loss
                 if self._profiler_cfg is not None:
                     from deeplearning4j_tpu.utils.profiler import (
@@ -422,6 +505,8 @@ class MultiLayerNetwork:
                         listener.iterationDone(self, self._iteration,
                                                self._epoch)
             self._epoch += 1
+        if pm is not None:
+            pm.flush()   # before hm.flush: same-step skip handshake
         if hm is not None:
             hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last_loss is not None:
@@ -541,8 +626,8 @@ class MultiLayerNetwork:
             out[i] = {}
         return out
 
-    def _fit_tbptt(self, params, states, opts, f, l, lmask, base_key,
-                   hm=None):
+    def _fit_tbptt(self, params, states, opts, prec, f, l, lmask, base_key,
+                   hm=None, pm=None):
         L = self.conf.tbpttLength
         T = f.shape[2]
         self._recurrent_indices(forbid_bidirectional=True)
@@ -567,16 +652,20 @@ class MultiLayerNetwork:
                         [mc, np.zeros((mc.shape[0], pad), mc.dtype)], axis=1)
             it_used = self._iteration
             rng = jax.random.fold_in(base_key, it_used)
-            loss, params, states, opts, health = self._train_step(
-                params, states, opts, fc, lc, mc, rng, it_used)
+            loss, params, states, opts, health, prec = self._train_step(
+                params, states, opts, prec, fc, lc, mc, rng, it_used)
             self._iteration += 1
-            if hm is not None:
+            if hm is not None or pm is not None:
                 # rebind first: on_step may raise (HALT) and the caller
                 # must not be left holding this step's donated buffers
                 self._params, self._states, self._opt_states = (
                     params, self._strip_rnn_states(states), opts)
-                hm.on_step(it_used, health)
-        return loss, params, self._strip_rnn_states(states), opts
+                self._prec_state = prec
+                if pm is not None:
+                    pm.on_step(it_used, prec)
+                if hm is not None:
+                    hm.on_step(it_used, health)
+        return loss, params, self._strip_rnn_states(states), opts, prec
 
     # -- streaming inference (reference: rnnTimeStep / rnnClearPreviousState,
     # SURVEY.md §2.5 TBPTT row) ---------------------------------------------
@@ -641,9 +730,20 @@ class MultiLayerNetwork:
     def _infer_fn(self, training=False):
         key = ("out", training)
         if key not in self._infer_fns:
+            from deeplearning4j_tpu.precision import cast_floating
+
+            pol = self._precision_policy()
+
             def fn(params, states, x):
+                # mixed policy: inference ALSO runs in the compute dtype
+                # (the MXU payoff applies to serving too) and returns
+                # output_dtype at the boundary; identity without a policy
+                if pol.is_mixed:
+                    params = cast_floating(params, pol.compute_jnp)
                 y, _ = self._forward(params, states, x, training, None)
-                return y
+                return y.astype(pol.output_jnp) \
+                    if y.dtype != pol.output_jnp and \
+                    jnp.issubdtype(y.dtype, jnp.floating) else y
 
             self._infer_fns[key] = jax.jit(fn)
         return self._infer_fns[key]
@@ -817,6 +917,8 @@ class MultiLayerNetwork:
             other._params = jax.tree_util.tree_map(copy, self._params)
             other._states = jax.tree_util.tree_map(copy, self._states)
             other._opt_states = jax.tree_util.tree_map(copy, self._opt_states)
+            other._prec_state = jax.tree_util.tree_map(copy,
+                                                       self._prec_state)
         return other
 
     def summary(self) -> str:

@@ -34,6 +34,20 @@ class IUpdater:
     def apply(self, grads, state, params, step):
         raise NotImplementedError
 
+    def apply_mixed(self, grads, state, params, step):
+        """Master-dtype guard for mixed-precision training (ISSUE 4):
+        force each gradient leaf to its parameter's dtype before the
+        updater math, so Adam/SGD moments and the update itself stay in
+        the MASTER dtype (fp32 under bf16_mixed) even if a compute-dtype
+        gradient leaks through (e.g. a custom layer whose backward
+        returns bf16 cotangents directly). Identity when dtypes already
+        match — the normal case, since the compute cast's transpose
+        upcasts cotangents at the master boundary."""
+        grads = _tm(
+            lambda g, p: g.astype(p.dtype) if g.dtype != p.dtype else g,
+            grads, params)
+        return self.apply(grads, state, params, step)
+
     def to_json(self):
         d = {"@class": type(self).__name__}
         for k, v in self.__dict__.items():

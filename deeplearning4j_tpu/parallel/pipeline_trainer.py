@@ -237,13 +237,25 @@ class PipelineParallelTrainer:
 
     def _loss(self, params, f, l, lmask):
         net, lo, hi = self.net, self.lo, self.hi
+        # mixed-precision policy (ISSUE 4): cast master params + input
+        # to the compute dtype INSIDE the differentiated function — the
+        # cast happens before the pipeline's shard_map, so stage params
+        # stay sharded and the transpose upcasts grads back to the
+        # master dtype. (Dynamic loss scaling is not wired through this
+        # trainer; bf16's fp32-range exponents make unscaled pipeline
+        # training safe — see docs/PRECISION.md.)
+        pol = net._precision_policy()
+        if pol.is_mixed:
+            from deeplearning4j_tpu.precision import cast_floating
+
+            params = cast_floating(params, pol.compute_jnp)
         outer = iter(params["outer"])
         outer_params = [
             (next(outer) if not (lo <= i < hi) else None)
             for i in range(len(net.layers))
         ]
         m = self.microbatches
-        x = jnp.asarray(f, net.conf.dtype) \
+        x = jnp.asarray(f, pol.compute_jnp) \
             if jnp.issubdtype(jnp.asarray(f).dtype, jnp.floating) else f
 
         from deeplearning4j_tpu.nn.multilayer import _apply_preprocessor
@@ -325,15 +337,15 @@ class PipelineParallelTrainer:
                     if plan.collect:
                         stats.append(_health.zero_stats())
                     continue
-                upd, o2 = u.apply(g, o, p, it)
+                upd, o2 = u.apply_mixed(g, o, p, it)
                 new_outer_p.append(jax.tree_util.tree_map(
                     lambda a, b: a - b, p, upd))
                 new_outer_o.append(o2)
                 if plan.collect:
                     stats.append(_health.layer_stats(g, upd,
                                                      new_outer_p[-1]))
-            upd, run_o = upds["run"].apply(grads["run"], opt["run"],
-                                           params["run"], it)
+            upd, run_o = upds["run"].apply_mixed(grads["run"], opt["run"],
+                                                 params["run"], it)
             new_run = jax.tree_util.tree_map(lambda a, b: a - b,
                                              params["run"], upd)
             new_params = {"outer": new_outer_p, "run": new_run}

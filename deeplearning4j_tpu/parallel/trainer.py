@@ -103,15 +103,26 @@ class ShardedTrainer:
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = health_plan or _health.INACTIVE
+        scaler = net._loss_scaler()
+        scaling = scaler is not None and bool(net._prec_state)
+        # scaler state is a few replicated scalars; the finite-check
+        # reduction over the sharded grads gets its psum from GSPMD just
+        # like the health stats — the policy survives sharding intact
+        prec_sh = jax.tree_util.tree_map(lambda _: repl, net._prec_state)
 
-        def step(params, states, opt_states, f, l, mask, rng, it):
+        def step(params, states, opt_states, prec, f, l, mask, rng, it):
             def loss_fn(p):
                 loss, ns = net._loss_from(p, states, f, l, True, rng,
                                           mask=mask)
-                return loss, ns
+                if scaling:
+                    return scaler.scale_loss(loss, prec), (loss, ns)
+                return loss, (loss, ns)
 
-            (loss, new_states), grads = jax.value_and_grad(
+            (_, (loss, new_states)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if scaling:
+                grads = scaler.unscale(grads, prec)
+                finite = scaler.all_finite(grads)
             new_params, new_opts, stats = [], [], []
             for i, lr in enumerate(net.layers):
                 g = grads[i]
@@ -124,8 +135,8 @@ class ShardedTrainer:
                 g = _normalize_grads(g, lr.gradientNormalization,
                                      lr.gradientNormalizationThreshold
                                      or 1.0)
-                upd, new_opt = updaters[i].apply(g, opt_states[i],
-                                                 params[i], it)
+                upd, new_opt = updaters[i].apply_mixed(g, opt_states[i],
+                                                       params[i], it)
                 new_params.append(jax.tree_util.tree_map(
                     lambda p, u: p - u, params[i], upd))
                 new_opts.append(new_opt)
@@ -137,18 +148,27 @@ class ShardedTrainer:
             if plan.collect:
                 stats.append(_health.loss_stats(loss))
             health = _health.stack_stats(stats) if plan.collect else None
+            if scaling:
+                new_params = _health.keep_if(finite, new_params, params)
+                new_opts = _health.keep_if(finite, new_opts, opt_states)
+                new_states = _health.keep_if(finite, new_states, states)
+                new_prec = scaler.next_state(prec, finite)
+            else:
+                new_prec = prec
             if plan.skip:
                 ok = _health.step_ok(health)
                 new_params = _health.keep_if(ok, new_params, params)
                 new_opts = _health.keep_if(ok, new_opts, opt_states)
                 new_states = _health.keep_if(ok, new_states, states)
-            return loss, new_params, new_states, new_opts, health
+            return loss, new_params, new_states, new_opts, health, new_prec
 
         out_health = (repl,) if plan.collect else (None,)
         return jax.jit(
             step,
-            in_shardings=(p_sh, s_sh, o_sh, b_sh, b_sh, b_sh, repl, repl),
-            out_shardings=(repl, p_sh, s_sh, o_sh) + out_health,
+            in_shardings=(p_sh, s_sh, o_sh, prec_sh, b_sh, b_sh, b_sh,
+                          repl, repl),
+            out_shardings=(repl, p_sh, s_sh, o_sh) + out_health
+            + (prec_sh,),
             donate_argnums=(0, 1, 2),
         )
 
@@ -170,6 +190,10 @@ class ShardedTrainer:
         net._params = put(net._params, p_sh)
         net._states = put(net._states, s_sh)
         net._opt_states = put(net._opt_states, o_sh)
+        if net._prec_state:
+            net._prec_state = put(
+                net._prec_state,
+                jax.tree_util.tree_map(lambda _: repl, net._prec_state))
 
     def fit(self, data, epochs: int = 1):
         import time
@@ -187,6 +211,7 @@ class ShardedTrainer:
             self._step_fn = self._build_step(plan)
             self._step_plan = plan
         params, states, opts = net._params, net._states, net._opt_states
+        prec = net._prec_state
         base_key = jax.random.key(net.conf.seed + 1)
         last = None
         # one flag check per fit(): tele is None when telemetry is
@@ -194,6 +219,13 @@ class ShardedTrainer:
         tele = telemetry.loop_instruments("sharded")
         hm = _health.monitor_for("sharded", net._layer_labels(),
                                  net._listeners)
+        from deeplearning4j_tpu import precision as _precision
+
+        pm = _precision.monitor_for("sharded", net._precision_policy())
+        if pm is not None:
+            pm.baseline_from(prec)
+        if hm is not None:
+            hm.precision = pm
         for _ in range(epochs):
             batch_iter = iter(_as_batches(data))
             while True:
@@ -224,8 +256,9 @@ class ShardedTrainer:
                 it_used = net._iteration
                 rng = jax.random.fold_in(base_key, it_used)
                 if tele is None:
-                    loss, params, states, opts, health = self._step_fn(
-                        params, states, opts, f, l, mask, rng, it_used)
+                    loss, params, states, opts, health, prec = \
+                        self._step_fn(params, states, opts, prec, f, l,
+                                      mask, rng, it_used)
                 else:
                     # the span is also a TraceAnnotation, so the host
                     # step region lines up with XPlane device traces;
@@ -233,15 +266,18 @@ class ShardedTrainer:
                     # equal the device step time in steady state (no
                     # sync added)
                     with tele.step_span():
-                        loss, params, states, opts, health = \
-                            self._step_fn(params, states, opts, f, l,
-                                          mask, rng, it_used)
+                        loss, params, states, opts, health, prec = \
+                            self._step_fn(params, states, opts, prec, f,
+                                          l, mask, rng, it_used)
                     tele.examples.inc(real)
                 # rebind BEFORE the health monitor runs: its HALT policy
                 # raises out of fit() and the caller must find live
                 # params, not the buffers this step donated
                 net._params, net._states, net._opt_states = (
                     params, states, opts)
+                net._prec_state = prec
+                if pm is not None:
+                    pm.on_step(it_used, prec)   # before hm (skip set)
                 if hm is not None:
                     hm.on_step(it_used, health)
                 net._iteration += 1
@@ -252,6 +288,8 @@ class ShardedTrainer:
                         listener.iterationDone(net, net._iteration,
                                                net._epoch)
             net._epoch += 1
+        if pm is not None:
+            pm.flush()   # before hm.flush: same-step skip handshake
         if hm is not None:
             hm.flush()   # drain the one-behind slot (HALT may raise here)
         if last is not None:

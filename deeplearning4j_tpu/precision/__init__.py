@@ -1,0 +1,36 @@
+"""Precision subsystem (ISSUE 4 tentpole): policy-based mixed-precision
+training and int8 post-training quantization for serving.
+
+Training::
+
+    conf = (NeuralNetConfiguration.Builder()
+            .precision("bf16_mixed")        # fp32 master, bf16 compute,
+            .list() ... .build())           # dynamic loss scaling
+    net = MultiLayerNetwork(conf).init()
+    net.fit(data, epochs)                   # scaler compiled into the step
+
+Serving::
+
+    from deeplearning4j_tpu.precision import quantize
+    qsv = quantize(net, calibration_batches, example_shape=(784,))
+    session.register("model_int8", qsv, warmup=True)   # unchanged route
+
+See docs/PRECISION.md for semantics and the PTQ recipe.
+"""
+
+from deeplearning4j_tpu.precision.monitor import (
+    PrecisionInstruments, PrecisionMonitor, monitor_for)
+from deeplearning4j_tpu.precision.policy import (
+    NAMED_POLICIES, Policy, cast_floating, named_policy, resolve_policy)
+from deeplearning4j_tpu.precision.quantize import (
+    QuantizedServable, dequantize_array, quantize, quantize_array,
+    quantize_params)
+from deeplearning4j_tpu.precision.scaler import (
+    DynamicLossScaler, FixedLossScaler)
+
+__all__ = [
+    "DynamicLossScaler", "FixedLossScaler", "NAMED_POLICIES", "Policy",
+    "PrecisionInstruments", "PrecisionMonitor", "QuantizedServable",
+    "cast_floating", "dequantize_array", "monitor_for", "named_policy",
+    "quantize", "quantize_array", "quantize_params", "resolve_policy",
+]

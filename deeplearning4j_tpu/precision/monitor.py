@@ -1,0 +1,158 @@
+"""Host side of the loss-scaling pipeline: one-step-behind publication
+of the scaler state (mirroring telemetry.health.HealthMonitor).
+
+The jitted step returns the scaler's NEW state every step; the monitor
+keeps a one-deep pending slot and processes the PREVIOUS step's state —
+already materialized in steady state, so reading it never stalls the
+dispatch queue. An overflow is detected as a delta in the cumulative
+device-side ``overflows`` counter, so no extra per-step flag output is
+needed and scan-of-K-steps launches (fitMultiBatch) publish correctly
+from their final state.
+
+Metrics (documented in docs/OBSERVABILITY.md):
+
+- ``dl4j_precision_loss_scale{loop}``        current loss scale (gauge)
+- ``dl4j_precision_overflow_total{loop}``    non-finite scaled-gradient
+  steps observed by the scaler (counter)
+- ``dl4j_precision_skipped_steps_total{loop}`` steps discarded on device
+  by the overflow gate (counter; == overflow_total for the in-step gate)
+
+Every overflow also lands in the flight recorder as a ``precision``
+event naming the loop, step, and the halved scale. The monitor exposes
+``skipped_at(step)`` so the health monitor's SKIP_BATCH accounting can
+defer to it when both gates fire on the same step (ISSUE 4 satellite:
+one skipped step must not count twice).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.telemetry.registry import get_registry
+
+SCALE_HELP = "Current dynamic loss scale per training loop"
+OVERFLOW_HELP = ("Training steps whose scaled gradients went non-finite "
+                 "(the dynamic loss scaler backed off)")
+SKIPPED_HELP = ("Training steps discarded on device by the loss-scaler "
+                "overflow gate")
+
+
+class PrecisionInstruments:
+    __slots__ = ("scale", "overflows", "skipped")
+
+    def __init__(self, registry, loop):
+        self.scale = registry.gauge(
+            "dl4j_precision_loss_scale", SCALE_HELP,
+            ("loop",)).labels(loop=loop)
+        self.overflows = registry.counter(
+            "dl4j_precision_overflow_total", OVERFLOW_HELP,
+            ("loop",)).labels(loop=loop)
+        self.skipped = registry.counter(
+            "dl4j_precision_skipped_steps_total", SKIPPED_HELP,
+            ("loop",)).labels(loop=loop)
+
+
+def _host(x) -> float:
+    if getattr(x, "is_fully_addressable", True):
+        return float(np.asarray(x))
+    return float(np.asarray(x.addressable_data(0)))
+
+
+class PrecisionMonitor:
+    """One per fit loop (created by ``monitor_for``); call
+    ``on_step(step, prec_state)`` after each step and ``flush()`` at the
+    end of the loop — BEFORE the health monitor's equivalents, so the
+    skip set is populated when health accounting asks."""
+
+    def __init__(self, loop, instruments=None):
+        self.loop = loop
+        self.instruments = instruments
+        self._pending = None
+        self._last_overflows = 0
+        # recent overflow steps for the health-monitor handshake; bounded
+        # so a pathological run cannot grow host memory
+        self._recent_skips: deque = deque(maxlen=256)
+
+    def skipped_at(self, step) -> bool:
+        return step in self._recent_skips
+
+    def baseline_from(self, state):
+        """Anchor the overflow-delta detection to the CURRENT cumulative
+        device count (call once before the hot loop: the monitor is
+        per-fit, the device counter is per-net-lifetime). The state is
+        materialized — produced by init() or a previous step — so this
+        read does not stall anything mid-loop."""
+        if state:
+            self._last_overflows = int(_host(state["overflows"]))
+
+    def on_step(self, step, prec_state):
+        if not prec_state:
+            return
+        prev, self._pending = self._pending, (step, prec_state)
+        if prev is not None:
+            self._process(*prev)
+
+    def flush(self):
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._process(*prev)
+
+    def on_launch(self, steps, state):
+        """Scan-of-K-steps launch (fitMultiBatch): publish from the
+        launch's final scaler state. Per-step attribution is not
+        available from a fused launch, so any overflows are attributed
+        to the whole `steps` range (keeps the health-monitor handshake
+        sound: a skip inside the launch never double-counts)."""
+        if not state:
+            return
+        scale = _host(state["scale"])
+        overflows = int(_host(state["overflows"]))
+        inst = self.instruments
+        if inst is not None:
+            inst.scale.set(scale)
+        delta = overflows - self._last_overflows
+        if delta > 0:
+            self._last_overflows = overflows
+            # only the last maxlen indices can survive the deque — slice
+            # the range instead of iterating a potentially huge launch
+            self._recent_skips.extend(
+                steps[-(self._recent_skips.maxlen or len(steps)):])
+            if inst is not None:
+                inst.overflows.inc(delta)
+                inst.skipped.inc(delta)
+            flight.record("precision", loop=self.loop,
+                          step=[min(steps), max(steps)],
+                          event="overflow", skipped=delta,
+                          loss_scale=scale, overflows_total=overflows)
+
+    def _process(self, step, state):
+        scale = _host(state["scale"])
+        overflows = int(_host(state["overflows"]))
+        inst = self.instruments
+        if inst is not None:
+            inst.scale.set(scale)
+        delta = overflows - self._last_overflows
+        if delta > 0:
+            self._last_overflows = overflows
+            self._recent_skips.append(step)
+            if inst is not None:
+                inst.overflows.inc(delta)
+                inst.skipped.inc(delta)
+            flight.record("precision", loop=self.loop, step=step,
+                          event="overflow", skipped=delta,
+                          loss_scale=scale, overflows_total=overflows)
+
+
+def monitor_for(loop, policy) -> PrecisionMonitor | None:
+    """The per-fit PrecisionMonitor, or None when the policy has no loss
+    scaling or telemetry is disabled (preserving the zero-registry-calls
+    -per-step contract; the on-device gate runs regardless)."""
+    if policy is None or not policy.scaling_enabled:
+        return None
+    if not _registry.enabled():
+        return None
+    return PrecisionMonitor(loop, PrecisionInstruments(get_registry(), loop))

@@ -1,0 +1,158 @@
+"""Precision policies: which dtype each role of a training/serving step
+runs in (ISSUE 4 tentpole).
+
+Reference capability: the reference stack exposes a single global
+``DataType`` knob (``dataType(DataType.HALF)``); cuDNN-era experience
+(PAPERS.md "cuDNN: Efficient Primitives") and every TPU framework since
+made precision a *policy* instead — separate dtypes for the stored
+(master) parameters, the compute that feeds the MXU, and the loss/output
+boundary, plus loss scaling for narrow-exponent compute types.
+
+A ``Policy`` names three dtypes and an optional loss-scaling mode:
+
+- ``param_dtype``: what ``init()`` allocates and the updater state
+  mirrors (the *master* weights — fp32 under any ``*_mixed`` policy);
+- ``compute_dtype``: what the forward/backward matmuls run in (params
+  and inputs are cast at the step boundary; the cast's transpose
+  upcasts the gradients back, so grads/moments stay ``param_dtype``);
+- ``output_dtype``: what inference returns at the serving boundary;
+- ``loss_scaling``: ``None``, ``"dynamic"`` (DynamicLossScaler compiled
+  into the jitted step), or a fixed float scale.
+
+Named policies::
+
+    "float32"     fp32 / fp32 / fp32, no scaling       (the default)
+    "bfloat16"    bf16 / bf16 / bf16, no scaling       (pure bf16)
+    "bf16_mixed"  fp32 master, bf16 compute, fp32 out, dynamic scaling
+    "fp16_mixed"  fp32 master, fp16 compute, fp32 out, dynamic scaling
+
+bf16 shares fp32's exponent range, so overflow under ``bf16_mixed`` is
+rare — the dynamic scaler is then a cheap insurance policy (one fused
+finite-check reduction riding with the gradients, a ``jnp.where`` gate
+on the donated buffers: a bad step costs zero host syncs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Policy:
+    name: str = "float32"
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str = "float32"
+    # None | "dynamic" | fixed float scale
+    loss_scaling: object = None
+    # DynamicLossScaler knobs (ignored unless loss_scaling == "dynamic")
+    init_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+
+    @property
+    def param_jnp(self):
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def compute_jnp(self):
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def output_jnp(self):
+        return jnp.dtype(self.output_dtype)
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.compute_dtype != self.param_dtype
+
+    @property
+    def scaling_enabled(self) -> bool:
+        return self.loss_scaling is not None
+
+    def to_json(self):
+        """Serialize for configuration.json round-trips. Named policies
+        collapse to their string (stable across releases); customized
+        ones serialize field-by-field."""
+        if self.name in NAMED_POLICIES and self == NAMED_POLICIES[self.name]:
+            return self.name
+        d = {"@policy": self.name}
+        for k, v in self.__dict__.items():
+            d[k] = v
+        return d
+
+    @staticmethod
+    def from_json(d):
+        if d is None or isinstance(d, Policy):
+            return d
+        if isinstance(d, str):
+            return named_policy(d)
+        d = dict(d)
+        name = d.pop("@policy", d.pop("name", "custom"))
+        return Policy(name=name, **{k: v for k, v in d.items()
+                                    if k in Policy.__dataclass_fields__})
+
+
+def _uniform(name, dtype):
+    return Policy(name=name, param_dtype=dtype, compute_dtype=dtype,
+                  output_dtype=dtype)
+
+
+NAMED_POLICIES = {
+    "float32": _uniform("float32", "float32"),
+    "fp32": _uniform("fp32", "float32"),
+    "bfloat16": _uniform("bfloat16", "bfloat16"),
+    "bf16": _uniform("bf16", "bfloat16"),
+    "bf16_mixed": Policy(name="bf16_mixed", param_dtype="float32",
+                         compute_dtype="bfloat16", output_dtype="float32",
+                         loss_scaling="dynamic"),
+    "fp16_mixed": Policy(name="fp16_mixed", param_dtype="float32",
+                         compute_dtype="float16", output_dtype="float32",
+                         loss_scaling="dynamic"),
+}
+
+
+def named_policy(name: str) -> Policy:
+    try:
+        return NAMED_POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {name!r}; choose from "
+            f"{sorted(NAMED_POLICIES)} or pass a precision.Policy") from None
+
+
+def resolve_policy(precision, data_type) -> Policy:
+    """The effective Policy for a net configuration: ``precision`` may be
+    None (uniform policy in the configured dataType), a policy name, a
+    Policy, or a serialized policy dict."""
+    if precision is None:
+        dt = str(jnp.dtype(data_type))
+        return _uniform(dt, dt)
+    if isinstance(precision, Policy):
+        return precision
+    if isinstance(precision, str):
+        return named_policy(precision)
+    return Policy.from_json(precision)
+
+
+def cast_floating(tree, dtype):
+    """Cast every inexact leaf of a pytree to ``dtype``, leaving integer
+    leaves (embedding ids) and fp64 leaves (the gradient-check harness
+    runs whole nets in fp64) untouched. Identity when nothing needs a
+    cast, so inactive policies add zero ops to the jaxpr."""
+    import jax
+
+    dtype = jnp.dtype(dtype)
+
+    def one(x):
+        xd = getattr(x, "dtype", None)
+        if xd is None or not jnp.issubdtype(xd, jnp.floating):
+            return x
+        if xd == dtype or xd == jnp.float64:
+            return x
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map(one, tree)

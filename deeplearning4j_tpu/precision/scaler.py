@@ -1,0 +1,114 @@
+"""Dynamic loss scaling, compiled INTO the jitted train step.
+
+The classic fp16 recipe (also the insurance policy for bf16): multiply
+the loss by a large power-of-two scale before the backward pass so small
+gradients survive the narrow mantissa/exponent, divide the gradients by
+the same scale before the updater, and — when any gradient came back
+non-finite — discard the step on device and halve the scale. After
+``growth_interval`` consecutive good steps the scale doubles back.
+
+Everything here is traced math: the scaler state is a tiny pytree of
+device scalars donated through the step like the params, the finite
+check is one fused reduction riding with the gradients (like the PR 3
+health row), and the skip reuses the keep-old-params ``jnp.where`` gate
+— an overflow step costs ZERO extra host syncs. The host observes the
+state one step behind through ``precision.monitor_for`` (dl4j_precision_*
+metrics + flight-recorder ``precision`` events).
+
+Scales are powers of two throughout, so ``scaled_loss / scale`` and
+``grad / scale`` are exact in every binary float format.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_tm = jax.tree_util.tree_map
+
+# state keys (all 0-d device arrays):
+#   scale      f32  current loss scale
+#   good_steps i32  consecutive finite steps since the last change
+#   overflows  i32  cumulative non-finite (skipped) steps
+STATE_KEYS = ("scale", "good_steps", "overflows")
+
+MAX_SCALE = 2.0 ** 31
+MIN_SCALE = 1.0
+
+
+class DynamicLossScaler:
+    """Built once per net from its Policy; all methods are traced."""
+
+    def __init__(self, init_scale=2.0 ** 15, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000):
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.dynamic = True
+
+    @classmethod
+    def for_policy(cls, policy):
+        """The scaler a Policy asks for, or None (no scaling)."""
+        if not policy.scaling_enabled:
+            return None
+        if policy.loss_scaling == "dynamic":
+            return cls(policy.init_scale, policy.growth_factor,
+                       policy.backoff_factor, policy.growth_interval)
+        return FixedLossScaler(float(policy.loss_scaling))
+
+    def init_state(self) -> dict:
+        return {"scale": jnp.float32(self.init_scale),
+                "good_steps": jnp.int32(0),
+                "overflows": jnp.int32(0)}
+
+    # -- traced step math ----------------------------------------------------
+    def scale_loss(self, loss, state):
+        return loss.astype(jnp.float32) * state["scale"]
+
+    def unscale(self, grads, state):
+        inv = (jnp.float32(1.0) / state["scale"]).astype(jnp.float32)
+        return _tm(lambda g: g * inv.astype(g.dtype), grads)
+
+    @staticmethod
+    def all_finite(tree):
+        """One fused boolean: every leaf of ``tree`` is finite. This is
+        the gate condition — it must see the gradients BEFORE the
+        updater touches them."""
+        leaves = [x for x in jax.tree_util.tree_leaves(tree)
+                  if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)]
+        if not leaves:
+            return jnp.bool_(True)
+        return jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+
+    def next_state(self, state, finite) -> dict:
+        grown = (state["good_steps"] + 1) >= self.growth_interval
+        scale_ok = jnp.where(
+            grown,
+            jnp.minimum(state["scale"] * self.growth_factor, MAX_SCALE),
+            state["scale"])
+        scale = jnp.where(
+            finite, scale_ok,
+            jnp.maximum(state["scale"] * self.backoff_factor, MIN_SCALE))
+        good = jnp.where(finite & ~grown, state["good_steps"] + 1, 0)
+        overflows = state["overflows"] + jnp.where(finite, 0, 1).astype(
+            jnp.int32)
+        return {"scale": scale.astype(jnp.float32),
+                "good_steps": good.astype(jnp.int32),
+                "overflows": overflows}
+
+
+class FixedLossScaler(DynamicLossScaler):
+    """Constant scale: still unscales, still finite-checks and skips
+    overflow steps, never adjusts."""
+
+    def __init__(self, scale):
+        super().__init__(init_scale=scale)
+        self.dynamic = False
+
+    def next_state(self, state, finite):
+        return {"scale": state["scale"],
+                "good_steps": jnp.where(finite, state["good_steps"] + 1,
+                                        0).astype(jnp.int32),
+                "overflows": state["overflows"] + jnp.where(
+                    finite, 0, 1).astype(jnp.int32)}

@@ -12,8 +12,6 @@ from __future__ import annotations
 import threading
 import time
 
-import numpy as np
-
 from deeplearning4j_tpu.serving.buckets import BucketLadder
 from deeplearning4j_tpu.serving.servable import Servable, as_servable
 
@@ -49,7 +47,7 @@ class _Entry:
 
     def describe(self) -> dict:
         sv = self.servable
-        return {
+        d = {
             "name": self.name,
             "version": self.version,
             "type": type(sv).__name__,
@@ -60,6 +58,12 @@ class _Entry:
             "warmed_shapes": [list(s) for s in sv.warmed_shapes],
             "warmup_seconds": self.warmup_seconds,
         }
+        # quantized servables report their int8 payload + calibration
+        # fidelity beside the standard row (GET /serving/v1/models)
+        extra = getattr(sv, "describe_extra", None)
+        if callable(extra):
+            d.update(extra())
+        return d
 
 
 class ModelRegistry:
@@ -74,8 +78,10 @@ class ModelRegistry:
         self._lock = threading.Lock()
 
     def register(self, name, model, version=1, example_shape=None,
-                 dtype=np.float32, ladder=None, input_name=None,
+                 dtype=None, ladder=None, input_name=None,
                  output_name=None, warmup=False) -> _Entry:
+        """dtype=None infers the serving dtype from the model's
+        configured dataType / precision policy (see as_servable)."""
         sv = (model if isinstance(model, Servable)
               else as_servable(model, example_shape, dtype,
                                input_name=input_name,

@@ -31,6 +31,18 @@ def _np(y):
     return np.asarray(y)
 
 
+def _model_dtype(model) -> np.dtype:
+    """The serving-boundary dtype a model's configuration implies: the
+    precision policy's output dtype (== the configured dataType without
+    a policy). bf16 nets previously got silently adapted to np.float32
+    at the serving boundary (ISSUE 4 satellite); fp32-master mixed nets
+    correctly resolve to fp32."""
+    conf = getattr(model, "conf", None)
+    if conf is not None and hasattr(conf, "precision_policy"):
+        return np.dtype(conf.precision_policy.output_jnp)
+    return np.dtype(np.float32)
+
+
 class Servable:
     """Base: shape-keyed AOT executable cache + jitted fallback.
 
@@ -108,8 +120,9 @@ class NetworkServable(Servable):
     function, so direct `net.output()` calls and serving share one jit
     cache (and produce bit-identical results)."""
 
-    def __init__(self, net, example_shape, dtype=np.float32):
-        super().__init__(example_shape, dtype)
+    def __init__(self, net, example_shape, dtype=None):
+        super().__init__(example_shape,
+                         _model_dtype(net) if dtype is None else dtype)
         self.net = net
 
     def _jit_fn(self):
@@ -122,8 +135,9 @@ class NetworkServable(Servable):
 class GraphServable(Servable):
     """ComputationGraph (single input / single output)."""
 
-    def __init__(self, graph, example_shape, dtype=np.float32):
-        super().__init__(example_shape, dtype)
+    def __init__(self, graph, example_shape, dtype=None):
+        super().__init__(example_shape,
+                         _model_dtype(graph) if dtype is None else dtype)
         if len(graph.conf.inputs) != 1 or len(graph.conf.outputs) != 1:
             raise ValueError(
                 f"serving supports single-input/single-output graphs; "
@@ -141,8 +155,9 @@ class GraphServable(Servable):
             g, out = self.graph, self._out
 
             def fn(params, states, inputs):
+                params = g._cast_for_inference(params)
                 env, _ = g._forward(params, states, inputs, False, None)
-                return env[out]
+                return g._cast_output(env[out])
 
             self._jitted = jax.jit(fn)
         return self._jitted
@@ -158,8 +173,9 @@ class SameDiffServable(Servable):
     """SameDiff graph: serve one placeholder -> one output variable."""
 
     def __init__(self, sd, input_name, output_name, example_shape,
-                 dtype=np.float32):
-        super().__init__(example_shape, dtype)
+                 dtype=None):
+        super().__init__(example_shape,
+                         np.float32 if dtype is None else dtype)
         import jax
 
         self.sd = sd
@@ -206,8 +222,9 @@ class FnServable(Servable):
     """A plain `fn(x) -> y` (jax-traceable), jitted and bucket-compiled
     like any network — the escape hatch for custom pipelines."""
 
-    def __init__(self, fn, example_shape, dtype=np.float32):
-        super().__init__(example_shape, dtype)
+    def __init__(self, fn, example_shape, dtype=None):
+        super().__init__(example_shape,
+                         np.float32 if dtype is None else dtype)
         import jax
 
         self._jitted = jax.jit(fn)
@@ -219,9 +236,13 @@ class FnServable(Servable):
         return ()
 
 
-def as_servable(model, example_shape=None, dtype=np.float32,
+def as_servable(model, example_shape=None, dtype=None,
                 input_name=None, output_name=None) -> Servable:
-    """Wrap any supported model type in its Servable adapter."""
+    """Wrap any supported model type in its Servable adapter.
+
+    dtype=None (the default) infers the serving-boundary dtype from the
+    model's configured dataType / precision policy instead of assuming
+    np.float32 — a bf16 net serves bf16, a bf16_mixed net serves fp32."""
     if isinstance(model, Servable):
         return model
     kind = type(model).__name__

@@ -349,6 +349,10 @@ class HealthMonitor:
         self.config = config or _state["config"]
         self.listener = listener
         self.instruments = health_instruments(loop, self.layer_names)
+        # optional precision.PrecisionMonitor (ISSUE 4): when the loss
+        # scaler's overflow gate already skipped a step on device, the
+        # SKIP_BATCH accounting defers to it (one skip, one counter)
+        self.precision = None
         self._pending = None
         self._count = 0
 
@@ -461,6 +465,16 @@ class HealthMonitor:
                 step=step, layers=layers, dump_path=path)
         if cfg.policy == SKIP_BATCH and kind == "nonfinite":
             # the in-step gate already discarded the update on device
+            if self.precision is not None and \
+                    self.precision.skipped_at(step):
+                # the loss scaler's overflow gate fired on the SAME step
+                # and already counted the skip (dl4j_precision_skipped_
+                # steps_total) and recorded a `precision` flight event —
+                # do not count the one discarded step twice (ISSUE 4)
+                log.warning("%s; handled by the dynamic loss scaler "
+                            "(scale backed off, step skipped on device)",
+                            msg)
+                return
             if inst is not None:
                 inst.skipped.inc()
             log.warning("%s; policy=SKIP_BATCH — the diverged update was "

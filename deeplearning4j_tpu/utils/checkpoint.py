@@ -96,8 +96,17 @@ class Dl4jCheckpoint:
                     upd = np.zeros(0, np.float32)
                 zf.writestr("updaterState.bin",
                             write_nd4j_array(upd.reshape(1, -1)))
-                zf.writestr("trainingState.json", json.dumps({
-                    "iteration": model._iteration, "epoch": model._epoch}))
+                ts = {"iteration": model._iteration, "epoch": model._epoch}
+                prec = getattr(model, "_prec_state", None)
+                if prec:
+                    # loss-scaler state (ISSUE 4): a resumed bf16_mixed
+                    # run must not restart at init_scale (the warmed
+                    # scale encodes everything learned about the run's
+                    # gradient magnitudes)
+                    ts["lossScale"] = {
+                        k: float(np.asarray(jax.device_get(v)))
+                        for k, v in prec.items()}
+                zf.writestr("trainingState.json", json.dumps(ts))
 
     @staticmethod
     def load(path, loadUpdater: bool = True):
@@ -148,6 +157,12 @@ class Dl4jCheckpoint:
                     ts = json.loads(zf.read("trainingState.json"))
                     model._iteration = ts["iteration"]
                     model._epoch = ts["epoch"]
+                    if ts.get("lossScale") and getattr(
+                            model, "_prec_state", None):
+                        model._prec_state = {
+                            k: jnp.asarray(
+                                v, model._prec_state[k].dtype)
+                            for k, v in ts["lossScale"].items()}
         return model
 
 

@@ -1,0 +1,767 @@
+"""Precision subsystem tests (ISSUE 4): policies, master-weight mixed
+training, the in-step dynamic loss scaler (overflow skip on device, zero
+extra dispatches), dl4j_precision_* telemetry + flight events, the
+health-monitor no-double-count handshake, checkpoint round-trips, int8
+PTQ servables end-to-end through /serving/v1, and the satellite fixes
+(as_servable dtype inference, fp32 eval accumulation)."""
+
+import json
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import precision, telemetry
+from deeplearning4j_tpu.precision import (
+    DynamicLossScaler, Policy, named_policy, quantize, resolve_policy)
+from deeplearning4j_tpu.telemetry import MetricsRegistry, flight, health
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    was_enabled = telemetry.enabled()
+    prev_cfg = health.get_config()
+    health.reset_status()
+    health.configure(enabled=True, policy=health.WARN, ratio_max=None,
+                     ratio_min=None, check_every=1, dump_dir=None)
+    flight.get_recorder().clear()
+    yield
+    health._state["config"] = prev_cfg
+    health._state["enabled"] = True
+    health.reset_status()
+    (telemetry.enable if was_enabled else telemetry.disable)()
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = telemetry.set_registry(reg)
+    telemetry.enable()
+    yield reg
+    telemetry.set_registry(prev)
+
+
+def _net(precision_policy=None, seed=1, n_in=8, hidden=16, n_out=3,
+         updater=None):
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .updater(updater or Adam(1e-3)))
+    if precision_policy is not None:
+        b = b.precision(precision_policy)
+    conf = (b.list()
+            .layer(DenseLayer.Builder().nIn(n_in).nOut(hidden)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(n_out).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, n_in=8, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return X, y
+
+
+class TestPolicy:
+    def test_named_policies(self):
+        p = named_policy("bf16_mixed")
+        assert p.param_dtype == "float32"
+        assert p.compute_dtype == "bfloat16"
+        assert p.output_dtype == "float32"
+        assert p.loss_scaling == "dynamic" and p.is_mixed
+        assert not named_policy("bfloat16").is_mixed
+        with pytest.raises(ValueError, match="unknown precision policy"):
+            named_policy("int4_wishful")
+
+    def test_resolve_defaults_to_datatype(self):
+        p = resolve_policy(None, "bfloat16")
+        assert p.param_dtype == p.compute_dtype == "bfloat16"
+        assert not p.scaling_enabled
+
+    def test_json_round_trip(self):
+        assert Policy.from_json("bf16_mixed") == named_policy("bf16_mixed")
+        custom = Policy(name="custom", compute_dtype="bfloat16",
+                        loss_scaling=128.0, growth_interval=7)
+        back = Policy.from_json(json.loads(json.dumps(custom.to_json())))
+        assert back.loss_scaling == 128.0 and back.growth_interval == 7
+
+    def test_conf_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            MultiLayerConfiguration)
+
+        net = _net("bf16_mixed")
+        c2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert c2.precision == "bf16_mixed"
+        assert c2.precision_policy == named_policy("bf16_mixed")
+
+    def test_builder_rejects_typo_eagerly(self):
+        from deeplearning4j_tpu.nn import NeuralNetConfiguration
+
+        with pytest.raises(ValueError, match="unknown precision policy"):
+            NeuralNetConfiguration.Builder().precision("bf61_mixed")
+
+    def test_cast_floating_leaves_ints_and_f64(self):
+        tree = {"w": jnp.ones((2,), jnp.float32),
+                "ids": jnp.ones((2,), jnp.int32)}
+        out = precision.cast_floating(tree, jnp.bfloat16)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+
+
+class TestMixedTraining:
+    def test_master_weights_and_moments_stay_fp32(self):
+        net = _net("bf16_mixed")
+        X, y = _data()
+        net.fit([(X, y)], 3)
+        assert str(net._params[0]["W"].dtype) == "float32"
+        assert str(net._opt_states[0]["m"]["W"].dtype) == "float32"
+        assert np.isfinite(float(net.score((X, y))))
+        st = net._prec_state
+        assert float(np.asarray(st["scale"])) == 2.0 ** 15
+        assert int(np.asarray(st["good_steps"])) == 3
+        assert int(np.asarray(st["overflows"])) == 0
+
+    def test_pure_bf16_unchanged(self):
+        net = _net("bf16")
+        X, y = _data()
+        net.fit([(X, y)], 2)
+        assert str(net._params[0]["W"].dtype) == "bfloat16"
+        assert net._prec_state == {}  # no scaler without loss scaling
+
+    def test_compute_dtype_actually_bf16(self):
+        """The traced step must run its matmuls in bf16: a bf16_mixed
+        net's loss differs from the fp32 net's beyond f32 roundoff but
+        agrees to bf16 tolerance (same seed, same data)."""
+        X, y = _data(seed=3)
+        l32 = float(_net(None, seed=9).score((X, y)))
+        lmx = float(_net("bf16_mixed", seed=9).score((X, y)))
+        assert lmx != l32                      # really not fp32 compute
+        assert abs(lmx - l32) / abs(l32) < 0.02  # but bf16-close
+
+    def test_growth_after_interval(self):
+        pol = Policy(name="grow", param_dtype="float32",
+                     compute_dtype="bfloat16", output_dtype="float32",
+                     loss_scaling="dynamic", init_scale=2.0 ** 10,
+                     growth_interval=3)
+        net = _net(pol)
+        X, y = _data()
+        net.fit([(X, y)], 3)
+        assert float(np.asarray(net._prec_state["scale"])) == 2.0 ** 11
+        assert int(np.asarray(net._prec_state["good_steps"])) == 0
+
+    def test_fixed_scaling(self):
+        pol = Policy(name="fixed", param_dtype="float32",
+                     compute_dtype="bfloat16", output_dtype="float32",
+                     loss_scaling=256.0)
+        net = _net(pol)
+        X, y = _data()
+        net.fit([(X, y)], 4)
+        assert float(np.asarray(net._prec_state["scale"])) == 256.0
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(Xbad, y)], 1)
+        # fixed scale never backs off, but the gate still skips
+        assert float(np.asarray(net._prec_state["scale"])) == 256.0
+        assert int(np.asarray(net._prec_state["overflows"])) == 1
+        assert np.isfinite(net.getParam(0, "W").numpy()).all()
+
+
+class TestOverflowSkip:
+    def test_skip_halve_recover_with_one_dispatch_per_step(
+            self, fresh_registry):
+        """Acceptance: induced inf gradient -> the step is discarded ON
+        DEVICE, the scale halves, training recovers, final params are
+        finite — with exactly one jitted-step dispatch per batch (no
+        extra host round trips for the gate)."""
+        net = _net("bf16_mixed", seed=7)
+        X, y = _data()
+        net.fit([(X, y)], 1)                      # build + warm
+        before = net.getParam(0, "W").numpy().copy()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+
+        inner = net._train_step
+        calls = []
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return inner(*a, **kw)
+
+        net._train_step = counting
+        net.fit([(Xbad, y), (X, y), (X, y)], 1)
+        assert len(calls) == 3                    # one dispatch per batch
+        net._train_step = inner
+        st = net._prec_state
+        assert int(np.asarray(st["overflows"])) == 1
+        assert float(np.asarray(st["scale"])) == 2.0 ** 14  # halved once
+        w = net.getParam(0, "W").numpy()
+        assert np.isfinite(w).all()
+        assert not np.array_equal(before, w)      # good steps applied
+
+    def test_bad_step_params_bitwise_unchanged(self):
+        net = _net("bf16_mixed", seed=8)
+        X, y = _data()
+        net.fit([(X, y)], 2)
+        before = net.getParam(0, "W").numpy().copy()
+        ob = net.getParam(0, "b").numpy().copy()
+        Xbad = X.copy()
+        Xbad[3, 1] = np.nan
+        net.fit([(Xbad, y)], 1)
+        assert np.array_equal(before, net.getParam(0, "W").numpy())
+        assert np.array_equal(ob, net.getParam(0, "b").numpy())
+
+    def test_precision_metrics_and_flight_event(self, fresh_registry):
+        net = _net("bf16_mixed", seed=9)
+        X, y = _data()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(X, y), (Xbad, y), (X, y)], 1)
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_precision_skipped_steps_total{loop="fit"}'] == 1.0
+        assert snap['dl4j_precision_overflow_total{loop="fit"}'] == 1.0
+        assert snap['dl4j_precision_loss_scale{loop="fit"}'] == 2.0 ** 14
+        events = flight.get_recorder().events("precision")
+        assert events and events[-1]["event"] == "overflow"
+        assert events[-1]["step"] == 1
+        assert events[-1]["loss_scale"] == 2.0 ** 14
+
+    def test_no_double_count_with_skip_batch_policy(self, fresh_registry):
+        """Satellite: when BOTH the scaler gate and the health SKIP_BATCH
+        gate fire on the same step, the skip is counted ONCE (precision
+        counter), the health skipped counter stays untouched, and a
+        `precision` flight event exists."""
+        from deeplearning4j_tpu.utils.listeners import HealthListener
+
+        net = _net("bf16_mixed", seed=10)
+        net.setListeners(HealthListener(policy="skip_batch"))
+        X, y = _data()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(X, y), (Xbad, y), (X, y)], 1)
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_precision_skipped_steps_total{loop="fit"}'] == 1.0
+        assert snap.get(
+            'dl4j_health_skipped_steps_total{loop="fit"}', 0.0) == 0.0
+        assert flight.get_recorder().events("precision")
+        # and training still recovered
+        assert np.isfinite(net.getParam(0, "W").numpy()).all()
+
+    def test_zero_registry_calls_when_telemetry_disabled(self):
+        """The gate is policy semantics, not telemetry: with telemetry
+        disabled the loop makes zero registry calls AND the overflow
+        step is still skipped on device."""
+        class CountingStub:
+            calls = 0
+
+            def __getattr__(self, name):
+                CountingStub.calls += 1
+                raise AssertionError(
+                    f"registry.{name} touched while disabled")
+
+        net = _net("bf16_mixed", seed=11)
+        X, y = _data()
+        prev = telemetry.set_registry(CountingStub())
+        telemetry.disable()
+        try:
+            Xbad = X.copy()
+            Xbad[0, 0] = np.inf
+            net.fit([(X, y), (Xbad, y), (X, y)], 1)
+            assert CountingStub.calls == 0
+        finally:
+            telemetry.set_registry(prev)
+            telemetry.enable()
+        assert int(np.asarray(net._prec_state["overflows"])) == 1
+        assert np.isfinite(net.getParam(0, "W").numpy()).all()
+
+    def test_fit_multi_batch_overflow(self, fresh_registry):
+        net = _net("bf16_mixed", seed=12)
+        X, y = _data()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fitMultiBatch(np.stack([X, Xbad, X, X]),
+                          np.stack([y, y, y, y]))
+        st = net._prec_state
+        assert int(np.asarray(st["overflows"])) == 1
+        assert float(np.asarray(st["scale"])) == 2.0 ** 14
+        assert np.isfinite(net.getParam(0, "W").numpy()).all()
+        snap = fresh_registry.snapshot()
+        assert snap['dl4j_precision_skipped_steps_total{loop="fit"}'] == 1.0
+
+
+class TestTrainerIntegration:
+    def test_sharded_trainer_policy_and_overflow(self, fresh_registry):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+        net = _net("bf16_mixed", seed=13)
+        X, y = _data()
+        tr = ShardedTrainer(net)
+        tr.fit([DataSet(X, y)], epochs=2)
+        assert str(net._params[0]["W"].dtype) == "float32"
+        assert int(np.asarray(net._prec_state["good_steps"])) == 2
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        before = np.asarray(net.getParam(0, "W").numpy()).copy()
+        tr.fit([DataSet(Xbad, y)], epochs=1)
+        assert np.array_equal(before, net.getParam(0, "W").numpy())
+        assert float(np.asarray(net._prec_state["scale"])) == 2.0 ** 14
+        snap = fresh_registry.snapshot()
+        key = 'dl4j_precision_skipped_steps_total{loop="sharded"}'
+        assert snap[key] == 1.0
+
+    def test_graph_mixed_training(self, fresh_registry):
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, DenseLayer, LossFunction,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(14)
+                .precision("bf16_mixed")
+                .graphBuilder()
+                .addInputs("in")
+                .addLayer("d", DenseLayer.Builder().nIn(8).nOut(16)
+                          .activation("relu").build(), "in")
+                .addLayer("out", OutputLayer.Builder().nIn(16).nOut(3)
+                          .activation("softmax")
+                          .lossFunction(LossFunction.MCXENT).build(), "d")
+                .setOutputs("out")
+                .build())
+        assert conf.precision_policy.is_mixed
+        net = ComputationGraph(conf).init()
+        X, y = _data()
+        net.fit([(X, y)], 2)
+        assert str(net._params["d"]["W"].dtype) == "float32"
+        assert int(np.asarray(net._prec_state["good_steps"])) == 2
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(Xbad, y)], 1)
+        assert int(np.asarray(net._prec_state["overflows"])) == 1
+        snap = fresh_registry.snapshot()
+        key = 'dl4j_precision_skipped_steps_total{loop="graph"}'
+        assert snap[key] == 1.0
+
+    def test_pipeline_trainer_compute_cast(self):
+        """The policy's compute dtype survives the stage-stacked
+        pipeline path: loss matches the bf16-compute single-device run
+        to bf16 tolerance, master params stay fp32."""
+        import jax
+
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, LossFunction, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+        from deeplearning4j_tpu.parallel.mesh import MeshConfig
+        from deeplearning4j_tpu.parallel.pipeline_trainer import (
+            PipelineParallelTrainer)
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+
+        def build():
+            conf = (NeuralNetConfiguration.Builder().seed(15)
+                    .updater(Sgd(1e-2)).precision("bf16_mixed").list()
+                    .layer(DenseLayer.Builder().nIn(8).nOut(16)
+                           .activation("relu").build())
+                    .layer(DenseLayer.Builder().nOut(16)
+                           .activation("relu").build())
+                    .layer(DenseLayer.Builder().nOut(16)
+                           .activation("relu").build())
+                    .layer(DenseLayer.Builder().nOut(16)
+                           .activation("relu").build())
+                    .layer(OutputLayer.Builder().nOut(3)
+                           .activation("softmax")
+                           .lossFunction(LossFunction.MCXENT).build())
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        mesh = MeshConfig(data=1, pipe=2,
+                          devices=jax.devices()[:2]).build()
+        net = build()
+        tr = PipelineParallelTrainer(net, mesh, microbatches=2)
+        X, y = _data(n=16)
+        loss_pipe = tr.train_step(X, y)
+        ref = build()
+        ref.fit([(X, y)], 1)
+        assert loss_pipe == pytest.approx(ref._score, rel=2e-2)
+        tr.sync_to_net()
+        assert str(net._params[0]["W"].dtype) == "float32"
+
+
+class TestAccuracyParity:
+    def test_mnist_scale_bf16_within_1pct_of_fp32(self):
+        """Acceptance: an MNIST-scale classifier trained under
+        bf16_mixed reaches accuracy within 1% of the fp32 run."""
+        rng = np.random.default_rng(42)
+        n, d, k = 1024, 64, 10
+        centers = rng.normal(scale=2.0, size=(k, d)).astype(np.float32)
+        labels = rng.integers(0, k, n)
+        X = (centers[labels]
+             + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+        y = np.eye(k, dtype=np.float32)[labels]
+        batches = [(X[i:i + 128], y[i:i + 128]) for i in range(0, n, 128)]
+
+        def run(policy):
+            net = _net(policy, seed=21, n_in=d, hidden=128, n_out=k)
+            net.fit(batches, 12)
+            ev = net.evaluate([(X, y)], numClasses=k)
+            return net, ev.accuracy()
+
+        _, acc32 = run(None)
+        _, accmx = run("bf16_mixed")
+        assert acc32 > 0.8          # the task is learnable
+        assert abs(acc32 - accmx) <= 0.01
+
+
+class TestCheckpoints:
+    def test_sharded_master_weights_bit_identical_and_scaler_state(
+            self, tmp_path):
+        """Satellite: train under bf16_mixed, save via the sharded
+        checkpoint (uint-view codec), restore — master weights must be
+        BIT-identical and the loss-scale state must round-trip."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            load_sharded, save_sharded)
+
+        net = _net("bf16_mixed", seed=16)
+        X, y = _data()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(X, y), (Xbad, y), (X, y)], 2)   # 2 epochs: 2 overflows
+        tree = {"params": net._params, "prec": net._prec_state}
+        save_sharded(str(tmp_path / "ckpt"), tree, step=net._iteration)
+
+        net2 = _net("bf16_mixed", seed=99)        # different init
+        template = {"params": net2._params, "prec": net2._prec_state}
+        restored, step, _ = load_sharded(str(tmp_path / "ckpt"), template)
+        assert step == net._iteration
+        for p_saved, p_rest in zip(net._params, restored["params"]):
+            for k in p_saved:
+                a = np.asarray(p_saved[k])
+                b = np.asarray(p_rest[k])
+                assert a.dtype == b.dtype == np.float32
+                assert np.array_equal(a, b)
+        assert float(np.asarray(restored["prec"]["scale"])) == \
+            float(np.asarray(net._prec_state["scale"])) == 2.0 ** 13
+        assert int(np.asarray(restored["prec"]["overflows"])) == 2
+        # resumed training continues from the restored scaler state
+        net2._params = [
+            {k: jnp.asarray(v) for k, v in p.items()}
+            for p in restored["params"]]
+        net2._prec_state = {k: jnp.asarray(v)
+                            for k, v in restored["prec"].items()}
+        net2.fit([(X, y)], 1)
+        assert int(np.asarray(net2._prec_state["overflows"])) == 2
+
+    def test_pure_bf16_codec_round_trip(self, tmp_path):
+        """bf16 params go through the uint-view codec and restore with
+        dtype + bits intact."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            load_sharded, save_sharded)
+
+        net = _net("bf16", seed=17)
+        X, y = _data()
+        net.fit([(X, y)], 2)
+        save_sharded(str(tmp_path / "b"), {"params": net._params})
+        restored, _, _ = load_sharded(str(tmp_path / "b"))
+        w = restored["['params'][0]['W']"]
+        assert str(w.dtype) == "bfloat16"
+        assert np.array_equal(
+            w.view(np.uint16),
+            np.asarray(net._params[0]["W"]).view(np.uint16))
+
+    def test_dl4j_zip_loss_scale_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.utils.checkpoint import Dl4jCheckpoint
+
+        net = _net("bf16_mixed", seed=18)
+        X, y = _data()
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        net.fit([(X, y), (Xbad, y)], 1)
+        path = str(tmp_path / "model.zip")
+        Dl4jCheckpoint.save(net, path)
+        net2 = Dl4jCheckpoint.load(path)
+        assert net2.conf.precision == "bf16_mixed"
+        assert float(np.asarray(net2._prec_state["scale"])) == 2.0 ** 14
+        assert int(np.asarray(net2._prec_state["overflows"])) == 1
+
+
+class TestQuantization:
+    def _trained(self, seed=20):
+        net = _net(None, seed=seed, n_in=16, hidden=32, n_out=4)
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(64, 16)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+        net.fit([(X, y)], 8)
+        return net, X
+
+    def test_quantize_array_round_trip(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(32, 16)).astype(np.float32)
+        q, scale = precision.quantize_array(w)
+        assert q.dtype == np.int8 and scale.shape == (16,)
+        back = q.astype(np.float32) * scale
+        assert np.abs(back - w).max() <= scale.max() / 2 + 1e-7
+
+    def test_ptq_within_atol(self):
+        net, X = self._trained()
+        calib = [X[i * 16:(i + 1) * 16] for i in range(4)]
+        qsv = quantize(net, calib, example_shape=(16,))
+        assert qsv.calibration_max_err is not None
+        assert qsv.calibration_max_err <= 0.05   # acceptance
+        ref = np.asarray(net.output(X).numpy(), np.float32)
+        got = np.asarray(qsv.infer(X), np.float32)
+        assert np.abs(ref - got).max() <= 0.05
+        # activation stats collected per layer
+        assert len(qsv.activation_absmax) == len(net.layers)
+        assert all(a is not None for a in qsv.activation_absmax)
+
+    def test_ptq_weights_are_int8(self):
+        net, X = self._trained(seed=22)
+        qsv = quantize(net, [X[:8]], example_shape=(16,))
+        q, scale = qsv._qparams[0]["W"]
+        assert q.dtype == np.int8
+        assert scale.dtype == np.float32
+        b = qsv._qparams[0]["b"]
+        assert np.asarray(b).dtype == np.float32  # biases stay float
+
+    def test_ptq_snapshot_frozen_after_training(self):
+        net, X = self._trained(seed=23)
+        qsv = quantize(net, [X[:8]], example_shape=(16,))
+        before = np.asarray(qsv.infer(X[:8]), np.float32)
+        y = np.eye(4, dtype=np.float32)[np.zeros(64, np.int64)]
+        net.fit([(X, y)], 3)                     # train the source on
+        after = np.asarray(qsv.infer(X[:8]), np.float32)
+        assert np.array_equal(before, after)     # servable is a snapshot
+
+    def test_ptq_served_through_http_zero_recompiles(self, fresh_registry):
+        from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        net, X = self._trained(seed=24)
+        qsv = quantize(net, [X[:16]], example_shape=(16,))
+        with InferenceSession(max_latency=0.001) as session:
+            session.register("m_int8", qsv,
+                             ladder=BucketLadder((1, 8, 16)), warmup=True)
+            ui = UIServer()
+            ui.serveModels(session)
+            ui.start(port=0)
+            try:
+                base = f"http://127.0.0.1:{ui.port}"
+                # reference computed FIRST: net.output on a fresh batch
+                # shape compiles its own executable, which must not be
+                # confused with serving-path compiles
+                ref = np.asarray(net.output(X[:8]).numpy(), np.float32)
+                snap = fresh_registry.snapshot()
+                before = snap.get("dl4j_compile_total", 0.0)
+                body = json.dumps(
+                    {"instances": X[:8].tolist()}).encode()
+                for _ in range(3):
+                    req = urllib.request.Request(
+                        f"{base}/serving/v1/models/m_int8:predict",
+                        data=body,
+                        headers={"Content-Type": "application/json"})
+                    out = json.loads(urllib.request.urlopen(req).read())
+                preds = np.asarray(out["predictions"], np.float32)
+                assert np.abs(preds - ref).max() <= 0.05
+                snap = fresh_registry.snapshot()
+                assert snap.get("dl4j_compile_total", 0.0) == before
+                # registry row advertises the quantization
+                models = json.loads(urllib.request.urlopen(
+                    f"{base}/serving/v1/models").read())["models"]
+                assert models[0]["quantization"] == \
+                    "int8_per_channel_absmax"
+                assert models[0]["bytes"]["int8"] > 0
+            finally:
+                ui.stop()
+
+    def test_embedding_tables_auto_skipped(self):
+        from deeplearning4j_tpu.nn import (
+            EmbeddingLayer, LossFunction, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(25).list()
+                .layer(EmbeddingLayer.Builder().nIn(50).nOut(8).build())
+                .layer(OutputLayer.Builder().nIn(8).nOut(4)
+                       .activation("softmax")
+                       .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        ids = np.arange(8, dtype=np.int32)[:, None]
+        qsv = quantize(net, [], example_shape=(1,), dtype=np.int32)
+        # the 2-D [vocab, dim] embedding table stays float...
+        w_emb = qsv._qparams[0]["W"]
+        assert not isinstance(w_emb, tuple)
+        assert np.issubdtype(np.asarray(w_emb).dtype, np.floating)
+        # ...while the dense output weight is int8-quantized
+        assert isinstance(qsv._qparams[1]["W"], tuple)
+        ref = np.asarray(net.output(ids).numpy(), np.float32)
+        got = np.asarray(qsv.infer(ids), np.float32)
+        assert np.abs(ref - got).max() <= 0.05
+
+    def test_servable_does_not_pin_source_net(self):
+        import weakref
+
+        net, X = self._trained(seed=26)
+        qsv = quantize(net, [X[:8]], example_shape=(16,))
+        ref = weakref.ref(net)
+        del net
+        import gc
+
+        gc.collect()
+        assert ref() is None        # snapshot holds structure, not the net
+        y = np.asarray(qsv.infer(X[:8]))   # still serves
+        assert np.isfinite(y.astype(np.float32)).all()
+
+    def test_quantize_rejects_graphs(self):
+        from deeplearning4j_tpu.nn import (
+            ComputationGraph, DenseLayer, LossFunction,
+            NeuralNetConfiguration, OutputLayer)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).graphBuilder()
+                .addInputs("in")
+                .addLayer("out", OutputLayer.Builder().nIn(4).nOut(2)
+                          .activation("softmax")
+                          .lossFunction(LossFunction.MCXENT).build(), "in")
+                .setOutputs("out").build())
+        g = ComputationGraph(conf).init()
+        with pytest.raises(TypeError, match="MultiLayerNetwork"):
+            quantize(g, [], example_shape=(4,))
+
+
+class TestServableDtypeInference:
+    def test_fp32_default(self):
+        from deeplearning4j_tpu.serving import as_servable
+
+        net = _net(None, seed=30)
+        assert as_servable(net, example_shape=(8,)).dtype == np.float32
+
+    def test_bf16_net_infers_bf16(self):
+        import ml_dtypes
+
+        from deeplearning4j_tpu.serving import as_servable
+
+        net = _net("bf16", seed=31)
+        sv = as_servable(net, example_shape=(8,))
+        assert sv.dtype == np.dtype(ml_dtypes.bfloat16)
+        y = sv.infer(np.zeros((2, 8), np.float32))
+        assert np.asarray(y).dtype == np.dtype(ml_dtypes.bfloat16)
+
+    def test_mixed_net_infers_fp32_boundary(self):
+        from deeplearning4j_tpu.serving import as_servable
+
+        net = _net("bf16_mixed", seed=32)
+        sv = as_servable(net, example_shape=(8,))
+        assert sv.dtype == np.float32
+        y = sv.infer(np.zeros((2, 8), np.float32))
+        assert np.asarray(y).dtype == np.float32
+
+    def test_explicit_dtype_still_wins(self):
+        from deeplearning4j_tpu.serving import as_servable
+
+        net = _net("bf16", seed=33)
+        sv = as_servable(net, example_shape=(8,), dtype=np.float32)
+        assert sv.dtype == np.float32
+
+
+class TestEvalUpcast:
+    def test_regression_bf16_sums_do_not_lose_precision(self):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        rng = np.random.default_rng(0)
+        labels = rng.normal(loc=5.0, size=(4096, 1)).astype(np.float32)
+        preds = labels + rng.normal(scale=0.01,
+                                    size=labels.shape).astype(np.float32)
+        # pre-round to the bf16 grid so the ONLY difference between the
+        # two accumulations is summation precision (the thing the
+        # satellite fixes); input quantization noise is identical
+        lab16 = np.asarray(jnp.asarray(labels, jnp.bfloat16))
+        pre16 = np.asarray(jnp.asarray(preds, jnp.bfloat16))
+        ref = RegressionEvaluation()
+        ev = RegressionEvaluation()
+        for i in range(0, 4096, 64):
+            ref.eval(lab16[i:i + 64].astype(np.float64),
+                     pre16[i:i + 64].astype(np.float64))
+            ev.eval(lab16[i:i + 64], pre16[i:i + 64])
+        # bf16 SUMMATION of 4096 squared-error terms would be off by
+        # orders of magnitude; fp32-upcast accumulation tracks float64
+        assert ev.meanSquaredError() == pytest.approx(
+            ref.meanSquaredError(), rel=1e-3)
+        assert ev.averageMeanAbsoluteError() == pytest.approx(
+            ref.averageMeanAbsoluteError(), rel=1e-3)
+
+    def test_roc_bf16_counts_exact(self):
+        from deeplearning4j_tpu.evaluation import ROC
+
+        rng = np.random.default_rng(1)
+        n = 2048          # bf16 integer grid ends at 256: cumsums on
+        y = (rng.random(n) > 0.5).astype(np.float32)
+        s = rng.random(n).astype(np.float32)
+        ref = ROC()
+        ref.eval(y, s)
+        roc = ROC()
+        roc.eval(y.astype(jnp.bfloat16), s.astype(jnp.bfloat16))
+        assert roc.calculateAUC() == pytest.approx(ref.calculateAUC(),
+                                                   abs=0.02)
+        assert np.isfinite(roc.calculateAUC())
+
+    def test_classification_counts_exact_from_bf16(self):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        rng = np.random.default_rng(2)
+        n = 1000
+        labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+        ev = Evaluation(3)
+        for i in range(0, n, 50):
+            ev.eval(labels[i:i + 50].astype(jnp.bfloat16),
+                    labels[i:i + 50].astype(jnp.bfloat16))
+        assert ev.getNumRowCounter() == n
+        assert ev.accuracy() == 1.0
+
+
+class TestScalerUnit:
+    def test_unscale_exact_powers_of_two(self):
+        sc = DynamicLossScaler(init_scale=2.0 ** 12)
+        st = sc.init_state()
+        g = {"w": jnp.asarray([1.5, -2.25], jnp.float32)}
+        scaled = jnp.asarray([1.5 * 2 ** 12, -2.25 * 2 ** 12], jnp.float32)
+        out = sc.unscale({"w": scaled}, st)
+        assert np.array_equal(np.asarray(out["w"]), np.asarray(g["w"]))
+
+    def test_all_finite(self):
+        sc = DynamicLossScaler()
+        assert bool(sc.all_finite({"a": jnp.ones((3,))}))
+        assert not bool(sc.all_finite(
+            {"a": jnp.ones(3), "b": jnp.asarray([np.inf])}))
+        assert bool(sc.all_finite({"ids": jnp.ones((2,), jnp.int32)}))
+
+    def test_backoff_floor_and_growth_cap(self):
+        from deeplearning4j_tpu.precision import scaler as scaler_mod
+
+        sc = DynamicLossScaler(init_scale=1.0, growth_interval=1)
+        st = sc.init_state()
+        st = sc.next_state(st, jnp.bool_(False))
+        assert float(np.asarray(st["scale"])) == scaler_mod.MIN_SCALE
+        sc2 = DynamicLossScaler(init_scale=scaler_mod.MAX_SCALE,
+                                growth_interval=1)
+        st2 = sc2.next_state(sc2.init_state(), jnp.bool_(True))
+        assert float(np.asarray(st2["scale"])) == scaler_mod.MAX_SCALE
+
+
+class TestUpdaterMixedGuard:
+    def test_apply_mixed_casts_grad_to_param_dtype(self):
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        u = Adam(1e-3)
+        params = {"W": jnp.ones((2, 2), jnp.float32)}
+        state = u.init_state(params)
+        g_bf16 = {"W": jnp.ones((2, 2), jnp.bfloat16)}
+        upd, new_state = u.apply_mixed(g_bf16, state, params, 0)
+        assert upd["W"].dtype == jnp.float32
+        assert new_state["m"]["W"].dtype == jnp.float32
