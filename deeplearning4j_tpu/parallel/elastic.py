@@ -10,15 +10,28 @@ transport-layer recovery protocol:
   handler that checkpoints synchronously before exiting (the standard
   maintenance-event drill), plus periodic every-N-iteration checkpoints
   with rotation;
+- `asyncSave=True` (resilience ISSUE 5) moves the periodic write off
+  the train loop: the loop only pays for a device-side snapshot clone,
+  and a background writer (resilience/async_ckpt.py) serializes and
+  atomically commits — same artifact layout, interchangeable at
+  restore time. Preemption and end-of-fit still write synchronously
+  (durability before exit beats latency there);
 - multi-host: only process 0 writes; the checkpoint directory MUST be
   shared storage (NFS/GCS-fuse) so every process resumes from the same
   file after a restart — training is SPMD-deterministic from there, so
   global state stays consistent;
 - `ElasticTrainer.resume()` restores net + updater state + iteration
   counter; `fit(data, epochs=TOTAL)` treats `epochs` as the TOTAL
-  budget and skips the epochs the iteration counter already covers
-  (when `data` is a sized list of batches), so a preempted job rerun
-  with the SAME command line completes only the remaining work.
+  budget and skips the work the iteration counter already covers
+  (when `data` is a sized list of batches) — including the consumed
+  PREFIX of an interrupted epoch, so a mid-epoch resume replays the
+  exact batch-per-iteration schedule of an uninterrupted run and the
+  resumed state is bit-identical (the Supervisor's kill-and-resume
+  contract);
+- `faults=` accepts a resilience FaultPlan: its iteration faults fire
+  between steps and its IO faults fire inside the checkpoint writer —
+  the deterministic substrate the resilience tests (and the
+  supervisor) are built on.
 """
 
 from __future__ import annotations
@@ -45,7 +58,8 @@ class ElasticTrainer:
     ComputationGraph (anything ModelSerializer handles)."""
 
     def __init__(self, net, checkpointDir, everyNIterations=100,
-                 keepLast=3, saveUpdaterState=True, sharded=False):
+                 keepLast=3, saveUpdaterState=True, sharded=False,
+                 asyncSave=False, faults=None, runner=None):
         self.net = net
         self.dir = str(checkpointDir)
         self.every = int(everyNIterations)
@@ -56,6 +70,13 @@ class ElasticTrainer:
         # "sharded save for pod-scale params"); resume re-shards onto
         # the current topology, so a job can resume after a re-scale
         self.sharded = bool(sharded)
+        self.asyncSave = bool(asyncSave)
+        self.faults = faults
+        # runner: the object whose .fit(data, epochs) drives training —
+        # the net itself by default, or e.g. a ShardedTrainer built
+        # around it (Supervisor's runner_factory)
+        self.runner = runner if runner is not None else net
+        self._async = None
         os.makedirs(self.dir, exist_ok=True)
 
     # -- checkpoint files ---------------------------------------------------
@@ -79,28 +100,80 @@ class ElasticTrainer:
                 checkpointDir, f, MANIFEST))))
         return os.path.join(checkpointDir, cps[-1]) if cps else None
 
+    @staticmethod
+    def latest_agreed(checkpointDir):
+        """Newest checkpoint complete on EVERY host (multi-host sharded
+        directories are checked manifest + all shard files; zips are
+        atomic). See resilience.async_ckpt.latest_agreed."""
+        from deeplearning4j_tpu.resilience.async_ckpt import latest_agreed
+
+        return latest_agreed(checkpointDir)
+
+    # -- rotation + GC ------------------------------------------------------
+    def _rotate(self):
+        """keepLast rotation + garbage collection: incomplete shard
+        directories (mid-save remnants) and stale ``*.tmp`` files from
+        writes a preemption cut short. A remnant is stale once a
+        complete checkpoint at the same or a later iteration exists —
+        an in-flight async write (always for a NEWER iteration than the
+        newest commit) is never touched. Shared logic:
+        resilience.async_ckpt.rotate_checkpoints."""
+        from deeplearning4j_tpu.resilience.async_ckpt import (
+            rotate_checkpoints)
+
+        rotate_checkpoints(self.dir, self.keep)
+
+    # -- checkpoint writes --------------------------------------------------
+    def _checkpointer(self):
+        if self._async is None:
+            from deeplearning4j_tpu.resilience.async_ckpt import (
+                AsyncCheckpointer)
+
+            self._async = AsyncCheckpointer(
+                self.dir, keepLast=self.keep, sharded=self.sharded,
+                saveUpdater=self.save_updater, faults=self.faults,
+                rotate=self._rotate)
+        return self._async
+
+    def _checkpoint(self, iteration):
+        """Periodic checkpoint: async snapshot+submit, or sync write."""
+        if self.asyncSave:
+            self._checkpointer().checkpoint(self.net, iteration)
+            return None
+        return self._write(iteration)
+
     def _write(self, iteration):
-        """Checkpoint write with rotation. Single-file mode: process 0
-        writes the zip. Sharded mode: EVERY process writes its shard
-        directory entry (save_sharded syncs internally; the manifest
-        lands only after all shards are complete)."""
+        """Synchronous checkpoint write with rotation. Single-file
+        mode: process 0 writes the zip (tmp + atomic replace). Sharded
+        mode: EVERY process writes its shard directory entry
+        (save_sharded syncs internally; the manifest lands only after
+        all shards are complete)."""
         from deeplearning4j_tpu.utils import ModelSerializer
+        from deeplearning4j_tpu.utils.checkpoint import atomic_save
 
         t0 = time.perf_counter()
         path = self._path(iteration)
+        if self.faults is not None:
+            self.faults.check_write(iteration, "write")
+        pre_commit = None
+        if self.faults is not None:
+            pre_commit = lambda: self.faults.check_write(  # noqa: E731
+                iteration, "commit")
         is_writer = True
         if self.sharded:
             # telemetry recorded inside save_sharded (every process
             # writes a shard) — recording here too would double-count
             ModelSerializer.writeModel(self.net, path, self.save_updater,
-                                       sharded=True)
+                                       sharded=True,
+                                       pre_commit=pre_commit)
         else:
             is_writer = jax.process_index() == 0
             if is_writer:
-                tmp = path + ".tmp"
-                ModelSerializer.writeModel(self.net, tmp,
-                                           self.save_updater)
-                os.replace(tmp, path)  # atomic: preempt leaves .tmp
+                atomic_save(
+                    path,
+                    lambda tmp: ModelSerializer.writeModel(
+                        self.net, tmp, self.save_updater),
+                    pre_commit=pre_commit)
             # EVERY process records (non-writers with 0 bytes): the
             # multi-host aggregate contract requires identical
             # instrument sets on all hosts (telemetry/aggregate.py)
@@ -111,42 +184,36 @@ class ElasticTrainer:
                 "save", t0,
                 os.path.getsize(path)
                 if is_writer and os.path.exists(path) else 0)
-            if not is_writer:
-                return None
-        if jax.process_index() == 0:
-            from deeplearning4j_tpu.utils.sharded_checkpoint import (
-                MANIFEST)
-            import shutil
+        from deeplearning4j_tpu.resilience.async_ckpt import note_commit
 
-            complete, dead = [], []
-            for f in sorted(os.listdir(self.dir)):
-                if not f.startswith("checkpoint_") or f.endswith(".tmp"):
-                    continue
-                full = os.path.join(self.dir, f)
-                if os.path.isdir(full):
-                    # a manifest-less directory is a mid-save remnant
-                    # (save_sharded writes the manifest last, after the
-                    # cross-process sync) — it must not count toward
-                    # keepLast, and it never becomes restorable
-                    (complete if os.path.exists(
-                        os.path.join(full, MANIFEST)) else dead).append(f)
-                else:
-                    complete.append(f)
-            for old in complete[:-self.keep] + dead:
-                full = os.path.join(self.dir, old)
-                if os.path.isdir(full):
-                    shutil.rmtree(full)
-                else:
-                    os.remove(full)
-        return path
+        note_commit(path, iteration, time.perf_counter() - t0, "sync")
+        self._rotate()
+        return path if is_writer else None
+
+    def _durable_write(self, iteration):
+        """The before-exit write: drain any in-flight async snapshot,
+        then write the CURRENT state synchronously (durability beats
+        latency when the process is about to die)."""
+        if self._async is not None:
+            self._async.drain()
+        return self._write(iteration)
+
+    def close(self):
+        """Stop the background writer (drains first). Idempotent."""
+        if self._async is not None:
+            self._async.close()
+            self._async = None
 
     # -- resume -------------------------------------------------------------
     @classmethod
     def resume(cls, checkpointDir, graph=False, **kw):
-        """Restore the newest checkpoint into a fresh ElasticTrainer.
-        Returns None when the directory holds no checkpoint (caller
-        starts from scratch)."""
-        path = cls.latest(checkpointDir)
+        """Restore the newest COMPLETE checkpoint into a fresh
+        ElasticTrainer (latest_agreed: for async-written sharded
+        directories a manifest alone does not certify the other hosts'
+        shards — every referenced shard file must exist). Returns None
+        when the directory holds no checkpoint (caller starts from
+        scratch)."""
+        path = cls.latest_agreed(checkpointDir)
         if path is None:
             return None
         from deeplearning4j_tpu.utils import ModelSerializer
@@ -168,18 +235,21 @@ class ElasticTrainer:
         after a signal-triggered save so process managers see rc 143.
 
         `epochs` is the TOTAL training budget: when `data` is a sized
-        list of batches, epochs already covered by the restored
-        iteration counter are skipped, so rerunning the same command
-        after a preemption trains only the remainder. (For one-shot
-        iterables the epoch count cannot be inferred; all `epochs`
-        passes run.)"""
+        list of batches, work already covered by the restored iteration
+        counter is skipped — full epochs AND the consumed prefix of an
+        interrupted epoch, so rerunning the same command after a
+        preemption trains exactly the remainder, batch-aligned with an
+        uninterrupted run (bit-identical resume). (For one-shot
+        iterables the position cannot be inferred; all `epochs` passes
+        run.)"""
         try:
             iters_per_epoch = len(data)
         except TypeError:
             iters_per_epoch = None
-        remaining = epochs
+        remaining, offset = epochs, 0
         if iters_per_epoch:
             done = self.net._iteration // iters_per_epoch
+            offset = self.net._iteration % iters_per_epoch
             remaining = max(0, epochs - done)
 
         preempted = {"flag": False}
@@ -201,25 +271,49 @@ class ElasticTrainer:
             def iterationDone(self, model, iteration, epoch=None,
                               loss=None):
                 if preempted["flag"]:
-                    path = self.outer._write(iteration)
+                    path = self.outer._durable_write(iteration)
                     raise PreemptionCheckpoint(path)
                 if iteration - last_cp[0] >= self.outer.every:
-                    self.outer._write(iteration)
+                    self.outer._checkpoint(iteration)
                     last_cp[0] = iteration
 
         hook = _Every(self)
         prior = list(getattr(self.net, "_listeners", []))
+        # the fault injector runs BEFORE the checkpoint hook so an
+        # injected preemption signal is honored within the same
+        # iteration (mirroring a real SIGTERM landing mid-step)
+        injected = ([self.faults.listener()] if self.faults is not None
+                    else [])
+        from deeplearning4j_tpu.resilience.async_ckpt import (
+            mark_active, mark_idle)
+
+        mark_active()   # checkpoint staleness judgements apply in here
         try:
-            self.net.setListeners(*(prior + [hook]))
+            self.net.setListeners(*(prior + injected + [hook]))
+            if remaining > 0 and offset:
+                # finish the interrupted epoch first: replay only the
+                # batches the checkpointed iteration count has not
+                # consumed, keeping batch<->iteration alignment exact
+                try:
+                    partial = data[offset:]
+                except TypeError:
+                    import itertools
+
+                    partial = list(itertools.islice(iter(data), offset,
+                                                    None))
+                if len(partial):
+                    self.runner.fit(partial, 1)
+                remaining -= 1
             if remaining > 0:
-                self.net.fit(data, remaining)
-            final_path = self._write(self.net._iteration)
+                self.runner.fit(data, remaining)
+            final_path = self._durable_write(self.net._iteration)
             if preempted["flag"]:
                 # a signal landed after the last in-loop check (or this
                 # fit had nothing left to do): state is saved — honor
                 # the termination request instead of dropping it
                 raise PreemptionCheckpoint(final_path)
         finally:
+            mark_idle()
             self.net.setListeners(*prior)
             signal.signal(signal.SIGTERM, old_term)
             signal.signal(signal.SIGINT, old_int)

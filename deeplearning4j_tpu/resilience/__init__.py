@@ -1,0 +1,50 @@
+"""Resilience subsystem (ISSUE 5): async checkpointing, a training
+supervisor with auto-resume, and a deterministic fault-injection
+harness.
+
+The paper's blueprint replaces the reference's Aeron parameter server
+(whose fault model was "workers rejoin and re-sync") with ICI
+collectives — preemption tolerance therefore lives in the framework:
+
+- :class:`AsyncCheckpointer` — periodic checkpoints whose train-loop
+  cost is a device-side snapshot clone; serialization and the atomic
+  commit run on a background writer (depth-1 queue, newer snapshot
+  supersedes a queued one). ``latest_agreed()`` resolves the newest
+  checkpoint complete on every host.
+- :class:`Supervisor` — wraps ``ElasticTrainer`` (plain or
+  ShardedTrainer-driven) runs: watchdog stall detection, automatic
+  resume-from-latest after crash / preemption / divergence, bounded
+  restarts with exponential backoff, all published as
+  ``dl4j_resilience_*`` metrics and /healthz readiness detail.
+- :class:`FaultPlan` — seedable, step-exact injection of preemptions,
+  checkpoint IO errors, data-iterator failures, and stalls: the test
+  substrate proving the two pieces above (see docs/RESILIENCE.md for
+  the crash matrix).
+
+Quick use::
+
+    from deeplearning4j_tpu.resilience import Supervisor, SupervisorConfig
+
+    sup = Supervisor(build_net, "/ckpts",
+                     config=SupervisorConfig(max_restarts=5,
+                                             stall_timeout=120.0),
+                     everyNIterations=200, asyncSave=True)
+    net = sup.run(batches, epochs=TOTAL)   # survives kill -TERM et al.
+"""
+
+from deeplearning4j_tpu.resilience.async_ckpt import (
+    AsyncCheckpointer, Snapshot, checkpoint_status, latest_agreed,
+    note_commit, refresh_metrics, reset_state)
+from deeplearning4j_tpu.resilience.faults import (
+    FaultError, FaultInjector, FaultPlan, InjectedCheckpointIOError,
+    InjectedCrash, InjectedDataError)
+from deeplearning4j_tpu.resilience.supervisor import (
+    RestartBudgetExceeded, Supervisor, SupervisorConfig, Watchdog)
+
+__all__ = [
+    "AsyncCheckpointer", "FaultError", "FaultInjector", "FaultPlan",
+    "InjectedCheckpointIOError", "InjectedCrash", "InjectedDataError",
+    "RestartBudgetExceeded", "Snapshot", "Supervisor",
+    "SupervisorConfig", "Watchdog", "checkpoint_status", "latest_agreed",
+    "note_commit", "refresh_metrics", "reset_state",
+]

@@ -1,0 +1,625 @@
+"""Async checkpointing (ISSUE 5 tentpole, piece 1).
+
+Every ``ElasticTrainer`` checkpoint used to block the train loop for
+the full device→host transfer *and* serialization *and* disk write —
+exactly the "framework overhead off the math path" the Java-framework
+performance paper flags once kernels are fast. The async design splits
+the write into the two halves that actually have different costs:
+
+1. **Snapshot** (train thread, the only part the loop waits for): a
+   jitted device-side *clone* of params / updater state / loss-scale
+   state. The clone rides the dispatch queue like any other step — it
+   returns as soon as the copy computations are enqueued, and because
+   the clone owns fresh buffers the train step is free to donate the
+   originals on the very next iteration. ``copy_to_host_async`` is
+   issued immediately, so the device→host DMA overlaps training.
+2. **Write** (background thread): materialize the host copies (the DMA
+   has usually already landed), serialize with the *same*
+   ``ModelSerializer`` zip / ``save_sharded`` npz layout as the sync
+   path, and commit with the same tmp + ``os.replace`` protocol
+   (``utils.checkpoint.atomic_save``) — so a crash at any point leaves
+   the previous checkpoint current, never a partial one.
+
+The in-flight queue is bounded at depth 1 and a **newer snapshot
+supersedes a queued one** (the queued write had not started; its state
+is strictly older than what we now hold — writing both would just
+delay the newer commit). In multi-host runs supersede is disabled:
+whether a snapshot is still queued at submit time is a thread-timing
+race, so hosts could disagree on which steps exist at all.
+
+Multi-host async writes issue **no collectives from the writer
+thread** — a background barrier would interleave with the train loop's
+in-step collectives and desync the hosts (gloo context-init deadlock).
+Instead, each host's writer commits its shard independently and
+:func:`latest_agreed` certifies completeness at read time: a sharded
+checkpoint counts only when its committed manifest AND every shard
+file it references exist on the shared directory. (The synchronous
+durable writes at preemption/end-of-fit run on the train thread with
+the full ``save_sharded`` barrier, so the final checkpoint of a run
+keeps the manifest-after-sync property.)
+
+Commit bookkeeping (timestamps, steps, write durations) is published
+through the PR-1 registry (``dl4j_ckpt_*``) and feeds the /healthz
+checkpoint-staleness readiness detail.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+__all__ = ["AsyncCheckpointer", "Snapshot", "latest_agreed",
+           "checkpoint_status", "note_commit", "reset_state",
+           "refresh_metrics", "rotate_checkpoints"]
+
+_CKPT_RE = re.compile(r"^checkpoint_(\d+)")
+
+
+def rotate_checkpoints(directory, keep):
+    """keepLast rotation + garbage collection for a checkpoint
+    directory (process 0 only): drops complete checkpoints beyond the
+    newest ``keep``, plus mid-save remnants — incomplete shard
+    directories and ``*.tmp`` files — once a complete checkpoint at the
+    same or a later iteration exists. An in-flight async write (always
+    newer than the newest commit) is never touched. Shared by the sync
+    ``ElasticTrainer`` writer and the async background writer."""
+    import shutil
+
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    from deeplearning4j_tpu.utils.sharded_checkpoint import is_complete
+
+    complete, partial, tmps = [], [], []
+    for f in sorted(os.listdir(directory)):
+        if not f.startswith("checkpoint_"):
+            continue
+        full = os.path.join(directory, f)
+        if f.endswith(".tmp"):
+            tmps.append(f)
+        elif os.path.isdir(full):
+            # an incomplete directory (no manifest, or a manifest
+            # referencing shard files that never landed) must not count
+            # toward keepLast, and it never becomes restorable
+            (complete if is_complete(full) else partial).append(f)
+        else:
+            complete.append(f)
+    newest_iter = -1
+    if complete:
+        m = _CKPT_RE.match(complete[-1])
+        newest_iter = int(m.group(1)) if m else -1
+
+    def stale(f):
+        m = _CKPT_RE.match(f)
+        return m and int(m.group(1)) <= newest_iter
+
+    for old in complete[:-int(keep)] + [f for f in partial + tmps
+                                        if stale(f)]:
+        full = os.path.join(directory, old)
+        if os.path.isdir(full):
+            shutil.rmtree(full)
+        else:
+            os.remove(full)
+
+
+# ---------------------------------------------------------------------------
+# commit bookkeeping + metrics (shared by sync AND async writers)
+# ---------------------------------------------------------------------------
+
+AGE_HELP = ("Seconds since the last committed training checkpoint "
+            "(refreshed at commit and on /metrics and /healthz reads)")
+QUEUE_DEPTH_HELP = "Async-checkpoint snapshots queued or being written"
+SNAPSHOT_HELP = ("Seconds the train loop was blocked taking a checkpoint "
+                 "snapshot (device-side clone dispatch + enqueue — the "
+                 "async-mode per-checkpoint stall)")
+WRITE_HELP = ("Seconds spent serializing + committing one checkpoint "
+              "(mode=sync blocks the train loop; mode=async runs in the "
+              "background writer)")
+SUPERSEDED_HELP = ("Queued checkpoint snapshots replaced by a newer one "
+                   "before their write started")
+FAILURES_HELP = "Checkpoint writes that failed, by phase (write|commit)"
+
+_state = {
+    "commits": [],        # (ts, step) of recent commits, bounded
+    "last": None,         # {"ts", "step", "path", "seconds", "mode"}
+    "failures": 0,
+    "queue_depth": 0,
+    "active": 0,          # checkpointed fits currently in flight
+    "provider": False,    # healthz provider registered?
+}
+_lock = threading.Lock()
+_MAX_COMMITS = 16
+
+
+def reset_state():
+    """Forget commit history (tests)."""
+    with _lock:
+        _state["commits"] = []
+        _state["last"] = None
+        _state["failures"] = 0
+        _state["queue_depth"] = 0
+        _state["active"] = 0
+
+
+def mark_active():
+    """A checkpointed fit started: staleness judgements apply until the
+    matching :func:`mark_idle`. (A finished run's checkpoint aging is
+    not a degradation — nothing more is expected to land.)"""
+    with _lock:
+        _state["active"] += 1
+
+
+def mark_idle():
+    with _lock:
+        _state["active"] = max(0, _state["active"] - 1)
+
+
+def _registry():
+    from deeplearning4j_tpu import telemetry
+
+    if not telemetry.enabled():
+        return None
+    return telemetry.get_registry()
+
+
+def _ensure_provider():
+    """Register the /healthz resilience section once (checkpoint
+    staleness + supervisor state)."""
+    with _lock:
+        if _state["provider"]:
+            return
+        _state["provider"] = True
+    from deeplearning4j_tpu.telemetry import health
+
+    health.register_healthz_provider("resilience", healthz_section)
+
+
+def note_commit(path, step, seconds, mode, registry=None):
+    """Record one committed checkpoint (called by both the sync
+    ``ElasticTrainer._write`` path and the async writer) and refresh
+    the ``dl4j_ckpt_*`` gauges."""
+    now = time.time()
+    with _lock:
+        _state["commits"].append((now, int(step)))
+        del _state["commits"][:-_MAX_COMMITS]
+        _state["last"] = {"ts": now, "step": int(step), "path": str(path),
+                          "seconds": float(seconds), "mode": mode}
+    _ensure_provider()
+    reg = registry if registry is not None else _registry()
+    if reg is None:
+        return
+    reg.gauge("dl4j_ckpt_age_seconds", AGE_HELP).set(0.0)
+    reg.histogram("dl4j_ckpt_write_seconds", WRITE_HELP,
+                  ("mode",)).labels(mode=mode).observe(seconds)
+    from deeplearning4j_tpu.telemetry import flight
+
+    flight.record("checkpoint", step=int(step), mode=mode,
+                  seconds=round(float(seconds), 6))
+
+
+def note_failure(step, phase, error):
+    with _lock:
+        _state["failures"] += 1
+    reg = _registry()
+    if reg is not None:
+        reg.counter("dl4j_ckpt_failures_total", FAILURES_HELP,
+                    ("phase",)).labels(phase=phase).inc()
+    from deeplearning4j_tpu.telemetry import flight
+
+    flight.record("checkpoint_failure", step=int(step), phase=phase,
+                  error=f"{type(error).__name__}: {error}")
+    log.warning("checkpoint write for step %s failed during %s: %s "
+                "(previous checkpoint remains current)", step, phase, error)
+
+
+def _set_queue_depth(depth):
+    with _lock:
+        _state["queue_depth"] = depth
+    reg = _registry()
+    if reg is not None:
+        reg.gauge("dl4j_ckpt_async_queue_depth", QUEUE_DEPTH_HELP).set(depth)
+
+
+def refresh_metrics():
+    """Recompute the time-derived gauge(s) — called by the /metrics and
+    /healthz handlers so scrapes see a live age, not the age as of the
+    last commit."""
+    with _lock:
+        last = _state["last"]
+    if last is None:
+        return
+    reg = _registry()
+    if reg is not None:
+        reg.gauge("dl4j_ckpt_age_seconds", AGE_HELP).set(
+            time.time() - last["ts"])
+
+
+def checkpoint_status(stale_after=None):
+    """Current checkpoint recency: ``{"step", "age_seconds",
+    "expected_interval_seconds", "stale"}`` (or None before the first
+    commit). Staleness: age > ``stale_after`` when given, else >
+    2 × the median inter-commit interval once two commits exist —
+    "two missed checkpoints' worth of steps"."""
+    with _lock:
+        last = _state["last"]
+        commits = list(_state["commits"])
+        active = _state["active"]
+    if last is None:
+        return None
+    age = time.time() - last["ts"]
+    expected = None
+    if len(commits) >= 2:
+        gaps = sorted(b[0] - a[0] for a, b in zip(commits, commits[1:]))
+        expected = gaps[len(gaps) // 2]
+    if stale_after is not None:
+        threshold = float(stale_after)
+    elif expected:
+        threshold = 2.0 * expected
+    else:
+        threshold = None
+    # staleness is only meaningful while a checkpointed fit is running:
+    # an idle process is not "behind on checkpoints"
+    stale = bool(active > 0 and threshold is not None and age > threshold)
+    return {"step": last["step"], "age_seconds": round(age, 3),
+            "mode": last["mode"], "active": active > 0,
+            "expected_interval_seconds": (round(expected, 3)
+                                          if expected else None),
+            "stale": stale}
+
+
+def healthz_section():
+    """The /healthz ``resilience`` readiness detail: checkpoint
+    staleness (degraded, never 503 — a live trainer that is behind on
+    checkpoints should keep serving) plus supervisor state."""
+    refresh_metrics()
+    out = {}
+    ck = checkpoint_status()
+    if ck is not None:
+        out["checkpoint"] = ck
+        if ck["stale"]:
+            out["degraded"] = True
+            out["detail"] = (
+                f"last checkpoint (step {ck['step']}) is "
+                f"{ck['age_seconds']}s old, > 2x the expected "
+                f"{ck['expected_interval_seconds']}s interval")
+    from deeplearning4j_tpu.resilience import supervisor as _sup
+
+    sup = _sup.status()
+    if sup is not None:
+        out["supervisor"] = sup
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checkpointer
+# ---------------------------------------------------------------------------
+
+class Snapshot:
+    """A device-side clone of one model state, plus everything the
+    background writer needs to serialize it without ever touching the
+    live (mutating, donation-recycled) net."""
+
+    __slots__ = ("step", "params", "states", "opt_states", "prec",
+                 "iteration", "epoch", "conf", "model_type",
+                 "save_updater", "taken_at")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    # ModelSerializer.writeModel duck-types against these:
+    @property
+    def _params(self):
+        return self.params
+
+    @property
+    def _states(self):
+        return self.states
+
+    @property
+    def _opt_states(self):
+        return self.opt_states
+
+    @property
+    def _prec_state(self):
+        return self.prec
+
+    @property
+    def _iteration(self):
+        return self.iteration
+
+    @property
+    def _epoch(self):
+        return self.epoch
+
+
+_CLONER = []
+
+
+def _clone_to_device(tree):
+    """Fresh device buffers holding a copy of ``tree`` — dispatched
+    asynchronously (jit), preserving shardings, and safe against the
+    train step donating the originals afterwards."""
+    if not _CLONER:
+        import jax
+        import jax.numpy as jnp
+
+        _CLONER.append(jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t)))
+    return _CLONER[0](tree)
+
+
+def _start_host_copies(tree):
+    import jax
+
+    def start(x):
+        if isinstance(x, jax.Array):
+            try:
+                x.copy_to_host_async()
+            except Exception:
+                pass
+        return x
+
+    jax.tree_util.tree_map(start, tree)
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer with a depth-1 supersede queue.
+
+    ``snapshot(net, step)`` (train thread) clones state on device and
+    returns the handle; ``submit(snap)`` enqueues it. The writer thread
+    serializes and atomically commits using the same artifact layout as
+    the sync path, so sync and async checkpoints are interchangeable at
+    restore time. ``drain()`` blocks until the queue is empty (end of
+    fit / preemption); ``close()`` drains and stops the thread.
+    """
+
+    def __init__(self, directory, keepLast=3, sharded=False,
+                 saveUpdater=True, supersede=None, faults=None,
+                 rotate=None):
+        import jax
+
+        self.dir = str(directory)
+        self.keep = int(keepLast)
+        self.sharded = bool(sharded)
+        self.save_updater = bool(saveUpdater)
+        self.faults = faults
+        # rotation: ElasticTrainer injects its own; standalone use gets
+        # the shared keepLast rotation so checkpoints never pile up
+        self._rotate = rotate if rotate is not None else (
+            lambda: rotate_checkpoints(self.dir, self.keep))
+        multi = jax.process_count() > 1
+        # supersede is a submit-time race in multi-host (see module
+        # docstring): force every submitted snapshot to be written there
+        self.supersede = (not multi) if supersede is None \
+            else (bool(supersede) and not multi)
+        self._pending = None
+        self._busy = False
+        self._closing = False
+        self._error = None
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._writer_loop, daemon=True,
+            name=f"dl4j-async-ckpt-{os.path.basename(self.dir)}")
+        self._thread.start()
+        os.makedirs(self.dir, exist_ok=True)
+        _ensure_provider()
+
+    # -- train-thread half ---------------------------------------------------
+    def snapshot(self, net, step) -> Snapshot:
+        """Clone the net's training state on device (async dispatch)
+        and start the device→host copies. This is the ONLY part of a
+        checkpoint the train loop waits for."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        t0 = time.perf_counter()
+        tree = {"p": net._params, "s": net._states}
+        if self.save_updater:
+            tree["o"] = net._opt_states
+        if getattr(net, "_prec_state", None):
+            tree["prec"] = net._prec_state
+        clone = _clone_to_device(tree)
+        _start_host_copies(clone)
+        snap = Snapshot(
+            step=int(step),
+            params=clone["p"], states=clone["s"],
+            opt_states=clone.get("o"),
+            prec=clone.get("prec", {}),
+            iteration=int(net._iteration), epoch=int(net._epoch),
+            conf=net.conf,
+            model_type=("ComputationGraph"
+                        if isinstance(net, ComputationGraph)
+                        else "MultiLayerNetwork"),
+            save_updater=self.save_updater,
+            taken_at=time.time())
+        reg = _registry()
+        if reg is not None:
+            reg.histogram("dl4j_ckpt_snapshot_seconds",
+                          SNAPSHOT_HELP).observe(time.perf_counter() - t0)
+        return snap
+
+    def submit(self, snap: Snapshot):
+        """Queue a snapshot for background write. Depth-1: with
+        supersede on, a still-queued older snapshot is replaced (and
+        counted); otherwise blocks until the slot frees."""
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            if self._pending is not None:
+                if self.supersede:
+                    reg = _registry()
+                    if reg is not None:
+                        reg.counter("dl4j_ckpt_superseded_total",
+                                    SUPERSEDED_HELP).inc()
+                    from deeplearning4j_tpu.telemetry import flight
+
+                    flight.record("checkpoint_superseded",
+                                  step=self._pending.step,
+                                  by_step=snap.step)
+                else:
+                    while self._pending is not None and not self._closing:
+                        self._cond.wait(0.05)
+            self._pending = snap
+            self._cond.notify_all()
+        self._update_depth_locked()
+
+    def checkpoint(self, net, step):
+        """snapshot + submit (the ElasticTrainer hook entry point)."""
+        import jax
+
+        if not self.sharded and jax.process_index() != 0:
+            # single-file mode: process 0 owns the write — skip the
+            # device clone entirely on other hosts, but keep their
+            # instrument sets identical (the multi-host aggregate
+            # contract, same as the sync path's zero-byte records)
+            note_commit(self._path(int(step)), step, 0.0, "async")
+            return
+        self.submit(self.snapshot(net, step))
+
+    def drain(self, timeout=30.0):
+        """Block until every queued snapshot is committed (or failed).
+        Re-raises nothing: write failures are recorded and the previous
+        checkpoint stays current — the caller's durable fallback is a
+        final synchronous write."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._pending is not None or self._busy) \
+                    and time.monotonic() < deadline:
+                self._cond.wait(0.05)
+            return self._pending is None and not self._busy
+
+    def close(self, timeout=30.0):
+        self.drain(timeout)
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    def _update_depth_locked(self):
+        with self._cond:
+            depth = (1 if self._pending is not None else 0) + \
+                (1 if self._busy else 0)
+        _set_queue_depth(depth)
+
+    # -- background half -----------------------------------------------------
+    def _writer_loop(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closing:
+                    self._cond.wait(0.2)
+                if self._pending is None and self._closing:
+                    return
+                snap, self._pending = self._pending, None
+                self._busy = True
+                self._cond.notify_all()
+            self._update_depth_locked()
+            try:
+                self._write(snap)
+            except Exception as e:  # injected or real IO failure
+                phase = "commit" if getattr(e, "_dl4j_commit", False) \
+                    else "write"
+                from deeplearning4j_tpu.resilience.faults import FaultError
+
+                note_failure(snap.step, phase, e)
+                if not isinstance(e, (OSError, FaultError)):
+                    log.exception("unexpected async checkpoint failure")
+                self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+                self._update_depth_locked()
+
+    def _path(self, iteration):
+        suffix = "" if self.sharded else ".zip"
+        return os.path.join(self.dir, f"checkpoint_{iteration:010d}{suffix}")
+
+    def _write(self, snap: Snapshot):
+        from deeplearning4j_tpu.utils import ModelSerializer
+        from deeplearning4j_tpu.utils.checkpoint import atomic_save
+
+        t0 = time.perf_counter()
+        path = self._path(snap.step)
+        if self.faults is not None:
+            self.faults.check_write(snap.step, "write")
+
+        def pre_commit():
+            if self.faults is not None:
+                try:
+                    self.faults.check_write(snap.step, "commit")
+                except Exception as e:
+                    e._dl4j_commit = True
+                    raise
+
+        if self.sharded:
+            from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                extract_snapshot, write_snapshot)
+
+            tree = {"p": snap.params, "s": snap.states}
+            if snap.save_updater:
+                tree["o"] = snap.opt_states
+            if snap.prec:
+                tree["prec"] = snap.prec
+            meta = {"modelType": snap.model_type,
+                    "configuration": snap.conf.to_json(),
+                    "saveUpdater": bool(snap.save_updater),
+                    "hasPrecState": bool(snap.save_updater and snap.prec),
+                    "trainingState": {"iteration": snap.iteration,
+                                      "epoch": snap.epoch}}
+            # sync=False: a background thread must not issue collectives
+            # (they would interleave with the train loop's in-step
+            # collectives and desync the hosts) — completeness is
+            # certified at read time by latest_agreed() instead
+            write_snapshot(self._path(snap.step),
+                           extract_snapshot(tree, snap.step, meta),
+                           pre_commit=pre_commit, sync=False)
+        else:
+            import jax
+
+            if jax.process_index() == 0:
+                atomic_save(
+                    path,
+                    lambda tmp: ModelSerializer.writeModel(
+                        snap, tmp, snap.save_updater,
+                        modelType=snap.model_type),
+                    pre_commit=pre_commit)
+            # non-writers fall through: identical instrument sets on
+            # every host (multi-host aggregate contract)
+        dt = time.perf_counter() - t0
+        note_commit(path, snap.step, dt, "async")
+        try:
+            self._rotate()
+        except Exception:
+            log.exception("checkpoint rotation failed")
+
+
+# ---------------------------------------------------------------------------
+# latest_agreed
+# ---------------------------------------------------------------------------
+
+def latest_agreed(checkpointDir):
+    """Newest checkpoint that is complete on EVERY host: zip files are
+    atomic (committed == complete); sharded directories must hold a
+    committed manifest AND every shard file it references (the manifest
+    is written only after the cross-process sync, so on shared storage
+    this certifies all hosts finished). Returns a path or None."""
+    if not os.path.isdir(checkpointDir):
+        return None
+    from deeplearning4j_tpu.utils.sharded_checkpoint import is_complete
+
+    for name in sorted(os.listdir(checkpointDir), reverse=True):
+        if not name.startswith("checkpoint_") or name.endswith(".tmp"):
+            continue
+        full = os.path.join(checkpointDir, name)
+        if os.path.isdir(full):
+            if is_complete(full):
+                return full
+        elif name.endswith(".zip"):
+            return full
+    return None

@@ -1,0 +1,257 @@
+"""Deterministic fault injection: the test substrate of the resilience
+subsystem (ISSUE 5 tentpole, piece 3).
+
+The paper's blueprint moves fault tolerance out of the transport (the
+reference's Aeron parameter server let workers rejoin and re-sync) and
+into the framework — which means the framework's claims ("resumes after
+preemption", "never exposes a partial checkpoint") need a way to be
+*proved*, repeatably, in CI. A :class:`FaultPlan` is a seedable,
+inspectable schedule of failures injected at exact train-loop steps:
+
+- **preemption signals** (``preempt_at``): SIGTERM delivered to this
+  process, exercising the real ``ElasticTrainer`` maintenance-event
+  drill (checkpoint-then-``PreemptionCheckpoint``);
+- **crashes** (``crash_at``): an exception raised between iterations,
+  simulating process death at the Python level;
+- **checkpoint-write IO errors** (``io_error_at``): raised inside the
+  checkpoint writer, either mid-``write`` or between write and
+  ``commit`` — the window where a partial artifact must never become
+  ``latest()``;
+- **data-iterator exceptions** (``data_error_at``): raised from the
+  batch iterator at a chosen global batch ordinal;
+- **stalls** (``stall_at``): a cooperative sleep that simulates a hung
+  step; it watches ``abort_event`` so a supervisor watchdog can break
+  it the way an external process manager would kill a hung worker.
+
+Every event fires a bounded number of times (default once) so a
+resumed run replaying the same step numbers does not re-fire it, and
+every firing is appended to ``plan.log`` for assertions. Plans are
+deterministic by construction (explicit steps); ``random_steps`` draws
+steps from a seeded generator for soak-style tests.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+__all__ = [
+    "FaultError", "InjectedCrash", "InjectedDataError",
+    "InjectedCheckpointIOError", "FaultPlan", "FaultInjector",
+]
+
+
+class FaultError(Exception):
+    """Base of every injected failure (lets tests and the supervisor
+    distinguish planned faults from real bugs)."""
+
+
+class InjectedCrash(FaultError, RuntimeError):
+    """Simulated process death between iterations."""
+
+
+class InjectedDataError(FaultError, RuntimeError):
+    """Simulated ETL failure raised from the data iterator."""
+
+
+class InjectedCheckpointIOError(FaultError, OSError):
+    """Simulated storage failure inside a checkpoint write/commit."""
+
+
+# event kinds
+PREEMPT = "preempt"
+CRASH = "crash"
+STALL = "stall"
+IO_ERROR = "io_error"
+DATA_ERROR = "data_error"
+
+
+class _Event:
+    __slots__ = ("kind", "at", "times", "args")
+
+    def __init__(self, kind, at, times=1, **args):
+        self.kind = kind
+        self.at = int(at)
+        self.times = int(times)
+        self.args = args
+
+
+class FaultPlan:
+    """A deterministic schedule of injected failures.
+
+    Builders are chainable::
+
+        plan = (FaultPlan()
+                .preempt_at(7)
+                .io_error_at(step=12, phase="commit")
+                .data_error_at(batch=30)
+                .stall_at(20, seconds=30.0))
+
+    Thread-safe: the train loop fires iteration events while a
+    background checkpoint writer consults ``check_write``.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.abort_event = threading.Event()
+        self.log: list = []          # (kind, step_or_batch) per firing
+        self._events: list = []
+        self._batches_drawn = 0      # global next() ordinal across epochs
+        self._lock = threading.Lock()
+
+    # -- builders ------------------------------------------------------------
+    def preempt_at(self, step, times=1):
+        """Deliver SIGTERM to this process after iteration ``step``."""
+        self._events.append(_Event(PREEMPT, step, times))
+        return self
+
+    def crash_at(self, step, times=1, message="injected crash"):
+        """Raise :class:`InjectedCrash` after iteration ``step``."""
+        self._events.append(_Event(CRASH, step, times, message=message))
+        return self
+
+    def stall_at(self, step, seconds, times=1):
+        """Sleep cooperatively for ``seconds`` after iteration ``step``
+        (broken early when ``abort_event`` is set — the supervisor
+        watchdog's controlled abort)."""
+        self._events.append(
+            _Event(STALL, step, times, seconds=float(seconds)))
+        return self
+
+    def io_error_at(self, step, phase="write", times=1):
+        """Fail the checkpoint write for iteration ``step``: phase
+        ``"write"`` fails while producing the tmp artifact, ``"commit"``
+        fails between the finished write and the atomic rename."""
+        if phase not in ("write", "commit"):
+            raise ValueError(f"phase must be write|commit, got {phase!r}")
+        self._events.append(_Event(IO_ERROR, step, times, phase=phase))
+        return self
+
+    def data_error_at(self, batch, times=1):
+        """Raise :class:`InjectedDataError` when the data iterator
+        serves global batch ordinal ``batch`` (counted across epochs
+        and restarts — a resumed run does not re-draw consumed
+        ordinals' failures)."""
+        self._events.append(_Event(DATA_ERROR, batch, times))
+        return self
+
+    def random_steps(self, n, max_step):
+        """``n`` deterministic pseudo-random steps in ``[1, max_step]``
+        drawn from this plan's seed (soak tests)."""
+        import random
+
+        rng = random.Random(self.seed)
+        return sorted(rng.randrange(1, int(max_step) + 1)
+                      for _ in range(int(n)))
+
+    # -- runtime hooks -------------------------------------------------------
+    def _take(self, kind, at, pred=None):
+        """Pop one firing of a matching armed event (thread-safe)."""
+        with self._lock:
+            for ev in self._events:
+                if ev.kind == kind and ev.at == int(at) and ev.times > 0 \
+                        and (pred is None or pred(ev)):
+                    ev.times -= 1
+                    self.log.append((kind, int(at)))
+                    return ev
+        return None
+
+    def fired(self, kind=None):
+        """Firings so far, optionally filtered by kind."""
+        with self._lock:
+            return [f for f in self.log if kind is None or f[0] == kind]
+
+    def on_iteration(self, iteration):
+        """Called by :class:`FaultInjector` after each train iteration;
+        executes any preempt/crash/stall armed for it."""
+        ev = self._take(STALL, iteration)
+        if ev is not None:
+            self._stall(ev.args["seconds"])
+        ev = self._take(PREEMPT, iteration)
+        if ev is not None:
+            os.kill(os.getpid(), signal.SIGTERM)
+        ev = self._take(CRASH, iteration)
+        if ev is not None:
+            raise InjectedCrash(
+                f"{ev.args['message']} at iteration {iteration}")
+
+    def _stall(self, seconds, tick=0.02):
+        """Cooperative hang: sleeps in short ticks so a watchdog's
+        ``abort_event`` (or a delivered signal's Python-level handler)
+        can end it — the in-process analogue of a hung step that an
+        external supervisor would eventually shoot."""
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            if self.abort_event.wait(tick):
+                return
+
+    def check_write(self, step, phase):
+        """Called by checkpoint writers around the atomic commit; raises
+        :class:`InjectedCheckpointIOError` when armed for (step, phase).
+        """
+        ev = self._take(IO_ERROR, step,
+                        pred=lambda e: e.args["phase"] == phase)
+        if ev is not None:
+            raise InjectedCheckpointIOError(
+                f"injected checkpoint {phase} failure at step {step}")
+        return None
+
+    def on_batch(self):
+        """Called by the data wrapper per served batch; raises when the
+        global ordinal has an armed data error."""
+        with self._lock:
+            ordinal = self._batches_drawn
+            self._batches_drawn += 1
+        ev = self._take(DATA_ERROR, ordinal)
+        if ev is not None:
+            raise InjectedDataError(
+                f"injected data-iterator failure at batch {ordinal}")
+
+    # -- adapters ------------------------------------------------------------
+    def listener(self):
+        """A DL4J-style listener injecting iteration faults (install
+        alongside training listeners; ``ElasticTrainer``/``Supervisor``
+        do this when handed a plan)."""
+        return FaultInjector(self)
+
+    def wrap_data(self, data):
+        """Wrap a batch source so armed data errors fire at their global
+        ordinal. Preserves ``len()`` so epoch accounting (and the
+        bit-identical resume offset math) still works."""
+        return _FaultyData(self, data)
+
+
+class FaultInjector:
+    """Listener-shaped adapter: fires the plan's iteration faults."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def iterationDone(self, model, iteration, epoch=None, loss=None):
+        self.plan.on_iteration(iteration)
+
+
+class _FaultyData(list):
+    """A list of batches whose iteration consults the plan per draw.
+    Subclassing list keeps the training loops' sized-data fast paths
+    (len, slicing, no per-epoch materialization by _prepare_batches)
+    while every ``for batch in data`` goes through :meth:`__iter__`."""
+
+    def __init__(self, plan, data):
+        super().__init__(data)
+        self._plan = plan
+
+    def __getitem__(self, idx):
+        # slicing support keeps ElasticTrainer's mid-epoch resume offset
+        # working through the wrapper
+        if isinstance(idx, slice):
+            return _FaultyData(self._plan, super().__getitem__(idx))
+        return super().__getitem__(idx)
+
+    def __iter__(self):
+        it = super().__iter__()
+        for batch in it:
+            self._plan.on_batch()
+            yield batch

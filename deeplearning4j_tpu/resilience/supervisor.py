@@ -1,0 +1,321 @@
+"""Training supervisor: watchdog + auto-resume (ISSUE 5 tentpole,
+piece 2).
+
+The reference's Spark training master re-submitted failed stages; on a
+TPU pod the ICI collectives carry no recovery protocol, so surviving a
+crash, preemption, hung step, or divergence is the framework's job:
+
+- **auto-resume**: each attempt restores the newest complete checkpoint
+  (``latest_agreed`` on multi-host shared storage) via
+  ``ElasticTrainer.resume`` and continues the SAME total epoch budget —
+  with the mid-epoch offset skip, a resumed run is bit-identical to an
+  uninterrupted one at the same step;
+- **bounded restarts with exponential backoff**: a persistent fault
+  (bad batch, diverging config) cannot spin the job forever;
+- **watchdog**: no step progress within ``stall_timeout`` → dump the
+  flight recorder, then a *controlled abort*: the watchdog sets the
+  abort event (cooperative fault-injected stalls observe it) and
+  interrupts the main thread, which lands in ``ElasticTrainer``'s
+  signal handler → checkpoint-then-exit, and the supervisor restarts
+  the attempt. A step hung inside a C call cannot be interrupted from
+  within the process — that case needs an external process manager,
+  which is exactly what the flight-recorder dump is for;
+- **accounting**: every restart increments
+  ``dl4j_resilience_restarts_total{reason}`` and records a flight
+  event; ``/healthz`` shows supervisor state via the resilience
+  readiness section.
+
+Works with plain ``MultiLayerNetwork`` / ``ComputationGraph`` fits and
+with ``ShardedTrainer`` runs (pass ``runner_factory=lambda net:
+ShardedTrainer(net, mesh)``).
+"""
+
+from __future__ import annotations
+
+import _thread
+import threading
+import time
+
+from deeplearning4j_tpu.telemetry.health import DivergenceError
+
+__all__ = ["Supervisor", "SupervisorConfig", "RestartBudgetExceeded",
+           "Watchdog", "status"]
+
+RESTARTS_HELP = ("Supervised training restarts by reason "
+                 "(preemption|stall|divergence|exception)")
+
+_current = {"status": None}
+_lock = threading.Lock()
+
+
+def status():
+    """The active (or last) supervisor's state for /healthz, or None."""
+    with _lock:
+        st = _current["status"]
+        return dict(st) if st else None
+
+
+def _set_status(**kw):
+    with _lock:
+        st = _current["status"] or {}
+        st.update(kw)
+        _current["status"] = st
+
+
+def reset_status():
+    with _lock:
+        _current["status"] = None
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The supervisor gave up: more failures than ``max_restarts``.
+    Carries the last reason/exception and the restart count."""
+
+    def __init__(self, message, reason, restarts, last_error):
+        super().__init__(message)
+        self.reason = reason
+        self.restarts = restarts
+        self.last_error = last_error
+
+
+class SupervisorConfig:
+    """Restart policy. ``stall_timeout=None`` disables the watchdog."""
+
+    def __init__(self, max_restarts=3, backoff_base=0.5,
+                 backoff_factor=2.0, backoff_max=30.0,
+                 stall_timeout=None, stall_poll=None, stall_warmup=None):
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_max = float(backoff_max)
+        self.stall_timeout = stall_timeout
+        self.stall_poll = stall_poll
+        # grace before the first iteration of an attempt (jit compile /
+        # checkpoint restore are not stalls); default max(timeout, 30 s)
+        self.stall_warmup = stall_warmup
+
+    def backoff(self, restart_index):
+        """Delay before restart #`restart_index` (1-based)."""
+        return min(self.backoff_base *
+                   self.backoff_factor ** (restart_index - 1),
+                   self.backoff_max)
+
+
+class Watchdog:
+    """No-progress detector for one fit attempt. ``listener()`` yields
+    a DL4J-style listener that timestamps every finished iteration; the
+    watchdog thread trips when the gap exceeds ``timeout``."""
+
+    def __init__(self, timeout, poll=None, abort_event=None,
+                 loop="supervised", warmup_grace=None):
+        self.timeout = float(timeout)
+        self.poll = float(poll) if poll else max(0.05, self.timeout / 4.0)
+        self.abort_event = abort_event or threading.Event()
+        self.loop = loop
+        # before the FIRST iteration of an attempt the loop is (re)
+        # compiling the train step, not stalling — give it more rope
+        self.warmup_grace = (float(warmup_grace) if warmup_grace
+                             else max(self.timeout, 30.0))
+        self.stalled = False
+        self.last_step = None
+        self._seen_progress = False
+        self._last_progress = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    class _Progress:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def iterationDone(self, model, iteration, epoch=None, loss=None):
+            self.outer._last_progress = time.monotonic()
+            self.outer._seen_progress = True
+            self.outer.last_step = iteration
+
+    def listener(self):
+        return Watchdog._Progress(self)
+
+    def start(self):
+        self._last_progress = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dl4j-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self):
+        while not self._stop.wait(self.poll):
+            gap = time.monotonic() - self._last_progress
+            limit = self.timeout if self._seen_progress \
+                else self.warmup_grace
+            if gap < limit:
+                continue
+            self.stalled = True
+            from deeplearning4j_tpu.telemetry import flight
+
+            flight.record("stall", loop=self.loop, step=self.last_step,
+                          no_progress_seconds=round(gap, 3))
+            try:
+                path = flight.get_recorder().dump()
+                flight.record("stall_dump", path=path)
+            except Exception:
+                pass
+            # controlled abort: cooperative stalls watch the event;
+            # interrupt_main lands in ElasticTrainer's installed signal
+            # handler -> checkpoint-then-PreemptionCheckpoint. Re-check
+            # stop() first: firing after fit returned would deliver a
+            # stray KeyboardInterrupt to the supervisor loop instead
+            self.abort_event.set()
+            if not self._stop.is_set():
+                _thread.interrupt_main()
+            return
+
+
+class Supervisor:
+    """Run a checkpointed fit to completion across failures.
+
+    factory: zero-arg callable building a FRESH initialized net (used
+        when no checkpoint exists yet, i.e. the first attempt);
+    checkpointDir: shared storage in multi-host runs (the
+        ``ElasticTrainer`` contract);
+    runner_factory: optional ``net -> object with .fit(data, epochs)``
+        (e.g. a ``ShardedTrainer``) rebuilt around each restored net;
+    setup: optional ``net -> None`` applied to EVERY attempt's net —
+        fresh or restored. Listeners (divergence policies, stats) are
+        not serialized into checkpoints, so per-net configuration must
+        be reapplied here, not in ``factory``;
+    faults: optional :class:`FaultPlan` — its listener is installed,
+        its data wrapper applied, and its abort event wired to the
+        watchdog (deterministic fault-injection tests);
+    trainer_kw: forwarded to ``ElasticTrainer`` (everyNIterations,
+        keepLast, asyncSave, sharded, saveUpdaterState).
+    """
+
+    def __init__(self, factory, checkpointDir, config=None, graph=False,
+                 runner_factory=None, setup=None, faults=None,
+                 sleep=time.sleep, **trainer_kw):
+        self.factory = factory
+        self.dir = str(checkpointDir)
+        self.config = config or SupervisorConfig()
+        self.graph = graph
+        self.runner_factory = runner_factory
+        self.setup = setup
+        self.faults = faults
+        self.sleep = sleep
+        self.trainer_kw = trainer_kw
+        self.restarts = 0
+        self.reasons: list = []
+        from deeplearning4j_tpu.resilience import async_ckpt
+
+        async_ckpt._ensure_provider()
+
+    # -- metrics -------------------------------------------------------------
+    def _count_restart(self, reason, step):
+        self.restarts += 1
+        self.reasons.append(reason)
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import flight
+
+        if telemetry.enabled():
+            telemetry.get_registry().counter(
+                "dl4j_resilience_restarts_total", RESTARTS_HELP,
+                ("reason",)).labels(reason=reason).inc()
+        flight.record("restart", reason=reason, step=step,
+                      restarts=self.restarts)
+
+    # -- the loop ------------------------------------------------------------
+    def _build_trainer(self):
+        from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+
+        trainer = ElasticTrainer.resume(self.dir, graph=self.graph,
+                                        faults=self.faults,
+                                        **self.trainer_kw)
+        resumed = trainer is not None
+        if trainer is None:
+            trainer = ElasticTrainer(self.factory(), self.dir,
+                                     faults=self.faults, **self.trainer_kw)
+        if self.setup is not None:
+            self.setup(trainer.net)
+        if self.runner_factory is not None:
+            trainer.runner = self.runner_factory(trainer.net)
+        return trainer, resumed
+
+    def run(self, data, epochs=1):
+        """Fit to the TOTAL `epochs` budget, restarting through
+        failures; returns the trained net. Raises
+        :class:`RestartBudgetExceeded` when the budget runs out, with
+        the final checkpoint still on disk."""
+        from deeplearning4j_tpu.parallel.elastic import PreemptionCheckpoint
+        from deeplearning4j_tpu.telemetry import flight
+        from deeplearning4j_tpu.telemetry import health as _health
+
+        cfg = self.config
+        wrapped = self.faults.wrap_data(data) if self.faults else data
+        _set_status(state="starting", restarts=0, last_reason=None,
+                    max_restarts=cfg.max_restarts)
+        while True:
+            trainer, resumed = self._build_trainer()
+            net = trainer.net
+            if resumed:
+                flight.record("resume", step=net._iteration,
+                              attempt=self.restarts + 1)
+            wd = None
+            prior = list(net._listeners)
+            if cfg.stall_timeout:
+                wd = Watchdog(cfg.stall_timeout, cfg.stall_poll,
+                              abort_event=(self.faults.abort_event
+                                           if self.faults else None),
+                              warmup_grace=cfg.stall_warmup)
+                net.setListeners(*(prior + [wd.listener()]))
+                wd.start()
+            _set_status(state="running", restarts=self.restarts,
+                        resumed_from=net._iteration if resumed else None)
+            reason = err = None
+            try:
+                trainer.fit(wrapped, epochs)
+                _set_status(state="completed", restarts=self.restarts)
+                return net
+            except PreemptionCheckpoint as e:
+                reason = "stall" if (wd is not None and wd.stalled) \
+                    else "preemption"
+                err = e
+            except KeyboardInterrupt:
+                # the watchdog's interrupt_main can land after fit()
+                # already returned (handlers restored): if the watchdog
+                # DID trip, treat it as the stall abort it was meant to
+                # be; a real Ctrl-C propagates
+                if not (wd is not None and wd.stalled):
+                    raise
+                reason, err = "stall", None
+            except DivergenceError as e:
+                reason, err = "divergence", e
+                # the restart rolls back to the last checkpoint; clear
+                # the recorded divergence so /healthz readiness recovers
+                _health.reset_status()
+            except Exception as e:
+                reason, err = "exception", e
+            finally:
+                if wd is not None:
+                    wd.stop()
+                net.setListeners(*prior)
+                if self.faults is not None:
+                    self.faults.abort_event.clear()
+                trainer.close()
+            self._count_restart(reason, net._iteration)
+            _set_status(state="restarting", restarts=self.restarts,
+                        last_reason=reason)
+            if self.restarts > cfg.max_restarts:
+                _set_status(state="failed", last_reason=reason)
+                raise RestartBudgetExceeded(
+                    f"supervised training failed {self.restarts} times "
+                    f"(last reason: {reason}: {err}); restart budget "
+                    f"{cfg.max_restarts} exhausted", reason,
+                    self.restarts, err) from err
+            delay = cfg.backoff(self.restarts)
+            flight.record("backoff", seconds=round(delay, 3),
+                          restarts=self.restarts)
+            self.sleep(delay)

@@ -497,12 +497,33 @@ def monitor_for(loop, layer_names, listeners=()):
 
 # -- /healthz ----------------------------------------------------------------
 
+_healthz_providers: dict = {}
+
+
+def register_healthz_provider(name, fn):
+    """Add a readiness-detail section to /healthz. ``fn()`` returns a
+    JSON-able dict merged under ``payload[name]``; a truthy
+    ``"degraded"`` key marks the process degraded (status
+    ``"degraded"``, still HTTP 200 — degradation informs operators, it
+    does not stop traffic the way divergence/warming do). Used by the
+    resilience subsystem for checkpoint staleness + supervisor state."""
+    with _lock:   # registration can come from a background writer
+        _healthz_providers[name] = fn
+
+
+def unregister_healthz_provider(name):
+    with _lock:
+        _healthz_providers.pop(name, None)
+
+
 def healthz(serving=None):
     """(payload, http_status) for the liveness/readiness endpoint.
 
     live: the process answers (always True if we got here);
     ready: no recorded divergence AND (if a serving session is
-    attached) every registered model's bucket ladder is warmed.
+    attached) every registered model's bucket ladder is warmed;
+    degraded (ready, 200): a registered provider reports a soft
+    condition, e.g. stale checkpoints.
     """
     now = time.time()
     with _lock:   # the fit-loop thread mutates these as we read
@@ -535,4 +556,18 @@ def healthz(serving=None):
         "ok" if ready else "warming")
     payload = {"status": status, "live": True, "ready": ready,
                "loops": loops, "divergence": div, "serving": serving_info}
+    degraded = False
+    with _lock:   # a first-commit registration may race this scrape
+        providers = sorted(_healthz_providers.items())
+    for name, fn in providers:
+        try:
+            section = fn()
+        except Exception:
+            log.exception("healthz provider %r failed", name)
+            continue
+        if section:
+            payload[name] = section
+            degraded = degraded or bool(section.get("degraded"))
+    if degraded and status == "ok":
+        payload["status"] = "degraded"
     return payload, (200 if ready else 503)

@@ -11,7 +11,10 @@ via serveModels() — "/serving/v1/models" lists registered models and
 "POST /serving/v1/models/<name>:predict" serves JSON inference
 (ISSUE 2: the serving endpoint). ISSUE 3 adds "/healthz" (liveness +
 readiness: serving warmup done, last-step age, divergence state) and
-"/debug/flightrecorder" (the telemetry.flight ring buffer as JSONL)."""
+"/debug/flightrecorder" (the telemetry.flight ring buffer as JSONL).
+ISSUE 5: /healthz readiness detail gains the resilience section
+(supervisor state + checkpoint staleness — "degraded", still 200) and
+/metrics refreshes the checkpoint-age gauge at scrape time."""
 
 from __future__ import annotations
 
@@ -86,6 +89,15 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             from deeplearning4j_tpu.telemetry import prometheus
 
+            try:
+                # time-derived gauges (dl4j_ckpt_age_seconds) refresh at
+                # scrape time so Prometheus sees a live age, not the age
+                # as of the last checkpoint commit
+                from deeplearning4j_tpu.resilience import async_ckpt
+
+                async_ckpt.refresh_metrics()
+            except Exception:
+                pass
             body = prometheus.render().encode()
             ctype = prometheus.CONTENT_TYPE
         elif self.path == "/healthz":

@@ -26,10 +26,33 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import struct
 import zipfile
 
 import numpy as np
+
+
+def atomic_save(path, write_fn, pre_commit=None):
+    """The tmp + ``os.replace`` commit protocol every checkpoint writer
+    here shares (ElasticTrainer zips, sharded shard files + manifest,
+    async checkpoints): ``write_fn(tmp_path)`` produces the artifact
+    under ``<path>.tmp``; the rename commits it. A crash at ANY point
+    leaves either the previous committed file or a ``.tmp`` remnant —
+    never a partial artifact under the real name, so ``latest()`` /
+    ``latest_agreed()`` can trust whatever they find.
+
+    ``pre_commit`` (optional callable) runs after the write but before
+    the rename — the deterministic fault-injection seam (resilience
+    ISSUE 5: a simulated crash *between snapshot and commit* must leave
+    the tmp behind and the previous checkpoint current)."""
+    tmp = str(path) + ".tmp"
+    write_fn(tmp)
+    if pre_commit is not None:
+        pre_commit()
+    os.replace(tmp, path)
+    return str(path)
+
 
 _MAGIC = b"ND4J"
 _DTYPES = {0: ">f4", 1: ">f8"}

@@ -27,10 +27,15 @@ class ModelSerializer:
     @staticmethod
     def writeModel(model, path, saveUpdater: bool = True, normalizer=None,
                    includeFlatCoefficients: bool = False,
-                   sharded: bool = False):
+                   sharded: bool = False, modelType: str | None = None,
+                   pre_commit=None):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-        is_graph = isinstance(model, ComputationGraph)
+        # modelType override: async-checkpoint snapshots (resilience/
+        # async_ckpt.py) hand in a detached host copy of the model state
+        # that is not an actual ComputationGraph instance
+        is_graph = (modelType == "ComputationGraph" if modelType is not None
+                    else isinstance(model, ComputationGraph))
         if sharded:
             # pod-scale path: `path` is a DIRECTORY; every process must
             # call this (each writes its own shard file). Normalizers
@@ -46,10 +51,17 @@ class ModelSerializer:
             tree = {"p": model._params, "s": model._states}
             if saveUpdater:
                 tree["o"] = model._opt_states
+            prec = getattr(model, "_prec_state", None) or None
+            if saveUpdater and prec:
+                # dynamic loss-scaler state rides the sharded tree too:
+                # a resumed mixed-precision run must keep the warmed
+                # scale (resilience bit-identical-resume contract)
+                tree["prec"] = prec
             meta = {"modelType": ("ComputationGraph" if is_graph
                                   else "MultiLayerNetwork"),
                     "configuration": model.conf.to_json(),
                     "saveUpdater": bool(saveUpdater),
+                    "hasPrecState": bool(saveUpdater and prec),
                     "trainingState": {"iteration": model._iteration,
                                       "epoch": model._epoch}}
             if normalizer is not None:
@@ -57,7 +69,8 @@ class ModelSerializer:
                     "class": type(normalizer).__name__,
                     "state": {k: np.asarray(v).tolist()
                               for k, v in normalizer._state().items()}}
-            save_sharded(path, tree, step=model._iteration, meta=meta)
+            save_sharded(path, tree, step=model._iteration, meta=meta,
+                         pre_commit=pre_commit)
             return
         with zipfile.ZipFile(path, "w") as zf:
             zf.writestr("configuration.json", model.conf.to_json())
@@ -120,8 +133,16 @@ class ModelSerializer:
                 ubuf = io.BytesIO()
                 np.savez(ubuf, **uarrs)
                 zf.writestr("updaterState.npz", ubuf.getvalue())
-                zf.writestr("trainingState.json", json.dumps({
-                    "iteration": model._iteration, "epoch": model._epoch}))
+                ts = {"iteration": model._iteration, "epoch": model._epoch}
+                prec = getattr(model, "_prec_state", None)
+                if prec:
+                    # loss-scaler state (ISSUE 4 / resilience ISSUE 5):
+                    # a resumed mixed-precision run must keep the warmed
+                    # dynamic scale, not restart at init_scale
+                    ts["lossScale"] = {
+                        k: float(np.asarray(jax.device_get(v)))
+                        for k, v in prec.items()}
+                zf.writestr("trainingState.json", json.dumps(ts))
             if normalizer is not None:
                 nbuf = io.BytesIO()
                 np.savez(nbuf, __class__=type(normalizer).__name__,
@@ -192,6 +213,11 @@ class ModelSerializer:
                 ts = json.loads(zf.read("trainingState.json"))
                 model._iteration = ts["iteration"]
                 model._epoch = ts["epoch"]
+                if ts.get("lossScale") and getattr(
+                        model, "_prec_state", None):
+                    model._prec_state = {
+                        k: jnp.asarray(v, model._prec_state[k].dtype)
+                        for k, v in ts["lossScale"].items()}
         return model
 
     @staticmethod
@@ -227,6 +253,14 @@ class ModelSerializer:
         template = {"p": model._params, "s": model._states}
         if meta.get("saveUpdater"):
             template["o"] = model._opt_states
+        if meta.get("hasPrecState"):
+            # scaler state saved; the template must mirror it even when
+            # this model's policy does no scaling (dropped after load)
+            template["prec"] = (model._prec_state
+                                if getattr(model, "_prec_state", None)
+                                else {"scale": np.float32(0),
+                                      "good_steps": np.int32(0),
+                                      "overflows": np.int32(0)})
         # restore each leaf with the sharding the freshly initialized
         # model gave it (re-shards from any saved topology)
         shardings = jax.tree_util.tree_map(
@@ -241,6 +275,12 @@ class ModelSerializer:
             ts = meta["trainingState"]
             model._iteration = ts["iteration"]
             model._epoch = ts["epoch"]
+            if meta.get("hasPrecState") and getattr(
+                    model, "_prec_state", None):
+                model._prec_state = {
+                    k: jnp.asarray(np.asarray(v),
+                                   model._prec_state[k].dtype)
+                    for k, v in tree["prec"].items()}
         return model
 
     @staticmethod

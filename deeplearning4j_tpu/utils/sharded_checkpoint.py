@@ -122,14 +122,17 @@ def _record_checkpoint(op, t0, nbytes):
                 ("op",)).labels(op=op).inc(nbytes)
 
 
-def save_sharded(directory, tree, step=0, meta=None):
-    """Write this process's chunks of `tree` (a pytree of jax/numpy
-    arrays) under `directory`; process 0 also writes the manifest."""
+def extract_snapshot(tree, step=0, meta=None):
+    """The device-facing half of :func:`save_sharded`: compute the
+    global chunk->file map and collect THIS process's chunk payload.
+    No file I/O and no cross-process sync happen here, so the result —
+    a plain dict of host metadata plus (possibly still-transferring)
+    array views — can be handed to a background writer thread
+    (resilience ISSUE 5 async checkpointing) while the train loop moves
+    on. ``write_snapshot`` commits it."""
     import jax
 
-    t0 = time.perf_counter()
     pid = jax.process_index()
-    os.makedirs(directory, exist_ok=True)
     named, _ = _flatten_with_names(tree)
     payload, leaves_spec = {}, {}
     for i, (name, leaf) in enumerate(named):
@@ -164,20 +167,75 @@ def save_sharded(directory, tree, step=0, meta=None):
         leaves_spec[name] = {"shape": list(shape), "dtype": str(dtype),
                              "host": not isinstance(leaf, jax.Array),
                              "chunks": chunks}
+    return {"pid": pid, "process_count": jax.process_count(),
+            "payload": payload, "leaves": leaves_spec,
+            "step": int(step), "meta": meta or {}}
+
+
+def write_snapshot(directory, snap, pre_commit=None, sync=True):
+    """The I/O half of :func:`save_sharded`: write this process's shard
+    npz (tmp + replace), sync all processes, then process 0 commits the
+    manifest (tmp + replace, after the sync — a complete manifest
+    implies complete shard files on every host). ``pre_commit`` runs
+    before the manifest rename (fault-injection seam).
+
+    ``sync=False`` (the async-checkpoint writer thread): NO collectives
+    are issued — a background thread's barrier would interleave with
+    the train loop's in-step collectives and the processes would
+    disagree on collective order (observed as gloo context-init
+    deadlocks). Without the barrier a manifest no longer certifies the
+    other hosts' shards, so readers must use ``latest_agreed()`` /
+    :func:`is_complete`, which verify every referenced shard file on
+    the shared directory instead."""
+    t0 = time.perf_counter()
+    pid = snap["pid"]
+    os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f"shard_{pid}.tmp.npz")
-    np.savez(tmp, **payload)
+    np.savez(tmp, **snap["payload"])
     shard_path = os.path.join(directory, f"shard_{pid}.npz")
     os.replace(tmp, shard_path)
-    _sync("shards_written")
+    if sync:
+        _sync("shards_written")
     _record_checkpoint("save", t0, os.path.getsize(shard_path))
     if pid == 0:
-        man = {"step": int(step), "process_count": jax.process_count(),
-               "leaves": leaves_spec, "meta": meta or {}}
+        man = {"step": snap["step"], "process_count": snap["process_count"],
+               "leaves": snap["leaves"], "meta": snap["meta"]}
         mtmp = os.path.join(directory, MANIFEST + ".tmp")
         with open(mtmp, "w") as f:
             json.dump(man, f)
+        if pre_commit is not None:
+            pre_commit()
         os.replace(mtmp, os.path.join(directory, MANIFEST))
-    _sync("manifest_written")
+    if sync:
+        _sync("manifest_written")
+
+
+def save_sharded(directory, tree, step=0, meta=None, pre_commit=None):
+    """Write this process's chunks of `tree` (a pytree of jax/numpy
+    arrays) under `directory`; process 0 also writes the manifest.
+    ``pre_commit`` runs before the manifest rename (fault seam)."""
+    write_snapshot(directory, extract_snapshot(tree, step, meta),
+                   pre_commit=pre_commit)
+
+
+def is_complete(directory) -> bool:
+    """True when `directory` holds a committed manifest AND every chunk
+    file the manifest references exists — i.e. every host finished its
+    shard write and the commit happened. The building block of
+    ``latest_agreed()`` (resilience ISSUE 5): on shared storage a
+    checkpoint directory passing this check is restorable from ANY
+    host."""
+    mpath = os.path.join(directory, MANIFEST)
+    if not os.path.isfile(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return False
+    files = {ch["file"] for spec in man.get("leaves", {}).values()
+             for ch in spec["chunks"]}
+    return all(os.path.isfile(os.path.join(directory, f)) for f in files)
 
 
 class _ChunkReader:

@@ -1,0 +1,98 @@
+"""Worker for the two-process resilience test (spawned by
+tests/test_resilience_multiprocess.py, one per simulated host).
+
+Each process joins a 2-process / 4-device CPU "pod", trains a small net
+through a Supervisor with sharded async checkpoints to the shared
+directory, and prints a content hash of the final params + updater
+state. Phase "faulted": a FaultPlan preempts BOTH processes at the same
+iteration mid-epoch (the deterministic SPMD analogue of a maintenance
+event); the supervisor restores the agreed checkpoint and finishes the
+budget. Phase "clean": the same run uninterrupted. The test asserts the
+two phases' hashes match on both processes — kill-and-resume is
+bit-identical at pod scale."""
+
+import hashlib
+import os
+import sys
+
+
+def tree_hash(leaves):
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in leaves:
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    coord, n_proc, pid, phase, ckdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:  # CPU collectives need gloo (see parallel/multihost.py)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n_proc, process_id=pid)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+    from deeplearning4j_tpu.resilience import (
+        FaultPlan, Supervisor, SupervisorConfig)
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer.Builder(nOut=8, activation="tanh")
+                       .build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .build())
+                .setInputType(InputType.feedForward(4))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    data = [(X[i:i + 8], y[i:i + 8]) for i in range(0, 32, 8)]
+
+    # preempt BOTH processes after iteration 5 (mid-epoch: 4 iters/epoch)
+    faults = FaultPlan().preempt_at(5) if phase == "faulted" else None
+    sup = Supervisor(
+        build, ckdir,
+        config=SupervisorConfig(max_restarts=2, backoff_base=0.0),
+        runner_factory=lambda net: ShardedTrainer(net),
+        faults=faults,
+        everyNIterations=2, keepLast=3, sharded=True, asyncSave=True)
+    net = sup.run(data, epochs=3)
+
+    leaves = jax.tree_util.tree_leaves(net._params) + \
+        jax.tree_util.tree_leaves(net._opt_states)
+    host = [np.asarray(jax.device_get(v)) for v in leaves]
+    print(f"RESTARTS {sup.restarts} {','.join(sup.reasons) or '-'}",
+          flush=True)
+    print(f"ITER {net._iteration}", flush=True)
+    print(f"HASH {tree_hash(host)}", flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

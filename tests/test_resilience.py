@@ -1,0 +1,554 @@
+"""Resilience subsystem tests (ISSUE 5): async checkpointing (snapshot
+stall, supersede, crash-safe commits), the training supervisor
+(kill-and-resume bit-identity, bounded restarts + backoff, watchdog
+stalls), the deterministic fault-injection harness, latest_agreed, and
+the /healthz resilience readiness section."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.nn import (
+    DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer)
+from deeplearning4j_tpu.optimize.updaters import Adam
+from deeplearning4j_tpu.parallel import ElasticTrainer, PreemptionCheckpoint
+from deeplearning4j_tpu.resilience import (
+    AsyncCheckpointer, FaultPlan, InjectedCheckpointIOError,
+    RestartBudgetExceeded, Supervisor, SupervisorConfig, latest_agreed)
+from deeplearning4j_tpu.resilience import async_ckpt, faults as faults_mod
+from deeplearning4j_tpu.resilience import supervisor as supervisor_mod
+from deeplearning4j_tpu.telemetry import MetricsRegistry, flight, health
+
+
+@pytest.fixture(autouse=True)
+def clean_resilience_state():
+    """Fresh commit bookkeeping + supervisor status + flight ring per
+    test (module-level state leaks across tests otherwise)."""
+    async_ckpt.reset_state()
+    supervisor_mod.reset_status()
+    health.reset_status()
+    flight.get_recorder().clear()
+    yield
+    async_ckpt.reset_state()
+    supervisor_mod.reset_status()
+    health.reset_status()
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = telemetry.set_registry(reg)
+    telemetry.enable()
+    yield reg
+    telemetry.set_registry(prev)
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer.Builder(nOut=8, activation="tanh").build())
+            .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                   .build())
+            .setInputType(InputType.feedForward(4))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _data(n=32, batch=8):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return [(X[i:i + batch], y[i:i + batch]) for i in range(0, n, batch)]
+
+
+def _params_equal(a_net, b_net):
+    for a, b in zip(a_net._params, b_net._params):
+        for k in a:
+            if not np.array_equal(np.asarray(a[k]), np.asarray(b[k])):
+                return False
+    return True
+
+
+def _opt_equal(a_net, b_net):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a_net._opt_states)
+    lb = jax.tree_util.tree_leaves(b_net._opt_states)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+# ---------------------------------------------------------------------------
+
+class TestAsyncCheckpointer:
+    def test_async_checkpoints_restorable_and_rotated(self, tmp_path):
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            keepLast=2, asyncSave=True)
+        tr.fit(_data(), epochs=6)   # 24 iterations
+        tr.close()
+        cps = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+        assert 1 <= len(cps) <= 2
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+        # the final (synchronous, durable) write holds the live state
+        resumed = ElasticTrainer.resume(str(tmp_path))
+        assert resumed.net._iteration == net._iteration
+        assert _params_equal(net, resumed.net)
+        assert _opt_equal(net, resumed.net)
+
+    def test_supersede_keeps_newest(self, tmp_path, fresh_registry,
+                                    monkeypatch):
+        """While the writer is busy, queued snapshots are superseded by
+        newer ones — the queue never grows beyond one and the newest
+        submitted state is the one that lands."""
+        ck = AsyncCheckpointer(str(tmp_path), keepLast=10)
+        orig_write = ck._write
+        gate = {"block": True}
+
+        def slow_write(snap):
+            while gate["block"]:
+                time.sleep(0.005)
+            orig_write(snap)
+
+        monkeypatch.setattr(ck, "_write", slow_write)
+        net = _net()
+        ck.checkpoint(net, 1)      # writer picks this up and blocks
+        time.sleep(0.05)
+        ck.checkpoint(net, 2)      # queued
+        ck.checkpoint(net, 3)      # supersedes 2
+        ck.checkpoint(net, 4)      # supersedes 3
+        gate["block"] = False
+        assert ck.drain(timeout=10.0)
+        ck.close()
+        names = sorted(os.listdir(tmp_path))
+        assert "checkpoint_0000000004.zip" in names
+        assert "checkpoint_0000000002.zip" not in names
+        assert fresh_registry.counter(
+            "dl4j_ckpt_superseded_total").value == 2
+        assert fresh_registry.gauge(
+            "dl4j_ckpt_async_queue_depth").value == 0
+
+    def test_commit_fault_never_exposes_partial(self, tmp_path):
+        """An injected crash between snapshot and commit leaves latest()
+        at the previous checkpoint and only a .tmp remnant behind."""
+        plan = FaultPlan().io_error_at(step=8, phase="commit")
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            keepLast=10, asyncSave=True, faults=plan)
+        tr.fit(_data(), epochs=2)   # ckpts at 4, 8(fails), final 8(sync)
+        tr.close()
+        assert plan.fired("io_error") == [("io_error", 8)]
+        # the failed write left no partial zip under the real name: the
+        # final durable write recreated step 8's file afterwards, so
+        # every .zip present must be a loadable checkpoint
+        for f in sorted(os.listdir(tmp_path)):
+            if f.endswith(".zip"):
+                ElasticTrainer.resume(str(tmp_path))  # loads newest
+        resumed = ElasticTrainer.resume(str(tmp_path))
+        assert resumed.net._iteration == 8
+
+    def test_write_fault_keeps_previous_latest(self, tmp_path,
+                                               fresh_registry):
+        """Async write-phase failure: training continues, latest() stays
+        at the previous good checkpoint, the failure is counted."""
+        plan = FaultPlan().io_error_at(step=8, phase="write")
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            keepLast=10, asyncSave=True, faults=plan)
+        try:
+            tr.fit(_data(), epochs=2)
+        finally:
+            tr.close()
+        assert plan.fired("io_error") == [("io_error", 8)]
+        assert fresh_registry.counter(
+            "dl4j_ckpt_failures_total", labelnames=("phase",)).labels(
+                phase="write").value == 1
+        kinds = [e["kind"] for e in flight.get_recorder().events()]
+        assert "checkpoint_failure" in kinds
+
+    def test_snapshot_stall_under_10pct_of_write(self, tmp_path,
+                                                 fresh_registry):
+        """Acceptance: the train-loop stall per checkpoint (device-side
+        snapshot) is <= 10% of the synchronous write cost at MNIST
+        scale, measured via the write-duration instruments."""
+        conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer.Builder(nOut=256, activation="relu")
+                       .build())
+                .layer(DenseLayer.Builder(nOut=256, activation="relu")
+                       .build())
+                .layer(OutputLayer.Builder().nOut(10)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(784))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(128, 784)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)]
+        data = [(X, y)] * 10
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=2,
+                            keepLast=2, asyncSave=True)
+        tr.fit(data, epochs=2)    # warm: train step, cloner, writer path
+        fresh_registry.reset()
+        tr.fit(data, epochs=4)    # measured, steady state
+        tr.close()
+        snap = fresh_registry.histogram("dl4j_ckpt_snapshot_seconds")
+        write = fresh_registry.histogram(
+            "dl4j_ckpt_write_seconds", labelnames=("mode",)).labels(
+                mode="async")
+        assert snap.count >= 5 and write.count >= 3
+        stall = snap.sum / snap.count
+        write_cost = write.sum / write.count
+        assert stall <= 0.10 * write_cost, (
+            f"per-checkpoint stall {stall * 1e3:.2f} ms > 10% of the "
+            f"{write_cost * 1e3:.2f} ms write cost")
+
+    def test_sync_sharded_commit_fault_fires(self, tmp_path):
+        """The commit-phase fault seam reaches the synchronous sharded
+        writer too: the manifest rename fails, the directory stays
+        incomplete, and latest_agreed skips it."""
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+        plan = FaultPlan().io_error_at(step=8, phase="commit")
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            keepLast=10, sharded=True, faults=plan,
+                            runner=ShardedTrainer(net))
+        with pytest.raises(InjectedCheckpointIOError):
+            tr.fit(_data(), epochs=2)
+        assert plan.fired("io_error") == [("io_error", 8)]
+        agreed = latest_agreed(str(tmp_path))
+        assert agreed and agreed.endswith("checkpoint_0000000004")
+
+    def test_standalone_checkpointer_rotates(self, tmp_path):
+        """AsyncCheckpointer used directly (no ElasticTrainer) honors
+        keepLast and sweeps stale tmps."""
+        (tmp_path / "checkpoint_0000000000.zip.tmp").write_bytes(b"x")
+        ck = AsyncCheckpointer(str(tmp_path), keepLast=2)
+        net = _net()
+        for step in (1, 2, 3, 4):
+            ck.checkpoint(net, step)
+            assert ck.drain(timeout=10.0)
+        ck.close()
+        names = sorted(os.listdir(tmp_path))
+        zips = [n for n in names if n.endswith(".zip")]
+        assert zips == ["checkpoint_0000000003.zip",
+                        "checkpoint_0000000004.zip"]
+        assert not [n for n in names if n.endswith(".tmp")]
+
+    def test_checkpoints_bit_identical_to_sync_mode(self, tmp_path):
+        """Async and sync artifacts for the same step restore to the
+        same state (interchangeable layouts)."""
+        net_a, net_b = _net(), _net()
+        da, db = str(tmp_path / "a"), str(tmp_path / "b")
+        ElasticTrainer(net_a, da, everyNIterations=4,
+                       asyncSave=True).fit(_data(), epochs=2)
+        ElasticTrainer(net_b, db, everyNIterations=4,
+                       asyncSave=False).fit(_data(), epochs=2)
+        ra = ElasticTrainer.resume(da)
+        rb = ElasticTrainer.resume(db)
+        assert ra.net._iteration == rb.net._iteration
+        assert _params_equal(ra.net, rb.net)
+        assert _opt_equal(ra.net, rb.net)
+
+
+class TestLatestAgreed:
+    def test_zip_checkpoints_are_atomic(self, tmp_path):
+        net = _net()
+        ElasticTrainer(net, str(tmp_path), everyNIterations=4).fit(
+            _data(), epochs=2)
+        assert latest_agreed(str(tmp_path)) == \
+            ElasticTrainer.latest(str(tmp_path))
+        # tmp remnants are never candidates
+        open(os.path.join(tmp_path, "checkpoint_0000009999.zip.tmp"),
+             "w").close()
+        assert latest_agreed(str(tmp_path)).endswith(
+            "checkpoint_0000000008.zip")
+
+    def test_sharded_incomplete_dir_skipped(self, tmp_path):
+        from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4,
+                            sharded=True, runner=ShardedTrainer(net))
+        tr.fit(_data(), epochs=2)
+        agreed = latest_agreed(str(tmp_path))
+        assert agreed and os.path.isdir(agreed)
+        # simulate a host that never finished: delete a shard file the
+        # manifest references from a NEWER fake checkpoint
+        import shutil
+
+        broken = os.path.join(tmp_path, "checkpoint_0000099999")
+        shutil.copytree(agreed, broken)
+        os.remove(os.path.join(broken, "shard_0.npz"))
+        assert latest_agreed(str(tmp_path)) == agreed
+        # and a manifest-less directory is skipped outright
+        empty = os.path.join(tmp_path, "checkpoint_0000099998")
+        os.makedirs(empty)
+        assert latest_agreed(str(tmp_path)) == agreed
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class TestSupervisorResume:
+    def test_kill_and_resume_bit_identical(self, tmp_path):
+        """Acceptance: fault-injected preemption mid-epoch; the
+        supervisor resumes and the final params / updater state are
+        bit-identical to an uninterrupted run at the same step."""
+        ref = _net()
+        ElasticTrainer(ref, str(tmp_path / "ref"),
+                       everyNIterations=1000).fit(_data(), epochs=4)
+
+        plan = FaultPlan().preempt_at(7)   # mid-epoch: 4 iters/epoch
+        sup = Supervisor(
+            _net, str(tmp_path / "sup"),
+            config=SupervisorConfig(max_restarts=2, backoff_base=0.0),
+            faults=plan, everyNIterations=3, asyncSave=True)
+        net = sup.run(_data(), epochs=4)
+        assert sup.restarts == 1 and sup.reasons == ["preemption"]
+        assert plan.fired("preempt") == [("preempt", 7)]
+        assert net._iteration == ref._iteration == 16
+        assert _params_equal(ref, net)
+        assert _opt_equal(ref, net)
+
+    def test_loss_scaler_state_survives_resume(self, tmp_path):
+        """The dynamic loss-scale rides checkpoints: a resumed
+        bf16_mixed run carries the same scaler state as an
+        uninterrupted one (bit-identical params included)."""
+        def build(seed=3):
+            conf = (NeuralNetConfiguration.Builder().seed(seed)
+                    .updater(Adam(1e-2)).precision("bf16_mixed").list()
+                    .layer(DenseLayer.Builder(nOut=8, activation="tanh")
+                           .build())
+                    .layer(OutputLayer.Builder().nOut(2)
+                           .activation("softmax").build())
+                    .setInputType(InputType.feedForward(4))
+                    .build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            return net
+
+        ref = build()
+        ElasticTrainer(ref, str(tmp_path / "ref"),
+                       everyNIterations=1000).fit(_data(), epochs=3)
+        plan = FaultPlan().preempt_at(6)
+        sup = Supervisor(
+            build, str(tmp_path / "sup"),
+            config=SupervisorConfig(max_restarts=2, backoff_base=0.0),
+            faults=plan, everyNIterations=2)
+        net = sup.run(_data(), epochs=3)
+        assert sup.restarts == 1
+        assert _params_equal(ref, net)
+        for k in ref._prec_state:
+            assert np.asarray(ref._prec_state[k]) == \
+                np.asarray(net._prec_state[k]), k
+
+    def test_sharded_checkpoint_carries_scaler_state(self, tmp_path):
+        """The dynamic loss-scale also rides the SHARDED tree (a pod
+        resume must not restart at init_scale)."""
+        from deeplearning4j_tpu.utils import ModelSerializer
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .precision("bf16_mixed").list()
+                .layer(DenseLayer.Builder(nOut=8, activation="tanh")
+                       .build())
+                .layer(OutputLayer.Builder().nOut(2).activation("softmax")
+                       .build())
+                .setInputType(InputType.feedForward(4))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.fit(_data(), 2)
+        d = str(tmp_path / "ck")
+        ModelSerializer.writeModel(net, d, True, sharded=True)
+        restored = ModelSerializer.restoreMultiLayerNetwork(
+            d, True, sharded=True)
+        for k in net._prec_state:
+            assert np.asarray(net._prec_state[k]) == \
+                np.asarray(restored._prec_state[k]), k
+
+    def test_data_error_restart_completes(self, tmp_path):
+        plan = FaultPlan().data_error_at(batch=6)
+        sup = Supervisor(
+            _net, str(tmp_path),
+            config=SupervisorConfig(max_restarts=2, backoff_base=0.0),
+            faults=plan, everyNIterations=2)
+        net = sup.run(_data(), epochs=3)
+        assert sup.restarts == 1 and sup.reasons == ["exception"]
+        assert plan.fired("data_error") == [("data_error", 6)]
+        assert net._iteration == 12
+
+    def test_restart_budget_and_backoff(self, tmp_path, fresh_registry):
+        """A recurring divergence exhausts the bounded restart budget
+        with exponential backoff, visible in /metrics."""
+        from deeplearning4j_tpu.utils.listeners import HealthListener
+
+        bad = _data()
+        Xb, yb = bad[2]
+        Xb = Xb.copy()
+        Xb[0, 0] = np.inf
+        bad[2] = (Xb, yb)
+        sleeps = []
+        sup = Supervisor(
+            _net, str(tmp_path),
+            config=SupervisorConfig(max_restarts=2, backoff_base=0.1,
+                                    backoff_factor=2.0),
+            setup=lambda n: n.setListeners(HealthListener(policy="halt")),
+            sleep=sleeps.append, everyNIterations=2)
+        with pytest.raises(RestartBudgetExceeded) as ei:
+            sup.run(bad, epochs=2)
+        assert ei.value.reason == "divergence" and ei.value.restarts == 3
+        assert sleeps == [0.1, 0.2]   # exponential, capped by budget
+        assert fresh_registry.counter(
+            "dl4j_resilience_restarts_total",
+            labelnames=("reason",)).labels(reason="divergence").value == 3
+        kinds = [e["kind"] for e in flight.get_recorder().events()]
+        assert "restart" in kinds and "backoff" in kinds
+
+    def test_watchdog_stall_aborts_and_resumes(self, tmp_path,
+                                               fresh_registry):
+        """An injected stall trips the watchdog: flight dump, controlled
+        abort (checkpoint-then-exit), restart with reason=stall, run
+        completes."""
+        plan = FaultPlan().stall_at(5, seconds=60.0)
+        sup = Supervisor(
+            _net, str(tmp_path),
+            config=SupervisorConfig(max_restarts=2, backoff_base=0.0,
+                                    stall_timeout=0.6, stall_poll=0.1),
+            faults=plan, everyNIterations=2)
+        t0 = time.monotonic()
+        net = sup.run(_data(), epochs=3)
+        assert time.monotonic() - t0 < 30.0   # did not sit out the stall
+        assert sup.reasons == ["stall"]
+        assert net._iteration == 12
+        assert fresh_registry.counter(
+            "dl4j_resilience_restarts_total",
+            labelnames=("reason",)).labels(reason="stall").value == 1
+        kinds = [e["kind"] for e in flight.get_recorder().events()]
+        assert "stall" in kinds
+
+    def test_success_without_faults_no_restarts(self, tmp_path):
+        sup = Supervisor(_net, str(tmp_path),
+                         config=SupervisorConfig(max_restarts=1),
+                         everyNIterations=4)
+        net = sup.run(_data(), epochs=2)
+        assert sup.restarts == 0 and net._iteration == 8
+        st = supervisor_mod.status()
+        assert st["state"] == "completed" and st["restarts"] == 0
+
+
+class TestFaultPlan:
+    def test_events_fire_once_and_log(self):
+        plan = FaultPlan().crash_at(3).crash_at(5, times=2)
+        plan.on_iteration(1)
+        with pytest.raises(faults_mod.InjectedCrash):
+            plan.on_iteration(3)
+        plan.on_iteration(3)   # consumed: no refire on replayed steps
+        for _ in range(2):
+            with pytest.raises(faults_mod.InjectedCrash):
+                plan.on_iteration(5)
+        plan.on_iteration(5)
+        assert plan.fired("crash") == [("crash", 3), ("crash", 5),
+                                       ("crash", 5)]
+
+    def test_io_error_phase_selective(self):
+        plan = FaultPlan().io_error_at(step=4, phase="commit")
+        plan.check_write(4, "write")    # wrong phase: does not fire
+        with pytest.raises(InjectedCheckpointIOError):
+            plan.check_write(4, "commit")
+        plan.check_write(4, "commit")   # consumed
+
+    def test_random_steps_deterministic(self):
+        a = FaultPlan(seed=11).random_steps(4, 100)
+        b = FaultPlan(seed=11).random_steps(4, 100)
+        c = FaultPlan(seed=12).random_steps(4, 100)
+        assert a == b and a != c and all(1 <= s <= 100 for s in a)
+
+    def test_stall_breaks_on_abort(self):
+        plan = FaultPlan().stall_at(1, seconds=60.0)
+        plan.abort_event.set()
+        t0 = time.monotonic()
+        plan.on_iteration(1)
+        assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# healthz + metrics surface
+# ---------------------------------------------------------------------------
+
+class TestHealthzResilience:
+    def test_checkpoint_staleness_degrades_not_503(self, fresh_registry,
+                                                   tmp_path):
+        net = _net()
+        tr = ElasticTrainer(net, str(tmp_path), everyNIterations=4)
+        tr.fit(_data(), epochs=2)
+        payload, status = health.healthz()
+        assert status == 200
+        assert payload["resilience"]["checkpoint"]["stale"] is False
+        assert payload["status"] == "ok"
+        # age the last commit past 2x the expected interval DURING an
+        # active fit: degraded, still 200 (stale checkpoints inform
+        # operators, they do not stop traffic)
+        with async_ckpt._lock:
+            async_ckpt._state["last"]["ts"] -= 3600.0
+        async_ckpt.mark_active()
+        try:
+            payload, status = health.healthz()
+        finally:
+            async_ckpt.mark_idle()
+        assert status == 200
+        assert payload["status"] == "degraded"
+        ck = payload["resilience"]["checkpoint"]
+        assert ck["stale"] is True and ck["age_seconds"] >= 3600.0
+        assert "detail" in payload["resilience"]
+        # idle again: the finished run's aging checkpoint is NOT a
+        # degradation (nothing more is expected to land)
+        payload, status = health.healthz()
+        assert status == 200 and payload["status"] == "ok"
+
+    def test_supervisor_state_in_healthz(self, fresh_registry, tmp_path):
+        sup = Supervisor(_net, str(tmp_path), everyNIterations=4)
+        sup.run(_data(), epochs=1)
+        payload, _ = health.healthz()
+        assert payload["resilience"]["supervisor"]["state"] == "completed"
+
+    def test_age_gauge_refreshes_on_read(self, fresh_registry, tmp_path):
+        async_ckpt.note_commit(str(tmp_path / "x.zip"), 5, 0.01, "sync",
+                               registry=fresh_registry)
+        g = fresh_registry.gauge("dl4j_ckpt_age_seconds")
+        assert g.value == 0.0
+        with async_ckpt._lock:
+            async_ckpt._state["last"]["ts"] -= 10.0
+        async_ckpt.refresh_metrics()
+        assert g.value >= 10.0
+
+    def test_metric_names_documented(self):
+        """The new dl4j_ckpt_* / dl4j_resilience_* names pass the drift
+        check (prefix + documented in docs/OBSERVABILITY.md)."""
+        import pathlib
+        import sys as _sys
+
+        tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+        _sys.path.insert(0, str(tools))
+        try:
+            import check_metrics
+
+            names = check_metrics.collect_metric_names()
+            assert "dl4j_ckpt_age_seconds" in names
+            assert "dl4j_resilience_restarts_total" in names
+            assert check_metrics.check(names) == []
+        finally:
+            _sys.path.remove(str(tools))
