@@ -267,6 +267,157 @@ def bench_resnet_etl():
     }
 
 
+def bench_etl(n_images=256, side=224):
+    """Streaming-ETL engine scaling curve (ISSUE 6 acceptance): img/s of
+    the persistent-pool + shm-ring pipeline at 1/2/4/8 workers on a
+    synthetic 224x224 JPEG tree, against the legacy single-worker
+    equivalent path (per-image full bilinear resize to float32 + a
+    pickled-float32 IPC roundtrip per batch — the cost model of the
+    pre-ISSUE-6 iterator that recorded 210.9 img/s), plus the trainer
+    etl-wait fraction at MNIST scale with and without the
+    DevicePrefetcher."""
+    import os
+    import pickle
+    import shutil
+    import tempfile
+    import time as _t
+
+    from PIL import Image
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.datasets import (
+        FileSplit, ParallelImageDataSetIterator, set_default_depth)
+    from deeplearning4j_tpu.datasets.image import (
+        NativeImageLoader, _bilinear_resize_chw)
+
+    root = tempfile.mkdtemp(prefix="bench_etl_")
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b"):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(n_images // 2):
+            arr = rng.integers(0, 255, (side, side, 3), np.uint8)
+            Image.fromarray(arr, "RGB").save(
+                os.path.join(d, f"{i}.jpg"), quality=85)
+    files = sorted(os.path.join(root, c, f)
+                   for c in ("a", "b")
+                   for f in os.listdir(os.path.join(root, c)))
+    batch = 64
+
+    # -- legacy equivalent: the pre-rebuild per-image pipeline ---------------
+    loader = NativeImageLoader(side, side, 3)
+    t0 = _t.perf_counter()
+    for lo in range(0, n_images, batch):
+        feats = []
+        for p in files[lo:lo + batch]:
+            hwc = loader._decode_hwc(p)
+            feats.append(_bilinear_resize_chw(hwc, side, side))
+        arr = np.stack(feats).astype(np.float32)
+        arr = pickle.loads(pickle.dumps(arr))  # the mp.Queue byte cost
+    legacy = n_images / (_t.perf_counter() - t0)
+
+    # -- the new engine: serial baseline + 1/2/4/8-worker pool curve ---------
+    def epoch_rate(**kw):
+        it = ParallelImageDataSetIterator(
+            FileSplit(root), side, side, 3, batchSize=batch, **kw)
+        # warm epoch: pool fork + page cache; the persistent pool makes
+        # epoch 2+ the steady state an epoch-boundary refork would hide
+        for _ in it:
+            pass
+        best = 0.0
+        for _ in range(2):   # best-of-2: the shared CI host is noisy
+            it.reset()
+            t0 = _t.perf_counter()
+            count = 0
+            for ds in it:
+                count += np.asarray(ds.getFeatures()).shape[0]
+            best = max(best, count / (_t.perf_counter() - t0))
+        it.close()
+        return round(best, 1)
+
+    serial = epoch_rate(transport="serial")
+    # uint8 output = the streaming configuration (decode stays uint8 end
+    # to end, normalize happens on device via DevicePrefetcher)
+    curve = {w: epoch_rate(numWorkers=w, transport="shm",
+                           floatOutput=False)
+             for w in (1, 2, 4, 8)}
+    float_out_8 = epoch_rate(numWorkers=8, transport="shm")
+    shutil.rmtree(root, ignore_errors=True)
+
+    # -- trainer etl-wait fraction at MNIST scale ----------------------------
+    # blocking = the trainer eats split+pad+mask+transfer at every
+    # next(); prefetch = the DevicePrefetcher does that in its producer
+    # thread and the trainer pops a staged device batch. (On a CPU
+    # backend the jitted step itself saturates the host cores, so a
+    # decode-heavy input pipeline cannot truly overlap — the img/s curve
+    # above carries that contention; this measurement isolates the
+    # prefetcher's steady-state wait at MNIST scale, where input prep is
+    # cheaper than the step, i.e. the regime a fed chip runs in.)
+    from deeplearning4j_tpu.datasets import MnistDataSetIterator
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    def wait_fraction(depth):
+        telemetry.get_registry().reset()
+        set_default_depth(depth)
+        try:
+            conf = (NeuralNetConfiguration.Builder().seed(0)
+                    .updater(Adam(1e-3)).list()
+                    .layer(DenseLayer.Builder(nOut=256,
+                                              activation="relu").build())
+                    .layer(DenseLayer.Builder(nOut=256,
+                                              activation="relu").build())
+                    .layer(OutputLayer.Builder().nOut(10)
+                           .activation("softmax").build())
+                    .setInputType(InputType.feedForward(784))
+                    .build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            it = MnistDataSetIterator(128, num_examples=2048)
+            net.fit(it, 3)
+            reg = telemetry.get_registry()
+            etl = reg.histogram("dl4j_etl_wait_seconds",
+                                labelnames=("loop",)).labels(loop="fit")
+            step = reg.histogram("dl4j_step_seconds",
+                                 labelnames=("loop",)).labels(loop="fit")
+            return etl.sum / max(step.sum, 1e-9)
+        finally:
+            set_default_depth(2)
+            telemetry.get_registry().reset()
+
+    blocking_frac = wait_fraction(0)
+    prefetch_frac = wait_fraction(2)
+
+    w8 = curve[8]
+    return {
+        "metric": "etl_img_per_sec_8_workers",
+        "value": w8,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "img_per_sec_by_workers": curve,
+        "img_per_sec_serial": serial,
+        "img_per_sec_8_workers_float_out": float_out_8,
+        "legacy_single_worker_img_per_sec": round(legacy, 1),
+        "speedup_vs_legacy_at_8_workers": round(w8 / legacy, 2),
+        "etl_wait_fraction_blocking": round(blocking_frac, 4),
+        "etl_wait_fraction_prefetch": round(prefetch_frac, 4),
+        "host_cores": os.cpu_count(),
+        "note": (f"{n_images} synthetic {side}x{side} JPEGs, batch "
+                 f"{batch}; steady-state epoch (persistent pool, warm "
+                 "page cache); curve is the uint8-to-device "
+                 "configuration over the shm ring; legacy = pre-ISSUE-6 "
+                 "path (full bilinear resize to f32 + pickled-f32 IPC) "
+                 "at 1 worker; wait fractions are "
+                 "sum(dl4j_etl_wait)/sum(dl4j_step) for a 784-256-256-10 "
+                 "MLP on MNIST, batch 128, DevicePrefetcher off/on; "
+                 "worker counts above host_cores oversubscribe, and on "
+                 "the CPU backend the step itself occupies the cores "
+                 "the decode workers need"),
+    }
+
+
 def bench_graves_lstm():
     """Char-RNN throughput + fraction-of-peak (VERDICT round-2 item 6;
     r3 item 5 closed by the r4 slope-timing correction).
@@ -718,6 +869,7 @@ def bench_resilience(steps_per_epoch=10, epochs=4, every=2):
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
+               ("etl", bench_etl),
                ("graves_lstm", bench_graves_lstm),
                ("word2vec", bench_word2vec),
                ("serving_latency", bench_serving_latency),

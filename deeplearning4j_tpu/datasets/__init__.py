@@ -30,4 +30,7 @@ from deeplearning4j_tpu.datasets.image import (  # noqa: F401
     PathLabelGenerator, PipelineImageTransform, ResizeImageTransform,
     ScaleImageTransform)
 from deeplearning4j_tpu.datasets.parallel_etl import (  # noqa: F401
-    LocalTransformExecutor, ParallelImageDataSetIterator)
+    EtlWorkerPool, LocalTransformExecutor, ParallelImageDataSetIterator,
+    shared_pool)
+from deeplearning4j_tpu.datasets.prefetch import (  # noqa: F401
+    DeviceBatch, DevicePrefetcher, default_depth, set_default_depth)

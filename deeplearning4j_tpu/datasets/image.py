@@ -68,15 +68,9 @@ class NativeImageLoader:
     def __init__(self, height, width, channels=3):
         self.height, self.width, self.channels = height, width, channels
 
-    def asMatrix(self, path_or_image) -> np.ndarray:
-        """Resize semantics are classic half-pixel-center bilinear (OpenCV
-        INTER_LINEAR — what the reference's NativeImageLoader does), NOT
-        PIL's antialiased downscale. The native kernel and the numpy
-        fallback implement the SAME math, so pixel values do not depend
-        on whether the g++ toolchain was present. PIL is used only to
-        decode files and convert color modes."""
-        from deeplearning4j_tpu import native
-
+    def _decode_hwc(self, path_or_image) -> np.ndarray:
+        """Decode + color-convert to [H,W,C] uint8 at SOURCE resolution
+        (no resize)."""
         img = path_or_image
         if isinstance(img, np.ndarray):
             if img.dtype != np.uint8:
@@ -107,11 +101,41 @@ class NativeImageLoader:
                     f"{self.channels} channels")
         if hwc.shape[0] == 0 or hwc.shape[1] == 0:
             raise ValueError(f"empty image {hwc.shape}")
+        return hwc
+
+    def asMatrix(self, path_or_image) -> np.ndarray:
+        """Resize semantics are classic half-pixel-center bilinear (OpenCV
+        INTER_LINEAR — what the reference's NativeImageLoader does), NOT
+        PIL's antialiased downscale. The native kernel and the numpy
+        fallback implement the SAME math, so pixel values do not depend
+        on whether the g++ toolchain was present. PIL is used only to
+        decode files and convert color modes."""
+        from deeplearning4j_tpu import native
+
+        hwc = self._decode_hwc(path_or_image)
+        if hwc.shape[0] == self.height and hwc.shape[1] == self.width:
+            # identity resize: half-pixel-center bilinear at 1:1 scale
+            # maps every output pixel exactly onto its source pixel
+            # (fy = i, wy = 0), so the interpolation reduces to a cast
+            return np.ascontiguousarray(
+                hwc.transpose(2, 0, 1)).astype(np.float32)
         if native.available():
             chw = native.resize_hwc_to_chw(hwc, self.height, self.width)
             if chw is not None:
                 return chw
         return _bilinear_resize_chw(hwc, self.height, self.width)
+
+    def asBytes(self, path_or_image) -> np.ndarray | None:
+        """[C,H,W] uint8 when the decoded image is ALREADY exactly
+        height x width (no resample needed), else None. The uint8 form
+        is bit-faithful: ``asBytes(p).astype(float32) == asMatrix(p)``
+        whenever it is available, which is what lets ETL workers ship
+        quarter-size decode output over IPC and defer the float cast to
+        the consumer (or the device) without changing a single pixel."""
+        hwc = self._decode_hwc(path_or_image)
+        if hwc.shape[0] == self.height and hwc.shape[1] == self.width:
+            return np.ascontiguousarray(hwc.transpose(2, 0, 1))
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,306 @@
+"""Double-buffered host->device prefetch (ISSUE 6 piece 3).
+
+:class:`DevicePrefetcher` wraps ANY :class:`DataSetIterator` — the
+``AsyncDataSetIterator`` analog pushed one level further down: a
+background thread pulls batches from the base iterator, runs an
+optional host-side ``prepare`` step (padding, masks), issues
+``jax.device_put`` and an optional jitted on-device transform
+(e.g. uint8 -> float normalize), and stages up to ``depth`` batches in
+a bounded queue. The H2D copy for batch *k+1* (and *k+2*, ...) overlaps
+the device compute of batch *k*, so in steady state the trainer's
+etl-wait collapses to a queue pop.
+
+Donation safety: every staged batch is a FRESH device buffer produced
+by ``device_put`` in the producer thread; the prefetcher never touches
+a batch again after handing it to the consumer, so the trainers'
+donated-input patterns (and the PR-5 snapshot-clone rule: never hand a
+buffer to two owners) hold.
+
+The trainers auto-wrap plain iterators (``MultiLayerNetwork.fit`` and
+single-process ``ShardedTrainer.fit``) when ``default_depth() > 0``;
+``set_default_depth(0)`` (or ``DL4J_PREFETCH_DEPTH=0``) restores the
+blocking path.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+_depth = [max(0, int(os.environ.get("DL4J_PREFETCH_DEPTH", "2")))]
+
+
+def default_depth() -> int:
+    """Prefetch depth trainers use when auto-wrapping iterators
+    (0 disables auto-wrap)."""
+    return _depth[0]
+
+
+def set_default_depth(n: int):
+    _depth[0] = max(0, int(n))
+
+
+class DeviceBatch:
+    """A training batch staged on device by a trainer-specific
+    ``prepare`` callback: features/labels/mask are device arrays, and
+    the trainer's fit loop consumes them without the usual host-side
+    pad/mask/transfer work. ``bucket`` is the padded batch-axis size
+    (``MultiLayerNetwork``'s executable bucket); ``real`` the number of
+    non-padding rows (``ShardedTrainer``'s example accounting)."""
+
+    __slots__ = ("features", "labels", "mask", "bucket", "real")
+
+    def __init__(self, features, labels, mask, bucket=None, real=None):
+        self.features = features
+        self.labels = labels
+        self.mask = mask
+        self.bucket = bucket
+        self.real = real
+
+
+class DevicePrefetcher(DataSetIterator):
+    """Background host->device staging around any DataSetIterator.
+
+    - ``depth``: max batches in flight (the double buffer; >=1);
+    - ``prepare``: optional ``DataSet -> DataSet | DeviceBatch`` run in
+      the producer thread (trainers inject their pad+mask+device_put
+      pipelines; default stages features/labels with ``device_put``);
+    - ``deviceTransform``: optional jitted ``(features) -> features``
+      applied after the transfer — the "normalize/augment-to-float on
+      device" hook for uint8 pipelines (``floatOutput=False``).
+
+    Ordering is the base iterator's order (single producer, FIFO
+    queue); backpressure is the bounded queue. ``reset()`` restarts the
+    producer (draining any stale generation); ``close()`` stops it.
+    """
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, depth: int | None = None,
+                 prepare=None, deviceTransform=None, loop="prefetch"):
+        super().__init__(base.batch())
+        self._base = base
+        self._depth = max(1, depth if depth is not None
+                          else (default_depth() or 2))
+        self._prepare = prepare
+        self._device_transform = deviceTransform
+        self._loop = loop
+        self._gen = 0
+        self._queue = None
+        self._thread = None
+        self._error = None
+        self._done = False
+        self._closed = False
+        self._tele = None
+        self._tele_bound = False
+
+    # -- delegation ----------------------------------------------------------
+    def getLabels(self):
+        return self._base.getLabels()
+
+    def totalOutcomes(self):
+        return getattr(self._base, "totalOutcomes", lambda: 0)()
+
+    def set_epoch(self, epoch):
+        if hasattr(self._base, "set_epoch"):
+            self._base.set_epoch(epoch)
+
+    def __len__(self):
+        return len(self._base)
+
+    def resetSupported(self):
+        return self._base.resetSupported()
+
+    def setPreProcessor(self, pp):
+        # preprocessing belongs to the base (it runs in the producer
+        # thread, before staging)
+        self._base.setPreProcessor(pp)
+
+    # -- producer ------------------------------------------------------------
+    def _instruments(self):
+        if not self._tele_bound:
+            from deeplearning4j_tpu import telemetry
+
+            self._tele = telemetry.etl_instruments(self._loop)
+            self._tele_bound = True
+        return self._tele
+
+    def _default_prepare(self, ds):
+        import jax
+
+        f = jax.device_put(ds.getFeatures())
+        if self._device_transform is not None:
+            f = self._device_transform(f)
+        labels = ds.getLabels()
+        out = DataSet(f, jax.device_put(labels)
+                      if labels is not None else None)
+        return out
+
+    def _produce(self, gen, q):
+        prepare = self._prepare or self._default_prepare
+        try:
+            self._base.reset()
+            while self._gen == gen and self._base.hasNext():
+                item = self._base.next()
+                # no blanket fallback here: trainer prepare callbacks
+                # already return the raw DataSet for shapes they do not
+                # handle, so an exception out of prepare is a REAL bug
+                # (OOM in device_put, bad deviceTransform) and surfaces
+                # at next() via the error path instead of silently
+                # degrading every batch to the blocking host path
+                staged = prepare(item)
+                if self._device_transform is not None \
+                        and isinstance(staged, DeviceBatch):
+                    staged.features = self._device_transform(
+                        staged.features)
+                while self._gen == gen:
+                    try:
+                        q.put(staged, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+        except Exception as e:  # surfaced at next()
+            if self._gen == gen:
+                self._error = e
+        finally:
+            while self._gen == gen:
+                try:
+                    q.put(self._END, timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    def _start(self):
+        self._gen += 1
+        self._queue = queue_mod.Queue(maxsize=self._depth)
+        self._error = None
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(self._gen, self._queue),
+            daemon=True, name=f"dl4j-prefetch-{self._loop}")
+        self._thread.start()
+
+    def _stop(self):
+        """Invalidate the current generation and unblock the producer."""
+        self._gen += 1
+        t, q = self._thread, self._queue
+        if t is not None and t.is_alive():
+            # drain so a producer blocked on put() sees the stale gen
+            while t.is_alive():
+                try:
+                    q.get(timeout=0.05)
+                except queue_mod.Empty:
+                    pass
+                t.join(timeout=0.05)
+        self._thread = None
+        self._queue = None
+
+    # -- consumer ------------------------------------------------------------
+    def hasNext(self):
+        if getattr(self, "_closed", False):
+            return False
+        if self._queue is None:
+            self._start()
+        if self._done:
+            return False
+        if getattr(self, "_peek", None) is not None:
+            return True
+        item = self._take()
+        if item is None:
+            return False
+        self._peek = item
+        return True
+
+    def next(self):
+        if getattr(self, "_closed", False):
+            raise StopIteration
+        if getattr(self, "_peek", None) is not None:
+            item, self._peek = self._peek, None
+            return item
+        if self._queue is None:
+            self._start()
+        item = self._take()
+        if item is None:
+            raise StopIteration
+        return item
+
+    def _take(self):
+        if self._done:
+            return None
+        tele = self._instruments()
+        try:
+            item = self._queue.get_nowait()
+            blocked = False
+        except queue_mod.Empty:
+            item = self._queue.get()
+            blocked = True
+        if tele is not None and item is not self._END:
+            # counted AFTER the pop (no qsize race) and never for the
+            # end-of-epoch sentinel: a miss is precisely "the trainer
+            # blocked waiting for a real batch"
+            (tele.prefetch_misses if blocked
+             else tele.prefetch_hits).inc()
+            try:
+                tele.prefetch_depth.set(self._queue.qsize())
+            except NotImplementedError:  # pragma: no cover
+                pass
+        if item is self._END:
+            self._done = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            return None
+        return item
+
+    def reset(self):
+        """Stop the producer; the next consume restarts it. Lazy on
+        purpose: training loops reset iterators more than once per
+        epoch (`_as_batches` + `__iter__`), and an eagerly restarted
+        producer would consume the base iterator's epoch state (epoch
+        counters, augmentation seeds) for a generation that is then
+        immediately discarded."""
+        self._stop()
+        self._peek = None
+
+    def close(self):
+        """Stop the producer thread; the prefetcher is terminal after
+        this (hasNext() False, next() raises — nothing can silently
+        respawn a producer over the base iterator). The base iterator
+        itself is NOT closed — its lifecycle belongs to the caller."""
+        self._stop()
+        self._peek = None
+        self._done = True
+        self._closed = True
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self._stop()
+        except Exception:
+            pass
+
+    # -- multi-batch staging -------------------------------------------------
+    def takeMulti(self, k: int):
+        """Stack the next ``k`` staged batches into device-resident
+        ``[K, batch, ...]`` features/labels for
+        ``MultiLayerNetwork.fitMultiBatch`` (the scan-of-K-steps launch
+        consumes prefetched input without a host bounce). Returns
+        ``(features_k, labels_k)`` or None when fewer than ``k``
+        batches remain."""
+        import jax.numpy as jnp
+
+        feats, labels = [], []
+        for _ in range(k):
+            if not self.hasNext():
+                return None
+            ds = self.next()
+            if isinstance(ds, DeviceBatch):
+                feats.append(ds.features)
+                labels.append(ds.labels)
+            else:
+                feats.append(ds.getFeatures())
+                labels.append(ds.getLabels())
+        return jnp.stack(feats), jnp.stack(labels)

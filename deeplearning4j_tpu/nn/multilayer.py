@@ -393,6 +393,52 @@ class MultiLayerNetwork:
                 hm.flush()
         return losses
 
+    def _prefetch_prepare(self):
+        """The host-side half of the input pipeline, run in the
+        DevicePrefetcher's producer thread: split + pad-to-bucket +
+        mask build + device_put, so the fit loop's per-batch host work
+        collapses to a queue pop. Falls back to the raw DataSet (and
+        the classic host path) for shapes it does not understand."""
+        from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
+
+        def prepare(ds):
+            feats, labels, _, lmasks = _split_dataset_full(ds)
+            if len(feats) != 1 or len(labels) != 1:
+                return ds
+            f = _host_array(feats[0])
+            l = _host_array(labels[0])
+            lmask = (_host_array(lmasks[0], np.float32)
+                     if lmasks[0] is not None else _ones_mask(l))
+            real = f.shape[0]
+            bucket = max(real, self._bucket or 0)
+            if real < bucket:
+                (f, l), lmask, _ = _pad_to_bucket([f, l], lmask, bucket)
+            if f.dtype != np.float32:
+                f = f.astype(np.float32)
+            return DeviceBatch(jax.device_put(f), jax.device_put(l),
+                               jax.device_put(lmask), bucket=bucket,
+                               real=real)
+
+        return prepare
+
+    def _wrap_prefetch(self, data):
+        """Auto-wrap a plain DataSetIterator in a DevicePrefetcher
+        (ISSUE 6: transfer overlaps compute on every consumption path).
+        Returns (data, prefetcher-or-None); callers close() it."""
+        from deeplearning4j_tpu.datasets import prefetch as _prefetch
+        from deeplearning4j_tpu.datasets.iterator import (
+            DataSetIterator as _DSI)
+
+        if (isinstance(data, _DSI)
+                and not isinstance(data, _prefetch.DevicePrefetcher)
+                and data.asyncSupported()
+                and _prefetch.default_depth() > 0
+                and self.conf.backpropType != BackpropType.TruncatedBPTT):
+            wrapped = _prefetch.DevicePrefetcher(
+                data, prepare=self._prefetch_prepare(), loop="fit")
+            return wrapped, wrapped
+        return data, None
+
     def fit(self, data, epochs: int | None = None):
         """fit(iterator) / fit(iterator, nEpochs) / fit(features, labels) /
         fit(DataSet)."""
@@ -405,9 +451,11 @@ class MultiLayerNetwork:
         import time as _time
 
         from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
         from deeplearning4j_tpu.telemetry import health as _health
 
         self._refresh_train_step()
+        data, _prefetcher = self._wrap_prefetch(data)
         params, states, opts = self._params, self._states, self._opt_states
         prec = self._prec_state
         base_key = jax.random.key(self.conf.seed + 1)
@@ -430,88 +478,115 @@ class MultiLayerNetwork:
             pm.baseline_from(prec)
         if hm is not None:
             hm.precision = pm
-        for epoch_i in range(epochs):
-            batches, data = _prepare_batches(data, epoch_i, epochs)
-            batch_iter = iter(batches)
-            while True:
-                if tele is not None:
-                    t_etl = _time.perf_counter()
-                ds = next(batch_iter, None)
-                if ds is None:
-                    break
-                if tele is not None:
-                    tele.record_etl_wait(_time.perf_counter() - t_etl)
-                feats, labels, _, lmasks = _split_dataset_full(ds)
-                f = _host_array(feats[0])
-                l = _host_array(labels[0])
-                # always train with an explicit mask so the jit signature
-                # (and hence the ONE compiled executable) is stable whether
-                # or not the batch is ragged/masked
-                lmask = (_host_array(lmasks[0], np.float32)
-                         if lmasks[0] is not None else _ones_mask(l))
-                if self._bucket is None or f.shape[0] > self._bucket:
-                    self._bucket = f.shape[0]
-                if f.shape[0] < self._bucket:
-                    (f, l), lmask, _ = _pad_to_bucket([f, l], lmask,
-                                                      self._bucket)
-                tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
-                         and self.conf.tbpttLength and f.ndim == 3
-                         and f.shape[2] > self.conf.tbpttLength)
-                if tele is not None:
-                    t_step = _time.perf_counter()
-                if tbptt:
-                    loss, params, states, opts, prec = self._fit_tbptt(
-                        params, states, opts, prec, f, l, lmask, base_key,
-                        hm=hm, pm=pm)
-                else:
-                    it_used = self._iteration
-                    rng = jax.random.fold_in(base_key, it_used)
-                    (loss, params, states, opts, health,
-                     prec) = self._train_step(
-                        params, states, opts, prec, f, l, lmask, rng,
-                        it_used)
-                    self._iteration += 1
-                if tele is not None:
-                    tele.record_step(_time.perf_counter() - t_step,
-                                     f.shape[0])
-                # rebind before anything can observe donated buffers —
-                # including the health monitor, whose HALT policy raises
-                # out of fit(): the caller must find live params to
-                # checkpoint/inspect, not the buffers this step donated
-                self._params, self._states, self._opt_states = (
-                    params, states, opts)
-                self._prec_state = prec
-                if not tbptt:
-                    if pm is not None:
-                        # pm BEFORE hm: the skip set must be populated
-                        # when hm's SKIP_BATCH accounting asks
-                        pm.on_step(it_used, prec)
-                    if hm is not None:
-                        # one step behind: processes the PREVIOUS step's
-                        # (already materialized) stats — no added sync
-                        hm.on_step(it_used, health)
-                last_loss = loss
-                if self._profiler_cfg is not None:
-                    from deeplearning4j_tpu.utils.profiler import (
-                        nan_panic_check)
+        try:
+            for epoch_i in range(epochs):
+                batches, data = _prepare_batches(data, epoch_i, epochs)
+                batch_iter = iter(batches)
+                while True:
+                    if tele is not None:
+                        t_etl = _time.perf_counter()
+                    ds = next(batch_iter, None)
+                    if ds is None:
+                        break
+                    if tele is not None:
+                        tele.record_etl_wait(_time.perf_counter() - t_etl)
+                    if isinstance(ds, DeviceBatch) and (
+                            self._bucket is None
+                            or ds.bucket >= self._bucket):
+                        # prefetched: pad/mask/transfer already happened in
+                        # the producer thread, arrays are device-resident
+                        f, l, lmask = ds.features, ds.labels, ds.mask
+                        self._bucket = ds.bucket
+                    elif isinstance(ds, DeviceBatch):
+                        # staged against a smaller bucket than the
+                        # compiled executable's (producer raced a bucket
+                        # growth): rejoin the host pad path, KEEPING the
+                        # staged mask so already-padded rows stay
+                        # zero-weighted
+                        f = np.asarray(ds.features)
+                        l = np.asarray(ds.labels)
+                        lmask = np.asarray(ds.mask)
+                        if f.shape[0] < self._bucket:
+                            (f, l), lmask, _ = _pad_to_bucket(
+                                [f, l], lmask, self._bucket)
+                    else:
+                        feats, labels, _, lmasks = _split_dataset_full(ds)
+                        f = _host_array(feats[0])
+                        l = _host_array(labels[0])
+                        # always train with an explicit mask so the jit
+                        # signature (and hence the ONE compiled executable)
+                        # is stable whether or not the batch is ragged/masked
+                        lmask = (_host_array(lmasks[0], np.float32)
+                                 if lmasks[0] is not None else _ones_mask(l))
+                        if self._bucket is None or f.shape[0] > self._bucket:
+                            self._bucket = f.shape[0]
+                        if f.shape[0] < self._bucket:
+                            (f, l), lmask, _ = _pad_to_bucket([f, l], lmask,
+                                                              self._bucket)
+                    tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
+                             and self.conf.tbpttLength and f.ndim == 3
+                             and f.shape[2] > self.conf.tbpttLength)
+                    if tele is not None:
+                        t_step = _time.perf_counter()
+                    if tbptt:
+                        loss, params, states, opts, prec = self._fit_tbptt(
+                            params, states, opts, prec, f, l, lmask, base_key,
+                            hm=hm, pm=pm)
+                    else:
+                        it_used = self._iteration
+                        rng = jax.random.fold_in(base_key, it_used)
+                        (loss, params, states, opts, health,
+                         prec) = self._train_step(
+                            params, states, opts, prec, f, l, lmask, rng,
+                            it_used)
+                        self._iteration += 1
+                    if tele is not None:
+                        tele.record_step(_time.perf_counter() - t_step,
+                                         f.shape[0])
+                    # rebind before anything can observe donated buffers —
+                    # including the health monitor, whose HALT policy raises
+                    # out of fit(): the caller must find live params to
+                    # checkpoint/inspect, not the buffers this step donated
+                    self._params, self._states, self._opt_states = (
+                        params, states, opts)
+                    self._prec_state = prec
+                    if not tbptt:
+                        if pm is not None:
+                            # pm BEFORE hm: the skip set must be populated
+                            # when hm's SKIP_BATCH accounting asks
+                            pm.on_step(it_used, prec)
+                        if hm is not None:
+                            # one step behind: processes the PREVIOUS step's
+                            # (already materialized) stats — no added sync
+                            hm.on_step(it_used, health)
+                    last_loss = loss
+                    if self._profiler_cfg is not None:
+                        from deeplearning4j_tpu.utils.profiler import (
+                            nan_panic_check)
 
-                    nan_panic_check(
-                        self._profiler_cfg, loss, params,
-                        context=f" at iteration {self._iteration}")
-                if self._listeners:
-                    lv = float(loss)
-                    self._score = lv
-                    for listener in self._listeners:
-                        listener.iterationDone(self, self._iteration,
-                                               self._epoch)
-            self._epoch += 1
-        if pm is not None:
-            pm.flush()   # before hm.flush: same-step skip handshake
-        if hm is not None:
-            hm.flush()   # drain the one-behind slot (HALT may raise here)
-        if last_loss is not None:
-            self._score = float(last_loss)
-        return self
+                        nan_panic_check(
+                            self._profiler_cfg, loss, params,
+                            context=f" at iteration {self._iteration}")
+                    if self._listeners:
+                        lv = float(loss)
+                        self._score = lv
+                        for listener in self._listeners:
+                            listener.iterationDone(self, self._iteration,
+                                                   self._epoch)
+                self._epoch += 1
+            if pm is not None:
+                pm.flush()   # before hm.flush: same-step skip handshake
+            if hm is not None:
+                hm.flush()   # drain the one-behind slot (HALT may raise here)
+            if last_loss is not None:
+                self._score = float(last_loss)
+            return self
+        finally:
+            # deterministic producer shutdown: a fit that raises
+            # (HALT, preemption) must not leave a prefetch thread
+            # racing the next attempt for the same base iterator
+            if _prefetcher is not None:
+                _prefetcher.close()
 
     # -- layerwise unsupervised pretraining (reference:
     # MultiLayerNetwork.pretrain/pretrainLayer over AutoEncoder / VAE

@@ -251,6 +251,13 @@ class ElasticTrainer:
             done = self.net._iteration // iters_per_epoch
             offset = self.net._iteration % iters_per_epoch
             remaining = max(0, epochs - done)
+            if hasattr(data, "set_epoch"):
+                # epoch-aware iterators (seeded epoch shuffling): tell
+                # the data which epoch the checkpoint left off in so a
+                # resumed run replays the SAME per-epoch batch->file
+                # assignment as an uninterrupted one (bit-identical
+                # resume extends to shuffled input)
+                data.set_epoch(done)
 
         preempted = {"flag": False}
 

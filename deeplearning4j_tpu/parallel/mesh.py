@@ -107,5 +107,26 @@ def global_batch(mesh: Mesh, arr, spec=None):
                                         lambda idx: arr[idx])
 
 
+def host_sharded_batch(mesh: Mesh, arr, spec=None):
+    """Assemble a global device array from PER-PROCESS-DISTINCT host
+    shards: each process contributes its own local rows and the global
+    batch is their concatenation in process order (global batch size =
+    local batch size × process_count). This is the input convention for
+    host-sharded pipelines (ParallelImageDataSetIterator shardByHost),
+    where each host decodes a disjoint file shard — feeding those
+    through :func:`global_batch` would silently drop every row outside
+    the host's own addressable slice. Single-process: plain device_put.
+    """
+    import numpy as _np
+
+    spec = spec if spec is not None else spec_for(mesh, DATA_AXIS)
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    arr = _np.asarray(arr)
+    global_shape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]
+    return jax.make_array_from_process_local_data(sh, arr, global_shape)
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())

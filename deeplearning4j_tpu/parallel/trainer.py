@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import (
-    DATA_AXIS, MeshConfig, global_batch, spec_for)
+    DATA_AXIS, MeshConfig, global_batch, host_sharded_batch, spec_for)
 
 
 def _host_scalar(x) -> float:
@@ -195,12 +195,60 @@ class ShardedTrainer:
                 net._prec_state,
                 jax.tree_util.tree_map(lambda _: repl, net._prec_state))
 
+    def _prefetch_prepare(self):
+        """Host-side batch prep (split + pad-to-multiple + mask) plus
+        the sharded device_put, run in the DevicePrefetcher's producer
+        thread so the H2D transfer of batch k+1 overlaps the step of
+        batch k. Single-process only (the multi-host path assembles
+        global arrays inline)."""
+        from deeplearning4j_tpu.autodiff.samediff import _split_dataset
+        from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
+
+        batch_sh = self._shardings()[3]
+
+        def prepare(ds):
+            feats, labels = _split_dataset(ds)
+            if len(feats) != 1 or len(labels) != 1:
+                return ds
+            f = np.asarray(feats[0])
+            l = np.asarray(labels[0])
+            if f.dtype != np.float32:
+                f = f.astype(np.float32)
+            f, real = _pad_batch(f, self._n_data)
+            l, _ = _pad_batch(l, self._n_data)
+            mshape = ((l.shape[0], l.shape[2]) if l.ndim == 3
+                      else (l.shape[0],))
+            mask = np.ones(mshape, np.float32)
+            mask[real:] = 0.0
+            return DeviceBatch(jax.device_put(f, batch_sh),
+                               jax.device_put(l, batch_sh),
+                               jax.device_put(mask, batch_sh),
+                               real=real)
+
+        return prepare
+
+    def _wrap_prefetch(self, data):
+        from deeplearning4j_tpu.datasets import prefetch as _prefetch
+        from deeplearning4j_tpu.datasets.iterator import (
+            DataSetIterator as _DSI)
+
+        if (jax.process_count() == 1
+                and isinstance(data, _DSI)
+                and not isinstance(data, _prefetch.DevicePrefetcher)
+                and data.asyncSupported()
+                and _prefetch.default_depth() > 0):
+            wrapped = _prefetch.DevicePrefetcher(
+                data, prepare=self._prefetch_prepare(), loop="sharded")
+            return wrapped, wrapped
+        return data, None
+
     def fit(self, data, epochs: int = 1):
         import time
 
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.autodiff.samediff import (
             _as_batches, _split_dataset)
+        from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
         from deeplearning4j_tpu.telemetry import health as _health
 
         net = self.net
@@ -210,6 +258,10 @@ class ShardedTrainer:
         if self._step_fn is None or self._step_plan != plan:
             self._step_fn = self._build_step(plan)
             self._step_plan = plan
+        data, _prefetcher = self._wrap_prefetch(data)
+        assemble = (host_sharded_batch
+                    if getattr(data, "hostSharded", False)
+                    else global_batch)
         params, states, opts = net._params, net._states, net._opt_states
         prec = net._prec_state
         base_key = jax.random.key(net.conf.seed + 1)
@@ -226,68 +278,86 @@ class ShardedTrainer:
             pm.baseline_from(prec)
         if hm is not None:
             hm.precision = pm
-        for _ in range(epochs):
-            batch_iter = iter(_as_batches(data))
-            while True:
-                if tele is not None:
-                    t_etl = time.perf_counter()
-                ds = next(batch_iter, None)
-                if ds is None:
-                    break
-                if tele is not None:
-                    tele.record_etl_wait(time.perf_counter() - t_etl)
-                feats, labels = _split_dataset(ds)
-                f = np.asarray(feats[0])
-                l = np.asarray(labels[0])
-                f, real = _pad_batch(f, self._n_data)
-                l, _ = _pad_batch(l, self._n_data)
-                # zero-weight the padding rows so repeated examples do not
-                # bias gradients ([N] for 2D labels, [N,T] for NCW labels)
-                mshape = ((l.shape[0], l.shape[2]) if l.ndim == 3
-                          else (l.shape[0],))
-                mask = np.ones(mshape, np.float32)
-                mask[real:] = 0.0
-                if jax.process_count() > 1:
-                    # multi-host SPMD: every process feeds the identical
-                    # global batch; each device takes its own shard
-                    f = global_batch(self.mesh, f)
-                    l = global_batch(self.mesh, l)
-                    mask = global_batch(self.mesh, mask)
-                it_used = net._iteration
-                rng = jax.random.fold_in(base_key, it_used)
-                if tele is None:
-                    loss, params, states, opts, health, prec = \
-                        self._step_fn(params, states, opts, prec, f, l,
-                                      mask, rng, it_used)
-                else:
-                    # the span is also a TraceAnnotation, so the host
-                    # step region lines up with XPlane device traces;
-                    # dispatch-queue backpressure makes its wall time
-                    # equal the device step time in steady state (no
-                    # sync added)
-                    with tele.step_span():
+        try:
+            for _ in range(epochs):
+                batch_iter = iter(_as_batches(data))
+                while True:
+                    if tele is not None:
+                        t_etl = time.perf_counter()
+                    ds = next(batch_iter, None)
+                    if ds is None:
+                        break
+                    if tele is not None:
+                        tele.record_etl_wait(time.perf_counter() - t_etl)
+                    if isinstance(ds, DeviceBatch):
+                        # prefetched: pad/mask/sharded-placement already
+                        # happened in the producer thread
+                        f, l, mask, real = (ds.features, ds.labels, ds.mask,
+                                            ds.real)
+                    else:
+                        feats, labels = _split_dataset(ds)
+                        f = np.asarray(feats[0])
+                        l = np.asarray(labels[0])
+                        f, real = _pad_batch(f, self._n_data)
+                        l, _ = _pad_batch(l, self._n_data)
+                        # zero-weight the padding rows so repeated examples
+                        # do not bias gradients ([N] for 2D labels, [N,T]
+                        # for NCW labels)
+                        mshape = ((l.shape[0], l.shape[2]) if l.ndim == 3
+                                  else (l.shape[0],))
+                        mask = np.ones(mshape, np.float32)
+                        mask[real:] = 0.0
+                        if jax.process_count() > 1:
+                            # multi-host SPMD. Host-sharded pipelines
+                            # (shardByHost) feed per-process-DISTINCT
+                            # batches that concatenate into the global
+                            # batch; everything else follows the
+                            # identical-copy convention where each
+                            # device takes its own slice
+                            f = assemble(self.mesh, f)
+                            l = assemble(self.mesh, l)
+                            mask = assemble(self.mesh, mask)
+                    it_used = net._iteration
+                    rng = jax.random.fold_in(base_key, it_used)
+                    if tele is None:
                         loss, params, states, opts, health, prec = \
-                            self._step_fn(params, states, opts, prec, f,
-                                          l, mask, rng, it_used)
-                    tele.examples.inc(real)
-                # rebind BEFORE the health monitor runs: its HALT policy
-                # raises out of fit() and the caller must find live
-                # params, not the buffers this step donated
-                net._params, net._states, net._opt_states = (
-                    params, states, opts)
-                net._prec_state = prec
-                if pm is not None:
-                    pm.on_step(it_used, prec)   # before hm (skip set)
-                if hm is not None:
-                    hm.on_step(it_used, health)
-                net._iteration += 1
-                last = loss
-                if net._listeners:
-                    net._score = _host_scalar(loss)
-                    for listener in net._listeners:
-                        listener.iterationDone(net, net._iteration,
-                                               net._epoch)
-            net._epoch += 1
+                            self._step_fn(params, states, opts, prec, f, l,
+                                          mask, rng, it_used)
+                    else:
+                        # the span is also a TraceAnnotation, so the host
+                        # step region lines up with XPlane device traces;
+                        # dispatch-queue backpressure makes its wall time
+                        # equal the device step time in steady state (no
+                        # sync added)
+                        with tele.step_span():
+                            loss, params, states, opts, health, prec = \
+                                self._step_fn(params, states, opts, prec, f,
+                                              l, mask, rng, it_used)
+                        tele.examples.inc(real)
+                    # rebind BEFORE the health monitor runs: its HALT policy
+                    # raises out of fit() and the caller must find live
+                    # params, not the buffers this step donated
+                    net._params, net._states, net._opt_states = (
+                        params, states, opts)
+                    net._prec_state = prec
+                    if pm is not None:
+                        pm.on_step(it_used, prec)   # before hm (skip set)
+                    if hm is not None:
+                        hm.on_step(it_used, health)
+                    net._iteration += 1
+                    last = loss
+                    if net._listeners:
+                        net._score = _host_scalar(loss)
+                        for listener in net._listeners:
+                            listener.iterationDone(net, net._iteration,
+                                                   net._epoch)
+                net._epoch += 1
+        finally:
+            # deterministic producer shutdown (see
+            # MultiLayerNetwork.fit): a raising fit must not
+            # leave a prefetch thread racing the next attempt
+            if _prefetcher is not None:
+                _prefetcher.close()
         if pm is not None:
             pm.flush()   # before hm.flush: same-step skip handshake
         if hm is not None:

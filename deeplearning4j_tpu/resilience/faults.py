@@ -219,7 +219,14 @@ class FaultPlan:
     def wrap_data(self, data):
         """Wrap a batch source so armed data errors fire at their global
         ordinal. Preserves ``len()`` so epoch accounting (and the
-        bit-identical resume offset math) still works."""
+        bit-identical resume offset math) still works. DataSetIterators
+        are wrapped by a forwarding proxy, NOT materialized — an
+        epoch-shuffling iterator must keep producing different batches
+        per epoch through the wrapper (ISSUE 6)."""
+        from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+        if isinstance(data, DataSetIterator):
+            return _FaultyIterator(self, data)
         return _FaultyData(self, data)
 
 
@@ -231,6 +238,49 @@ class FaultInjector:
 
     def iterationDone(self, model, iteration, epoch=None, loss=None):
         self.plan.on_iteration(iteration)
+
+
+class _FaultyIterator:
+    """Forwarding proxy around a DataSetIterator that fires the plan's
+    data faults per drawn batch. Forwards the epoch-resume protocol
+    (``len``, ``[offset:]`` tail slices, ``set_epoch``, ``reset``) so
+    ``ElasticTrainer``'s bit-identical mid-epoch resume works through
+    the wrapper for epoch-shuffling iterators."""
+
+    def __init__(self, plan, base):
+        self._plan = plan
+        self._base = base
+
+    def __len__(self):
+        return len(self._base)
+
+    def __getitem__(self, idx):
+        return _FaultyIterator(self._plan, self._base[idx])
+
+    def __getattr__(self, name):
+        # batch(), asyncSupported(), getLabels(), set_epoch(), close(),
+        # ... all forward (so hasattr probes see exactly the base's
+        # protocol); only the draw path is intercepted below
+        return getattr(self._base, name)
+
+    def reset(self):
+        self._base.reset()
+
+    def hasNext(self):
+        return self._base.hasNext()
+
+    def next(self):
+        self._plan.on_batch()
+        return self._base.next()
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self._base.hasNext():
+            raise StopIteration
+        return self.next()
 
 
 class _FaultyData(list):

@@ -29,18 +29,20 @@ from deeplearning4j_tpu.telemetry.health import (
     DivergenceError, HealthConfig, HealthMonitor)
 from deeplearning4j_tpu.telemetry.listener import MetricsListener
 from deeplearning4j_tpu.telemetry.registry import (
-    BYTES_BUCKETS, Counter, ETL_HELP, Gauge, Histogram, LoopInstruments,
-    MetricsRegistry, SECONDS_BUCKETS, STEP_HELP, ServingInstruments, Timer,
-    collect_device_memory, disable, enable, enabled, get_registry,
-    log_buckets, loop_instruments, serving_instruments, set_registry, span)
+    BYTES_BUCKETS, Counter, ETL_HELP, EtlInstruments, Gauge, Histogram,
+    LoopInstruments, MetricsRegistry, SECONDS_BUCKETS, STEP_HELP,
+    ServingInstruments, Timer, collect_device_memory, disable, enable,
+    enabled, etl_instruments, get_registry, log_buckets, loop_instruments,
+    serving_instruments, set_registry, span)
 
 __all__ = [
     "BYTES_BUCKETS", "Counter", "DivergenceError", "ETL_HELP",
-    "FlightRecorder", "Gauge", "HealthConfig", "HealthMonitor", "Histogram",
-    "LoopInstruments", "MetricsListener", "MetricsRegistry",
-    "SECONDS_BUCKETS", "STEP_HELP", "ServingInstruments", "Timer",
-    "aggregate", "aggregate_snapshot", "collect_device_memory", "disable",
-    "enable", "enabled", "flight", "get_registry", "health", "log_buckets",
-    "loop_instruments", "prometheus", "serving_instruments", "set_registry",
-    "span",
+    "EtlInstruments", "FlightRecorder", "Gauge", "HealthConfig",
+    "HealthMonitor", "Histogram", "LoopInstruments", "MetricsListener",
+    "MetricsRegistry", "SECONDS_BUCKETS", "STEP_HELP",
+    "ServingInstruments", "Timer", "aggregate", "aggregate_snapshot",
+    "collect_device_memory", "disable", "enable", "enabled",
+    "etl_instruments", "flight", "get_registry", "health", "log_buckets",
+    "loop_instruments", "prometheus", "serving_instruments",
+    "set_registry", "span",
 ]

@@ -425,6 +425,61 @@ def loop_instruments(loop):
     return LoopInstruments(get_registry(), loop)
 
 
+# -- the standard instrument set for the streaming ETL engine (ISSUE 6) ------
+
+ETL_QUEUE_DEPTH_HELP = ("Decoded batches queued between the ETL worker "
+                        "pool and the consumer")
+ETL_RING_HELP = ("Occupied slots in the shared-memory batch ring "
+                 "(bounded by the ring size; persistently full = "
+                 "consumer-bound, empty = decode-bound)")
+ETL_DECODED_HELP = "Images decoded by the ETL pipeline"
+ETL_PREFETCH_HITS_HELP = ("Device-prefetch queue hits (a batch was "
+                          "already staged when the trainer asked)")
+ETL_PREFETCH_MISSES_HELP = ("Device-prefetch queue misses (the trainer "
+                            "blocked waiting for the producer thread)")
+ETL_PREFETCH_DEPTH_HELP = "Batches currently staged by the DevicePrefetcher"
+
+
+class EtlInstruments:
+    """Bound instruments for one ETL pipeline (mirrors LoopInstruments:
+    obtained once per iterator/prefetcher, None when telemetry is
+    disabled, so a disabled pipeline performs zero registry calls per
+    batch)."""
+
+    __slots__ = ("loop", "queue_depth", "ring_occupancy", "decoded",
+                 "prefetch_hits", "prefetch_misses", "prefetch_depth")
+
+    def __init__(self, registry, loop):
+        self.loop = loop
+        self.queue_depth = registry.gauge(
+            "dl4j_etl_queue_depth", ETL_QUEUE_DEPTH_HELP,
+            ("loop",)).labels(loop=loop)
+        self.ring_occupancy = registry.gauge(
+            "dl4j_etl_shm_ring_occupancy", ETL_RING_HELP,
+            ("loop",)).labels(loop=loop)
+        self.decoded = registry.counter(
+            "dl4j_etl_decoded_images_total", ETL_DECODED_HELP,
+            ("loop",)).labels(loop=loop)
+        self.prefetch_hits = registry.counter(
+            "dl4j_etl_prefetch_hits_total", ETL_PREFETCH_HITS_HELP,
+            ("loop",)).labels(loop=loop)
+        self.prefetch_misses = registry.counter(
+            "dl4j_etl_prefetch_misses_total", ETL_PREFETCH_MISSES_HELP,
+            ("loop",)).labels(loop=loop)
+        self.prefetch_depth = registry.gauge(
+            "dl4j_etl_prefetch_depth", ETL_PREFETCH_DEPTH_HELP,
+            ("loop",)).labels(loop=loop)
+
+
+def etl_instruments(loop):
+    """The per-pipeline ETL instrument bundle, or None when telemetry
+    is disabled (same zero-cost-when-off contract as
+    loop_instruments)."""
+    if not _state["enabled"]:
+        return None
+    return EtlInstruments(get_registry(), loop)
+
+
 # -- the standard instrument set for inference serving (ISSUE 2) -------------
 
 SERVING_REQUESTS_HELP = ("Inference requests by terminal outcome "

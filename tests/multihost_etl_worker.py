@@ -1,0 +1,73 @@
+"""Worker process for the two-process ETL sharding test (invoked by
+tests/test_parallel_etl.py as a subprocess, one per simulated host).
+
+Each process joins the 2-process gloo-backed distributed runtime and
+builds a ParallelImageDataSetIterator with shardByHost="auto" over the
+SAME image tree; it prints its shard's file basenames and label list so
+the parent can assert per-host disjointness + full coverage, plus its
+first batch's checksum so the parent can verify both hosts decode their
+own (different) shards."""
+
+import os
+import sys
+
+
+def main():
+    coord, n_proc, pid, root = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]), sys.argv[4])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from deeplearning4j_tpu.parallel.multihost import (
+        MultiHost, VoidConfiguration)
+
+    topo = MultiHost.initialize(
+        VoidConfiguration(controllerAddress=coord),
+        num_processes=n_proc, process_id=pid)
+    print(f"TOPOLOGY {topo['process_index']} {topo['process_count']}",
+          flush=True)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets import (
+        FileSplit, ParallelImageDataSetIterator)
+
+    it = ParallelImageDataSetIterator(
+        FileSplit(root), 8, 8, 3, batchSize=4, numWorkers=2,
+        shuffle=True)
+    names = sorted(os.path.basename(os.path.dirname(f)) + "/" +
+                   os.path.basename(f) for f in it._files)
+    print("SHARD " + ",".join(names), flush=True)
+    print("LABELS " + ",".join(it.getLabels()), flush=True)
+    ds = it.next()
+    feats = np.asarray(ds.getFeatures())
+    print(f"BATCHSUM {float(feats.sum()):.3f} {it._n_batches}",
+          flush=True)
+
+    # host-sharded batches are per-process DISTINCT: assembling them
+    # through mesh.host_sharded_batch must concatenate both hosts'
+    # rows into the global batch (nothing silently dropped)
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel.mesh import (
+        MeshConfig, host_sharded_batch)
+
+    assert it.hostSharded
+    mesh = MeshConfig.data_parallel()
+    g = host_sharded_batch(mesh, feats)
+    gsum = jax.jit(jnp.sum)(g)
+    print(f"GLOBALSUM {float(gsum):.3f} {g.shape[0]}", flush=True)
+    it.close()
+
+
+if __name__ == "__main__":
+    main()
