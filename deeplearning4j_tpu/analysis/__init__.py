@@ -1,0 +1,23 @@
+"""dl4jlint: AST-level static analysis of the repo's own invariants
+(ISSUE 7) plus the runtime lock witness.
+
+Entry points:
+  analyze(paths, ...)        -> Report            (runner.py)
+  all_rules()                -> {name: Rule}      (core.py)
+  Baseline.load(path)        -> Baseline          (baseline.py)
+  witness.install()/WitnessLock                   (witness.py)
+
+CLI: tools/dl4jlint.py. Rule catalog: docs/STATIC_ANALYSIS.md.
+"""
+
+from deeplearning4j_tpu.analysis.core import (  # noqa: F401
+    Finding, Rule, Severity, all_rules, register)
+from deeplearning4j_tpu.analysis.baseline import Baseline  # noqa: F401
+from deeplearning4j_tpu.analysis.runner import (  # noqa: F401
+    Report, analyze, run_rules)
+from deeplearning4j_tpu.analysis.model import (  # noqa: F401
+    Module, Project, load_project)
+
+__all__ = ["Finding", "Rule", "Severity", "all_rules", "register",
+           "Baseline", "Report", "analyze", "run_rules", "Module",
+           "Project", "load_project"]

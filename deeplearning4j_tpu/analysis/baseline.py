@@ -1,0 +1,83 @@
+"""dl4jlint baseline: triaged pre-existing findings, checked in.
+
+The baseline is the escape hatch that lets the analyzer run with zero
+tolerance in tier-1 from day one: every finding is either fixed,
+inline-suppressed at the site, or listed here WITH a one-line reason.
+``--baseline-update`` rewrites the file from the current findings,
+preserving reasons for keys that survive; new entries get reason
+"TODO: triage" so an un-reviewed regeneration is visible in diff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class Baseline:
+    def __init__(self, entries=None, path=None):
+        # key -> entry dict {key, rule, file, reason}
+        self.entries = {e["key"]: dict(e) for e in (entries or [])}
+        self.path = path
+
+    @classmethod
+    def load(cls, path):
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("findings", []), path=path)
+
+    def matches(self, finding) -> bool:
+        return finding.key() in self.entries
+
+    def split(self, findings):
+        """(new, baselined, stale_keys): findings not in the baseline,
+        findings covered by it, and baseline keys no longer produced
+        (fixed code — prune them with --baseline-update)."""
+        new, covered, seen = [], [], set()
+        for f in findings:
+            if self.matches(f):
+                covered.append(f)
+                seen.add(f.key())
+            else:
+                new.append(f)
+        stale = [k for k in self.entries if k not in seen]
+        return new, covered, stale
+
+    def update_from(self, findings, restrict_to_rules=None):
+        """Rewrite entries from ``findings``. With ``restrict_to_rules``
+        (a set of rule names — the CLI passes it for ``--rules`` subset
+        runs), entries of rules NOT in the set are kept untouched: a
+        partial run must not wipe other rules' triage."""
+        if restrict_to_rules is None:
+            fresh = {}
+        else:
+            fresh = {k: e for k, e in self.entries.items()
+                     if e.get("rule") not in restrict_to_rules}
+        for f in findings:
+            k = f.key()
+            old = self.entries.get(k)
+            fresh[k] = {
+                "key": k,
+                "rule": f.rule,
+                "file": f.file,
+                "reason": (old or {}).get("reason", "TODO: triage"),
+            }
+        self.entries = fresh
+
+    def save(self, path=None):
+        path = path or self.path
+        data = {
+            "version": 1,
+            "comment": ("Triaged pre-existing dl4jlint findings. Every "
+                        "entry needs a one-line reason; regenerate with "
+                        "tools/dl4jlint.py --baseline-update (reasons "
+                        "are preserved for surviving keys)."),
+            "findings": sorted(self.entries.values(),
+                               key=lambda e: (e["rule"], e["file"],
+                                              e["key"])),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")

@@ -1,0 +1,258 @@
+"""dl4jlint project call graph (conservative, name-resolution based).
+
+Resolution is deliberately narrow — a wrong edge in the collective or
+lock-order rule becomes a false ERROR, so we only resolve what we can
+justify:
+
+  f(...)            -> enclosing scopes, then module top level, then an
+                       explicit ``from X import f`` of a project module
+  self.m(...)       -> method m of the lexically enclosing class
+  cls.m/Class.m(...)-> method m of that class when defined in-project
+  mod.f(...)        -> top-level f of the project module imported as mod
+  self._fn(...)     -> where ``self._fn = jax.jit(step, ...)`` (or
+                       shard_map) was recorded in the same class, the
+                       edge goes to ``step`` — a jitted alias executes
+                       the wrapped body at *call* time, which is exactly
+                       what the collective rule must see.
+
+Unresolvable calls (stdlib, dynamic dispatch) produce no edge.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.model import call_chain, keyword
+
+JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pmap"}
+
+
+def _flat_targets(stmt):
+    """Assignment target expressions, tuples flattened."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        raw = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        raw = [stmt.target]
+    else:
+        return targets
+    stack = list(raw)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        else:
+            targets.append(t)
+    return targets
+
+
+def wrapped_function(call):
+    """For ``jax.jit(f, ...)`` / ``shard_map(f, ...)`` return the name
+    node of f (first positional or fun=), else None."""
+    chain = call_chain(call.func)
+    if not chain or chain[-1] not in JIT_WRAPPERS:
+        return None
+    fn = call.args[0] if call.args else keyword(call, "fun")
+    return fn
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.project = project
+        # (module.rel, qualname) -> FunctionInfo
+        self.functions = {}
+        for mod in project.modules:
+            for info in mod.functions.values():
+                self.functions[(mod.rel, info.qualname)] = info
+        # per module: local alias -> target FunctionInfo for jitted
+        # assignments (name or self-attr), e.g. "_step_fn" -> step
+        self.jit_aliases = {}
+        for mod in project.modules:
+            self.jit_aliases[mod.rel] = self._jit_aliases(mod)
+        # edges: FunctionInfo id -> [FunctionInfo]
+        self._edges = {}
+
+    # -- jitted alias table --------------------------------------------------
+    def _jit_aliases(self, mod) -> dict:
+        aliases = {}
+        builders = self._jit_builders(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            fn = wrapped_function(node.value)
+            target_fn = None
+            if fn is not None and isinstance(fn, ast.Name):
+                target_fn = self._resolve_local_name(mod, node.value,
+                                                     fn.id)
+            elif fn is None:
+                # `self._fit = self._make_step()` builder idiom: route
+                # the alias to the builder — its body contains the
+                # jitted step (callees() follows the jit wrapper), so
+                # reachability through the stored executable is kept
+                chain = call_chain(node.value.func)
+                if chain and chain[-1] in builders:
+                    target_fn = builders[chain[-1]]
+            if target_fn is None:
+                continue
+            for t in _flat_targets(node):
+                if isinstance(t, ast.Name):
+                    aliases[t.id] = target_fn
+                elif isinstance(t, ast.Attribute):
+                    aliases[t.attr] = target_fn
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute):
+                    aliases[t.value.attr] = target_fn
+        return aliases
+
+    def _jit_builders(self, mod) -> dict:
+        """{short name: FunctionInfo} for functions returning a jit
+        wrapper call (directly or via a local bound to one)."""
+        out = {}
+        for info in mod.functions.values():
+            local_jits = set()
+            returns_jit = False
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        wrapped_function(node.value) is not None:
+                    for t in _flat_targets(node):
+                        if isinstance(t, ast.Name):
+                            local_jits.add(t.id)
+                elif isinstance(node, ast.Return) and \
+                        node.value is not None:
+                    if isinstance(node.value, ast.Call) and \
+                            wrapped_function(node.value) is not None:
+                        returns_jit = True
+                    elif isinstance(node.value, ast.Name) and \
+                            node.value.id in local_jits:
+                        returns_jit = True
+            if returns_jit:
+                out[info.qualname.rsplit(".", 1)[-1]] = info
+        return out
+
+    # -- resolution ----------------------------------------------------------
+    def _resolve_local_name(self, mod, at_node, name):
+        """A bare name: enclosing function scopes (nested defs), then
+        module top level, then project imports."""
+        info = mod.enclosing_function(at_node)
+        prefix = info.qualname + "." if info is not None else ""
+        while True:
+            cand = mod.functions.get(prefix + name)
+            if cand is not None:
+                return cand
+            if not prefix:
+                break
+            # pop one scope level:  a.b.c. -> a.b.
+            prefix = prefix[:-1]
+            prefix = prefix[: prefix.rfind(".") + 1] \
+                if "." in prefix else ""
+        # class-level sibling: a method calling another method by bare
+        # name doesn't resolve (that's self.m); skip to imports
+        imported = mod.imports.get(name)
+        if imported:
+            return self._resolve_dotted(imported)
+        return None
+
+    def _resolve_dotted(self, dotted):
+        """'pkg.mod.fn' -> FunctionInfo when pkg.mod is in-project."""
+        if "." not in dotted:
+            return None
+        modpath, fname = dotted.rsplit(".", 1)
+        target = self._project_module(modpath)
+        if target is None:
+            return None
+        return target.functions.get(fname)
+
+    def _project_module(self, dotted):
+        by = self.project.by_modname
+        if dotted in by:
+            return by[dotted]
+        for name, mod in by.items():  # suffix match: analysis root may
+            if dotted.endswith("." + name) or \
+                    name.endswith("." + dotted):  # sit below the package
+                return mod
+        return None
+
+    def resolve_call(self, mod, info, chain, call):
+        """FunctionInfo for a call site, or None."""
+        if not chain or chain[-1] in ("()", "[]"):
+            return None
+        aliases = self.jit_aliases.get(mod.rel, {})
+        if len(chain) == 1:
+            name = chain[0]
+            if name in aliases:
+                return aliases[name]
+            return self._resolve_local_name(mod, call, name)
+        root, meth = chain[0], chain[-1]
+        if len(chain) == 2 and root in ("self", "cls"):
+            if meth in aliases:
+                return aliases[meth]
+            cls = info.class_name if info else None
+            if cls:
+                cand = mod.functions.get(f"{cls}.{meth}")
+                if cand is not None:
+                    return cand
+            return None
+        if len(chain) >= 2 and chain[-2] == "self" or \
+                (len(chain) == 2 and root in mod.classes):
+            # self.attr.m() beyond jit aliases: unresolved;
+            # ClassName.m(): resolve in that class
+            if len(chain) == 2 and root in mod.classes:
+                return mod.functions.get(f"{root}.{meth}")
+            if meth in aliases:
+                return aliases[meth]
+            return None
+        if len(chain) == 2:
+            imported = mod.imports.get(root)
+            if imported:
+                target = self._project_module(imported)
+                if target is not None:
+                    return target.functions.get(meth)
+                return self._resolve_dotted(f"{imported}.{meth}")
+        return None
+
+    # -- edges / reachability ------------------------------------------------
+    def callees(self, info):
+        key = id(info)
+        if key not in self._edges:
+            out = []
+            for chain, call in info.calls:
+                target = self.resolve_call(info.module, info, chain,
+                                           call)
+                if target is not None:
+                    out.append(target)
+                fn = call.args and wrapped_function(call)
+                if fn is not None and isinstance(fn, ast.Name):
+                    # directly-invoked jit wrapper: jax.jit(f)(x)
+                    t = self._resolve_local_name(info.module, call,
+                                                 fn.id)
+                    if t is not None:
+                        out.append(t)
+            self._edges[key] = out
+        return self._edges[key]
+
+    def find_path(self, start, predicate, max_depth=25):
+        """BFS from FunctionInfo ``start``; returns the qualname path
+        [start..target] to the first function satisfying
+        ``predicate(info)``, else None."""
+        if predicate(start):
+            return [start]
+        seen = {id(start)}
+        frontier = [[start]]
+        for _ in range(max_depth):
+            nxt = []
+            for path in frontier:
+                for callee in self.callees(path[-1]):
+                    if id(callee) in seen:
+                        continue
+                    seen.add(id(callee))
+                    new = path + [callee]
+                    if predicate(callee):
+                        return new
+                    nxt.append(new)
+            if not nxt:
+                return None
+            frontier = nxt
+        return None

@@ -1,0 +1,119 @@
+"""dl4jlint core: findings, severities, the rule registry (ISSUE 7).
+
+The invariants PRs 1-6 established — no collectives from background
+threads, zero registry calls when telemetry is disabled, no host sync
+inside jitted step functions, tmp+os.replace checkpoint commits, lock
+discipline — previously lived in reviewers' heads and scattered runtime
+tests. Each rule here encodes one of them as an AST-level check so a
+violation fails tier-1 *before* it becomes a gloo deadlock or a
+non-resumable checkpoint. See docs/STATIC_ANALYSIS.md for the rule
+catalog and the PR-history incident each rule descends from.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class Severity:
+    """Ordered severity levels. ERROR findings are bugs (the invariant
+    is violated); WARN findings are hygiene debt that has caused bugs
+    before; INFO is advisory."""
+
+    INFO = "INFO"
+    WARN = "WARN"
+    ERROR = "ERROR"
+
+    _ORDER = {INFO: 0, WARN: 1, ERROR: 2}
+
+    @classmethod
+    def rank(cls, sev) -> int:
+        return cls._ORDER[sev]
+
+
+class Finding:
+    """One rule violation anchored to file:line.
+
+    ``key()`` is the baseline identity: rule + file + enclosing scope +
+    a digit-stripped message fingerprint — deliberately NOT the line
+    number, so unrelated edits above a triaged finding don't invalidate
+    the baseline entry."""
+
+    __slots__ = ("rule", "severity", "file", "line", "scope", "message",
+                 "_node")
+
+    def __init__(self, rule, severity, file, line, message,
+                 scope="<module>"):
+        self._node = None  # AST anchor for inline-suppression lookup
+        self.rule = rule
+        self.severity = severity
+        self.file = file          # path relative to the analysis root
+        self.line = int(line)
+        self.scope = scope        # enclosing function qualname
+        self.message = message
+
+    def fingerprint(self) -> str:
+        # digits collapse so argnum/line references inside the message
+        # stay stable across unrelated churn
+        return re.sub(r"\d+", "N", self.message)[:160]
+
+    def key(self) -> str:
+        return f"{self.rule}::{self.file}::{self.scope}::" \
+               f"{self.fingerprint()}"
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.severity}] "
+                f"{self.rule}: {self.message} (in {self.scope})")
+
+    def __repr__(self):
+        return f"<Finding {self.render()}>"
+
+
+class Rule:
+    """Base class. Subclasses set ``name`` / ``severity`` /
+    ``description`` and override ``check_module`` (per-file rules)
+    and/or ``check_project`` (cross-module rules that need the call
+    graph or the whole lock graph)."""
+
+    name = "abstract"
+    severity = Severity.ERROR
+    description = ""
+
+    def check_module(self, module, project):
+        return ()
+
+    def check_project(self, project):
+        return ()
+
+    def finding(self, module, node, message, scope=None,
+                severity=None, line=None):
+        """Build a Finding anchored to an AST node (enables inline
+        suppression via the node's enclosing def lines). ``node`` may
+        be None when there is no AST anchor (pass ``line``)."""
+        if line is None:
+            line = getattr(node, "lineno", 0)
+        if scope is None:
+            scope = module.scope_name(node) if node is not None \
+                else "<module>"
+        f = Finding(self.name, severity or self.severity,
+                    module.rel, line, message, scope)
+        f._node = node
+        return f
+
+
+_RULES: dict = {}
+
+
+def register(cls):
+    """Class decorator adding a Rule subclass to the registry."""
+    inst = cls()
+    if inst.name in _RULES:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    _RULES[inst.name] = inst
+    return cls
+
+
+def all_rules() -> dict:
+    """{name: rule instance}, importing the rule modules on first use."""
+    from deeplearning4j_tpu.analysis import rules  # noqa: F401 registers
+    return dict(_RULES)

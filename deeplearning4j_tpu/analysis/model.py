@@ -1,0 +1,273 @@
+"""dl4jlint source model: parsed modules, scopes, call sites.
+
+One ``Module`` per file: the AST plus the derived tables every rule
+needs — function/class scopes with dotted qualnames, call sites with
+resolved attribute chains, the import alias map, a node->parent map,
+and the ``# dl4jlint: disable=`` suppression index. ``Project`` is the
+set of modules under analysis plus shared config (analysis root, docs
+text for the metric-drift rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+
+_SUPPRESS = re.compile(r"#\s*dl4jlint:\s*disable=([\w,\-]+)")
+
+
+def call_chain(func_node):
+    """The dotted name chain of a call target: ``a.b.c(...)`` ->
+    ("a","b","c"); ``f(...)`` -> ("f",). None for computed targets
+    (subscripts resolve through their value: ``self._fns[k](...)`` ->
+    ("self","_fns","[]"))."""
+    parts = []
+    node = func_node
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return tuple(reversed(parts))
+        elif isinstance(node, ast.Subscript):
+            parts.append("[]")
+            node = node.value
+        elif isinstance(node, ast.Call):
+            # chained call like jax.jit(f)(x): resolve through the
+            # inner call's target
+            parts.append("()")
+            node = node.func
+        else:
+            return None
+
+
+def keyword(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class FunctionInfo:
+    """One function/method scope."""
+
+    __slots__ = ("node", "qualname", "module", "class_name", "calls")
+
+    def __init__(self, node, qualname, module, class_name):
+        self.node = node
+        self.qualname = qualname      # "Class.method" / "fn.inner"
+        self.module = module
+        self.class_name = class_name  # enclosing class or None
+        # [(chain tuple|None, Call node)] in source order
+        self.calls = []
+
+
+class Module:
+    """Parsed file + derived tables."""
+
+    def __init__(self, path, root):
+        self.path = str(path)
+        self.rel = os.path.relpath(self.path, root).replace(os.sep, "/")
+        with open(self.path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.path)
+        # module name relative to the package tree, for import
+        # resolution: "deeplearning4j_tpu.serving.batcher"
+        self.modname = self.rel[:-3].replace("/", ".") \
+            if self.rel.endswith(".py") else self.rel.replace("/", ".")
+        self.is_pkg = self.modname.endswith(".__init__") or \
+            self.modname == "__init__"
+        if self.modname.endswith(".__init__"):
+            self.modname = self.modname[: -len(".__init__")]
+
+        self.parent: dict = {}          # ast node -> parent node
+        self.functions: dict = {}       # qualname -> FunctionInfo
+        self.classes: dict = {}         # class name -> ClassDef node
+        self.imports: dict = {}         # local alias -> dotted module/obj
+        self.suppressed: dict = {}      # lineno -> set of rule names
+        self._index()
+
+    # -- construction --------------------------------------------------------
+    def _index(self):
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS.search(line)
+            if m:
+                self.suppressed[i] = {r.strip() for r in
+                                      m.group(1).split(",") if r.strip()}
+
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+        self._walk_scope(self.tree, prefix="", class_name=None)
+        self._node_fn = {id(info.node): info
+                         for info in self.functions.values()}
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_import_base(node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}"
+
+    def _resolve_import_base(self, node):
+        """The absolute dotted module an ImportFrom names — relative
+        imports (``from .registry import X``) resolve against THIS
+        module's package, so the call graph can't suffix-match the
+        wrong module on basename collisions (serving/registry vs
+        telemetry/registry)."""
+        if not node.level:
+            return node.module  # absolute (None never occurs here)
+        parts = self.modname.split(".")
+        if not self.is_pkg:      # drop the module's own name first
+            parts = parts[:-1]
+        keep = len(parts) - (node.level - 1)  # extra levels drop one
+        if keep <= 0:                         # package each
+            return None          # beyond the analysis root
+        parts = parts[:keep]
+        base = ".".join(parts)
+        return f"{base}.{node.module}" if node.module else base
+
+    def _walk_scope(self, node, prefix, class_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = FunctionInfo(child, qual, self, class_name)
+                self.functions[qual] = info
+                self._collect_calls(child, info)
+                self._walk_scope(child, prefix=qual + ".",
+                                 class_name=class_name)
+            elif isinstance(child, ast.ClassDef):
+                self.classes[child.name] = child
+                self._walk_scope(child, prefix=f"{prefix}{child.name}.",
+                                 class_name=child.name)
+            else:
+                self._walk_scope(child, prefix=prefix,
+                                 class_name=class_name)
+
+    def _collect_calls(self, fn_node, info):
+        # calls lexically inside this def, EXCLUDING nested defs (those
+        # get their own FunctionInfo)
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    info.calls.append((call_chain(child.func), child))
+                visit(child)
+        visit(fn_node)
+
+    # -- queries -------------------------------------------------------------
+    def enclosing_function(self, node):
+        """The FunctionInfo whose def lexically contains ``node`` (the
+        innermost one), or None at module level."""
+        cur = node
+        while cur is not None:
+            info = self._node_fn.get(id(cur))
+            if info is not None:
+                return info
+            cur = self.parent.get(cur)
+        return None
+
+    def scope_name(self, node) -> str:
+        info = self.enclosing_function(node)
+        return info.qualname if info is not None else "<module>"
+
+    def is_suppressed(self, rule, node) -> bool:
+        """True when the node's line, any enclosing def's line, or a
+        module-wide directive (line 1/2) carries
+        ``# dl4jlint: disable=<rule>`` (or ``=all``)."""
+        lines = {getattr(node, "lineno", 0)}
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                lines.add(cur.lineno)
+                # decorators push the def line down; the directive is
+                # usually written on the decorator line
+                for dec in cur.decorator_list:
+                    lines.add(dec.lineno)
+            cur = self.parent.get(cur)
+        lines.update((1, 2))
+        for ln in lines:
+            rules = self.suppressed.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+class Project:
+    """All modules under analysis + shared config.
+
+    config keys used by rules:
+      docs_text    OBSERVABILITY.md text for metric-drift (auto-loaded
+                   from <root>/docs/OBSERVABILITY.md when present)
+    """
+
+    def __init__(self, modules, root, config=None):
+        self.modules = list(modules)
+        self.root = str(root)
+        self.config = dict(config or {})
+        self.by_rel = {m.rel: m for m in self.modules}
+        self.by_modname = {m.modname: m for m in self.modules}
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from deeplearning4j_tpu.analysis.callgraph import CallGraph
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+
+def collect_py_files(paths):
+    """Expand files/directories into a sorted .py file list (skipping
+    __pycache__)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(set(out))
+
+
+def load_project(paths, root=None, config=None) -> Project:
+    files = collect_py_files(paths)
+    if root is None:
+        root = os.path.commonpath([os.path.abspath(f) for f in files]) \
+            if files else os.getcwd()
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+    modules = []
+    for f in files:
+        try:
+            modules.append(Module(f, root))
+        except SyntaxError as e:  # broken file IS a finding, not a crash
+            print(f"dl4jlint: syntax error in {f}: {e}",
+                  file=sys.stderr)
+    project = Project(modules, root, config)
+    if "docs_text" not in project.config:
+        docs = os.path.join(root, "docs", "OBSERVABILITY.md")
+        if os.path.exists(docs):
+            with open(docs, "r", encoding="utf-8") as f:
+                project.config["docs_text"] = f.read()
+    return project

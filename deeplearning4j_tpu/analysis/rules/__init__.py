@@ -1,0 +1,15 @@
+"""dl4jlint rule modules — importing this package registers every
+rule with the core registry. One module per rule; each docstring names
+the PR-history incident the rule descends from (catalog:
+docs/STATIC_ANALYSIS.md)."""
+
+from deeplearning4j_tpu.analysis.rules import (  # noqa: F401
+    atomic_commit,
+    collectives,
+    donation,
+    jit_purity,
+    lock_order,
+    metric_drift,
+    telemetry_gate,
+    threads,
+)

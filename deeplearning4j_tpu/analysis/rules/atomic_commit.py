@@ -1,0 +1,102 @@
+"""atomic-commit: checkpoint artifacts commit via tmp + os.replace.
+
+Contract (PR 5, utils/checkpoint.atomic_save): a crash at ANY point
+leaves either the previous committed file or a ``.tmp`` remnant —
+never a partial artifact under the real name — so ``latest()`` /
+``latest_agreed()`` can trust whatever they find. The PR-5 elastic GC
+satellite existed because one writer leaked ``.tmp`` files; a writer
+that skips the protocol entirely is worse: a torn file under the real
+name poisons auto-resume.
+
+Detection: direct write calls (``open(path, "w"/"wb")``,
+``zipfile.ZipFile(path, "w")``, ``np.savez*``, ``shutil.copy*``,
+``.write_text``/``.write_bytes``, ``json.dump`` target opens) where the
+*path expression* looks checkpoint-ish (mentions ckpt/checkpoint/
+manifest/shard) — flagged unless the path goes through a tmp name or
+the enclosing function participates in the protocol (calls
+``atomic_save`` or ``os.replace``/``os.rename``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain
+
+_CKPT_PATH = re.compile(r"ckpt|checkpoint|manifest|shard_",
+                        re.IGNORECASE)
+_TMPISH = re.compile(r"tmp|temp", re.IGNORECASE)
+_PROTOCOL = {"atomic_save", "replace", "rename"}
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _write_path_arg(chain, call):
+    """The path expression of a direct-write call, else None."""
+    last = chain[-1]
+    if last == "open" and call.args:
+        if len(call.args) >= 2 and isinstance(call.args[1],
+                                              ast.Constant):
+            mode = str(call.args[1].value)
+            if "w" not in mode and "a" not in mode and "x" not in mode:
+                return None
+        elif len(call.args) < 2:
+            return None  # read mode by default
+        return call.args[0]
+    if last == "ZipFile" and len(call.args) >= 2:
+        if isinstance(call.args[1], ast.Constant) and \
+                "w" in str(call.args[1].value):
+            return call.args[0]
+        return None
+    if last in ("savez", "savez_compressed", "save") and call.args:
+        return call.args[0]
+    if last in ("copy", "copyfile", "copy2", "move") and \
+            len(call.args) >= 2:
+        return call.args[1]
+    if last in ("write_text", "write_bytes") and len(chain) >= 2:
+        return call.func.value if isinstance(call.func,
+                                             ast.Attribute) else None
+    return None
+
+
+@register
+class AtomicCommitRule(Rule):
+    name = "atomic-commit"
+    severity = Severity.ERROR
+    description = ("direct write to a checkpoint path bypassing the "
+                   "tmp + os.replace commit protocol "
+                   "(utils/checkpoint.atomic_save) — a crash can "
+                   "expose a torn artifact to auto-resume")
+
+    def check_module(self, mod, project):
+        for info in mod.functions.values():
+            in_protocol = any(
+                chain and chain[-1] in _PROTOCOL
+                for chain, _ in info.calls)
+            if in_protocol:
+                continue
+            for chain, call in info.calls:
+                if not chain:
+                    continue
+                path_arg = _write_path_arg(chain, call)
+                if path_arg is None:
+                    continue
+                text = _unparse(path_arg)
+                if not _CKPT_PATH.search(text):
+                    continue
+                if _TMPISH.search(text):
+                    continue  # writing the tmp half of the protocol
+                yield self.finding(
+                    mod, call,
+                    f"direct write to checkpoint path "
+                    f"({text[:60]!r}) without atomic_save/os.replace "
+                    f"— commit via tmp + rename so a crash never "
+                    f"exposes a partial artifact",
+                    scope=info.qualname)

@@ -1,0 +1,105 @@
+"""collective-thread: no collectives reachable from background threads.
+
+Incident (PR 5, async_ckpt.py): the async checkpoint writer originally
+issued a multi-host barrier from its background thread; gloo serializes
+collective context initialization, so the barrier interleaved with the
+training step's in-step psums and deadlocked the pod — "found the hard
+way" per the module docstring. The invariant since: background threads
+(``threading.Thread`` targets, ``concurrent.futures`` submissions)
+must never reach ``psum``/``pmean``/``all_gather``/barrier-class
+primitives; multi-host agreement happens at *read* time
+(``latest_agreed``) instead.
+
+Detection: build the project call graph, then BFS from every thread
+entry point to any function whose body directly invokes a collective.
+A jitted alias (``self._fn = jax.jit(step)``) counts as calling
+``step`` — the collective executes at call time of the compiled fn.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain, keyword
+
+COLLECTIVE_NAMES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "sync_global_devices",
+    "process_allgather", "broadcast_one_to_all",
+}
+# bare "barrier" is too generic for attribute calls in general, but a
+# *distributed* barrier is exactly the PR-5 deadlock — match it only
+# when the chain mentions a distributed-ish root
+_BARRIER_ROOTS = {"multihost_utils", "distributed", "dist", "mesh"}
+
+
+def _is_collective(chain) -> bool:
+    if not chain:
+        return False
+    last = chain[-1]
+    if last in COLLECTIVE_NAMES:
+        return True
+    if last == "barrier" and any(p in _BARRIER_ROOTS for p in chain):
+        return True
+    return False
+
+
+def thread_entries(mod, graph):
+    """[(Call node creating the thread/submission, entry FunctionInfo)]
+    for ``threading.Thread(target=...)`` and ``<executor>.submit(f)``."""
+    out = []
+    for info in mod.functions.values():
+        for chain, call in info.calls:
+            if not chain:
+                continue
+            target = None
+            if chain[-1] == "Thread":
+                target = keyword(call, "target")
+            elif chain[-1] == "submit" and len(chain) >= 2 and call.args:
+                # Queue.put etc. don't use .submit; executors do
+                target = call.args[0]
+            if target is None:
+                continue
+            tchain = call_chain(target) if not isinstance(
+                target, ast.Lambda) else None
+            entry = None
+            if tchain:
+                entry = graph.resolve_call(mod, info, tchain, call)
+            elif isinstance(target, ast.Lambda):
+                continue  # lambdas: no body-level resolution; skip
+            if entry is not None:
+                out.append((call, entry))
+    return out
+
+
+def _directly_collective(info):
+    for chain, _call in info.calls:
+        if _is_collective(chain):
+            return True
+    return False
+
+
+@register
+class CollectiveThreadRule(Rule):
+    name = "collective-thread"
+    severity = Severity.ERROR
+    description = ("collective primitives (psum/pmean/all_gather/"
+                   "barrier) reachable from a background thread target "
+                   "or executor submission — the PR-5 gloo deadlock "
+                   "class")
+
+    def check_project(self, project):
+        graph = project.callgraph
+        for mod in project.modules:
+            for call, entry in thread_entries(mod, graph):
+                path = graph.find_path(entry, _directly_collective)
+                if path is None:
+                    continue
+                names = " -> ".join(p.qualname for p in path)
+                yield self.finding(
+                    mod, call,
+                    f"background thread entry '{entry.qualname}' "
+                    f"reaches a collective: {names}; collectives from "
+                    f"background threads deadlock gloo context init "
+                    f"(PR-5 async-writer incident)")

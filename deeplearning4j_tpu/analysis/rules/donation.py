@@ -1,0 +1,187 @@
+"""donation-use-after: donated buffers must not be read after the call.
+
+Incident (PR 5, async_ckpt.py): the checkpoint snapshot had to be a
+jitted device-side *clone* precisely because every trainer step donates
+``params``/``opt_state`` (``donate_argnums=(0, 1, ...)``) — reading a
+donated buffer after the donated call returns garbage (or raises on
+TPU, silently "works" on CPU until it doesn't). The safe idiom is
+rebinding in the same statement: ``params, state = step(params,
+state)``; this rule flags a donated argument name that is *read again*
+after the call without that rebinding.
+
+Limits (documented in docs/STATIC_ANALYSIS.md): analysis is per
+function and statement-ordered by line; a read that only happens on
+the next loop iteration (line above the call) is not seen — the
+rebinding idiom makes that case safe in practice anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.callgraph import _flat_targets
+from deeplearning4j_tpu.analysis.model import call_chain, keyword
+
+
+def _donated_argnums(call):
+    """The donate_argnums tuple of a jax.jit/pjit call, else None."""
+    chain = call_chain(call.func)
+    if not chain or chain[-1] not in ("jit", "pjit"):
+        return None
+    kw = keyword(call, "donate_argnums")
+    if kw is None:
+        return None
+    nums = []
+    for node in ast.walk(kw):
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         int):
+            nums.append(node.value)
+    return tuple(nums) or None
+
+
+def _record_targets(node, nums, out):
+    for t in _flat_targets(node):
+        if isinstance(t, ast.Name):
+            out[t.id] = nums
+        elif isinstance(t, ast.Attribute):
+            out[t.attr] = nums
+        elif isinstance(t, ast.Subscript) and \
+                isinstance(t.value, ast.Attribute):
+            out[t.value.attr] = nums
+
+
+def donation_builders(mod):
+    """{builder short name: argnums} for functions whose body returns
+    a donated jit — the prevailing idiom here is ``def _make_step():
+    ... return jax.jit(step, donate_argnums=(0, 1))`` with the alias
+    established at the CALLER (``self._fit = self._make_step()``)."""
+    out = {}
+    for info in mod.functions.values():
+        local_jits = {}
+        nums_returned = None
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                nums = _donated_argnums(node.value)
+                if nums is not None:
+                    for t in _flat_targets(node):
+                        if isinstance(t, ast.Name):
+                            local_jits[t.id] = nums
+            elif isinstance(node, ast.Return) and node.value is not None:
+                if isinstance(node.value, ast.Call):
+                    nums_returned = _donated_argnums(node.value) or \
+                        nums_returned
+                elif isinstance(node.value, ast.Name):
+                    nums_returned = local_jits.get(node.value.id) or \
+                        nums_returned
+        if nums_returned is not None:
+            out[info.qualname.rsplit(".", 1)[-1]] = nums_returned
+    return out
+
+
+def donated_aliases(mod):
+    """{name: argnums} for names/attrs bound to a donated jit — either
+    directly (``X = jax.jit(f, donate_argnums=...)``; X a bare name, a
+    self-attribute, or a subscripted self-attribute
+    ``self._fns[k] = ...``) or via a builder call
+    (``self._fit = self._make_step()``)."""
+    out = {}
+    builders = donation_builders(mod)
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call)):
+            continue
+        nums = _donated_argnums(node.value)
+        if nums is None:
+            callee = _call_name(node.value.func)
+            nums = builders.get(callee)
+        if nums is None:
+            continue
+        _record_targets(node, nums, out)
+    return out
+
+
+def _call_name(func_node):
+    """Matchable alias name of a call target."""
+    chain = call_chain(func_node)
+    if not chain:
+        return None
+    # self._fns[k](...) -> chain ends "[]": use the attr before it
+    if chain[-1] == "[]" and len(chain) >= 2:
+        return chain[-2]
+    return chain[-1]
+
+
+@register
+class DonationUseAfterRule(Rule):
+    name = "donation-use-after"
+    severity = Severity.ERROR
+    description = ("an argument passed at a donate_argnums position is "
+                   "read after the donated call without rebinding — "
+                   "donated device buffers are invalidated")
+
+    def check_module(self, mod, project):
+        aliases = donated_aliases(mod)
+        if not aliases:
+            return
+        for info in mod.functions.values():
+            yield from self._check_function(mod, info, aliases)
+
+    def _check_function(self, mod, info, aliases):
+        fn = info.node
+        for chain, call in info.calls:
+            name = _call_name(call.func)
+            if name not in aliases:
+                continue
+            stmt = self._enclosing_stmt(mod, call)
+            if stmt is None:
+                continue
+            rebound = {t.id for t in _flat_targets(stmt)
+                       if isinstance(t, ast.Name)}
+            for pos in aliases[name]:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound:
+                    continue  # params, s = step(params, s): safe idiom
+                bad = self._read_after(fn, arg.id, stmt, call)
+                if bad is not None:
+                    yield self.finding(
+                        mod, bad,
+                        f"'{arg.id}' is donated (argnum {pos}) to "
+                        f"'{name}' at line {call.lineno} and read "
+                        f"again afterwards — rebind it from the call's "
+                        f"results or pass a copy",
+                        scope=info.qualname)
+
+    def _enclosing_stmt(self, mod, node):
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = mod.parent.get(cur)
+        return cur
+
+    def _read_after(self, fn, name, stmt, call):
+        """First Load of ``name`` after the call statement that happens
+        before any re-Store, else None."""
+        after = getattr(stmt, "end_lineno", stmt.lineno)
+        first_store = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == name and \
+                    isinstance(node.ctx, ast.Store) and \
+                    node.lineno > after:
+                if first_store is None or node.lineno < first_store:
+                    first_store = node.lineno
+        best = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == name and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.lineno > after:
+                if first_store is not None and \
+                        node.lineno > first_store:
+                    continue
+                if best is None or node.lineno < best.lineno:
+                    best = node
+        return best

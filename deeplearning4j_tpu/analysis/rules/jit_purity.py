@@ -1,0 +1,172 @@
+"""jit-purity: no host sync / wall clock / host RNG inside jitted code.
+
+Incidents: the bit-identical kill-and-resume contract (PR 5) dies the
+moment a jitted step consults ``time.time()`` or ``np.random`` — the
+resumed replay diverges; and a ``float()``/``.item()``/
+``.block_until_ready()`` on a traced value forces a host sync that
+stalls the dispatch pipeline the PR-6 prefetcher exists to keep full
+(PR 1 measured the seed's 100k-dispatch import stall from exactly this
+class). ``np.asarray`` on a traced value silently falls back to host
+numpy — the op leaves the device.
+
+Scope: functions passed to ``jax.jit``/``pjit``/``shard_map``/
+``jax.pmap`` (positionally, as ``fun=``, or via decorator, incl.
+``@partial(jax.jit, ...)``) and ``lax.scan``/``while_loop``/``fori_loop``
+body functions. Sync-class calls (``float``/``int``/``.item``/
+``np.asarray``/``.block_until_ready``) are only flagged on *tainted*
+expressions — values derived from the jitted function's own parameters
+— so casting a closure constant stays legal. Wall clock and host RNG
+are flagged unconditionally.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain, keyword
+
+_WRAPPERS = {"jit", "pjit", "shard_map", "pmap"}
+# control-flow primitives -> positions of their function-valued args
+_BODY_TAKERS = {"scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+                "cond": (1, 2), "checkpoint": (0,), "remat": (0,)}
+_NUMPY_ROOTS = {"np", "numpy", "onp"}
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "copy_to_host"}
+
+
+def jit_root_functions(mod, graph):
+    """FunctionInfos whose bodies become jitted/staged computations."""
+    roots = {}
+
+    def add(fn_expr, at_node):
+        if isinstance(fn_expr, ast.Name):
+            info = graph._resolve_local_name(mod, at_node, fn_expr.id)
+            if info is not None:
+                roots[id(info)] = info
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = call_chain(node.func)
+            if not chain:
+                continue
+            last = chain[-1]
+            if last in _WRAPPERS:
+                add(node.args[0] if node.args else keyword(node, "fun"),
+                    node)
+            elif last in _BODY_TAKERS:
+                for pos in _BODY_TAKERS[last]:
+                    if pos < len(node.args):
+                        add(node.args[pos], node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dchain = None
+                if isinstance(dec, ast.Call):
+                    dchain = call_chain(dec.func)
+                    if dchain and dchain[-1] == "partial" and dec.args:
+                        dchain = call_chain(dec.args[0])
+                else:
+                    dchain = call_chain(dec)
+                if dchain and dchain[-1] in _WRAPPERS:
+                    for info in mod.functions.values():
+                        if info.node is node:
+                            roots[id(info)] = info
+    return list(roots.values())
+
+
+def _taint_set(fn_node):
+    """Names derived from the function's parameters, by one forward
+    pass in statement order (loops are not iterated to fixpoint — the
+    rebinding idiom ``x = f(x)`` keeps taint anyway)."""
+    args = fn_node.args
+    tainted = {a.arg for a in
+               list(args.posonlyargs) + list(args.args) +
+               list(args.kwonlyargs)}
+    if args.vararg:
+        tainted.add(args.vararg.arg)
+    if args.kwarg:
+        tainted.add(args.kwarg.arg)
+    tainted.discard("self")
+
+    def expr_tainted(expr):
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return True
+        return False
+
+    for stmt in ast.walk(fn_node):
+        if isinstance(stmt, ast.Assign) and expr_tainted(stmt.value):
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) and \
+                stmt.value is not None and expr_tainted(stmt.value):
+            if isinstance(stmt.target, ast.Name):
+                tainted.add(stmt.target.id)
+    return tainted, expr_tainted
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    severity = Severity.ERROR
+    description = ("host sync (float/.item/np.asarray/"
+                   ".block_until_ready), wall clock, or host RNG "
+                   "inside a jitted/scan body — breaks dispatch "
+                   "pipelining and bit-identical resume")
+
+    def check_module(self, mod, project):
+        graph = project.callgraph
+        for root in jit_root_functions(mod, graph):
+            yield from self._check_root(mod, root)
+
+    def _check_root(self, mod, root):
+        tainted, expr_tainted = _taint_set(root.node)
+        scope = root.qualname
+        for node in ast.walk(root.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node.func)
+            if not chain:
+                # computed call target; only flag method syncs below
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS:
+                    chain = ("?", node.func.attr)
+                else:
+                    continue
+            last = chain[-1]
+            msg = None
+            if chain[0] == "time" and len(chain) == 2:
+                msg = (f"wall clock '{'.'.join(chain)}' inside jitted "
+                       f"code — nondeterministic across resume replay")
+            elif chain[0] == "random" and len(chain) == 2:
+                msg = (f"host RNG '{'.'.join(chain)}' inside jitted "
+                       f"code — use jax.random with a threaded key")
+            elif len(chain) >= 2 and chain[0] in _NUMPY_ROOTS and \
+                    chain[1] == "random":
+                msg = (f"host RNG '{'.'.join(chain)}' inside jitted "
+                       f"code — use jax.random with a threaded key")
+            elif len(chain) >= 2 and last in _SYNC_METHODS:
+                # obj.item() / arr.block_until_ready(): sync when obj
+                # is traced; 'items' (dict) is a different name
+                base = node.func.value if isinstance(
+                    node.func, ast.Attribute) else None
+                if last == "block_until_ready" or (
+                        base is not None and expr_tainted(base)):
+                    msg = (f".{last}() on a traced value inside jitted "
+                           f"code — forces a host sync")
+            elif len(chain) == 2 and chain[0] in _NUMPY_ROOTS and \
+                    last in ("asarray", "array"):
+                if node.args and expr_tainted(node.args[0]):
+                    msg = (f"'{'.'.join(chain)}' on a traced value "
+                           f"inside jitted code — silently leaves the "
+                           f"device")
+            elif chain == ("float",) or chain == ("int",) or \
+                    chain == ("bool",):
+                if node.args and expr_tainted(node.args[0]):
+                    msg = (f"'{last}()' on a traced value inside "
+                           f"jitted code — forces a host sync (and "
+                           f"fails under jit)")
+            if msg is not None:
+                yield self.finding(mod, node, msg, scope=scope)

@@ -1,0 +1,264 @@
+"""lock-order: the static lock-acquisition graph must be acyclic.
+
+Incident class: 16 modules use locks (serving batcher/registry/
+session, resilience writer condvars, telemetry registry/health/flight,
+datasets transform) and the PR-6 review found a queued-barrier
+deadlock in exactly this shape — two subsystems each holding their own
+lock while calling into the other. A cycle in the *static* acquisition
+graph (lock A held while a path acquires B, and elsewhere B held while
+a path acquires A) is a deadlock waiting for the right interleaving.
+
+Lock identity is per declaration site: ``self._x = threading.Lock()``
+in class C of module M -> ``M.C._x``; module-level ``_lock =
+threading.Lock()`` -> ``M._lock``. ``Condition`` counts (it owns a
+lock). Edges come from (a) lexical nesting of ``with`` blocks and (b)
+calls made while holding a lock, resolved through the project call
+graph to the callee's transitively-acquired locks. Non-reentrant
+``Lock`` re-acquired on a path from its own holder is flagged too
+(self-deadlock, no interleaving needed).
+
+The runtime half is analysis/witness.py: an instrumented Lock wrapper
+(activated by the lock_witness fixture under the slow multi-thread
+tests) that records ACTUAL acquisition orders and fails on inversion —
+catching orders the static over/under-approximation cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "rlock",
+               "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+
+def declared_locks(mod):
+    """{(class_or_None, attr_or_name): (lock_id, kind)} for every
+    ``threading.Lock()``-style declaration in the module."""
+    out = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call)):
+            continue
+        chain = call_chain(node.value.func)
+        if not chain or chain[-1] not in _LOCK_CTORS:
+            continue
+        if len(chain) >= 2 and chain[-2] not in ("threading",
+                                                 "_thread"):
+            continue
+        kind = _LOCK_CTORS[chain[-1]]
+        info = mod.enclosing_function(node)
+        cls = info.class_name if info is not None else None
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id in ("self", "cls"):
+                lock_id = f"{mod.modname}.{cls}.{t.attr}" if cls \
+                    else f"{mod.modname}.{t.attr}"
+                out[(cls, t.attr)] = (lock_id, kind)
+            elif isinstance(t, ast.Name) and info is None:
+                out[(None, t.id)] = (f"{mod.modname}.{t.id}", kind)
+    return out
+
+
+def _lock_ref(mod, locks, info, expr):
+    """Resolve a with-context / .acquire() receiver expression to a
+    declared lock id, else None."""
+    chain = call_chain(expr)
+    if not chain:
+        return None
+    cls = info.class_name if info is not None else None
+    if len(chain) == 2 and chain[0] in ("self", "cls"):
+        hit = locks.get((cls, chain[1]))
+        return hit
+    if len(chain) == 1:
+        return locks.get((None, chain[0]))
+    return None
+
+
+class _FnLocks:
+    """Per-function lock facts: ordered (held_set, acquired_lock,
+    node) events from lexical with-nesting, plus calls made while
+    holding locks."""
+
+    def __init__(self):
+        self.acquires = []     # (frozenset(held), lock_id, kind, node)
+        self.calls_holding = []  # (frozenset(held), chain, call node)
+        self.all_acquired = set()
+
+
+def _scan_function(mod, locks, info):
+    facts = _FnLocks()
+
+    def ref_of(expr):
+        hit = _lock_ref(mod, locks, info, expr)
+        return hit
+
+    def visit(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            new_held = held
+            if isinstance(child, ast.With):
+                acquired_here = []
+                for item in child.items:
+                    hit = ref_of(item.context_expr)
+                    if hit is not None:
+                        lock_id, kind = hit
+                        facts.acquires.append(
+                            (frozenset(held + acquired_here), lock_id,
+                             kind, child))
+                        acquired_here.append(lock_id)
+                        facts.all_acquired.add(lock_id)
+                new_held = held + acquired_here
+            elif isinstance(child, ast.Call):
+                chain = call_chain(child.func)
+                if chain and chain[-1] == "acquire" and len(chain) >= 2:
+                    hit = ref_of(child.func.value)
+                    if hit is not None:
+                        lock_id, kind = hit
+                        facts.acquires.append(
+                            (frozenset(held), lock_id, kind, child))
+                        facts.all_acquired.add(lock_id)
+                        # conservatively: held for the rest of the fn
+                        held = held + [lock_id]
+                        new_held = held
+                elif chain and held:
+                    facts.calls_holding.append(
+                        (frozenset(held), chain, child))
+            visit(child, new_held)
+
+    visit(info.node, [])
+    return facts
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    severity = Severity.ERROR
+    description = ("cycle in the static lock-acquisition graph (lock A "
+                   "held while acquiring B, and elsewhere B while A) — "
+                   "a deadlock awaiting the right interleaving; or a "
+                   "non-reentrant Lock re-acquired under itself")
+
+    def check_project(self, project):
+        graph = project.callgraph
+        mod_locks = {m.rel: declared_locks(m) for m in project.modules}
+        facts = {}
+        for mod in project.modules:
+            for info in mod.functions.values():
+                facts[id(info)] = _scan_function(
+                    mod, mod_locks[mod.rel], info)
+
+        # transitive lock set per function (fixpoint over call graph)
+        trans = {k: set(f.all_acquired) for k, f in facts.items()}
+        infos = {id(info): info
+                 for m in project.modules
+                 for info in m.functions.values()}
+        for _ in range(12):
+            changed = False
+            for key, info in infos.items():
+                cur = trans[key]
+                before = len(cur)
+                for callee in graph.callees(info):
+                    cur |= trans.get(id(callee), set())
+                if len(cur) != before:
+                    changed = True
+            if not changed:
+                break
+
+        # edges: held -> acquired (direct + via calls)
+        edges: dict = {}   # (a, b) -> (module, node, via)
+        kinds: dict = {}
+
+        def add_edge(a, b, mod, node, via):
+            edges.setdefault((a, b), (mod, node, via))
+
+        for mod in project.modules:
+            for info in mod.functions.values():
+                f = facts[id(info)]
+                for held, lock_id, kind, node in f.acquires:
+                    kinds[lock_id] = kind
+                    for h in held:
+                        add_edge(h, lock_id, mod, node,
+                                 info.qualname)
+                for held, chain, call in f.calls_holding:
+                    callee = graph.resolve_call(mod, info, chain, call)
+                    if callee is None:
+                        continue
+                    for b in trans.get(id(callee), ()):
+                        for h in held:
+                            add_edge(
+                                h, b, mod, call,
+                                f"{info.qualname} -> "
+                                f"{callee.qualname}")
+
+        yield from self._report(edges, kinds, project)
+
+    def _report(self, edges, kinds, project):
+        # self-deadlock: non-reentrant lock under itself
+        reported = set()
+        adj: dict = {}
+        for (a, b), (mod, node, via) in edges.items():
+            if a == b:
+                if kinds.get(a) == "lock" and a not in reported:
+                    reported.add(a)
+                    yield self.finding(
+                        mod, node,
+                        f"non-reentrant lock '{a}' can be re-acquired "
+                        f"while already held (via {via}) — "
+                        f"self-deadlock, no interleaving needed",
+                        )
+                continue
+            adj.setdefault(a, []).append(b)
+
+        # inversion pairs (2-cycles) and longer cycles via DFS
+        seen_pairs = set()
+        for (a, b) in list(edges):
+            if a == b or (b, a) not in edges:
+                continue
+            pair = tuple(sorted((a, b)))
+            if pair in seen_pairs:
+                continue
+            seen_pairs.add(pair)
+            mod, node, via = edges[(a, b)]
+            _, _, via2 = edges[(b, a)]
+            yield self.finding(
+                mod, node,
+                f"lock-order inversion: '{a}' held while acquiring "
+                f"'{b}' (via {via}) but elsewhere '{b}' is held while "
+                f"acquiring '{a}' (via {via2}) — deadlock under the "
+                f"right interleaving")
+
+        # longer cycles (3+) not already covered by a 2-cycle pair
+        for cycle in self._cycles(adj):
+            if len(cycle) < 3:
+                continue
+            if any(tuple(sorted((cycle[i], cycle[(i + 1) % len(cycle)])))
+                   in seen_pairs for i in range(len(cycle))):
+                continue
+            a, b = cycle[0], cycle[1]
+            mod, node, via = edges[(a, b)]
+            yield self.finding(
+                mod, node,
+                f"lock-order cycle: {' -> '.join(cycle + [cycle[0]])} "
+                f"— deadlock under the right interleaving")
+
+    def _cycles(self, adj, limit=20):
+        """Bounded simple-cycle enumeration (Johnson-lite DFS)."""
+        out = []
+        nodes = sorted(adj)
+        for start in nodes:
+            stack = [(start, [start])]
+            while stack and len(out) < limit:
+                cur, path = stack.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt == start and len(path) > 1:
+                        out.append(path[:])
+                    elif nxt not in path and nxt > start and \
+                            len(path) < 6:
+                        stack.append((nxt, path + [nxt]))
+        return out

@@ -1,0 +1,89 @@
+"""metric-drift: every registered metric uses dl4j_ and is documented.
+
+Origin: tools/check_metrics.py (PR 3 satellite), absorbed here as a
+rule so the whole invariant set runs as ONE tier-1 analyzer pass. The
+old CLI remains as a thin shim over this module. The contract is
+unchanged: every literal ``.counter("...")`` / ``.gauge`` /
+``.histogram`` registration must (a) use the ``dl4j_`` prefix and (b)
+appear in docs/OBSERVABILITY.md — otherwise dashboards and alert rules
+silently drift from the code (cross-link: docs/OBSERVABILITY.md
+"Metric-name drift").
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain
+
+_REGISTRATION_METHODS = {"counter", "gauge", "histogram"}
+
+
+def registered_metrics(mod):
+    """[(name, Call node)] for literal metric registrations."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = call_chain(node.func)
+        if not chain or chain[-1] not in _REGISTRATION_METHODS:
+            continue
+        if len(chain) < 2:
+            continue  # bare gauge(...): not a registry method call
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def _name_problems(name, docs_text, where=None):
+    """The two drift checks, shared by the rule and the shim so they
+    cannot diverge. Whole-name docs match: plain substring would let
+    ``dl4j_step`` hide behind a documented ``dl4j_step_seconds``."""
+    loc = f" ({where})" if where else ""
+    out = []
+    if not name.startswith("dl4j_"):
+        out.append(f"metric {name!r}{loc} does not use the dl4j_ "
+                   f"prefix")
+    if not re.search(re.escape(name) + r"(?![\w])", docs_text):
+        out.append(f"metric {name!r}{loc} is not documented in "
+                   f"docs/OBSERVABILITY.md")
+    return out
+
+
+def drift_problems(names, docs_text):
+    """The shim-compatible pure check: {name: [files]} + docs text ->
+    human-readable problem strings (the historical check_metrics.check
+    contract, used by tools/check_metrics.py and test_health.py)."""
+    problems = []
+    for name, files in sorted(names.items()):
+        problems.extend(_name_problems(
+            name, docs_text, where=", ".join(sorted(set(files)))))
+    return problems
+
+
+def collect_metric_names(project) -> dict:
+    """{metric_name: [files]} across the project (AST-based successor
+    of the old regex scan)."""
+    names: dict = {}
+    for mod in project.modules:
+        for name, _node in registered_metrics(mod):
+            names.setdefault(name, []).append(mod.rel)
+    return names
+
+
+@register
+class MetricDriftRule(Rule):
+    name = "metric-drift"
+    severity = Severity.ERROR
+    description = ("registered metric name without the dl4j_ prefix or "
+                   "missing from docs/OBSERVABILITY.md (absorbed "
+                   "tools/check_metrics.py)")
+
+    def check_module(self, mod, project):
+        docs_text = project.config.get("docs_text", "")
+        for name, node in registered_metrics(mod):
+            for message in _name_problems(name, docs_text):
+                yield self.finding(mod, node, message)

@@ -1,0 +1,50 @@
+"""telemetry-gate: ``telemetry.disable()`` must mean zero registry
+calls.
+
+Contract (PR 1, re-asserted every PR since): ``telemetry.disable()``
+compiles observability OUT — the disabled step path performs *zero*
+registry calls (tested with a counting stub in test_health.py). The
+idiom is either the ``*_instruments()`` factories (which return None
+when disabled, so the hot loop guards on the bundle) or an explicit
+``if telemetry.enabled():`` before ``get_registry()``.
+
+This rule flags a ``get_registry()`` call in a function (outside
+``telemetry/`` itself and the analyzer) that contains no
+``enabled()``/``enable()`` check — the class of drift that silently
+re-introduces per-step registry overhead on the disabled path.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+
+_GATES = {"enabled", "enable", "loop_instruments", "etl_instruments",
+          "serving_instruments"}
+_EXEMPT_PREFIXES = ("telemetry/", "analysis/")
+
+
+@register
+class TelemetryGateRule(Rule):
+    name = "telemetry-gate"
+    severity = Severity.ERROR
+    description = ("get_registry() in a function with no enabled() "
+                   "check — breaks the zero-registry-calls-when-"
+                   "disabled contract (PR 1)")
+
+    def check_module(self, mod, project):
+        rel = mod.rel
+        if any(p in rel for p in _EXEMPT_PREFIXES):
+            return
+        for info in mod.functions.values():
+            gated = any(chain and chain[-1] in _GATES
+                        for chain, _ in info.calls)
+            if gated:
+                continue
+            for chain, call in info.calls:
+                if chain and chain[-1] == "get_registry":
+                    yield self.finding(
+                        mod, call,
+                        "get_registry() without an enabled() gate in "
+                        "the same function — the disabled telemetry "
+                        "path must make zero registry calls",
+                        scope=info.qualname)

@@ -1,0 +1,142 @@
+"""thread-hygiene: explicit daemon=, and stored threads get joined.
+
+Incidents: the PR-5/6 review-fix lists are a catalog of thread
+lifecycle bugs (the batcher re-arming its own shutdown sentinel after
+a timed-out join, the prefetcher producer leaking into the next fit,
+supervisor watchdog shutdown races). Two cheap invariants prevent the
+recurring half: (a) every ``threading.Thread`` states ``daemon=``
+explicitly — an implicit non-daemon worker turns a crashed test into a
+hung process; (b) a thread stored on ``self`` is joined somewhere in
+its class (``close``/``stop``/``shutdown``/``retire``/``join`` path) —
+otherwise shutdown is fire-and-forget and errors are never surfaced.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain, keyword
+
+
+@register
+class ThreadHygieneRule(Rule):
+    name = "thread-hygiene"
+    severity = Severity.WARN
+    description = ("threading.Thread without explicit daemon=, or a "
+                   "self-stored thread never joined anywhere in its "
+                   "class")
+
+    def check_module(self, mod, project):
+        # class name -> set of attr names .join()ed anywhere in it;
+        # local aliases count: `t = self._thread; t.join()` joins
+        # _thread (the prefetcher's drain-then-join idiom)
+        joined: dict = {}
+        daemon_attr_set: dict = {}
+        for info in mod.functions.values():
+            cls = info.class_name
+            if cls is None:
+                continue
+            aliases = self._self_attr_aliases(info.node)
+            for chain, call in info.calls:
+                if chain and chain[-1] == "join" and len(chain) >= 2:
+                    name = chain[-2]
+                    joined.setdefault(cls, set()).add(name)
+                    for attr in aliases.get(name, ()):
+                        joined[cls].add(attr)
+        # `t.daemon = True` after construction also satisfies (a)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon":
+                        base = call_chain(t.value)
+                        if base:
+                            daemon_attr_set.setdefault(
+                                mod.scope_name(node), set()).add(
+                                    base[-1])
+
+        for info in mod.functions.values():
+            for chain, call in info.calls:
+                if not chain or chain[-1] != "Thread":
+                    continue
+                if len(chain) == 2 and chain[0] not in ("threading",):
+                    continue  # SomeClass.Thread / other libs
+                yield from self._check_thread(mod, info, call, joined,
+                                              daemon_attr_set)
+
+    def _check_thread(self, mod, info, call, joined, daemon_attr_set):
+        stmt = self._enclosing_stmt(mod, call)
+        target_names = self._assign_names(stmt)
+        if keyword(call, "daemon") is None:
+            set_later = daemon_attr_set.get(info.qualname, set())
+            if not (target_names & set_later):
+                yield self.finding(
+                    mod, call,
+                    "threading.Thread without explicit daemon= — an "
+                    "implicit non-daemon worker hangs process exit on "
+                    "a crash; state the lifecycle intent",
+                    scope=info.qualname)
+        # (b) stored on self and never joined in the class
+        self_attrs = self._self_attrs(stmt)
+        cls = info.class_name
+        if cls is not None:
+            cls_joined = joined.get(cls, set())
+            for attr in self_attrs:
+                if attr not in cls_joined:
+                    yield self.finding(
+                        mod, call,
+                        f"thread stored as self.{attr} is never "
+                        f".join()ed in class {cls} — shutdown is "
+                        f"fire-and-forget and worker errors are never "
+                        f"surfaced; join it in close()/stop()",
+                        scope=info.qualname)
+
+    def _enclosing_stmt(self, mod, node):
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = mod.parent.get(cur)
+        return cur
+
+    def _assign_names(self, stmt):
+        names = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    def _self_attr_aliases(self, fn_node):
+        """{local_name: {self attrs it aliases}} from assignments like
+        ``t = self._thread`` / ``t, q = self._thread, self._queue``."""
+        aliases: dict = {}
+
+        def pair(target, value):
+            if isinstance(target, ast.Name) and \
+                    isinstance(value, ast.Attribute) and \
+                    isinstance(value.value, ast.Name) and \
+                    value.value.id == "self":
+                aliases.setdefault(target.id, set()).add(value.attr)
+
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, (ast.Tuple, ast.List)) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)) \
+                        and len(t.elts) == len(node.value.elts):
+                    for te, ve in zip(t.elts, node.value.elts):
+                        pair(te, ve)
+                else:
+                    pair(t, node.value)
+        return aliases
+
+    def _self_attrs(self, stmt):
+        attrs = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    attrs.add(t.attr)
+        return attrs

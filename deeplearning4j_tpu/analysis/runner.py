@@ -1,0 +1,78 @@
+"""dl4jlint runner: parse -> rules -> suppressions -> baseline."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.analysis.core import Severity, all_rules
+from deeplearning4j_tpu.analysis.model import load_project
+
+
+class Report:
+    """Outcome of one analysis run."""
+
+    def __init__(self, project, findings, baseline=None,
+                 suppressed_count=0):
+        self.project = project
+        self.baseline = baseline
+        self.suppressed_count = suppressed_count
+        if baseline is not None:
+            self.new, self.baselined, self.stale_keys = \
+                baseline.split(findings)
+        else:
+            self.new, self.baselined, self.stale_keys = \
+                list(findings), [], []
+        self.all_findings = list(findings)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def render(self, show_baselined=False) -> str:
+        out = []
+        for f in sorted(self.new,
+                        key=lambda f: (-Severity.rank(f.severity),
+                                       f.file, f.line)):
+            out.append(f.render())
+        if show_baselined:
+            for f in sorted(self.baselined,
+                            key=lambda f: (f.file, f.line)):
+                out.append(f"[baselined] {f.render()}")
+        return "\n".join(out)
+
+
+def run_rules(project, rules=None):
+    """All findings (pre-baseline), inline suppressions applied.
+    Returns (findings, suppressed_count)."""
+    rules = rules if rules is not None else all_rules()
+    findings, suppressed = [], 0
+    by_rel = project.by_rel
+    for rule in rules.values():
+        produced = []
+        for mod in project.modules:
+            produced.extend(rule.check_module(mod, project))
+        produced.extend(rule.check_project(project))
+        for f in produced:
+            mod = by_rel.get(f.file)
+            node = getattr(f, "_node", None)
+            if mod is not None and node is not None and \
+                    mod.is_suppressed(f.rule, node):
+                suppressed += 1
+                continue
+            if mod is not None and node is None:
+                # findings without an anchored node: honor a line-level
+                # or module-level directive
+                rules_at = mod.suppressed.get(f.line, set()) | \
+                    mod.suppressed.get(1, set()) | \
+                    mod.suppressed.get(2, set())
+                if f.rule in rules_at or "all" in rules_at:
+                    suppressed += 1
+                    continue
+            findings.append(f)
+    return findings, suppressed
+
+
+def analyze(paths, root=None, baseline=None, rules=None, config=None):
+    """Full pipeline; returns a Report."""
+    project = load_project(paths, root=root, config=config)
+    findings, suppressed = run_rules(project, rules=rules)
+    return Report(project, findings, baseline=baseline,
+                  suppressed_count=suppressed)

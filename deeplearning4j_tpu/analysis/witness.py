@@ -1,0 +1,215 @@
+"""Runtime lock witness: the dynamic half of the lock-order rule.
+
+The static rule (rules/lock_order.py) sees the graph it can resolve;
+this wrapper sees the orders that ACTUALLY happen. A ``WitnessLock``
+records, per thread, the stack of witnessed locks held; acquiring B
+while holding A registers the edge A->B with a code location. If the
+reverse edge B->A was ever witnessed, that is an inversion — two
+threads running those two paths concurrently can deadlock — and the
+witness records it (or raises in ``strict`` mode).
+
+Activation: ``install()`` monkeypatches ``threading.Lock`` /
+``threading.RLock`` with factories that return witnessed locks ONLY
+when constructed from code under this package (caller-frame check) —
+stdlib internals (queue.Queue, Condition's inner lock) keep real
+locks. The ``lock_witness`` pytest fixture (tests/conftest.py)
+installs it for every ``slow``-marked test and fails the test on any
+recorded inversion.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the genuine constructors, captured before any install() patches the
+# threading module — WitnessLock's own inner lock and the witness's
+# graph lock must never route back through the factory
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class Inversion:
+    __slots__ = ("first", "second")
+
+    def __init__(self, first, second):
+        self.first = first    # (a, b, site) edge seen earlier
+        self.second = second  # (b, a, site) edge that inverted it
+
+    def render(self) -> str:
+        (a, b, s1), (b2, a2, s2) = self.first, self.second
+        return (f"lock-order inversion: {a} -> {b} at {s1} vs "
+                f"{b2} -> {a2} at {s2}")
+
+
+class LockOrderViolation(RuntimeError):
+    pass
+
+
+class LockWitness:
+    """Shared recorder: the order graph + inversions."""
+
+    def __init__(self, strict=False):
+        self.strict = strict
+        self._graph_lock = _REAL_LOCK()  # guards order/inversions
+        self.order: dict = {}        # (a, b) -> first-seen site str
+        self.inversions: list = []
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------------
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, name):
+        site = self._caller_site()
+        held = self._held()
+        if name in held:        # RLock re-entry: no new edges
+            held.append(name)
+            return
+        with self._graph_lock:
+            for prev in set(held):
+                edge = (prev, name)
+                if edge not in self.order:
+                    self.order[edge] = site
+                rev = (name, prev)
+                if rev in self.order:
+                    inv = Inversion((name, prev, self.order[rev]),
+                                    (prev, name, site))
+                    self.inversions.append(inv)
+                    if self.strict:
+                        held.append(name)  # keep the stack truthful
+                        raise LockOrderViolation(inv.render())
+        held.append(name)
+
+    def note_release(self, name):
+        held = self._held()
+        if name in held:
+            # remove the most recent acquisition of this lock
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+
+    @staticmethod
+    def _caller_site() -> str:
+        # nearest frame outside this module (call depth varies between
+        # .acquire() and the with-statement __enter__ path)
+        f = sys._getframe(1)
+        here = os.path.abspath(__file__)
+        while f is not None and \
+                os.path.abspath(f.f_code.co_filename) == here:
+            f = f.f_back
+        if f is None:
+            return "<unknown>"
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+    def format_inversions(self) -> str:
+        return "\n".join(i.render() for i in self.inversions)
+
+
+class WitnessLock:
+    """Drop-in for threading.Lock/RLock that reports to a witness."""
+
+    def __init__(self, witness, name=None, reentrant=False):
+        self._witness = witness
+        self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        if name is None:
+            f = sys._getframe(1)
+            name = (f"{os.path.basename(f.f_code.co_filename)}:"
+                    f"{f.f_lineno}")
+        self.name = name
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                self._witness.note_acquire(self.name)
+            except BaseException:
+                # strict-mode LockOrderViolation: the raise must not
+                # leave the inner lock held (the caller's with-block
+                # never runs, so release would never come)
+                self._witness.note_release(self.name)
+                self._inner.release()
+                raise
+        return got
+
+    def release(self):
+        self._witness.note_release(self.name)
+        self._inner.release()
+
+    def locked(self):
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        # RLock grows .locked() only in Python 3.12 — probe with a
+        # non-blocking acquire (held-by-self reports unlocked, matching
+        # RLock's reacquirability)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition(lock) compatibility
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+
+_installed = None  # (witness, real_Lock, real_RLock)
+
+
+def install(strict=False, package_dir=None) -> LockWitness:
+    """Patch threading.Lock/RLock so locks constructed from code under
+    ``package_dir`` (default: this package) are witnessed. Returns the
+    witness; call uninstall() to restore."""
+    global _installed
+    if _installed is not None:
+        raise RuntimeError("lock witness already installed")
+    pkg = os.path.abspath(package_dir or _PKG_DIR)
+    here = os.path.abspath(__file__)
+    witness = LockWitness(strict=strict)
+    real_lock, real_rlock = threading.Lock, threading.RLock
+
+    def _from_pkg() -> bool:
+        f = sys._getframe(2)
+        fname = os.path.abspath(f.f_code.co_filename)
+        return fname.startswith(pkg) and fname != here
+
+    def lock_factory():
+        if _from_pkg():
+            return WitnessLock(witness, reentrant=False)
+        return real_lock()
+
+    def rlock_factory():
+        if _from_pkg():
+            return WitnessLock(witness, reentrant=True)
+        return real_rlock()
+
+    threading.Lock = lock_factory
+    threading.RLock = rlock_factory
+    _installed = (witness, real_lock, real_rlock)
+    return witness
+
+
+def uninstall():
+    global _installed
+    if _installed is None:
+        return None
+    witness, real_lock, real_rlock = _installed
+    threading.Lock = real_lock
+    threading.RLock = real_rlock
+    _installed = None
+    return witness

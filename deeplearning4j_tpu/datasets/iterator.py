@@ -145,10 +145,16 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread.start()
 
     def reset(self):
-        # drain current thread then restart
-        if self._thread is not None and self._thread.is_alive():
-            while self._queue.get() is not self._END:
-                pass
+        # drain current thread then restart; drain only while _END is
+        # still in flight (if the consumer already took it, a blind
+        # get() would block forever on the empty queue), then join so
+        # the old producer can't interleave with the new epoch's
+        t = self._thread
+        if t is not None and t.is_alive():
+            if not self._done:
+                while self._queue.get() is not self._END:
+                    pass
+            t.join(timeout=5.0)
         self._start()
         self._peek = None
 

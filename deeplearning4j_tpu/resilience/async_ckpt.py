@@ -572,13 +572,14 @@ class AsyncCheckpointer:
                     "hasPrecState": bool(snap.save_updater and snap.prec),
                     "trainingState": {"iteration": snap.iteration,
                                       "epoch": snap.epoch}}
-            # sync=False: a background thread must not issue collectives
-            # (they would interleave with the train loop's in-step
-            # collectives and desync the hosts) — completeness is
-            # certified at read time by latest_agreed() instead
+            # write_snapshot is collective-free by construction — a
+            # background thread must not issue collectives (they would
+            # interleave with the train loop's in-step collectives and
+            # desync the hosts); completeness is certified at read time
+            # by latest_agreed() instead
             write_snapshot(self._path(snap.step),
                            extract_snapshot(tree, snap.step, meta),
-                           pre_commit=pre_commit, sync=False)
+                           pre_commit=pre_commit)
         else:
             import jax
 

@@ -238,5 +238,9 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+            # serve_forever returns after shutdown(); join so stop()
+            # means stopped and worker errors can't outlive the server
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
             self._thread = None
         return self

@@ -172,50 +172,74 @@ def extract_snapshot(tree, step=0, meta=None):
             "step": int(step), "meta": meta or {}}
 
 
-def write_snapshot(directory, snap, pre_commit=None, sync=True):
-    """The I/O half of :func:`save_sharded`: write this process's shard
-    npz (tmp + replace), sync all processes, then process 0 commits the
-    manifest (tmp + replace, after the sync — a complete manifest
-    implies complete shard files on every host). ``pre_commit`` runs
-    before the manifest rename (fault-injection seam).
-
-    ``sync=False`` (the async-checkpoint writer thread): NO collectives
-    are issued — a background thread's barrier would interleave with
-    the train loop's in-step collectives and the processes would
-    disagree on collective order (observed as gloo context-init
-    deadlocks). Without the barrier a manifest no longer certifies the
-    other hosts' shards, so readers must use ``latest_agreed()`` /
-    :func:`is_complete`, which verify every referenced shard file on
-    the shared directory instead."""
-    t0 = time.perf_counter()
+def _write_shard(directory, snap) -> str:
+    """Write this process's shard npz (tmp + replace); returns the
+    committed shard path."""
     pid = snap["pid"]
     os.makedirs(directory, exist_ok=True)
     tmp = os.path.join(directory, f"shard_{pid}.tmp.npz")
     np.savez(tmp, **snap["payload"])
     shard_path = os.path.join(directory, f"shard_{pid}.npz")
     os.replace(tmp, shard_path)
-    if sync:
-        _sync("shards_written")
+    return shard_path
+
+
+def _write_manifest(directory, snap, pre_commit=None):
+    """Process 0's manifest commit (tmp + replace). ``pre_commit``
+    runs before the rename (fault-injection seam)."""
+    man = {"step": snap["step"], "process_count": snap["process_count"],
+           "leaves": snap["leaves"], "meta": snap["meta"]}
+    mtmp = os.path.join(directory, MANIFEST + ".tmp")
+    with open(mtmp, "w") as f:
+        json.dump(man, f)
+    if pre_commit is not None:
+        pre_commit()
+    os.replace(mtmp, os.path.join(directory, MANIFEST))
+
+
+def write_snapshot(directory, snap, pre_commit=None):
+    """The I/O half of :func:`save_sharded`, collective-free BY
+    CONSTRUCTION: shard write, then (process 0) manifest commit, with
+    no cross-process sync anywhere on the path. This is the function a
+    background checkpoint writer thread may call — a background
+    thread's barrier would interleave with the train loop's in-step
+    collectives and the processes would disagree on collective order
+    (observed as gloo context-init deadlocks; PR 5). Without a barrier
+    the manifest does NOT certify the other hosts' shards, so readers
+    must use ``latest_agreed()`` / :func:`is_complete`, which verify
+    every referenced shard file on the shared directory instead.
+
+    The split (vs. the historical ``sync=`` flag) is deliberate: the
+    dl4jlint collective-thread rule proves background threads cannot
+    reach a collective, which a runtime flag cannot express."""
+    t0 = time.perf_counter()
+    shard_path = _write_shard(directory, snap)
     _record_checkpoint("save", t0, os.path.getsize(shard_path))
-    if pid == 0:
-        man = {"step": snap["step"], "process_count": snap["process_count"],
-               "leaves": snap["leaves"], "meta": snap["meta"]}
-        mtmp = os.path.join(directory, MANIFEST + ".tmp")
-        with open(mtmp, "w") as f:
-            json.dump(man, f)
-        if pre_commit is not None:
-            pre_commit()
-        os.replace(mtmp, os.path.join(directory, MANIFEST))
-    if sync:
-        _sync("manifest_written")
+    if snap["pid"] == 0:
+        _write_manifest(directory, snap, pre_commit)
+
+
+def write_snapshot_synced(directory, snap, pre_commit=None):
+    """Barrier-certified commit for the synchronous save path: shard
+    write, all-hosts sync, manifest commit (a complete manifest then
+    implies complete shard files on every host), final sync. TRAIN
+    THREAD ONLY — never call from a background thread (see
+    :func:`write_snapshot`)."""
+    t0 = time.perf_counter()
+    shard_path = _write_shard(directory, snap)
+    _sync("shards_written")
+    _record_checkpoint("save", t0, os.path.getsize(shard_path))
+    if snap["pid"] == 0:
+        _write_manifest(directory, snap, pre_commit)
+    _sync("manifest_written")
 
 
 def save_sharded(directory, tree, step=0, meta=None, pre_commit=None):
     """Write this process's chunks of `tree` (a pytree of jax/numpy
     arrays) under `directory`; process 0 also writes the manifest.
     ``pre_commit`` runs before the manifest rename (fault seam)."""
-    write_snapshot(directory, extract_snapshot(tree, step, meta),
-                   pre_commit=pre_commit)
+    write_snapshot_synced(directory, extract_snapshot(tree, step, meta),
+                          pre_commit=pre_commit)
 
 
 def is_complete(directory) -> bool:

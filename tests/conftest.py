@@ -29,3 +29,29 @@ jax.config.update("jax_platforms", "cpu")
 # (fine for the MXU perf path; fatal for numeric gradient checks) — force
 # full fp32 matmuls in tests (SURVEY.md §7 "Numerics").
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def lock_witness(request):
+    """Runtime half of the dl4jlint lock-order rule (ISSUE 7): under
+    the slow multi-thread tests (serving soak, resilience, parallel
+    ETL), package-created threading.Lock/RLock are replaced with
+    instrumented wrappers that record ACTUAL acquisition orders; the
+    test fails on any witnessed inversion — the deadlock orders the
+    static rule's call-graph resolution cannot see. Quick-mode tests
+    are untouched (no monkeypatching on the tier-1 path)."""
+    if request.node.get_closest_marker("slow") is None:
+        yield None
+        return
+    from deeplearning4j_tpu.analysis import witness
+
+    w = witness.install()
+    try:
+        yield w
+    finally:
+        witness.uninstall()
+    assert not w.inversions, (
+        "lock-order inversion witnessed at runtime:\n"
+        + w.format_inversions())

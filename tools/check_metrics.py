@@ -1,68 +1,53 @@
 #!/usr/bin/env python
-"""Metric-name drift check (ISSUE 3 satellite).
+"""Metric-name drift check — thin shim over the dl4jlint metric-drift
+rule (ISSUE 7 absorbed the PR-3 satellite tool into the analyzer).
 
-Every metric registered by instrumented code must (a) use the ``dl4j_``
-prefix and (b) be documented in docs/OBSERVABILITY.md — otherwise
-dashboards and alert rules silently drift from the code. Run standalone
-(``python tools/check_metrics.py``, exits non-zero on drift) or via
-tests/test_health.py::TestMetricNameDrift.
+The contract is unchanged: every metric registered by instrumented
+code must (a) use the ``dl4j_`` prefix and (b) be documented in
+docs/OBSERVABILITY.md. Run standalone (``python tools/check_metrics.py``,
+exits non-zero on drift), via tests/test_health.py::TestMetricNameDrift,
+or — the successor path — as the ``metric-drift`` rule inside
+``python tools/dl4jlint.py``.
 
-Names are collected by scanning the package source for literal
-``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
-registrations, so a new instrument cannot be added without either
-following the convention or updating this tool.
+Kept API (used by test_health.py and docs): ``collect_metric_names()``,
+``check(names=, docs_text=)``, ``main()``.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
 PACKAGE = ROOT / "deeplearning4j_tpu"
 DOCS = ROOT / "docs" / "OBSERVABILITY.md"
 
-# literal first argument of a registry registration call; re.S lets the
-# name sit on the line after the open paren (the prevailing style here)
-_REGISTRATION = re.compile(
-    r'\.\s*(?:counter|gauge|histogram)\(\s*[\'"]([A-Za-z_:][\w:]*)[\'"]',
-    re.S)
 
-# derived sample names the registry emits beside the family name — they
-# need no separate doc entry
-_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+def _project():
+    from deeplearning4j_tpu.analysis.model import load_project
+
+    return load_project([str(PACKAGE)], root=str(ROOT))
 
 
 def collect_metric_names() -> dict:
-    """{metric_name: [files registering it]} across the package."""
-    names: dict = {}
-    for path in sorted(PACKAGE.rglob("*.py")):
-        text = path.read_text()
-        for name in _REGISTRATION.findall(text):
-            names.setdefault(name, []).append(
-                str(path.relative_to(ROOT)))
-    return names
+    """{metric_name: [files registering it]} across the package
+    (AST-based, via the dl4jlint metric-drift rule collector)."""
+    from deeplearning4j_tpu.analysis.rules.metric_drift import (
+        collect_metric_names as collect)
+
+    return collect(_project())
 
 
 def check(names=None, docs_text=None) -> list:
     """Drift findings as human-readable strings (empty = clean)."""
+    from deeplearning4j_tpu.analysis.rules.metric_drift import (
+        drift_problems)
+
     names = collect_metric_names() if names is None else names
     docs_text = DOCS.read_text() if docs_text is None else docs_text
-    problems = []
-    for name, files in sorted(names.items()):
-        where = ", ".join(sorted(set(files)))
-        if not name.startswith("dl4j_"):
-            problems.append(
-                f"metric {name!r} ({where}) does not use the dl4j_ "
-                f"prefix")
-        # whole-name match: plain substring would let `dl4j_step` hide
-        # behind a documented `dl4j_step_seconds`
-        if not re.search(re.escape(name) + r"(?![\w])", docs_text):
-            problems.append(
-                f"metric {name!r} ({where}) is not documented in "
-                f"docs/OBSERVABILITY.md")
-    return problems
+    return drift_problems(names, docs_text)
 
 
 def main() -> int:

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""dl4jlint CLI: project-invariant static analysis (ISSUE 7).
+
+Usage:
+  python tools/dl4jlint.py deeplearning4j_tpu/          full run
+  python tools/dl4jlint.py --changed                    lint only files
+                                                        touched vs git
+  python tools/dl4jlint.py --baseline-update            re-triage
+  python tools/dl4jlint.py --list-rules                 rule catalog
+
+Exit codes: 0 clean (all findings baselined/suppressed), 1 findings,
+2 usage/internal error. Baseline: tools/dl4jlint_baseline.json
+(committed; every entry carries a one-line reason). Inline escape
+hatch: ``# dl4jlint: disable=<rule>[,<rule>]`` on the flagged line or
+the enclosing def. Catalog + workflow: docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "dl4jlint_baseline.json")
+DEFAULT_TARGET = os.path.join(ROOT, "deeplearning4j_tpu")
+
+
+def changed_files() -> list:
+    """Package .py files touched vs git HEAD (staged + unstaged +
+    untracked) — the fast pre-commit set."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=ROOT, capture_output=True,
+                                  text=True, check=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"dl4jlint: --changed needs git ({e})",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        out.update(l.strip() for l in proc.stdout.splitlines()
+                   if l.strip())
+    return sorted(
+        os.path.join(ROOT, f) for f in out
+        if f.endswith(".py") and f.startswith("deeplearning4j_tpu/")
+        and os.path.exists(os.path.join(ROOT, f)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dl4jlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories (default: the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report everything, ignore the baseline")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(reasons preserved for surviving keys)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in files changed vs git "
+                         "HEAD (whole package is still parsed for "
+                         "cross-module context)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print findings the baseline covers")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset to run")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.analysis import (Baseline, all_rules,
+                                             analyze)
+
+    rules = all_rules()
+    if args.list_rules:
+        for name in sorted(rules):
+            r = rules[name]
+            print(f"{name:22s} [{r.severity}] {r.description}")
+        return 0
+    if args.rules:
+        want = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = want - set(rules)
+        if unknown:
+            print(f"dl4jlint: unknown rules: {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in rules.items() if k in want}
+
+    paths = args.paths or [DEFAULT_TARGET]
+    changed = None
+    if args.changed:
+        changed = changed_files()
+        if not changed:
+            print("dl4jlint: no changed package files")
+            return 0
+        paths = [DEFAULT_TARGET]  # full context, filtered report
+
+    baseline = None if args.no_baseline else \
+        Baseline.load(args.baseline)
+    report = analyze(paths, root=ROOT, baseline=baseline, rules=rules)
+
+    if args.baseline_update:
+        # always rewrite FROM the committed baseline (even under
+        # --no-baseline) so triage reasons survive the regeneration;
+        # a --rules subset run only rewrites that subset's entries
+        bl = baseline if baseline is not None \
+            else Baseline.load(args.baseline)
+        bl.update_from(report.all_findings,
+                       restrict_to_rules=set(rules) if args.rules
+                       else None)
+        bl.save(args.baseline)
+        print(f"dl4jlint: baseline rewritten with "
+              f"{len(bl.entries)} entries -> {args.baseline}")
+        return 0
+
+    new = report.new
+    if changed is not None:
+        rels = {os.path.relpath(c, ROOT).replace(os.sep, "/")
+                for c in changed}
+        new = [f for f in new if f.file in rels]
+
+    for f in sorted(new, key=lambda f: (f.file, f.line)):
+        print(f.render())
+    n_mod = len(report.project.modules)
+    n_base = len(report.baselined)
+    if new:
+        print(f"dl4jlint: {len(new)} finding(s) over {n_mod} files "
+              f"({n_base} baselined, {report.suppressed_count} "
+              f"suppressed)", file=sys.stderr)
+        if report.stale_keys:
+            print(f"dl4jlint: note: {len(report.stale_keys)} stale "
+                  f"baseline entr(ies) — run --baseline-update",
+                  file=sys.stderr)
+        return 1
+    print(f"dl4jlint: clean — {n_mod} files, "
+          f"{len(rules)} rules, {n_base} baselined, "
+          f"{report.suppressed_count} suppressed"
+          + (f", {len(report.stale_keys)} stale baseline entries "
+             f"(run --baseline-update)" if report.stale_keys else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
