@@ -32,6 +32,35 @@ _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
 
 
+def _caller_site(root: str | None = None) -> str:
+    """Nearest frame OUTSIDE this module. Call depth varies between
+    .acquire() and the with-statement path, and lock construction may
+    go through install()'s factories (also in this file) — walking past
+    every witness.py frame lands on the real user site either way."""
+    f = sys._getframe(1)
+    here = os.path.abspath(__file__)
+    while f is not None and \
+            os.path.abspath(f.f_code.co_filename) == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fname = os.path.abspath(f.f_code.co_filename)
+    root = root or _PKG_DIR
+    if fname.startswith(root + os.sep):
+        # full package-relative path: names are lock CLASSES, so any
+        # aliasing silences edges between the aliased locks (the
+        # same-class skip in note_acquire). One parent dir is not
+        # enough — serving/api/handlers.py and clustering/api/
+        # handlers.py would collapse.
+        label = os.path.relpath(fname, root)
+    else:
+        # outside the witnessed tree (tests, scripts): keep the parent
+        # dir so ui/server.py and clustering/server.py stay distinct
+        label = os.path.join(os.path.basename(os.path.dirname(fname)),
+                             os.path.basename(fname))
+    return f"{label}:{f.f_lineno}"
+
+
 class Inversion:
     __slots__ = ("first", "second")
 
@@ -50,13 +79,32 @@ class LockOrderViolation(RuntimeError):
 
 
 class LockWitness:
-    """Shared recorder: the order graph + inversions."""
+    """Shared recorder: the order graph + inversions.
 
-    def __init__(self, strict=False):
+    Identity is two-level, lockdep-style: the per-thread held stack
+    tracks lock OBJECTS (so only re-acquiring the same RLock counts as
+    re-entry), while the order graph is keyed by NAME — the lock's
+    lockdep-style class: the explicit name, or the construction site
+    for auto-named locks. Class keying catches an A-class/B-class
+    inversion even when threads touch different instances, and keeps
+    the graph bounded by the number of construction sites when code
+    churns fresh locks in a loop (a per-instance key would grow
+    order/inversions forever there). Known blind spot, same as
+    lockdep's: edges between two instances of ONE class are never
+    recorded, so an AB/BA inversion between two locks minted at the
+    same site goes unseen — the alternative would false-positive on
+    legal hierarchical same-class nesting (shard locks taken in index
+    order)."""
+
+    def __init__(self, strict=False, pkg_root=None):
         self.strict = strict
+        # root that site labels are made relative to; install() points
+        # it at the patched package_dir so auto-names never alias
+        self.pkg_root = os.path.abspath(pkg_root or _PKG_DIR)
         self._graph_lock = _REAL_LOCK()  # guards order/inversions
         self.order: dict = {}        # (a, b) -> first-seen site str
         self.inversions: list = []
+        self._inv_seen: set = set()  # (a, b) pairs already reported
         self._tls = threading.local()
 
     # -- per-thread held stack ----------------------------------------------
@@ -66,48 +114,49 @@ class LockWitness:
             held = self._tls.held = []
         return held
 
-    def note_acquire(self, name):
-        site = self._caller_site()
+    def note_acquire(self, lock):
+        name = lock.name
         held = self._held()
-        if name in held:        # RLock re-entry: no new edges
-            held.append(name)
+        if any(h is lock for h in held):  # RLock re-entry: no new edges
+            held.append(lock)
             return
-        with self._graph_lock:
-            for prev in set(held):
-                edge = (prev, name)
-                if edge not in self.order:
-                    self.order[edge] = site
-                rev = (name, prev)
-                if rev in self.order:
-                    inv = Inversion((name, prev, self.order[rev]),
-                                    (prev, name, site))
-                    self.inversions.append(inv)
-                    if self.strict:
-                        held.append(name)  # keep the stack truthful
-                        raise LockOrderViolation(inv.render())
-        held.append(name)
+        if held:  # frame walk + graph lock only when edges can form
+            site = _caller_site(self.pkg_root)
+            with self._graph_lock:
+                for prev in {h.name for h in held}:
+                    if prev == name:
+                        # sibling instance of the same lock class: a
+                        # self-edge would flag every nested same-site
+                        # pair, and hierarchical same-class nesting is
+                        # legal
+                        continue
+                    edge = (prev, name)
+                    if edge not in self.order:
+                        self.order[edge] = site
+                    rev = (name, prev)
+                    if rev in self.order:
+                        inv = Inversion((name, prev, self.order[rev]),
+                                        (prev, name, site))
+                        # record each inverted pair once, UNORDERED key
+                        # (both directions are the same defect) — a soak
+                        # loop hitting the same inversion 10k times must
+                        # not grow the report unboundedly
+                        pair = (prev, name) if prev < name else (name, prev)
+                        if pair not in self._inv_seen:
+                            self._inv_seen.add(pair)
+                            self.inversions.append(inv)
+                        if self.strict:
+                            held.append(lock)  # keep the stack truthful
+                            raise LockOrderViolation(inv.render())
+        held.append(lock)
 
-    def note_release(self, name):
+    def note_release(self, lock):
         held = self._held()
-        if name in held:
-            # remove the most recent acquisition of this lock
-            for i in range(len(held) - 1, -1, -1):
-                if held[i] == name:
-                    del held[i]
-                    break
-
-    @staticmethod
-    def _caller_site() -> str:
-        # nearest frame outside this module (call depth varies between
-        # .acquire() and the with-statement __enter__ path)
-        f = sys._getframe(1)
-        here = os.path.abspath(__file__)
-        while f is not None and \
-                os.path.abspath(f.f_code.co_filename) == here:
-            f = f.f_back
-        if f is None:
-            return "<unknown>"
-        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        # remove the most recent acquisition of this lock object
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
 
     def format_inversions(self) -> str:
         return "\n".join(i.render() for i in self.inversions)
@@ -120,27 +169,29 @@ class WitnessLock:
         self._witness = witness
         self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
         if name is None:
-            f = sys._getframe(1)
-            name = (f"{os.path.basename(f.f_code.co_filename)}:"
-                    f"{f.f_lineno}")
+            # frame-walk, not _getframe(1): when built via install()'s
+            # factories the immediate caller is the factory itself and
+            # every lock would share one name, silencing all edges
+            name = _caller_site(witness.pkg_root)
+        # lockdep-style class: explicit name, or construction site
         self.name = name
 
     def acquire(self, blocking=True, timeout=-1):
         got = self._inner.acquire(blocking, timeout)
         if got:
             try:
-                self._witness.note_acquire(self.name)
+                self._witness.note_acquire(self)
             except BaseException:
                 # strict-mode LockOrderViolation: the raise must not
                 # leave the inner lock held (the caller's with-block
                 # never runs, so release would never come)
-                self._witness.note_release(self.name)
+                self._witness.note_release(self)
                 self._inner.release()
                 raise
         return got
 
     def release(self):
-        self._witness.note_release(self.name)
+        self._witness.note_release(self)
         self._inner.release()
 
     def locked(self):
@@ -180,13 +231,16 @@ def install(strict=False, package_dir=None) -> LockWitness:
         raise RuntimeError("lock witness already installed")
     pkg = os.path.abspath(package_dir or _PKG_DIR)
     here = os.path.abspath(__file__)
-    witness = LockWitness(strict=strict)
+    witness = LockWitness(strict=strict, pkg_root=pkg)
     real_lock, real_rlock = threading.Lock, threading.RLock
 
     def _from_pkg() -> bool:
         f = sys._getframe(2)
         fname = os.path.abspath(f.f_code.co_filename)
-        return fname.startswith(pkg) and fname != here
+        # os.sep-anchored, matching _caller_site's relpath check: a bare
+        # prefix would witness a sibling dir like <pkg>_extras but label
+        # its locks with the out-of-tree scheme, re-opening aliasing
+        return fname.startswith(pkg + os.sep) and fname != here
 
     def lock_factory():
         if _from_pkg():

@@ -72,6 +72,154 @@ class TestIterators:
         async_it.reset()
         assert [b.features[0, 0] for b in async_it] == [0.0, 4.0, 8.0]
 
+    def test_async_reset_refuses_wedged_producer(self, monkeypatch):
+        """If the old producer doesn't stop within the join timeout,
+        reset() must raise rather than start a second producer that
+        would interleave with the stuck one on the base iterator."""
+        import threading
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        async_it = AsyncDataSetIterator(ListDataSetIterator(ds, 4), 2)
+        list(async_it)  # consume to _END so reset() skips the drain
+        release = threading.Event()
+        wedged = threading.Thread(target=release.wait, daemon=True)
+        wedged.start()
+        async_it._thread = wedged
+        monkeypatch.setattr(AsyncDataSetIterator, "_JOIN_TIMEOUT", 0.05)
+        try:
+            with pytest.raises(RuntimeError, match="wedged"):
+                async_it.reset()
+        finally:
+            release.set()
+            wedged.join()
+
+    def test_async_reset_escapes_producer_wedged_in_next(self):
+        """Producer stuck INSIDE base.next() never puts _END, so the
+        drain loop must time out (not block forever) and reset() must
+        then raise the wedged-producer error. Uses the per-instance
+        join_timeout= knob (slow-but-healthy sources tune it without
+        patching the class)."""
+        import threading
+
+        release = threading.Event()
+
+        class WedgingIterator(ListDataSetIterator):
+            def next(self):
+                self._calls = getattr(self, "_calls", 0) + 1
+                if self._calls > 1:  # first batch flows, then the
+                    release.wait()   # source wedges (stalled I/O)
+                return super().next()
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        # queue must fit both batches + _END so the released producer
+        # can run to completion and the join below terminates
+        async_it = AsyncDataSetIterator(WedgingIterator(ds, 4), 4,
+                                        join_timeout=0.1)
+        async_it.next()  # consume so reset() takes the drain path
+        try:
+            with pytest.raises(RuntimeError, match="wedged"):
+                async_it.reset()
+        finally:
+            release.set()
+            async_it._thread.join()
+
+    @pytest.mark.slow  # several seconds of deliberate sleeps
+    def test_async_reset_tolerates_slow_but_progressing_producer(self):
+        """A producer slower than one timeout window but still emitting
+        must NOT be declared wedged: the drain resumes on progress and
+        only two consecutive empty windows raise."""
+        import time
+
+        class SlowIterator(ListDataSetIterator):
+            def next(self):
+                time.sleep(1.2)  # slower than the 1.0s window below,
+                return super().next()  # 0.8s under the 2.0s two-window
+                # budget so CI scheduling overshoot can't flake it
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        it = AsyncDataSetIterator(SlowIterator(ds, 4), 2,
+                                  join_timeout=1.0)
+        it.next()  # consume so reset() takes the drain path
+        it.reset()  # mid-production: must drain patiently, not raise
+        assert sum(1 for _ in it) == 2
+
+    def test_async_slow_first_batch_not_wedged_on_implicit_reset(self):
+        """__iter__ calls reset() on a just-built iterator whose
+        producer may still be inside its very first base.next() (cold
+        storage, first-batch compile stall) — that must be a no-op, not
+        a drain that declares the healthy source wedged after two empty
+        windows."""
+        import time
+
+        class SlowFirstBatch(ListDataSetIterator):
+            def next(self):
+                time.sleep(0.2)  # >> 2x the 0.05s windows below
+                return super().next()
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        it = AsyncDataSetIterator(SlowFirstBatch(ds, 4), 2,
+                                  join_timeout=0.05)
+        assert sum(1 for _ in it) == 2  # for-loop: implicit reset()
+        assert sum(1 for _ in it) == 2  # post-epoch reset drains fine
+
+    def test_async_join_timeout_must_be_positive_finite(self,
+                                                        monkeypatch):
+        """-1/'inf'/nan 'wait forever' values would make the drain or
+        join block indefinitely — the exact hang the wedged guard
+        exists to prevent — so they are rejected: explicit ctor values
+        fail fast at construction, env values at the first reset()
+        that needs them."""
+        import threading
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        for bad in (-1, 0, float("inf"), float("nan")):
+            with pytest.raises(ValueError, match="join_timeout"):
+                AsyncDataSetIterator(ListDataSetIterator(ds, 4), 2,
+                                     join_timeout=bad)
+        # env path: resolved lazily, validated when a live producer
+        # makes the timeout matter (consume first: an untouched-epoch
+        # reset() is a no-op and never reads the env)
+        async_it = AsyncDataSetIterator(ListDataSetIterator(ds, 4), 4)
+        list(async_it)
+        release = threading.Event()
+        live = threading.Thread(target=release.wait, daemon=True)
+        live.start()
+        async_it._thread = live
+        monkeypatch.setenv("DL4J_ASYNC_JOIN_TIMEOUT", "inf")
+        try:
+            with pytest.raises(ValueError,
+                               match="DL4J_ASYNC_JOIN_TIMEOUT"):
+                async_it.reset()
+        finally:
+            release.set()
+            live.join()
+
+    def test_async_join_timeout_env_fallback(self, monkeypatch):
+        """DL4J_ASYNC_JOIN_TIMEOUT reaches iterators constructed by
+        fit()'s auto-wrap, which can't pass join_timeout= explicitly."""
+        import threading
+
+        ds = DataSet(np.arange(8, dtype=np.float32).reshape(8, 1),
+                     np.zeros((8, 1), np.float32))
+        async_it = AsyncDataSetIterator(ListDataSetIterator(ds, 4), 2)
+        list(async_it)
+        release = threading.Event()
+        wedged = threading.Thread(target=release.wait, daemon=True)
+        wedged.start()
+        async_it._thread = wedged
+        monkeypatch.setenv("DL4J_ASYNC_JOIN_TIMEOUT", "0.05")
+        try:
+            with pytest.raises(RuntimeError, match="wedged"):
+                async_it.reset()
+        finally:
+            release.set()
+            wedged.join()
+
 
 class TestMnist:
     def test_synthetic_deterministic(self):

@@ -724,6 +724,24 @@ class TestLockWitness:
         text = w.format_inversions()
         assert "lock-a" in text and "lock-b" in text
 
+    def test_repeated_inversion_recorded_once(self):
+        """A soak loop hitting the same A->B/B->A inversion thousands
+        of times must report it once, not grow the report unboundedly."""
+        w = LockWitness()
+        a = WitnessLock(w, name="lock-a")
+        b = WitnessLock(w, name="lock-b")
+        with a:
+            with b:
+                pass
+        for _ in range(100):
+            with b:
+                with a:
+                    pass
+            with a:  # re-running the ORIGINAL order is the same defect
+                with b:  # seen from the other side — still one report
+                    pass
+        assert len(w.inversions) == 1
+
     def test_consistent_order_clean_across_threads(self):
         w = LockWitness()
         a = WitnessLock(w, name="lock-a")
@@ -807,6 +825,147 @@ class TestLockWitness:
         with inside:
             pass
         assert threading.Lock is not None  # restored
+
+    def test_install_factory_locks_named_after_construction_site(
+            self, tmp_path):
+        """Locks built via install()'s patched factories must be named
+        after the CALLER's site, not the factory's own frame inside
+        witness.py — a shared name makes every cross-lock acquire look
+        like RLock re-entry and no edges are ever recorded."""
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        mod = pkg / "locks.py"
+        mod.write_text("import threading\n"
+                       "def make_a():\n"
+                       "    return threading.Lock()\n"
+                       "def make_b():\n"
+                       "    return threading.Lock()\n")
+        witness_mod.install(package_dir=str(pkg))
+        try:
+            ns = {}
+            exec(compile(mod.read_text(), str(mod), "exec"), ns)
+            a, b = ns["make_a"](), ns["make_b"]()
+            same_site_twin = ns["make_a"]()
+        finally:
+            witness_mod.uninstall()
+        assert "locks.py:" in a.name
+        assert "locks.py:" in b.name
+        assert a.name != b.name
+        # same site = one lockdep class: that keys the order graph, so
+        # instance churn in a loop can't grow it
+        assert same_site_twin.name == a.name
+
+    def test_install_inversion_recorded_through_factories(self, tmp_path):
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        mod = pkg / "locks.py"
+        mod.write_text("import threading\n"
+                       "a = threading.Lock()\n"
+                       "b = threading.Lock()\n")
+        w = witness_mod.install(package_dir=str(pkg))
+        try:
+            ns = {}
+            exec(compile(mod.read_text(), str(mod), "exec"), ns)
+            a, b = ns["a"], ns["b"]
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        finally:
+            witness_mod.uninstall()
+        assert len(w.inversions) == 1
+        assert (a.name, b.name) in w.order
+
+    def test_instance_churn_keeps_graph_bounded(self, tmp_path):
+        """Fresh locks minted at one site inside a loop (per-request
+        locks in a soak test) must collapse onto one graph class:
+        order/inversions bounded by sites, not iterations."""
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        mod = pkg / "locks.py"
+        mod.write_text("import threading\n"
+                       "g = threading.Lock()\n"
+                       "def make():\n"
+                       "    return threading.Lock()\n")
+        w = witness_mod.install(package_dir=str(pkg))
+        try:
+            ns = {}
+            exec(compile(mod.read_text(), str(mod), "exec"), ns)
+            g = ns["g"]
+            for _ in range(50):
+                fresh = ns["make"]()
+                with g:
+                    with fresh:
+                        pass
+                with fresh:  # inverted order, new instance every time
+                    with g:
+                        pass
+        finally:
+            witness_mod.uninstall()
+        assert len(w.order) == 2      # g->site and site->g, once each
+        assert len(w.inversions) == 1
+
+    def test_same_basename_different_dirs_get_distinct_classes(
+            self, tmp_path):
+        """ui/server.py and clustering/server.py declaring locks on the
+        same line must be distinct classes — basename-only site names
+        would alias them and the same-class skip would silence every
+        edge (and inversion) between them."""
+        src = "import threading\nlk = threading.Lock()\n"
+        for sub in ("ui", "clustering"):
+            d = tmp_path / sub
+            d.mkdir()
+            (d / "server.py").write_text(src)
+        w = witness_mod.install(package_dir=str(tmp_path))
+        try:
+            ns1, ns2 = {}, {}
+            exec(compile(src, str(tmp_path / "ui" / "server.py"),
+                         "exec"), ns1)
+            exec(compile(src, str(tmp_path / "clustering" / "server.py"),
+                         "exec"), ns2)
+            a, b = ns1["lk"], ns2["lk"]
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        finally:
+            witness_mod.uninstall()
+        assert a.name != b.name
+        assert len(w.inversions) == 1
+
+    def test_deep_trees_same_parent_dir_get_distinct_classes(
+            self, tmp_path):
+        """serving/api/handlers.py and clustering/api/handlers.py share
+        BOTH basename and immediate parent dir — a one-parent-deep site
+        label would alias them into one class and silence their edges.
+        In-package names must be package-root-relative."""
+        src = "import threading\nlk = threading.Lock()\n"
+        for sub in ("serving", "clustering"):
+            d = tmp_path / sub / "api"
+            d.mkdir(parents=True)
+            (d / "handlers.py").write_text(src)
+        w = witness_mod.install(package_dir=str(tmp_path))
+        try:
+            ns1, ns2 = {}, {}
+            exec(compile(src, str(tmp_path / "serving" / "api"
+                                  / "handlers.py"), "exec"), ns1)
+            exec(compile(src, str(tmp_path / "clustering" / "api"
+                                  / "handlers.py"), "exec"), ns2)
+            a, b = ns1["lk"], ns2["lk"]
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        finally:
+            witness_mod.uninstall()
+        assert a.name != b.name
+        assert len(w.inversions) == 1
 
     def test_install_is_exclusive(self):
         w = witness_mod.install()
