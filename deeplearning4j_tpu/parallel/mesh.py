@@ -130,3 +130,23 @@ def host_sharded_batch(mesh: Mesh, arr, spec=None):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
+
+
+def replica_devices(n=None, mesh=None, devices=None) -> list:
+    """Distinct devices to pin model-serving replicas to (ISSUE 8):
+    the mesh's devices in data-axis order when a mesh is given, else
+    the process's addressable devices. `n=None` takes them all; an `n`
+    beyond the device count round-robins (deliberate oversubscription —
+    on CPU more replicas than devices can still help when dispatches
+    are host-overhead-bound)."""
+    if devices is None:
+        devices = (list(mesh.devices.flat) if mesh is not None
+                   else jax.local_devices())
+    devices = list(devices)
+    if not devices:
+        raise ValueError("no devices available for replica placement")
+    if n is None:
+        n = len(devices)
+    if n < 1:
+        raise ValueError(f"need n >= 1 replicas, got {n}")
+    return [devices[i % len(devices)] for i in range(n)]

@@ -1,4 +1,5 @@
-"""Inference serving subsystem (ISSUE 2 tentpole).
+"""Inference serving subsystem (ISSUE 2 tentpole; rebuilt for real
+traffic in ISSUE 8).
 
 The repo's training side compiles once and executes many; this package
 gives the INFERENCE side the same contract under concurrent traffic:
@@ -11,6 +12,18 @@ gives the INFERENCE side the same contract under concurrent traffic:
 - `DynamicBatcher`: bounded-queue worker that coalesces concurrent
   predict() calls into one padded device dispatch (max-latency flush,
   backpressure, per-request timeouts, graceful shutdown);
+- `ReplicaSet` (ISSUE 8): N device-pinned copies of a model's bucket
+  executables with per-replica run queues and steal-on-idle, so one
+  model's throughput scales with device count instead of serializing
+  through the batcher thread;
+- `AdmissionController` (ISSUE 8): priority classes (high/normal/
+  batch), per-model concurrency budgets, and load shedding with a
+  computed Retry-After — overload degrades best-effort traffic, not
+  everything;
+- `DecodeEngine` (ISSUE 8): continuous (iteration-level) batching for
+  autoregressive decode over a preallocated paged KV cache — new
+  sequences join the in-flight batch at token boundaries, finished
+  ones free their slot immediately, zero steady-state recompiles;
 - `InferenceSession`: the sync/async facade, instrumented through the
   PR-1 telemetry registry (`dl4j_serving_*`);
 - HTTP: `UIServer.serveModels(session)` exposes
@@ -20,23 +33,31 @@ gives the INFERENCE side the same contract under concurrent traffic:
 See docs/SERVING.md.
 """
 
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController, ShedError)
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher, QueueFullError, ServingShutdown, ServingTimeout,
-    execute_plan)
+    execute_plan, run_batch)
 from deeplearning4j_tpu.serving.buckets import (
     BucketLadder, DEFAULT_BATCH_BUCKETS, pad_batch, pad_rows, pad_time,
     unpad)
+from deeplearning4j_tpu.serving.decode import (
+    DecodeEngine, PagedKVCache, RnnDecodeModel, TransformerDecodeModel)
 from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
+from deeplearning4j_tpu.serving.replica import Replica, ReplicaDeath, \
+    ReplicaSet
 from deeplearning4j_tpu.serving.servable import (
     FnServable, GraphServable, NetworkServable, SameDiffServable, Servable,
     as_servable)
 from deeplearning4j_tpu.serving.session import InferenceSession
 
 __all__ = [
-    "BucketLadder", "DEFAULT_BATCH_BUCKETS", "DynamicBatcher",
-    "FnServable", "GraphServable", "InferenceSession", "ModelNotFound",
-    "ModelRegistry", "NetworkServable", "QueueFullError",
-    "SameDiffServable", "Servable", "ServingShutdown", "ServingTimeout",
-    "as_servable", "execute_plan", "pad_batch", "pad_rows", "pad_time",
-    "unpad",
+    "AdmissionController", "BucketLadder", "DEFAULT_BATCH_BUCKETS",
+    "DecodeEngine", "DynamicBatcher", "FnServable", "GraphServable",
+    "InferenceSession", "ModelNotFound", "ModelRegistry",
+    "NetworkServable", "PagedKVCache", "QueueFullError", "Replica",
+    "ReplicaDeath", "ReplicaSet", "RnnDecodeModel", "SameDiffServable",
+    "Servable", "ServingShutdown", "ServingTimeout", "ShedError",
+    "TransformerDecodeModel", "as_servable", "execute_plan",
+    "pad_batch", "pad_rows", "pad_time", "run_batch", "unpad",
 ]

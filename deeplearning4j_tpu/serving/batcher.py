@@ -16,11 +16,20 @@ Semantics:
   QueueFullError immediately (callers see HTTP 429) instead of letting
   latency grow without bound;
 - per-request timeout: a request that exceeds its deadline while still
-  QUEUED fails with ServingTimeout and never reaches the device; one
-  already executing completes (the result is simply discarded by the
-  caller that stopped waiting);
+  QUEUED fails with ServingTimeout and never reaches the device
+  (outcome `timeout_queued`); one whose deadline passes DURING the
+  device dispatch completes but is recorded as `timeout_execute` — the
+  split tells an operator whether p99 is dying in the queue (shed
+  harder / add replicas) or on the device (kernels too slow), which a
+  single collapsed `timeout` outcome hid;
 - graceful shutdown: close() stops the worker and fails queued requests
   with ServingShutdown rather than hanging their futures.
+
+With an `executor` (a ReplicaSet), the worker thread becomes a pure
+coalescer: formed batches are handed to the work-stealing scheduler as
+BatchTasks and the padding/concat/dispatch/split runs on a replica
+worker, so N devices execute N batches concurrently instead of
+serializing through this thread.
 """
 
 from __future__ import annotations
@@ -55,9 +64,9 @@ class ServingShutdown(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "n", "t", "future", "t_enqueue", "deadline",
-                 "req_id", "model")
+                 "req_id", "model", "started", "priority")
 
-    def __init__(self, x, deadline, model=None):
+    def __init__(self, x, deadline, model=None, priority="normal"):
         self.x = x
         self.n = x.shape[0]
         # real trailing time length of sequence inputs: results slice
@@ -68,6 +77,8 @@ class _Request:
         self.deadline = deadline
         self.req_id = next(_REQ_IDS)
         self.model = model
+        self.started = False   # set_running already done (replica re-run)
+        self.priority = priority
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -83,22 +94,30 @@ class _Request:
                       queue_s=round(queue_s, 6), **extra)
 
     def fail(self, exc, instruments, outcome):
-        if self.future.set_running_or_notify_cancel():
+        if self.started:            # already RUNNING (mid-execute fail)
+            ok = not self.future.done()
+        else:
+            ok = self.future.set_running_or_notify_cancel()
+            self.started = True
+        if ok and not self.future.done():
             self.future.set_exception(exc)
         if instruments is not None:
             instruments.request(outcome)
         self.summary(outcome)
 
 
-def execute_plan(entry, xs):
+def execute_plan(entry, xs, servable=None):
     """Execute already-coalesced rows through the entry's bucketed
     executables: pad the time axis to its covering bucket ONCE, chunk
     rows by ladder.plan, pad each chunk to its bucket, run, and slice
     the padding rows back off. The ONE ladder-execution algorithm,
-    shared by the batcher worker and the session's direct path. Returns
-    (y_real_rows_time_padded, device_dispatch_count, padded_row_count).
+    shared by the batcher worker, the session's direct path, and the
+    replica workers (which pass their device-pinned `servable` clone).
+    Returns (y_real_rows_time_padded, device_dispatch_count,
+    padded_row_count).
     """
     ladder = entry.ladder
+    sv = servable if servable is not None else entry.servable
     if xs.ndim >= 3:
         xs = pad_time(xs, ladder.covering_seq(xs.shape[-1]))
     n = xs.shape[0]
@@ -107,11 +126,121 @@ def execute_plan(entry, xs):
     for bucket in plan:
         take = min(bucket, n - off)
         chunk = pad_rows(xs[off:off + take], bucket)
-        outs.append(entry.servable.infer(chunk)[:take])
+        outs.append(sv.infer(chunk)[:take])
         off += take
         n_padded += bucket
     y = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
     return y, len(plan), n_padded
+
+
+def _mark_running(req) -> bool:
+    """set_running_or_notify_cancel, tolerant of a batch being re-run
+    after a replica died mid-execute (the future is already RUNNING on
+    the second attempt — only a cancelled/finished future opts out;
+    the `started` flag avoids re-poking a RUNNING future, which logs a
+    critical and raises)."""
+    if req.started:
+        return not req.future.done()
+    ok = req.future.set_running_or_notify_cancel()
+    req.started = True
+    return ok
+
+
+def run_batch(entry, batch, inst, servable=None, replica=None):
+    """Run one formed batch of requests end to end: late expiry check
+    (outcome `timeout_queued`), pad/concat, ladder execution, result
+    split, telemetry, and the mid-execute deadline check (outcome
+    `timeout_execute`). Shared by the DynamicBatcher's inline worker
+    and the ReplicaSet workers. Raises ReplicaDeath through (the
+    scheduler re-queues the batch); every other exception terminates
+    the requests with outcome `error`. Returns True when the dispatch
+    errored — the ReplicaSet's circuit breaker counts consecutive
+    errors per replica."""
+    from deeplearning4j_tpu.serving.replica import ReplicaDeath
+
+    now = time.perf_counter()
+    live, first_run = [], []
+    for r in batch:
+        if r.expired(now):
+            r.fail(ServingTimeout("timed out in queue"), inst,
+                   "timeout_queued")
+        else:
+            first = not r.started   # before _mark_running flips it
+            if _mark_running(r):
+                live.append(r)
+                if first:
+                    first_run.append(r)
+            else:
+                if inst is not None:
+                    inst.request("rejected")  # caller cancelled
+                r.summary("cancelled")
+    if not live:
+        return False
+    total = sum(r.n for r in live)
+    if inst is not None:
+        # only first attempts: a batch re-run after a replica death
+        # would fold the failed attempt's execute time into the
+        # queue-wait histogram and skew exactly the signal the
+        # timeout_queued/timeout_execute split is meant to clean up
+        for r in first_run:
+            inst.queue_wait.observe(now - r.t_enqueue)
+    try:
+        if live[0].t is not None:
+            # sequence inputs may differ in trailing length within
+            # one coalesced batch: pad each to the covering seq
+            # bucket of the longest BEFORE concatenating (results
+            # slice back to each request's own real length)
+            t_bucket = entry.ladder.covering_seq(max(r.t for r in live))
+            parts = [pad_time(r.x, t_bucket) for r in live]
+        else:
+            parts = [r.x for r in live]
+        xs = (np.concatenate(parts, axis=0)
+              if len(parts) > 1 else parts[0])
+        t0 = time.perf_counter()
+        y, n_dispatch, n_padded = execute_plan(entry, xs,
+                                               servable=servable)
+        dt = time.perf_counter() - t0
+        if inst is not None:
+            inst.execute.observe(dt)
+            inst.dispatch.inc(n_dispatch)
+            inst.occupancy.set(total / max(n_padded, 1))
+        done_at = time.perf_counter()
+        off = 0
+        for r in live:
+            seg = y[off:off + r.n]
+            if r.t is not None and seg.ndim >= 3 and \
+                    seg.shape[-1] != r.t:
+                seg = seg[..., :r.t]
+            off += r.n
+            if r.expired(done_at):
+                # deadline passed while the device was executing: the
+                # caller already gave up, and the distinction from a
+                # queued expiry is what names the p99 driver
+                r.fail(ServingTimeout("deadline passed mid-execute"),
+                       inst, "timeout_execute")
+                continue
+            r.future.set_result(seg)
+            if inst is not None:
+                inst.request("ok")
+            extra = {} if replica is None else {"replica": replica}
+            r.summary("ok", queue_s=now - r.t_enqueue,
+                      batch_rows=total, dispatches=n_dispatch,
+                      execute_s=round(dt, 6), **extra)
+    except ReplicaDeath:
+        raise                     # scheduler re-queues; futures stay live
+    except Exception as e:  # surface the device error to every caller
+        for r in live:
+            if not r.future.done():
+                r.future.set_exception(e)
+            if inst is not None:
+                inst.request("error")
+            r.summary("error", queue_s=now - r.t_enqueue,
+                      error=f"{type(e).__name__}: {e}")
+        return True
+    return False
+
+
+_PRIO_RANK = {"high": 0, "normal": 1, "batch": 2}
 
 
 class DynamicBatcher:
@@ -120,20 +249,31 @@ class DynamicBatcher:
     `entry` is a ModelRegistry entry (servable + ladder); `instruments`
     a telemetry.ServingInstruments, a zero-arg callable returning one
     (or None) — re-resolved per use so telemetry toggled mid-flight is
-    honored — or None.
+    honored — or None. `executor` is an optional ReplicaSet: formed
+    batches are submitted to its work-stealing scheduler instead of
+    executing on this thread (the batcher owns the executor's
+    lifecycle: retire/close cascade).
+
+    The coalescing queue is a PRIORITY queue (high < normal < batch,
+    FIFO within a class via the monotonic request id): under overload
+    a high-priority request jumps the standing best-effort backlog
+    instead of aging behind it — one of the three places the ISSUE 8
+    priority story is enforced (admission budget, coalescing order,
+    replica-queue placement).
     """
 
     _SENTINEL = object()
 
     def __init__(self, entry, max_latency=0.002, queue_size=256,
-                 default_timeout=30.0, instruments=None):
+                 default_timeout=30.0, instruments=None, executor=None):
         self.entry = entry
         self.max_latency = float(max_latency)
         self.default_timeout = default_timeout
+        self.executor = executor
         self._instruments_fn = (instruments if callable(instruments)
                                 else lambda: instruments)
         self._accepting = True
-        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._q: queue.Queue = queue.PriorityQueue(maxsize=queue_size)
         self._carry = None   # dequeued but didn't fit the closing batch
         self._closed = False
         # serializes submit-enqueue against close-drain: without it a
@@ -146,22 +286,28 @@ class DynamicBatcher:
         self._worker.start()
 
     # -- client side --------------------------------------------------------
-    def submit(self, x, timeout=None) -> Future:
+    def submit(self, x, timeout=None, priority="normal") -> Future:
         """Enqueue one request batch [n, ...]; returns its Future.
-        Raises QueueFullError when the bounded queue is at capacity."""
+        Raises QueueFullError when the bounded queue is at capacity.
+        `priority` rides with the request: a ReplicaSet executor places
+        batches carrying high-priority requests at the HEAD of a
+        replica queue (the single coalescing queue itself stays
+        FIFO)."""
         x = np.asarray(x)
         if timeout is None:
             timeout = self.default_timeout
         deadline = (time.perf_counter() + timeout
                     if timeout is not None else None)
-        req = _Request(x, deadline, model=self.entry.name)
+        req = _Request(x, deadline, model=self.entry.name,
+                       priority=priority)
         inst = self._instruments_fn()
         try:
             with self._submit_lock:
                 if self._closed or not self._accepting:
                     raise ServingShutdown(
                         f"batcher for {self.entry.name!r} closed")
-                self._q.put_nowait(req)
+                self._q.put_nowait((_PRIO_RANK.get(priority, 1),
+                                    req.req_id, req))
         except queue.Full:
             if inst is not None:
                 inst.request("rejected")
@@ -174,7 +320,10 @@ class DynamicBatcher:
         return req.future
 
     def queue_depth(self) -> int:
-        return self._q.qsize() + (1 if self._carry is not None else 0)
+        depth = self._q.qsize() + (1 if self._carry is not None else 0)
+        if self.executor is not None:
+            depth += self.executor.depth()
+        return depth
 
     def retire(self, timeout=30.0):
         """Rolling-update shutdown: stop ACCEPTING, let the worker
@@ -184,8 +333,12 @@ class DynamicBatcher:
             if self._closed:
                 return
             self._accepting = False
-        self._q.put(self._SENTINEL)   # FIFO: drains the queue first
+        # rank above every priority class: drains the queue first
+        self._q.put((max(_PRIO_RANK.values()) + 1, next(_REQ_IDS),
+                     self._SENTINEL))
         self._worker.join(timeout)
+        if self.executor is not None:
+            self.executor.retire(timeout)
         self._closed = True
 
     def close(self, timeout=5.0):
@@ -194,7 +347,8 @@ class DynamicBatcher:
             return
         self._closed = True
         self._accepting = False
-        self._q.put(self._SENTINEL)   # may block briefly if full: bounded
+        # rank below every class: the worker sees it next, fail-fast
+        self._q.put((-1, next(_REQ_IDS), self._SENTINEL))
         self._worker.join(timeout)
         inst = self._instruments_fn()
         with self._submit_lock:       # no submit can enqueue after this
@@ -202,7 +356,7 @@ class DynamicBatcher:
             self._carry = None
             while True:
                 try:
-                    r = self._q.get_nowait()
+                    r = self._q.get_nowait()[2]
                 except queue.Empty:
                     break
                 if r is not self._SENTINEL:
@@ -211,9 +365,11 @@ class DynamicBatcher:
                 # join timed out mid-dispatch and the drain may have
                 # consumed the sentinel: re-arm it so the worker exits
                 # instead of polling forever
-                self._q.put(self._SENTINEL)
+                self._q.put((-1, next(_REQ_IDS), self._SENTINEL))
         for r in leftovers:
             r.fail(ServingShutdown("batcher closed"), inst, "shutdown")
+        if self.executor is not None:
+            self.executor.close(timeout)
 
     # -- worker side --------------------------------------------------------
     def _next(self, timeout):
@@ -221,7 +377,7 @@ class DynamicBatcher:
             r, self._carry = self._carry, None
             return r
         try:
-            return self._q.get(timeout=timeout)
+            return self._q.get(timeout=timeout)[2]
         except queue.Empty:
             return None
 
@@ -253,7 +409,7 @@ class DynamicBatcher:
                     return
                 if nxt.expired(time.perf_counter()):
                     nxt.fail(ServingTimeout("timed out in queue"),
-                             self._instruments_fn(), "timeout")
+                             self._instruments_fn(), "timeout_queued")
                     continue
                 if total + nxt.n > max_batch and nxt.n <= max_batch:
                     # would overflow the largest bucket: hold it for the
@@ -267,66 +423,19 @@ class DynamicBatcher:
 
     def _execute(self, batch, total):
         inst = self._instruments_fn()
-        now = time.perf_counter()
-        live = []
-        for r in batch:
-            if r.expired(now):
-                r.fail(ServingTimeout("timed out in queue"), inst,
-                       "timeout")
-            elif r.future.set_running_or_notify_cancel():
-                live.append(r)
-            else:
-                if inst is not None:
-                    inst.request("rejected")  # caller cancelled the future
-                r.summary("cancelled")
-        if not live:
-            return
-        total = sum(r.n for r in live)
         if inst is not None:
             inst.depth.set(self._q.qsize())
-            for r in live:
-                inst.queue_wait.observe(now - r.t_enqueue)
-        try:
-            if live[0].t is not None:
-                # sequence inputs may differ in trailing length within
-                # one coalesced batch: pad each to the covering seq
-                # bucket of the longest BEFORE concatenating (results
-                # slice back to each request's own real length)
-                t_bucket = self.entry.ladder.covering_seq(
-                    max(r.t for r in live))
-                parts = [pad_time(r.x, t_bucket) for r in live]
-            else:
-                parts = [r.x for r in live]
-            xs = (np.concatenate(parts, axis=0)
-                  if len(parts) > 1 else parts[0])
-            t0 = time.perf_counter()
-            y, n_dispatch, n_padded = self._dispatch(xs)
-            dt = time.perf_counter() - t0
-            if inst is not None:
-                inst.execute.observe(dt)
-                inst.dispatch.inc(n_dispatch)
-                inst.occupancy.set(total / max(n_padded, 1))
-            off = 0
-            for r in live:
-                seg = y[off:off + r.n]
-                if r.t is not None and seg.ndim >= 3 and \
-                        seg.shape[-1] != r.t:
-                    seg = seg[..., :r.t]
-                r.future.set_result(seg)
-                off += r.n
-                if inst is not None:
-                    inst.request("ok")
-                r.summary("ok", queue_s=now - r.t_enqueue,
-                          batch_rows=total, dispatches=n_dispatch,
-                          execute_s=round(dt, 6))
-        except Exception as e:  # surface the device error to every caller
-            for r in live:
-                if not r.future.done():
-                    r.future.set_exception(e)
-                if inst is not None:
-                    inst.request("error")
-                r.summary("error", queue_s=now - r.t_enqueue,
-                          error=f"{type(e).__name__}: {e}")
-
-    def _dispatch(self, xs) -> tuple:
-        return execute_plan(self.entry, xs)
+        if self.executor is not None:
+            # pure-coalescer mode: hand the formed batch to the
+            # work-stealing scheduler; padding/dispatch/split runs on a
+            # replica worker and this thread immediately coalesces the
+            # next batch
+            try:
+                self.executor.submit_batch(batch, inst)
+            except Exception as e:
+                outcome = ("shutdown" if isinstance(e, ServingShutdown)
+                           else "error")
+                for r in batch:
+                    r.fail(e, inst, outcome)
+            return
+        run_batch(self.entry, batch, inst)

@@ -1,0 +1,633 @@
+"""Continuous (iteration-level) batching for autoregressive decode
+(ISSUE 8 tentpole b).
+
+The PR-2 serving path batches at REQUEST granularity: a batch executes
+start-to-finish, so a 5-token completion waits for the 200-token one it
+shares a batch with, and a request arriving mid-batch waits for the
+whole batch to drain. Token streams need iteration-level batching (the
+Orca/vLLM scheduling insight): the device executes ONE token step for
+every in-flight sequence per iteration, new sequences join the batch at
+any token boundary, and finished sequences free their slot immediately.
+
+Fixed shapes everywhere: the step function is compiled ONCE for
+``[max_slots]`` token vectors and a preallocated paged KV pool — joins
+and leaves change the CONTENT of slots, never a shape, so the steady
+state adds nothing to ``dl4j_compile_total`` (the PR-2 contract,
+asserted in tests).
+
+The KV cache is PAGED (`PagedKVCache`): a pool of fixed-size
+``[page]``-token blocks with a per-slot page table. A joining sequence
+reserves ``ceil(total_len / page)`` pages up front (no mid-flight
+eviction), a leaving one returns them; page 0 is a scratch page that
+idle slots write into so the step function stays branch-free. The
+blocked attention accumulation — iterate over pages, carry flash-style
+online-softmax ``(m, l, o)`` — is `parallel/ring_attention.py`'s ring
+body with pages in place of ring ranks (and no collectives: a decode
+replica is single-device; the engine thread must stay collective-free
+per the dl4jlint collective-thread rule).
+
+Two shipped models:
+
+- `RnnDecodeModel`: wraps a real `MultiLayerNetwork` with recurrent
+  layers — slot state is the per-slot ``{h, c}`` carry rows (the
+  repo's `rnnTimeStep` streaming state, batched over slots). Params
+  are read live from the net: train-and-serve keeps working.
+- `TransformerDecodeModel`: causal decode-only transformer over the
+  paged KV pool, mirroring `models/bert.py`'s post-LN block so
+  `from_bert()` can lift a trained BERT encoder's weights into a
+  token-stream servable (tied LM head).
+
+Per-sequence determinism: every op along a slot's compute path is
+row-wise (LSTM carries, masked paged attention, layer norm, argmax),
+so a sequence's tokens are BIT-IDENTICAL whether it decodes alone or
+wedged between strangers — asserted by tests, and the property that
+makes continuous batching safe to enable by default.
+"""
+
+from __future__ import annotations
+
+import math
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry import flight
+
+
+class DecodeError(RuntimeError):
+    pass
+
+
+class DecodeShutdown(RuntimeError):
+    """Engine closed with this request still pending."""
+
+
+# ---------------------------------------------------------------------------
+# paged KV bookkeeping (host side)
+# ---------------------------------------------------------------------------
+
+class PagedKVCache:
+    """Host-side page accounting for a preallocated device KV pool.
+
+    `n_pages` counts the usable pool (page 0 is reserved scratch for
+    idle slots, so the device pool must hold ``n_pages + 1`` pages).
+    Allocation is all-up-front per sequence: `reserve()` either grants
+    every page the sequence can ever touch or refuses — admission
+    control at the slot boundary instead of mid-decode eviction."""
+
+    def __init__(self, n_pages, page, max_pages_per_slot, max_slots):
+        if page < 1 or n_pages < 1:
+            raise ValueError(f"need page >= 1 and n_pages >= 1, got "
+                             f"page={page} n_pages={n_pages}")
+        self.page = int(page)
+        self.n_pages = int(n_pages)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        # page 0 = scratch; usable pages are 1..n_pages
+        self._free = list(range(self.n_pages, 0, -1))
+        self.table = np.zeros((max_slots, self.max_pages_per_slot),
+                              np.int32)
+        self._owned: dict[int, list[int]] = {}
+
+    def pages_for(self, total_len: int) -> int:
+        return math.ceil(total_len / self.page)
+
+    def can_reserve(self, total_len: int) -> bool:
+        need = self.pages_for(total_len)
+        return need <= len(self._free) and \
+            need <= self.max_pages_per_slot
+
+    def reserve(self, slot: int, total_len: int):
+        need = self.pages_for(total_len)
+        if need > self.max_pages_per_slot:
+            raise DecodeError(
+                f"sequence of {total_len} tokens needs {need} pages > "
+                f"max_pages_per_slot={self.max_pages_per_slot}")
+        if need > len(self._free):
+            raise DecodeError(
+                f"KV pool exhausted: need {need} pages, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned[slot] = pages
+        self.table[slot, :] = 0
+        self.table[slot, :need] = pages
+        return pages
+
+    def release(self, slot: int):
+        pages = self._owned.pop(slot, [])
+        self._free.extend(reversed(pages))
+        self.table[slot, :] = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+
+# ---------------------------------------------------------------------------
+# decode models
+# ---------------------------------------------------------------------------
+
+class RnnDecodeModel:
+    """Token-step decode over a MultiLayerNetwork with recurrent
+    layers (the graves_lstm char-RNN workload as a token stream).
+
+    Slot state = the network's streaming rnn carry, batched over
+    ``max_slots`` rows; one engine iteration feeds every slot its next
+    token id as a one-hot [S, nIn, 1] timestep through the net's own
+    `_forward` — the same math `rnnTimeStep` runs, so a served stream
+    matches an offline `rnnTimeStep` loop bit for bit. Params are read
+    live from the net at every step (never captured)."""
+
+    uses_pages = False
+    page = None
+
+    def __init__(self, net, max_slots=8, vocab=None):
+        import jax
+
+        net._check_init()
+        self.net = net
+        self.max_slots = int(max_slots)
+        self._rec = set(net._recurrent_indices(forbid_bidirectional=True))
+        if not self._rec:
+            raise DecodeError("RnnDecodeModel needs at least one "
+                              "recurrent layer")
+        self.n_in = net.layers[0].nIn
+        self.vocab = int(vocab) if vocab is not None else int(self.n_in)
+        self._dtype = net.conf.dtype
+        self._jit_step = jax.jit(self._fn)
+        # slot is a TRACED scalar: one reset executable serves every
+        # slot (a static slot arg would compile per slot index and
+        # break the zero-steady-state-recompiles contract)
+        self._jit_reset = jax.jit(self._reset_fn)
+
+    # state: the full per-layer states list with recurrent carries
+    # seeded to [max_slots] rows
+    def init_state(self):
+        return self.net._seed_rnn_states(self.net._states,
+                                         self.max_slots)
+
+    def _fn(self, params, state, tokens, pos, table):
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.nn.one_hot(tokens, self.n_in,
+                           dtype=self._dtype)[:, :, None]
+        y, new_state = self.net._forward(params, state, x, False, None)
+        logits = y[:, :, 0].astype(jnp.float32)
+        nxt = jnp.argmax(logits[:, :self.vocab], axis=-1) \
+            .astype(jnp.int32)
+        return nxt, new_state
+
+    def _reset_fn(self, state, slot):
+        import jax.numpy as jnp
+
+        out = list(state)
+        for i in self._rec:
+            out[i] = {k: v.at[slot].set(jnp.zeros_like(v[slot]))
+                      for k, v in state[i].items()}
+        return out
+
+    def step(self, state, tokens, pos, table):
+        return self._jit_step(self.net._params, state, tokens, pos,
+                              table)
+
+    def reset_slot(self, state, slot):
+        return self._jit_reset(state, np.int32(slot))
+
+
+class TransformerDecodeModel:
+    """Causal single-token decode over a paged KV pool.
+
+    Mirrors `models/bert.py`'s post-LN encoder block (qkv/out/ln1/ffn/
+    ln2 naming, gelu FFN, tied LM head), so `from_bert()` serves a
+    trained encoder's weights as a token stream. Attention per slot
+    iterates its OWN page-table pages with the flash-style online
+    softmax carried from `ring_attention._ring_attention_local` (pages
+    play the role of ring ranks; no collectives — replicas are
+    single-device)."""
+
+    uses_pages = True
+
+    def __init__(self, params, n_heads, max_slots=8, page=16,
+                 max_pages_per_slot=8, n_pages=None, eps=1e-12):
+        import jax
+
+        self.params = params
+        self.n_heads = int(n_heads)
+        hidden = int(np.asarray(params["tok_emb"]).shape[1])
+        if hidden % self.n_heads:
+            raise DecodeError(f"hidden {hidden} not divisible by "
+                              f"{n_heads} heads")
+        self.hidden = hidden
+        self.head_dim = hidden // self.n_heads
+        self.vocab = int(np.asarray(params["tok_emb"]).shape[0])
+        self.max_len = int(np.asarray(params["pos_emb"]).shape[0])
+        self.max_slots = int(max_slots)
+        self.page = int(page)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.n_pages = (int(n_pages) if n_pages is not None
+                        else max_slots * max_pages_per_slot)
+        self.eps = eps
+        self.n_layers = len(params["layers"])
+        self._jit_step = jax.jit(self._fn)
+
+    @classmethod
+    def from_bert(cls, params, cfg, **kw):
+        """Lift a `models/bert.py` param tree into a decode servable
+        (cfg: BertConfig — supplies head count)."""
+        kw.setdefault("page", 16)
+        return cls(params, n_heads=cfg.num_heads,
+                   eps=cfg.layer_norm_eps, **kw)
+
+    @classmethod
+    def init(cls, vocab=64, hidden=32, n_layers=2, n_heads=2,
+             max_len=128, seed=0, **kw):
+        """Standalone random init (bert-style param naming)."""
+        from deeplearning4j_tpu.models.bert import (BertConfig,
+                                                    init_params)
+        import jax
+
+        cfg = BertConfig(vocab_size=vocab, hidden=hidden,
+                         num_layers=n_layers, num_heads=n_heads,
+                         ffn=4 * hidden, max_len=max_len)
+        params = init_params(cfg, jax.random.key(seed))
+        return cls(params, n_heads=n_heads, **kw)
+
+    # pools: [L, n_pages + 1, page, H, D]; page 0 is scratch
+    def init_state(self):
+        import jax.numpy as jnp
+
+        shape = (self.n_layers, self.n_pages + 1, self.page,
+                 self.n_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.float32)}
+
+    def _paged_attention(self, q, kpool, vpool, table, pos):
+        """q [S,H,D] against this slot's pages. Blockwise online
+        softmax over the page axis — ring_attention's accumulation with
+        pages instead of ring ranks; masked pages contribute exactly
+        zero, so a slot's output never depends on its neighbors."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        s_, h_, d_ = q.shape
+        scale = 1.0 / math.sqrt(d_)
+        page = self.page
+
+        def body(i, carry):
+            m, l, o = carry
+            kb = kpool[table[:, i]]                  # [S, page, H, D]
+            vb = vpool[table[:, i]]
+            s = jnp.einsum("shd,sphd->shp", q, kb) * scale
+            k_pos = i * page + jnp.arange(page)      # this block's slots
+            mask = k_pos[None, :] <= pos[:, None]    # causal + length
+            s = jnp.where(mask[:, None, :], s, -jnp.inf)
+            blk_max = jnp.max(s, axis=-1)            # [S, H]
+            new_m = jnp.maximum(m, blk_max)
+            new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+            p = jnp.exp(s - new_m_safe[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            corr = jnp.exp(
+                jnp.where(jnp.isfinite(m), m - new_m_safe, -jnp.inf))
+            corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+            new_l = l * corr + jnp.sum(p, axis=-1)
+            new_o = o * corr[..., None] + jnp.einsum("shp,sphd->shd",
+                                                     p, vb)
+            return new_m, new_l, new_o
+
+        m0 = jnp.full((s_, h_), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((s_, h_), jnp.float32)
+        o0 = jnp.zeros((s_, h_, d_), jnp.float32)
+        m, l, o = lax.fori_loop(0, self.max_pages_per_slot, body,
+                                (m0, l0, o0))
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    def _fn(self, params, state, tokens, pos, table):
+        import jax
+        import jax.numpy as jnp
+
+        S = self.max_slots
+        nh, hd = self.n_heads, self.head_dim
+        ln = lambda x, p: _layer_norm(x, p["g"], p["b"], self.eps)  # noqa: E731
+        h = params["tok_emb"][tokens] + params["pos_emb"][pos]
+        h = ln(h, params["emb_ln"])
+        pidx = table[jnp.arange(S), pos // self.page]   # [S] write page
+        off = pos % self.page
+        new_k, new_v = [], []
+        for li, lp in enumerate(params["layers"]):
+            qkv = h @ lp["qkv_w"] + lp["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(S, nh, hd)
+            k = k.reshape(S, nh, hd)
+            v = v.reshape(S, nh, hd)
+            kpool = state["k"][li].at[pidx, off].set(k)
+            vpool = state["v"][li].at[pidx, off].set(v)
+            new_k.append(kpool)
+            new_v.append(vpool)
+            att = self._paged_attention(q, kpool, vpool, table, pos)
+            att = att.reshape(S, nh * hd) @ lp["out_w"] + lp["out_b"]
+            h = ln(h + att, lp["ln1"])
+            ffn = jax.nn.gelu(h @ lp["ffn_in_w"] + lp["ffn_in_b"])
+            ffn = ffn @ lp["ffn_out_w"] + lp["ffn_out_b"]
+            h = ln(h + ffn, lp["ln2"])
+        logits = h @ params["tok_emb"].T + params["mlm_bias"]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_state = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        return nxt, new_state
+
+    def step(self, state, tokens, pos, table):
+        return self._jit_step(self.params, state, tokens, pos, table)
+
+    def reset_slot(self, state, slot):
+        # stale page contents are unreachable once the page table drops
+        # them (the length mask covers in-page staleness): no wipe
+        return state
+
+
+def _layer_norm(x, g, b, eps):
+    import jax
+    import jax.numpy as jnp
+
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "future", "stream",
+                 "slot", "ptr", "generated", "t_submit", "req_id")
+    _END = object()
+
+    def __init__(self, prompt, max_new, eos_id, req_id):
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("decode needs at least one prompt token")
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.future: Future = Future()
+        self.stream: _queue.Queue = _queue.Queue()
+        self.slot = None
+        self.ptr = 0            # next prompt position to feed
+        self.generated: list[int] = []
+        self.t_submit = time.perf_counter()
+        self.req_id = req_id
+
+    def tokens(self, timeout=None):
+        """Generator of tokens as they decode (terminates with the
+        sequence; raises if the engine failed the request)."""
+        while True:
+            item = self.stream.get(timeout=timeout)
+            if item is self._END:
+                exc = self.future.exception()
+                if exc is not None:
+                    raise exc
+                return
+            yield item
+
+    def result(self, timeout=None) -> list:
+        return self.future.result(timeout=timeout)
+
+
+class DecodeEngine:
+    """Continuous batcher: one worker thread advancing every in-flight
+    sequence one token per iteration.
+
+    - `submit(prompt, max_new_tokens)` joins at the next token
+      boundary if a slot (and, for paged models, enough KV pages) is
+      free, else waits in the pending queue;
+    - prompt PREFILL runs through the same step executable, one token
+      per iteration — a joining sequence interleaves with in-flight
+      decodes from its first token (no separate prefill executable,
+      no second compiled shape);
+    - a finished sequence (max_new reached or eos) frees its slot and
+      pages at the SAME token boundary, and the next pending request
+      takes them over immediately;
+    - `warmup()` runs one throwaway step + slot reset so every
+      executable exists before traffic; after it, `dl4j_compile_total`
+      stays flat (asserted in tests).
+    """
+
+    def __init__(self, model, name="decode", pending_size=64,
+                 max_new_limit=1024, instruments=None):
+        self.model = model
+        self.name = name
+        # hard per-request generation cap, enforced for EVERY model:
+        # paged models are also bounded by max_len/pool, but a
+        # page-less RNN model has no natural ceiling — without this an
+        # HTTP client asking for 10**6 tokens wedges a slot for hours
+        self.max_new_limit = int(max_new_limit)
+        self._instruments_fn = (instruments if callable(instruments)
+                                else lambda: instruments)
+        self._pending: _queue.Queue = _queue.Queue(maxsize=pending_size)
+        self._waiting: list = []   # engine-side FIFO (page head-block)
+        self._active: dict[int, _DecodeRequest] = {}
+        self._free_slots = list(range(model.max_slots - 1, -1, -1))
+        self._state = model.init_state()
+        self._kv = None
+        if getattr(model, "uses_pages", False):
+            self._kv = PagedKVCache(model.n_pages, model.page,
+                                    model.max_pages_per_slot,
+                                    model.max_slots)
+        self._table = (self._kv.table if self._kv is not None
+                       else np.zeros((model.max_slots, 1), np.int32))
+        self._closed = False
+        self._warmed = False
+        self._ids = 0
+        # serializes submit(): the capacity check and the req-id
+        # counter both race under concurrent HTTP handler threads
+        self._submit_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"dl4j-decode-{name}", daemon=True)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               timeout=None) -> _DecodeRequest:
+        if self._closed:
+            raise DecodeShutdown(f"decode engine {self.name!r} closed")
+        if int(max_new_tokens) > self.max_new_limit:
+            raise DecodeError(
+                f"max_new_tokens={max_new_tokens} exceeds the "
+                f"engine's limit of {self.max_new_limit} "
+                f"(max_new_limit=)")
+        total = len(list(prompt)) + int(max_new_tokens)
+        max_len = getattr(self.model, "max_len", None)
+        if max_len is not None and total > max_len:
+            raise DecodeError(
+                f"prompt + max_new_tokens = {total} exceeds the "
+                f"model's max_len {max_len}")
+        if self._kv is not None:
+            need = self._kv.pages_for(total)
+            # validate against BOTH per-slot max and the pool total:
+            # a request that could never reserve would head-block the
+            # strict-FIFO waiting line forever
+            limit = min(self.model.max_pages_per_slot,
+                        self._kv.n_pages)
+            if need > limit:
+                raise DecodeError(
+                    f"sequence of {total} tokens needs {need} KV "
+                    f"pages > the engine's limit of {limit} "
+                    f"(max_pages_per_slot="
+                    f"{self.model.max_pages_per_slot}, pool="
+                    f"{self._kv.n_pages})")
+        with self._submit_lock:
+            # backpressure bound spans the submit queue AND the
+            # engine's head-blocking FIFO (requests parked waiting for
+            # KV pages) — without counting _waiting, the engine
+            # draining the queue each token boundary would make
+            # pending_size meaningless
+            if self._pending.qsize() + len(self._waiting) >= \
+                    self._pending.maxsize:
+                from deeplearning4j_tpu.serving.batcher import (
+                    QueueFullError)
+
+                raise QueueFullError(
+                    f"decode pending queue for {self.name!r} full "
+                    f"({self._pending.maxsize} waiting)")
+            self._ids += 1
+            req = _DecodeRequest(prompt, max_new_tokens, eos_id,
+                                 self._ids)
+            self._pending.put_nowait(req)
+        self._wake.set()
+        return req
+
+    def decode(self, prompt, max_new_tokens, eos_id=None,
+               timeout=None) -> list:
+        """Synchronous decode: the generated token ids."""
+        return self.submit(prompt, max_new_tokens,
+                           eos_id=eos_id).result(timeout=timeout)
+
+    def warmup(self):
+        """Compile the step + reset executables with a throwaway
+        iteration, leaving the engine state untouched (slot 0's carry
+        is re-reset afterwards). Steady state adds zero compiles."""
+        state = self.model.reset_slot(self._state, 0)
+        tokens = np.zeros((self.model.max_slots,), np.int32)
+        pos = np.zeros((self.model.max_slots,), np.int32)
+        self.model.step(state, tokens, pos,
+                        np.ascontiguousarray(self._table))
+        self._state = self.model.reset_slot(self._state, 0)
+        self._warmed = True
+        return self
+
+    @property
+    def active_slots(self) -> int:
+        return len(self._active)
+
+    def close(self, timeout=5.0):
+        self._closed = True
+        self._wake.set()
+        self._thread.join(timeout)
+        # fail everything still pending or active
+        leftovers = list(self._active.values()) + list(self._waiting)
+        self._active.clear()
+        self._waiting = []
+        while True:
+            try:
+                leftovers.append(self._pending.get_nowait())
+            except _queue.Empty:
+                break
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(
+                    DecodeShutdown("decode engine closed"))
+            req.stream.put(_DecodeRequest._END)
+
+    # -- engine side ---------------------------------------------------------
+    def _admit(self):
+        """Move pending requests into free slots at this token
+        boundary. The submit queue drains into an engine-private FIFO
+        first, so a request that can't get its KV pages yet
+        head-blocks (fairness) without races against submit()."""
+        while True:
+            try:
+                self._waiting.append(self._pending.get_nowait())
+            except _queue.Empty:
+                break
+        admitted = 0
+        while self._free_slots and self._waiting:
+            req = self._waiting[0]
+            if self._kv is not None and not self._kv.can_reserve(
+                    len(req.prompt) + req.max_new):
+                break   # head-of-line waits for pages: strict FIFO
+            self._waiting.pop(0)
+            slot = self._free_slots.pop()
+            req.slot = slot
+            if self._kv is not None:
+                self._kv.reserve(slot, len(req.prompt) + req.max_new)
+            self._state = self.model.reset_slot(self._state, slot)
+            self._active[slot] = req
+            admitted += 1
+            flight.record("decode_join", model=self.name,
+                          req_id=req.req_id, slot=slot,
+                          prompt=len(req.prompt), max_new=req.max_new)
+        return admitted
+
+    def _finish(self, req, error=None):
+        slot = req.slot
+        self._active.pop(slot, None)
+        if self._kv is not None:
+            self._kv.release(slot)
+        self._free_slots.append(slot)
+        if error is not None:
+            if not req.future.done():
+                req.future.set_exception(error)
+        elif not req.future.done():
+            req.future.set_result(list(req.generated))
+        req.stream.put(_DecodeRequest._END)
+        flight.record("decode_leave", model=self.name,
+                      req_id=req.req_id, slot=slot,
+                      generated=len(req.generated),
+                      seconds=round(time.perf_counter() - req.t_submit,
+                                    6))
+
+    def _loop(self):
+        S = self.model.max_slots
+        while not self._closed:
+            self._admit()
+            if not self._active:
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            tokens = np.zeros((S,), np.int32)
+            pos = np.zeros((S,), np.int32)
+            # snapshot: close() may clear _active concurrently
+            for slot, req in list(self._active.items()):
+                if req.ptr < len(req.prompt):
+                    tokens[slot] = req.prompt[req.ptr]
+                else:
+                    tokens[slot] = req.generated[-1]
+                pos[slot] = req.ptr
+            table = np.ascontiguousarray(self._table)
+            try:
+                nxt, self._state = self.model.step(
+                    self._state, tokens, pos, table)
+                nxt = np.asarray(nxt)
+            except Exception as e:
+                for req in list(self._active.values()):
+                    self._finish(req, error=RuntimeError(
+                        f"decode step failed: {type(e).__name__}: {e}"))
+                continue
+            inst = self._instruments_fn()
+            n_decoded = 0
+            for slot, req in list(self._active.items()):
+                req.ptr += 1
+                if req.ptr < len(req.prompt):
+                    continue            # still prefilling
+                tok = int(nxt[slot])
+                req.generated.append(tok)
+                req.stream.put(tok)
+                n_decoded += 1
+                if len(req.generated) >= req.max_new or \
+                        (req.eos_id is not None and tok == req.eos_id):
+                    self._finish(req)
+            if inst is not None:
+                inst.tokens.inc(n_decoded)
+                inst.slots.set(len(self._active))

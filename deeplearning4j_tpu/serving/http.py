@@ -6,7 +6,9 @@ through an InferenceSession, and maps serving errors onto HTTP statuses:
 
     400  malformed JSON / wrong shape or dtype
     404  unknown model (or no serving session attached)
-    429  queue full (backpressure — retry with backoff)
+    429  queue full, or shed by admission control (the shed response
+         carries a Retry-After header computed from the model's
+         current service rate)
     504  request timed out before execution
     503  session shut down
     500  device/runtime error
@@ -15,7 +17,8 @@ Wire format (TF-Serving-style):
 
     POST /serving/v1/models/<name>:predict
     {"instances": [[...], ...]}             -> {"predictions": [[...], ...]}
-    {"instances": [...], "version": 2, "timeout_ms": 100}
+    {"instances": [...], "version": 2, "timeout_ms": 100,
+     "priority": "high"}                    # high | normal | batch
 """
 
 from __future__ import annotations
@@ -24,19 +27,22 @@ import json
 
 import numpy as np
 
+from deeplearning4j_tpu.serving.admission import ShedError
 from deeplearning4j_tpu.serving.batcher import (
     QueueFullError, ServingShutdown, ServingTimeout)
 from deeplearning4j_tpu.serving.registry import ModelNotFound
 
 PREDICT_SUFFIX = ":predict"
+DECODE_SUFFIX = ":decode"
 MODELS_PATH = "/serving/v1/models"
 
 
 class HttpError(Exception):
-    def __init__(self, status, message):
+    def __init__(self, status, message, headers=None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = dict(headers or {})
 
 
 def parse_predict_path(path: str):
@@ -47,6 +53,77 @@ def parse_predict_path(path: str):
         return None
     name = path[len(MODELS_PATH) + 1:-len(PREDICT_SUFFIX)]
     return name or None
+
+
+def parse_decode_path(path: str):
+    """'/serving/v1/models/<name>:decode' -> name, or None."""
+    if not path.startswith(MODELS_PATH + "/") or \
+            not path.endswith(DECODE_SUFFIX):
+        return None
+    name = path[len(MODELS_PATH) + 1:-len(DECODE_SUFFIX)]
+    return name or None
+
+
+def handle_decode(session, name: str, body: bytes) -> bytes:
+    """POST /serving/v1/models/<name>:decode — continuous-batching
+    autoregressive decode:
+
+        {"prompt": [1, 2, 3], "max_new_tokens": 16,
+         "eos_id": 0, "priority": "high"}       # eos/priority optional
+        -> {"model": ..., "tokens": [...]}
+    """
+    if session is None:
+        raise HttpError(404, "no serving session attached "
+                             "(UIServer.serveModels(session))")
+    try:
+        payload = json.loads(body or b"")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HttpError(400, f"malformed JSON body: {e}") from None
+    if not isinstance(payload, dict) or "prompt" not in payload \
+            or "max_new_tokens" not in payload:
+        raise HttpError(400, 'body must be {"prompt": [...], '
+                             '"max_new_tokens": N}')
+    priority = payload.get("priority", "normal")
+    if priority not in ("high", "normal", "batch"):
+        raise HttpError(400, f"priority must be high|normal|batch, "
+                             f"got {priority!r}")
+    timeout = payload.get("timeout_ms")
+    try:
+        timeout = float(timeout) / 1e3 if timeout is not None else None
+        prompt = [int(t) for t in payload["prompt"]]
+        max_new = int(payload["max_new_tokens"])
+        eos_id = payload.get("eos_id")
+        eos_id = int(eos_id) if eos_id is not None else None
+    except (TypeError, ValueError) as e:
+        raise HttpError(400, f"bad decode parameters: {e}") from None
+    try:
+        tokens = session.decode(name, prompt, max_new, eos_id=eos_id,
+                                timeout=timeout, priority=priority)
+    except ModelNotFound as e:
+        raise HttpError(404, f"unknown decoder: {e}") from None
+    except ShedError as e:
+        raise HttpError(
+            429, str(e),
+            headers={"Retry-After": f"{max(e.retry_after, 0.001):.3f}"},
+        ) from None
+    except (ServingTimeout, TimeoutError) as e:
+        raise HttpError(504, f"timed out: {e}") from None
+    except ServingShutdown as e:
+        raise HttpError(503, str(e)) from None
+    except QueueFullError as e:
+        raise HttpError(429, str(e)) from None
+    except ValueError as e:
+        raise HttpError(400, str(e)) from None
+    except Exception as e:
+        from deeplearning4j_tpu.serving.decode import (DecodeError,
+                                                       DecodeShutdown)
+
+        if isinstance(e, DecodeShutdown):
+            raise HttpError(503, str(e)) from None
+        if isinstance(e, DecodeError):   # limits: too long for the pool
+            raise HttpError(400, str(e)) from None
+        raise HttpError(500, f"{type(e).__name__}: {e}") from None
+    return json.dumps({"model": name, "tokens": tokens}).encode()
 
 
 def handle_models(session) -> bytes:
@@ -74,13 +151,26 @@ def handle_predict(session, name: str, body: bytes) -> bytes:
         raise HttpError(400, f"timeout_ms must be a number, "
                              f"got {timeout!r}") from None
     version = payload.get("version")
+    priority = payload.get("priority", "normal")
+    if priority not in ("high", "normal", "batch"):
+        raise HttpError(400, f"priority must be high|normal|batch, "
+                             f"got {priority!r}")
     try:
         entry = session.registry.get(name, version)
         x = np.asarray(payload["instances"],
                        dtype=entry.servable.dtype)
-        y = session.predict(name, x, timeout=timeout, version=version)
+        y = session.predict(name, x, timeout=timeout, version=version,
+                            priority=priority)
     except ModelNotFound as e:
         raise HttpError(404, f"unknown model: {e}") from None
+    except ShedError as e:
+        # overload policy, not backpressure accident: tell the client
+        # WHEN to come back (admission computed it from the model's
+        # recent service rate)
+        raise HttpError(
+            429, str(e),
+            headers={"Retry-After": f"{max(e.retry_after, 0.001):.3f}"},
+        ) from None
     except QueueFullError as e:
         raise HttpError(429, str(e)) from None
     except (ServingTimeout, TimeoutError) as e:

@@ -1,0 +1,339 @@
+"""ReplicaSet: multi-replica work-stealing execution for one model.
+
+The PR-2 serving path ran every model through ONE DynamicBatcher worker
+thread — correct, but a model's throughput was capped at one device
+dispatch at a time regardless of how many mesh devices sit idle
+(ROADMAP item 3). Here a model's per-bucket AOT executables are CLONED
+onto N distinct devices (`Servable.for_device`, one executable cache
+per device) and a scheduler spreads formed batches across them:
+
+- per-replica run queues: a submitted batch is routed to the replica
+  with the least load (queued + in-flight), so a slow dispatch on one
+  device doesn't head-of-line-block the others;
+- steal-on-idle: a replica with an empty queue pops from the TAIL of
+  the longest sibling queue (FIFO order preserved for the victim's
+  head), so skewed batch sizes can't strand work behind one device;
+- death containment: a batch that fails with :class:`ReplicaDeath` is
+  re-queued to a surviving replica (the request futures stay live —
+  work is moved, not lost) and the dead replica stops taking work;
+- graceful retire(): stop accepting, drain every queue and in-flight
+  dispatch, then stop the workers — the rolling-update half of the
+  lifecycle, mirroring DynamicBatcher.retire().
+
+All queues share ONE lock (the set's Condition): per-replica deques
+give routing and stealing their semantics; a single mutex keeps the
+lock-order rule trivially satisfiable and makes load reads consistent.
+At serving batch rates (hundreds/s, not millions/s) lock contention is
+noise next to a device dispatch.
+
+int8 `QuantizedServable` replicas ride the same path: `for_device`
+clones the quantized payload's executor cache exactly like an fp32
+servable (the payload itself is shared, placed per device on first
+use).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from deeplearning4j_tpu.serving.batcher import ServingShutdown, run_batch
+from deeplearning4j_tpu.telemetry import flight
+
+
+class ReplicaDeath(RuntimeError):
+    """Infrastructure-level replica failure (device lost, executable
+    invalidated). Distinct from a model/runtime error, which terminates
+    the requests: a ReplicaDeath moves the batch to a live replica."""
+
+
+class _BatchTask:
+    __slots__ = ("requests", "inst", "attempts")
+
+    def __init__(self, requests, inst):
+        self.requests = requests
+        self.inst = inst
+        self.attempts = 0
+
+
+class Replica:
+    """One device-pinned copy of the model plus its run queue and
+    worker thread."""
+
+    def __init__(self, rset, index, device, servable):
+        self.rset = rset
+        self.index = index
+        self.device = device
+        self.servable = servable
+        self.name = f"r{index}"
+        self.queue: deque = deque()
+        self.inflight = 0
+        self.dead = False
+        self.consec_errors = 0   # circuit breaker input
+        self._thread = threading.Thread(
+            target=rset._worker_loop, args=(self,),
+            name=f"dl4j-replica-{rset.entry.name}-{index}", daemon=True)
+
+    def load(self) -> int:
+        return len(self.queue) + self.inflight
+
+    def start(self):
+        self._thread.start()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+class ReplicaSet:
+    """N replicas of one registry entry with work-stealing dispatch.
+
+    `devices`: explicit device list, or None to pick `n_replicas`
+    distinct devices via `parallel.mesh.replica_devices` (round-robin
+    when n_replicas exceeds the device count — useful on CPU). The
+    DynamicBatcher owns the set when wired through
+    `InferenceSession.register(..., replicas=N)`.
+    """
+
+    def __init__(self, entry, n_replicas=None, devices=None, mesh=None,
+                 instruments=None, steal=True, warmup=True,
+                 max_queued=None):
+        from deeplearning4j_tpu.parallel.mesh import replica_devices
+
+        if devices is None:
+            devices = replica_devices(n_replicas, mesh=mesh)
+        self.entry = entry
+        self.steal = steal
+        # total standing batches across all run queues: submit_batch
+        # BLOCKS the coalescer beyond this, which backs pressure up
+        # into the batcher's bounded request queue — so QueueFullError
+        # (HTTP 429) keeps firing at the front door instead of work
+        # piling up in unbounded deques behind it
+        self.max_queued = (max_queued if max_queued is not None
+                           else max(4, 2 * len(devices)))
+        self._instruments_fn = (instruments if callable(instruments)
+                                else lambda: instruments)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._accepting = True
+        self._closed = False
+        self.replicas = [
+            Replica(self, i, d, entry.servable.for_device(d))
+            for i, d in enumerate(devices)]
+        if warmup and entry.warmed:
+            # the source servable was AOT-warmed; each replica clone
+            # owns a per-device executable cache and warms its own
+            self.warmup()
+        for r in self.replicas:
+            r.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def warmup(self):
+        """AOT-compile the ladder on every replica's device. Compiles
+        land in dl4j_compile_total HERE; the steady state adds none."""
+        for r in self.replicas:
+            r.servable.warmup(self.entry.ladder)
+        return self
+
+    def retire(self, timeout=30.0):
+        """Drain: stop accepting, wait for every queue and in-flight
+        dispatch to finish, then stop the workers."""
+        deadline = time.perf_counter() + timeout
+        with self._lock:
+            self._accepting = False
+            while self.depth_locked() > 0 or any(
+                    r.inflight for r in self.replicas):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._work.wait(min(remaining, 0.1))
+            self._closed = True
+            self._work.notify_all()
+        for r in self.replicas:
+            r.join(max(0.0, deadline - time.perf_counter()) + 1.0)
+
+    def close(self, timeout=5.0):
+        """Fail-fast: queued batches fail with ServingShutdown.
+        Idempotent — a second close finds the queues already drained."""
+        with self._lock:
+            self._accepting = False
+            self._closed = True
+            leftovers = [t for r in self.replicas
+                         for t in self._drain_locked(r)]
+            self._work.notify_all()
+        inst = self._instruments_fn()
+        for task in leftovers:
+            for req in task.requests:
+                req.fail(ServingShutdown("replica set closed"), inst,
+                         "shutdown")
+        for r in self.replicas:
+            r.join(timeout)
+
+    def _drain_locked(self, replica):
+        out = list(replica.queue)
+        replica.queue.clear()
+        return out
+
+    # -- submission / introspection ------------------------------------------
+    def depth_locked(self) -> int:
+        return sum(len(r.queue) for r in self.replicas)
+
+    def depth(self) -> int:
+        with self._lock:
+            return self.depth_locked()
+
+    def live_replicas(self) -> list:
+        return [r for r in self.replicas if not r.dead]
+
+    def submit_batch(self, requests, inst=None):
+        """Route one formed batch to the least-loaded live replica. A
+        batch carrying a high-priority request goes to the HEAD of the
+        run queue (and tail-stealing then migrates best-effort work
+        first) — admission control bounds how MANY requests stand in
+        line; this bounds WHERE the latency-sensitive ones stand."""
+        self._submit(_BatchTask(list(requests),
+                                inst if inst is not None
+                                else self._instruments_fn()))
+
+    def _submit(self, task):
+        urgent = any(getattr(r, "priority", None) == "high"
+                     for r in task.requests)
+        with self._lock:
+            while (not self._closed and self._accepting
+                   and self.depth_locked() >= self.max_queued):
+                self._work.wait(0.05)   # workers notify on completion
+            if self._closed or not self._accepting:
+                raise ServingShutdown(
+                    f"replica set for {self.entry.name!r} closed")
+            live = [r for r in self.replicas if not r.dead]
+            if not live:
+                raise ReplicaDeath(
+                    f"no live replicas for {self.entry.name!r}")
+            target = min(live, key=lambda r: (r.load(), r.index))
+            if urgent:
+                target.queue.appendleft(task)
+            else:
+                target.queue.append(task)
+            self._work.notify_all()
+        self._publish_load()
+
+    def _publish_load(self):
+        inst = self._instruments_fn()
+        if inst is None or getattr(inst, "replica_load", None) is None:
+            return
+        for r in self.replicas:
+            inst.replica_load(r.name).set(-1.0 if r.dead else r.load())
+
+    # -- worker side ---------------------------------------------------------
+    def _next_task_locked(self, me):
+        """Own queue first (FIFO head); else steal from the tail of the
+        longest sibling queue."""
+        if me.queue:
+            return me.queue.popleft(), False
+        if self.steal:
+            victims = [r for r in self.replicas if r is not me and r.queue]
+            if victims:
+                victim = max(victims, key=lambda r: len(r.queue))
+                return victim.queue.pop(), True
+        return None, False
+
+    def _worker_loop(self, me):
+        try:
+            while True:
+                with self._lock:
+                    if me.dead:   # a dead replica must not steal work
+                        return
+                    task, stolen = self._next_task_locked(me)
+                    while task is None:
+                        if self._closed or me.dead:
+                            return
+                        if not self._accepting and \
+                                self.depth_locked() == 0:
+                            return
+                        self._work.wait(0.05)
+                        task, stolen = self._next_task_locked(me)
+                    me.inflight += 1
+                try:
+                    self._run_task(me, task, stolen)
+                finally:
+                    with self._lock:
+                        me.inflight -= 1
+                        self._work.notify_all()
+                    self._publish_load()
+        finally:
+            if me.dead:
+                self._on_death(me)
+
+    # consecutive batch-level errors before a replica is declared dead:
+    # a real device failure raises generic XLA errors, not ReplicaDeath
+    # — without a breaker the broken replica fails batches instantly,
+    # keeps a ~0 load, and least-loaded routing feeds it ALL traffic
+    # while healthy siblings idle
+    ERROR_BREAKER = 3
+
+    def _run_task(self, me, task, stolen):
+        inst = task.inst
+        if stolen and inst is not None and \
+                getattr(inst, "steals", None) is not None:
+            inst.steals.inc()
+        task.attempts += 1
+        try:
+            errored = run_batch(self.entry, task.requests, inst,
+                                servable=me.servable, replica=me.name)
+        except ReplicaDeath as e:
+            me.dead = True
+            flight.record("replica_death", model=self.entry.name,
+                          replica=me.name, error=str(e),
+                          attempt=task.attempts)
+            self._requeue(me, task, e)
+            return
+        if not errored:
+            me.consec_errors = 0
+            return
+        me.consec_errors += 1
+        others_alive = any(r is not me and not r.dead and r.is_alive()
+                           for r in self.replicas)
+        if me.consec_errors >= self.ERROR_BREAKER and others_alive:
+            # the batch's requests already failed; move the BACKLOG
+            me.dead = True
+            death = ReplicaDeath(
+                f"replica {me.name} tripped the error breaker "
+                f"({me.consec_errors} consecutive failed dispatches)")
+            flight.record("replica_death", model=self.entry.name,
+                          replica=me.name, error=str(death),
+                          attempt=task.attempts)
+            self._requeue(me, None, death)
+
+    def _requeue(self, me, task, death):
+        """Move a failed batch (and everything queued on the dead
+        replica) to survivors; fail the requests only when no replica
+        is left or the batch already died on every one of them.
+        task=None moves just the backlog (breaker-tripped path: the
+        triggering batch's requests already failed)."""
+        with self._lock:
+            stranded = self._drain_locked(me) + \
+                ([task] if task is not None else [])
+            live = [r for r in self.replicas
+                    if not r.dead and r.is_alive()]
+            requeued, doomed = [], []
+            for t in stranded:
+                if live and t.attempts < len(self.replicas):
+                    target = min(live, key=lambda r: (r.load(), r.index))
+                    target.queue.append(t)
+                    requeued.append(t)
+                else:
+                    doomed.append(t)
+            self._work.notify_all()
+        inst = self._instruments_fn()
+        for t in doomed:
+            for req in t.requests:
+                req.fail(death, inst, "error")
+        if requeued:
+            flight.record("replica_requeue", model=self.entry.name,
+                          source=me.name, batches=len(requeued))
+
+    def _on_death(self, me):
+        self._publish_load()

@@ -48,6 +48,13 @@ class Servable:
 
     Subclasses provide `_jit_fn()` (the jax.jit-wrapped pure function)
     and `_call_args()` (the non-input arguments, read fresh per call).
+
+    `device` (set via :meth:`for_device`) pins the servable to one mesh
+    device: executables are lowered against that device's sharding and
+    the call args are placed there (cached by identity, so a training
+    step that rebinds the params re-places them exactly once). This is
+    what lets a ReplicaSet run N copies of one model on N devices
+    without the copies sharing a dispatch queue.
     """
 
     def __init__(self, example_shape, dtype=np.float32):
@@ -57,8 +64,60 @@ class Servable:
                 "axis), e.g. example_shape=(784,)")
         self.example_shape = tuple(int(d) for d in example_shape)
         self.dtype = np.dtype(dtype)
+        self.device = None
+        # (args identity key, HOST args, placed args): the host args
+        # ride along to pin their ids — see _placed_args
+        self._placed = (None, None, None)
         self._compiled = {}
         self._lock = threading.Lock()
+
+    def for_device(self, device) -> "Servable":
+        """A device-pinned replica of this servable: shares the model
+        (params are read live through `_call_args()` like always) but
+        owns its executable cache and places args/executables on
+        `device`. The clone warms independently — executables are
+        per-device objects."""
+        import copy
+
+        clone = copy.copy(self)
+        clone.device = device
+        clone._placed = (None, None, None)
+        clone._compiled = {}
+        clone._lock = threading.Lock()
+        return clone
+
+    def _placed_args(self) -> tuple:
+        """The call args, on this servable's device when pinned. The
+        placement is cached keyed on the args' object identities:
+        `fit()` donates and rebinds params, so a changed identity means
+        a changed value (re-place). The cache tuple also HOLDS the host
+        args: without that reference, the step-N params could be
+        garbage-collected and a later step's fresh pytree could land on
+        a recycled address whose id() matches the cached key — and the
+        replica would silently serve stale parameters."""
+        args = self._call_args()
+        if self.device is None:
+            return args
+        key = tuple(map(id, args))
+        cached_key, _pinned, cached = self._placed
+        if key != cached_key:
+            import jax
+
+            cached = jax.device_put(args, self.device)
+            self._placed = (key, args, cached)   # one swap: thread-safe
+        return cached
+
+    def _input_spec(self, shape):
+        """ShapeDtypeStruct for one input shape, carrying the pinned
+        device's sharding so lowered executables commit to it."""
+        import jax
+
+        if self.device is None:
+            return jax.ShapeDtypeStruct(shape, self.dtype)
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.ShapeDtypeStruct(
+            shape, self.dtype, sharding=SingleDeviceSharding(self.device))
 
     # -- subclass surface ---------------------------------------------------
     def _jit_fn(self):
@@ -79,13 +138,11 @@ class Servable:
     def compile_shape(self, shape: tuple):
         """Lower + compile the inference function for one concrete input
         shape (idempotent)."""
-        import jax
-
         shape = tuple(shape)
         if shape in self._compiled:
             return self._compiled[shape]
-        spec = self._input(jax.ShapeDtypeStruct(shape, self.dtype))
-        exe = self._jit_fn().lower(*self._call_args(), spec).compile()
+        spec = self._input(self._input_spec(shape))
+        exe = self._jit_fn().lower(*self._placed_args(), spec).compile()
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]
@@ -109,9 +166,9 @@ class Servable:
         x = np.ascontiguousarray(x, dtype=self.dtype)
         exe = self._compiled.get(x.shape)
         if exe is not None:
-            y = exe(*self._call_args(), self._input(x))
+            y = exe(*self._placed_args(), self._input(x))
         else:
-            y = self._jit_fn()(*self._call_args(), self._input(x))
+            y = self._jit_fn()(*self._placed_args(), self._input(x))
         return self._output(y)
 
 
@@ -199,13 +256,11 @@ class SameDiffServable(Servable):
         return _np(y[self.output_name])
 
     def compile_shape(self, shape):
-        import jax
-
         shape = tuple(shape)
         if shape in self._compiled:
             return self._compiled[shape]
-        params, consts, rng = self._call_args()
-        spec = self._input(jax.ShapeDtypeStruct(shape, self.dtype))
+        params, consts, rng = self._placed_args()
+        spec = self._input(self._input_spec(shape))
         exe = self._jit_fn().lower(spec, params, consts, rng).compile()
         with self._lock:
             self._compiled.setdefault(shape, exe)
@@ -215,7 +270,7 @@ class SameDiffServable(Servable):
         x = np.ascontiguousarray(x, dtype=self.dtype)
         exe = self._compiled.get(x.shape)
         fn = exe if exe is not None else self._jit_fn()
-        return self._output(fn(self._input(x), *self._call_args()))
+        return self._output(fn(self._input(x), *self._placed_args()))
 
 
 class FnServable(Servable):

@@ -33,34 +33,120 @@ from deeplearning4j_tpu.serving.registry import ModelRegistry
 class InferenceSession:
     def __init__(self, registry: ModelRegistry | None = None,
                  max_latency=0.002, queue_size=256, default_timeout=30.0,
-                 batching=True):
+                 batching=True, admission=None):
         self.registry = registry or ModelRegistry()
         self.max_latency = max_latency
         self.queue_size = queue_size
         self.default_timeout = default_timeout
         self.batching = batching
+        self.admission = admission   # AdmissionController or None
         self._batchers: dict[str, DynamicBatcher] = {}
+        self._replica_spec: dict = {}  # (name, version) -> (n, devices)
+        self._decoders: dict = {}      # name -> DecodeEngine
         self._instruments: dict = {}   # per-model bundle, built once
         self._lock = threading.Lock()
         self._closed = False
 
     # -- registry passthrough ------------------------------------------------
-    def register(self, name, model, **kw):
+    def register(self, name, model, replicas=None, devices=None, **kw):
         """See ModelRegistry.register. Re-registering retires the
         model's old batchers: new predicts bind the new entry while
         already-queued requests finish on the old servable (rolling
-        update)."""
+        update).
+
+        `replicas=N` (or an explicit `devices` list) executes this
+        model through a work-stealing ReplicaSet: N device-pinned
+        copies of the bucket executables with per-replica run queues
+        (see serving/replica.py). The batcher thread then only
+        coalesces; dispatches run on the replica workers."""
         entry = self.registry.register(name, model, **kw)
         with self._lock:
             stale = [k for k in self._batchers if k[0] == name]
             dropped = [self._batchers.pop(k) for k in stale]
+            # specs of superseded versions leak across rolling updates
+            # otherwise — sweep every spec for this name first
+            for k in [k for k in self._replica_spec if k[0] == name]:
+                del self._replica_spec[k]
+            if replicas is not None or devices is not None:
+                self._replica_spec[(name, entry.version)] = (replicas,
+                                                             devices)
         for b in dropped:
             b.retire()
+        if entry.warmed and (name, entry.version) in self._replica_spec:
+            # build the ReplicaSet (and its N-replica ladder warmup)
+            # NOW, not lazily under the session lock on the first
+            # predict — ready() must keep meaning "no cold compile in
+            # any request's latency path"
+            self._batcher(name, entry)
         from deeplearning4j_tpu.telemetry import flight
 
         flight.record("model_registered", model=name,
-                      version=entry.version, warmed=entry.warmed)
+                      version=entry.version, warmed=entry.warmed,
+                      replicas=replicas)
         return entry
+
+    def register_decoder(self, name, model, warmup=True, **kw):
+        """Attach a continuous-batching DecodeEngine under `name`
+        (POST /serving/v1/models/<name>:decode). `model` is a
+        DecodeModel (RnnDecodeModel / TransformerDecodeModel) or an
+        already-built DecodeEngine."""
+        from deeplearning4j_tpu.serving.decode import DecodeEngine
+
+        if isinstance(model, DecodeEngine):
+            engine = model
+        else:
+            engine = DecodeEngine(model, name=name,
+                                  instruments=lambda: self._inst(name))
+        if warmup and not engine._warmed:
+            engine.warmup()
+        with self._lock:
+            old = self._decoders.get(name)
+            self._decoders[name] = engine
+        if old is not None and old is not engine:
+            old.close()
+        from deeplearning4j_tpu.telemetry import flight
+
+        flight.record("decoder_registered", model=name,
+                      slots=engine.model.max_slots)
+        return engine
+
+    def decoder(self, name):
+        engine = self._decoders.get(name)
+        if engine is None:
+            raise ModelNotFound(name)
+        return engine
+
+    def decode(self, name, prompt, max_new_tokens, eos_id=None,
+               timeout=None, priority="normal"):
+        """Generated token ids for one prompt through the continuous
+        batcher (admission-controlled like predict)."""
+        if self._closed:
+            raise RuntimeError("session closed")
+        engine = self.decoder(name)
+        ticket = None
+        if self.admission is not None:
+            ticket = self.admission.admit(name, priority,
+                                          inst=self._inst(name))
+        try:
+            req = engine.submit(prompt, max_new_tokens, eos_id=eos_id)
+            if ticket is not None:
+                # bind as a default: the variable is nulled on the next
+                # line, and a late-bound closure would call None.release
+                req.future.add_done_callback(
+                    lambda f, t=ticket: t.release())
+                ticket = None
+            try:
+                return req.result(timeout=timeout)
+            except _FutureTimeout:
+                # same normalization as predict(): pre-3.11 the futures
+                # TimeoutError is NOT the builtin, and the HTTP 504
+                # mapping keys on one exception type
+                raise ServingTimeout(
+                    f"decode on {name!r} timed out after {timeout}s"
+                ) from None
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     def ready(self) -> bool:
         """Readiness for /healthz: every registered model's bucket
@@ -90,19 +176,31 @@ class InferenceSession:
 
     def _batcher(self, name, entry) -> DynamicBatcher:
         """One batcher per served (name, version): pinned-version
-        requests coalesce among themselves, never across versions."""
+        requests coalesce among themselves, never across versions.
+        Models registered with replicas= get a ReplicaSet executor."""
         key = (name, entry.version)
         b = self._batchers.get(key)
         if b is None:
             with self._lock:
                 b = self._batchers.get(key)
                 if b is None:
+                    executor = None
+                    spec = self._replica_spec.get(key)
+                    if spec is not None:
+                        from deeplearning4j_tpu.serving.replica import (
+                            ReplicaSet)
+
+                        n, devices = spec
+                        executor = ReplicaSet(
+                            entry, n_replicas=n, devices=devices,
+                            instruments=lambda: self._inst(name))
                     b = DynamicBatcher(
                         entry,
                         max_latency=self.max_latency,
                         queue_size=self.queue_size,
                         default_timeout=self.default_timeout,
-                        instruments=lambda: self._inst(name))
+                        instruments=lambda: self._inst(name),
+                        executor=executor)
                     self._batchers[key] = b
         return b
 
@@ -124,14 +222,30 @@ class InferenceSession:
                 f"got {got}")
         return entry, x, single
 
-    def predict_async(self, name, features, timeout=None, version=None):
+    def predict_async(self, name, features, timeout=None, version=None,
+                      priority="normal"):
         """Future of the prediction batch. Concurrent callers of the
         same model (and version) coalesce into shared device
-        dispatches."""
+        dispatches. With an AdmissionController attached, the request
+        is admitted (or shed with ShedError -> HTTP 429) BEFORE it
+        takes a queue slot; the admission ticket releases when the
+        future goes terminal."""
         if self._closed:
             raise RuntimeError("session closed")
         entry, x, single = self._prep(name, features, version)
-        future = self._batcher(name, entry).submit(x, timeout=timeout)
+        ticket = None
+        if self.admission is not None:
+            ticket = self.admission.admit(name, priority,
+                                          inst=self._inst(name))
+        try:
+            future = self._batcher(name, entry).submit(
+                x, timeout=timeout, priority=priority)
+        except Exception:
+            if ticket is not None:
+                ticket.release()
+            raise
+        if ticket is not None:
+            future.add_done_callback(lambda f: ticket.release())
         if not single:
             return future
         from concurrent.futures import Future
@@ -150,17 +264,18 @@ class InferenceSession:
         return out
 
     def predict(self, name, features, timeout=None, batched=None,
-                version=None):
+                version=None, priority="normal"):
         """Synchronous predict. `batched=False` bypasses the queue and
         runs the bucketed servable on the calling thread."""
         if timeout is None:
             timeout = self.default_timeout
         use_batcher = self.batching if batched is None else batched
         if not use_batcher:
-            return self._direct(name, features, version)
+            return self._direct(name, features, version,
+                                priority=priority)
         t0 = time.perf_counter()
         future = self.predict_async(name, features, timeout=timeout,
-                                    version=version)
+                                    version=version, priority=priority)
         budget = (None if timeout is None
                   else max(0.0, timeout - (time.perf_counter() - t0)) + 0.25)
         try:
@@ -173,9 +288,15 @@ class InferenceSession:
                 f"request to {name!r} timed out after {timeout}s"
             ) from None
 
-    def _direct(self, name, features, version=None):
+    def _direct(self, name, features, version=None, priority="normal"):
         entry, x, single = self._prep(name, features, version)
         inst = self._inst(name)
+        if self.admission is not None:
+            with self.admission.admit(name, priority, inst=inst):
+                return self._direct_run(entry, x, single, inst)
+        return self._direct_run(entry, x, single, inst)
+
+    def _direct_run(self, entry, x, single, inst):
         t = x.shape[-1] if x.ndim >= 3 else None
         t0 = time.perf_counter()
         try:
@@ -194,15 +315,28 @@ class InferenceSession:
     # -- introspection / lifecycle -------------------------------------------
     def stats(self) -> dict:
         with self._lock:
-            return {f"{name}:v{version}": {"queue_depth": b.queue_depth()}
-                    for (name, version), b in self._batchers.items()}
+            out = {}
+            for (name, version), b in self._batchers.items():
+                row = {"queue_depth": b.queue_depth()}
+                if b.executor is not None:
+                    row["replicas"] = {
+                        r.name: {"device": str(r.device),
+                                 "load": r.load(), "dead": r.dead}
+                        for r in b.executor.replicas}
+                out[f"{name}:v{version}"] = row
+            if self.admission is not None:
+                out["admission"] = self.admission.describe()
+            return out
 
     def close(self):
         self._closed = True
         with self._lock:
             batchers, self._batchers = list(self._batchers.values()), {}
+            decoders, self._decoders = list(self._decoders.values()), {}
         for b in batchers:
             b.close()
+        for d in decoders:
+            d.close()
 
     def __enter__(self):
         return self

@@ -483,7 +483,8 @@ def etl_instruments(loop):
 # -- the standard instrument set for inference serving (ISSUE 2) -------------
 
 SERVING_REQUESTS_HELP = ("Inference requests by terminal outcome "
-                         "(ok|timeout|rejected|error|shutdown)")
+                         "(ok|timeout_queued|timeout_execute|rejected|"
+                         "shed|error|shutdown)")
 SERVING_QUEUE_HELP = "Seconds a request waited in the batching queue"
 SERVING_EXECUTE_HELP = ("Seconds per coalesced device dispatch (pad + "
                         "execute + split, host-visible)")
@@ -491,6 +492,15 @@ SERVING_OCCUPANCY_HELP = ("Real rows / bucket rows of the last coalesced "
                           "dispatch (1.0 = perfectly filled bucket)")
 SERVING_DISPATCH_HELP = "Coalesced device dispatches executed"
 SERVING_DEPTH_HELP = "Requests currently queued for batching"
+SERVING_STEALS_HELP = ("Batches executed by a replica that stole them "
+                       "from a sibling's run queue")
+SERVING_REPLICA_LOAD_HELP = ("Queued + in-flight batches per replica "
+                             "(-1 = replica dead)")
+SERVING_SHED_HELP = ("Requests shed by admission control, by priority "
+                     "class (HTTP 429 + Retry-After)")
+SERVING_TOKENS_HELP = "Tokens emitted by continuous-batching decode"
+SERVING_SLOTS_HELP = ("Decode slots currently occupied by in-flight "
+                      "sequences")
 
 
 class ServingInstruments:
@@ -499,7 +509,8 @@ class ServingInstruments:
     disabled serving path performs zero registry calls per request)."""
 
     __slots__ = ("model", "_requests", "queue_wait", "execute",
-                 "occupancy", "dispatch", "depth")
+                 "occupancy", "dispatch", "depth", "steals",
+                 "_replica_load", "_shed", "tokens", "slots")
 
     def __init__(self, registry, model):
         self.model = model
@@ -521,9 +532,31 @@ class ServingInstruments:
         self.depth = registry.gauge(
             "dl4j_serving_queue_depth", SERVING_DEPTH_HELP,
             ("model",)).labels(model=model)
+        self.steals = registry.counter(
+            "dl4j_serving_steals_total", SERVING_STEALS_HELP,
+            ("model",)).labels(model=model)
+        self._replica_load = registry.gauge(
+            "dl4j_serving_replica_load", SERVING_REPLICA_LOAD_HELP,
+            ("model", "replica"))
+        self._shed = registry.counter(
+            "dl4j_serving_shed_total", SERVING_SHED_HELP,
+            ("model", "priority"))
+        self.tokens = registry.counter(
+            "dl4j_serving_decode_tokens_total", SERVING_TOKENS_HELP,
+            ("model",)).labels(model=model)
+        self.slots = registry.gauge(
+            "dl4j_serving_decode_slots", SERVING_SLOTS_HELP,
+            ("model",)).labels(model=model)
 
     def request(self, outcome):
         self._requests.labels(model=self.model, outcome=outcome).inc()
+
+    def replica_load(self, replica):
+        return self._replica_load.labels(model=self.model,
+                                         replica=replica)
+
+    def shed(self, priority):
+        self._shed.labels(model=self.model, priority=priority).inc()
 
 
 def serving_instruments(model):

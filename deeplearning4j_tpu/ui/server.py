@@ -75,10 +75,13 @@ setInterval(draw, 2000);
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpuUI/1.0"
 
-    def _respond(self, body, ctype="application/json", status=200):
+    def _respond(self, body, ctype="application/json", status=200,
+                 headers=None):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -140,15 +143,21 @@ class _Handler(BaseHTTPRequestHandler):
         from deeplearning4j_tpu.serving import http as shttp
 
         name = shttp.parse_predict_path(self.path)
+        handler = shttp.handle_predict
+        if name is None:
+            name = shttp.parse_decode_path(self.path)
+            handler = shttp.handle_decode
         if name is None:
             self._respond(b'{"error": "not found"}', status=404)
             return
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            out = shttp.handle_predict(self.server.ui._serving, name, body)
+            out = handler(self.server.ui._serving, name, body)
         except shttp.HttpError as e:
-            self._respond(shttp.error_body(e), status=e.status)
+            # shed responses carry Retry-After (admission control)
+            self._respond(shttp.error_body(e), status=e.status,
+                          headers=e.headers)
             return
         self._respond(out)
 
@@ -213,7 +222,15 @@ class UIServer:
                       list(range(port, port + max_port_retries)) + [0])
         for p in candidates:
             try:
+                # ThreadingHTTPServer, NOT HTTPServer: one handler
+                # thread per connection, so concurrent predict requests
+                # reach the DynamicBatcher together and can coalesce —
+                # a serial accept loop would defeat batching before it
+                # starts (ISSUE 8 satellite; daemon_threads is the
+                # ThreadingHTTPServer default, stated here as intent —
+                # in-flight handlers must not block interpreter exit)
                 self._httpd = ThreadingHTTPServer(("127.0.0.1", p), _Handler)
+                self._httpd.daemon_threads = True
                 break
             except OSError as e:
                 if e.errno not in (errno.EADDRINUSE, errno.EACCES):

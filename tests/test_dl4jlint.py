@@ -134,6 +134,86 @@ class TestCollectiveThreadRule:
         assert len(rules_of(lint(tmp_path, src),
                             "collective-thread")) == 1
 
+    def test_work_stealing_loop_flagged_through_indirection(self, tmp_path):
+        """ISSUE 8 shape: an executor-submitted work-stealing loop
+        (pop own queue, else steal from a sibling) whose task-running
+        helper reaches a collective two hops down. The rule must see
+        through loop -> _run_task -> reduce_batch."""
+        src = """
+            from concurrent.futures import ThreadPoolExecutor
+            import jax
+
+            def reduce_batch(x):
+                return jax.lax.psum(x, "data")
+
+            class StealScheduler:
+                def __init__(self, pool):
+                    self._queues = [[], []]
+                    self._f = pool.submit(self._worker_loop)
+
+                def _steal(self):
+                    for q in self._queues:
+                        if q:
+                            return q.pop()
+                    return None
+
+                def _worker_loop(self):
+                    while True:
+                        task = self._steal()
+                        if task is None:
+                            return
+                        self._run_task(task)
+
+                def _run_task(self, task):
+                    return reduce_batch(task)
+        """
+        hits = rules_of(lint(tmp_path, src), "collective-thread")
+        assert len(hits) == 1
+        assert "_worker_loop" in hits[0].message
+        assert "reduce_batch" in hits[0].message or \
+            "psum" in hits[0].message
+
+    def test_work_stealing_loop_near_miss_clean(self, tmp_path):
+        """Same steal-loop shape, but the task runner is collective-
+        free and the psum lives on the MAIN thread — the rule must not
+        flag the indirection itself."""
+        src = """
+            from concurrent.futures import ThreadPoolExecutor
+            import jax
+
+            def reduce_main(x):
+                return jax.lax.psum(x, "data")   # main thread: fine
+
+            def train_step(x):
+                return reduce_main(x)
+
+            class StealScheduler:
+                def __init__(self, pool):
+                    self._queues = [[], []]
+                    self._f = pool.submit(self._worker_loop)
+
+                def _steal(self):
+                    for q in self._queues:
+                        if q:
+                            return q.pop()
+                    return None
+
+                def _worker_loop(self):
+                    while True:
+                        task = self._steal()
+                        if task is None:
+                            return
+                        self._run_task(task)
+
+                def _run_task(self, task):
+                    return task * 2   # pure host compute
+
+            def main(pool, x):
+                StealScheduler(pool)
+                return train_step(x)
+        """
+        assert rules_of(lint(tmp_path, src), "collective-thread") == []
+
     def test_relative_import_binds_to_own_package(self, tmp_path):
         # basename collision (the repo has serving/registry.py AND
         # telemetry/registry.py): each worker imports `.coll`

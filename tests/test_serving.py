@@ -257,8 +257,10 @@ class TestDynamicBatcher:
         f = sess.predict_async("slow2", x, timeout=0.05)
         with pytest.raises(TimeoutError):
             f.result(timeout=5.0)         # expired while queued
+        # ISSUE 8 satellite: queued expiry is its own outcome, distinct
+        # from a deadline passing mid-execute (timeout_execute)
         timeouts = _counter("dl4j_serving_requests_total", model="slow2",
-                            outcome="timeout")
+                            outcome="timeout_queued")
         assert timeouts.value >= 1
         sess.close()
 
