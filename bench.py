@@ -175,8 +175,15 @@ def bench_lenet():
 
 
 def resnet50_train_flops(batch):
-    """ResNet-50 fwd ~= 4.1 GFLOP per 224x224 image; train ~= 3x fwd."""
-    return 3 * 4.1e9 * batch
+    """ResNet-50 fwd = 4.1 GMACs per 224x224 image = 8.2e9 FLOP in the
+    2*MAC convention that XLA's cost model and the 197 TFLOP/s v5e peak
+    both use; train ~= 3x fwd. (PR-10 cost-model audit: the old 4.1e9
+    counted multiply-accumulates as single FLOPs against a peak quoted
+    in real FLOP/s — a 2x MFU understatement. cost_analysis() of this
+    repo's ResNet50 train step measures 2.25e10 at batch 1, within 10%
+    of 3*8.2e9; chip rows recorded before PR 10 carry the old
+    convention until re-measured.)"""
+    return 3 * 8.2e9 * batch
 
 
 def bench_resnet50():
@@ -1100,6 +1107,133 @@ def bench_resilience(steps_per_epoch=10, epochs=4, every=2):
     }
 
 
+def bench_trace_overhead(steps_per_epoch=8, epochs=30, trials=5,
+                         n_requests=150):
+    """ISSUE 10: what the tracing subsystem costs on the hot paths.
+
+    Same MLP fit loop and same serving path under four modes:
+    tracing sampled-ON (rate 1.0: every step/request builds spans),
+    sampled-OFF (rate 0: the head sampler declines, per-step cost is a
+    falsy-context check), tracing DISABLED (telemetry on, tracing
+    compiled out — the pre-PR-10 path), and full telemetry.disable()
+    for context. Steps/s are best-of-``trials`` (min wall time), which
+    is the standard way to see a <=1% effect through this container's
+    scheduler jitter. Acceptance: sampled-off steps/s within 1% of
+    tracing-disabled."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import BucketLadder, InferenceSession
+    from deeplearning4j_tpu.telemetry import tracing
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).list()
+            .layer(DenseLayer.Builder().nIn(128).nOut(256)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(10).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    batches = [(rng.normal(size=(64, 128)).astype(np.float32),
+                np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)])
+               for _ in range(steps_per_epoch)]
+    session = InferenceSession(max_latency=0.001)
+    session.register("trace_bench", net, example_shape=(128,),
+                     ladder=BucketLadder((1, 8)), warmup=True)
+    x1 = rng.normal(size=(128,)).astype(np.float32)
+
+    modes = {
+        "sampled_on": lambda: (telemetry.enable(),
+                               tracing.configure(enabled=True,
+                                                 sample_rate=1.0)),
+        "sampled_off": lambda: (telemetry.enable(),
+                                tracing.configure(enabled=True,
+                                                  sample_rate=0.0)),
+        "tracing_disabled": lambda: (telemetry.enable(),
+                                     tracing.configure(enabled=False)),
+        "telemetry_disabled": lambda: (telemetry.disable(),),
+    }
+
+    def traced_predict():
+        # a bare session.predict has no ambient trace, so it would
+        # measure zero tracing work in EVERY mode — give each request
+        # the root an HTTP handler would have opened (start_trace
+        # applies this mode's sampler: spans in sampled_on, None in
+        # the off/disabled modes)
+        root = tracing.start_trace("bench.predict")
+        with (root or tracing.NULL):
+            session.predict("trace_bench", x1)
+
+    best_s = {m: float("inf") for m in modes}
+    lats = {m: [] for m in modes}
+
+    def measure(mode, arm):
+        arm()
+        t0 = time.perf_counter()
+        net.fit(batches, epochs)
+        best_s[mode] = min(best_s[mode], time.perf_counter() - t0)
+        for _ in range(5):
+            traced_predict()
+        lat = np.empty(n_requests // trials + 1)
+        for i in range(len(lat)):
+            t0 = time.perf_counter()
+            traced_predict()
+            lat[i] = time.perf_counter() - t0
+        lats[mode].append(lat)
+
+    tracing_modes = {m: modes[m] for m in
+                     ("sampled_on", "sampled_off", "tracing_disabled")}
+    try:
+        telemetry.enable()
+        net.fit(batches, 2)           # warm the telemetry-on step plan
+        # INTERLEAVED rounds over the three tracing modes: a <=1%
+        # effect is smaller than this container's minute-scale load
+        # drift, so back-to-back per-mode blocks alias drift into the
+        # comparison; cycling modes inside each round puts every mode
+        # under the same drift. All three share one health build plan,
+        # so switching costs no step recompile — telemetry_disabled
+        # does NOT (its plan compiles health out), so it runs as its
+        # own sequential block below (context only, not part of the
+        # acceptance comparison).
+        for _ in range(trials):
+            for mode, arm in tracing_modes.items():
+                measure(mode, arm)
+        modes["telemetry_disabled"]()
+        net.fit(batches, 2)           # warm the disabled step plan
+        for _ in range(trials):
+            measure("telemetry_disabled", modes["telemetry_disabled"])
+    finally:
+        telemetry.enable()
+        tracing.configure(enabled=True, sample_rate=0.01)
+        session.close()
+    steps_s, p50_ms, p99_ms = {}, {}, {}
+    for mode in modes:
+        steps_s[mode] = round(steps_per_epoch * epochs / best_s[mode], 1)
+        p50, p99 = np.percentile(np.concatenate(lats[mode]) * 1e3,
+                                 [50, 99])
+        p50_ms[mode] = round(float(p50), 3)
+        p99_ms[mode] = round(float(p99), 3)
+    off_pct = 100.0 * (steps_s["tracing_disabled"]
+                       - steps_s["sampled_off"]) / \
+        steps_s["tracing_disabled"]
+    return {
+        "metric": "trace_overhead_sampled_off_pct",
+        "value": round(off_pct, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "steps_per_s": steps_s,
+        "serving_p50_ms": p50_ms,
+        "serving_p99_ms": p99_ms,
+        "steps_per_trial": steps_per_epoch * epochs,
+        "trials": trials,
+        "note": ("MLP 128-256-10 batch 64 fit loop + single-client "
+                 "serving predicts; value = sampled-off steps/s deficit "
+                 "vs tracing-disabled (acceptance <= 1%); sampled-on "
+                 "pays span construction every step/request"),
+    }
+
+
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
@@ -1110,7 +1244,8 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("serving_load", bench_serving_load),
                ("health_overhead", bench_health_overhead),
                ("precision", bench_precision),
-               ("resilience", bench_resilience)]
+               ("resilience", bench_resilience),
+               ("trace_overhead", bench_trace_overhead)]
 
 
 def _merge_bench_all(results, path="BENCH_ALL.json"):

@@ -1,25 +1,43 @@
-"""telemetry-gate: ``telemetry.disable()`` must mean zero registry
-calls.
+"""telemetry-gate: ``telemetry.disable()`` must mean zero registry —
+and zero tracer — calls.
 
-Contract (PR 1, re-asserted every PR since): ``telemetry.disable()``
-compiles observability OUT — the disabled step path performs *zero*
-registry calls (tested with a counting stub in test_health.py). The
-idiom is either the ``*_instruments()`` factories (which return None
-when disabled, so the hot loop guards on the bundle) or an explicit
-``if telemetry.enabled():`` before ``get_registry()``.
+Contract (PR 1, re-asserted every PR since; extended to the tracer in
+ISSUE 10): ``telemetry.disable()`` compiles observability OUT — the
+disabled step/request path performs *zero* registry calls (counting
+stub in test_health.py) and *zero* tracer-object calls (counting stub
+in test_tracing.py). The idiom is either the ``*_instruments()``
+factories (None when disabled, so the hot loop guards on the bundle),
+an explicit ``if telemetry.enabled():`` before ``get_registry()``, or
+— for spans — the high-level ``tracing`` helpers (``start_trace`` /
+``trace_or_span`` / ``span`` / ``emit`` / ``current``), which sample
+and gate internally and hand back None/NULL contexts the hot path
+guards on.
 
-This rule flags a ``get_registry()`` call in a function (outside
-``telemetry/`` itself and the analyzer) that contains no
-``enabled()``/``enable()`` check — the class of drift that silently
-re-introduces per-step registry overhead on the disabled path.
+This rule flags a raw ``get_registry()`` or ``get_tracer()`` call in a
+function (outside ``telemetry/`` itself and the analyzer) that
+contains no ``enabled()``/sampler-gate check — the class of drift that
+silently re-introduces per-step observability overhead on the disabled
+path.
 """
 
 from __future__ import annotations
 
 from deeplearning4j_tpu.analysis.core import Rule, Severity, register
 
-_GATES = {"enabled", "enable", "loop_instruments", "etl_instruments",
-          "serving_instruments"}
+# per-emitter gate sets: a tracing-helper call must NOT count as a
+# gate for a raw registry emission (or vice versa) — "span" in
+# particular also names telemetry.span, a pure TraceAnnotation that
+# gates nothing, so it appears in neither set
+_REGISTRY_GATES = {"enabled", "enable", "loop_instruments",
+                   "etl_instruments", "serving_instruments"}
+_TRACER_GATES = {"enabled", "enable",
+                 # tracer-side gates (ISSUE 10): each samples/gates
+                 # internally and returns a None/NULL handle the
+                 # caller guards on
+                 "start_trace", "trace_or_span", "current",
+                 "current_ids", "sample_interval"}
+_EMITTER_GATES = {"get_registry": _REGISTRY_GATES,
+                  "get_tracer": _TRACER_GATES}
 _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 
 
@@ -27,24 +45,27 @@ _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 class TelemetryGateRule(Rule):
     name = "telemetry-gate"
     severity = Severity.ERROR
-    description = ("get_registry() in a function with no enabled() "
-                   "check — breaks the zero-registry-calls-when-"
-                   "disabled contract (PR 1)")
+    description = ("get_registry()/get_tracer() in a function with no "
+                   "enabled()/sampler gate — breaks the zero-"
+                   "observability-calls-when-disabled contract "
+                   "(PR 1, PR 10)")
 
     def check_module(self, mod, project):
         rel = mod.rel
         if any(p in rel for p in _EXEMPT_PREFIXES):
             return
         for info in mod.functions.values():
-            gated = any(chain and chain[-1] in _GATES
-                        for chain, _ in info.calls)
-            if gated:
-                continue
+            called = {chain[-1] for chain, _ in info.calls if chain}
             for chain, call in info.calls:
-                if chain and chain[-1] == "get_registry":
-                    yield self.finding(
-                        mod, call,
-                        "get_registry() without an enabled() gate in "
-                        "the same function — the disabled telemetry "
-                        "path must make zero registry calls",
-                        scope=info.qualname)
+                emitter = chain[-1] if chain else None
+                if emitter not in _EMITTER_GATES:
+                    continue
+                if called & _EMITTER_GATES[emitter]:
+                    continue   # gated for THIS emitter kind
+                yield self.finding(
+                    mod, call,
+                    f"{emitter}() without an enabled()/sampler "
+                    "gate in the same function — the disabled "
+                    "telemetry path must make zero registry and "
+                    "zero tracer calls",
+                    scope=info.qualname)

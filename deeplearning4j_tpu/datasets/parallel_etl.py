@@ -341,6 +341,11 @@ def _run_epoch(wid, order, specs, rings, out_q, credits, cancel):
             return
         spec = specs[order["spec"]]
         files = spec["files"]
+        # sampled-trace ids riding the work order across the fork
+        # (ISSUE 10): the worker cannot reach the parent's tracer ring,
+        # so it ships finished span RECORDS back on the results queue
+        # and the parent materializes them (tracing.ingest in _handle)
+        trace = order.get("trace")
         perm = (_epoch_perm(len(files), order["seed"], order["epoch"])
                 if order["shuffle"] else None)
         ring = None
@@ -365,10 +370,20 @@ def _run_epoch(wid, order, specs, rings, out_q, credits, cancel):
             # host-heap queue memory)
             credits.acquire()
             held = True
+            t_dec = time.perf_counter() if trace is not None else 0.0
             feats, idxs = _decode_batch(
                 files, spec["label_idx"], spec["label_gen"],
                 spec["loader"], spec["transform"], order["batch_size"],
                 seq, order["seed"], order["epoch"], perm)
+            if trace is not None:
+                # CLOCK_MONOTONIC is shared across the fork, so these
+                # timestamps line up with the parent's spans
+                out_q.put(("span", job, {
+                    "name": "etl.decode", "trace_id": trace[0],
+                    "parent_id": trace[1], "start": t_dec,
+                    "end": time.perf_counter(),
+                    "attrs": {"seq": seq, "worker": wid,
+                              "rows": int(feats.shape[0])}}))
             if ring is None or feats.nbytes > ring.slot_bytes:
                 # queue fallback also catches transform output larger
                 # than the slot (e.g. an up-sizing ResizeImageTransform)
@@ -825,6 +840,8 @@ class ParallelImageDataSetIterator(DataSetIterator):
             self._started = False
             self._job = None
             return
+        from deeplearning4j_tpu.telemetry import tracing
+
         order = {
             "spec": self._register_spec(),
             "seed": self._seed, "epoch": epoch,
@@ -837,6 +854,10 @@ class ParallelImageDataSetIterator(DataSetIterator):
             "stall": self._stall,
             "ring": (self._ensure_ring().descriptor
                      if self._transport == "shm" else None),
+            # (trace_id, span_id) of the sampled training trace, or
+            # None: workers decode under this identity and ship
+            # etl.decode span records back beside their batches
+            "trace": tracing.current_ids(),
         }
         self._job = self._pool.submit_epoch(order)
         self._started = True
@@ -868,6 +889,15 @@ class ParallelImageDataSetIterator(DataSetIterator):
         waiting out the stall timeout for a done that will never
         come."""
         kind, job = msg[0], msg[1]
+        if kind == "span":
+            # worker-produced span record (holds no credit, no slot):
+            # materialize it into the parent's tracer ring — stale-job
+            # and drain spans are simply dropped
+            if job == self._job and not drain:
+                from deeplearning4j_tpu.telemetry import tracing
+
+                tracing.ingest(msg[2])
+            return False
         if kind == "error":
             if msg[4]:   # the failing worker held an unconsumed credit
                 self._pool.release_credit()

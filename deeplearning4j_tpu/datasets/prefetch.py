@@ -140,29 +140,45 @@ class DevicePrefetcher(DataSetIterator):
                       if labels is not None else None)
         return out
 
-    def _produce(self, gen, q):
+    def _produce(self, gen, q, trace_ctx):
+        import time as _time
+
+        from deeplearning4j_tpu.telemetry import tracing
+
         prepare = self._prepare or self._default_prepare
         try:
-            self._base.reset()
-            while self._gen == gen and self._base.hasNext():
-                item = self._base.next()
-                # no blanket fallback here: trainer prepare callbacks
-                # already return the raw DataSet for shapes they do not
-                # handle, so an exception out of prepare is a REAL bug
-                # (OOM in device_put, bad deviceTransform) and surfaces
-                # at next() via the error path instead of silently
-                # degrading every batch to the blocking host path
-                staged = prepare(item)
-                if self._device_transform is not None \
-                        and isinstance(staged, DeviceBatch):
-                    staged.features = self._device_transform(
-                        staged.features)
-                while self._gen == gen:
-                    try:
-                        q.put(staged, timeout=0.1)
-                        break
-                    except queue_mod.Full:
-                        continue
+            # the consumer's sampled trace context (captured at _start)
+            # becomes current on THIS producer thread, so base-iterator
+            # work (including the ETL pool's work orders) parents to
+            # the training trace across the thread hop (ISSUE 10)
+            with tracing.use(trace_ctx):
+                self._base.reset()
+                while self._gen == gen and self._base.hasNext():
+                    item = self._base.next()
+                    # no blanket fallback here: trainer prepare
+                    # callbacks already return the raw DataSet for
+                    # shapes they do not handle, so an exception out of
+                    # prepare is a REAL bug (OOM in device_put, bad
+                    # deviceTransform) and surfaces at next() via the
+                    # error path instead of silently degrading every
+                    # batch to the blocking host path
+                    t_prep = (_time.perf_counter()
+                              if trace_ctx is not None else 0.0)
+                    staged = prepare(item)
+                    if self._device_transform is not None \
+                            and isinstance(staged, DeviceBatch):
+                        staged.features = self._device_transform(
+                            staged.features)
+                    if trace_ctx is not None:
+                        tracing.emit("prefetch.prepare", trace_ctx,
+                                     t_prep, _time.perf_counter(),
+                                     loop=self._loop)
+                    while self._gen == gen:
+                        try:
+                            q.put(staged, timeout=0.1)
+                            break
+                        except queue_mod.Full:
+                            continue
         except Exception as e:  # surfaced at next()
             if self._gen == gen:
                 self._error = e
@@ -175,12 +191,15 @@ class DevicePrefetcher(DataSetIterator):
                     continue
 
     def _start(self):
+        from deeplearning4j_tpu.telemetry import tracing
+
         self._gen += 1
         self._queue = queue_mod.Queue(maxsize=self._depth)
         self._error = None
         self._done = False
         self._thread = threading.Thread(
-            target=self._produce, args=(self._gen, self._queue),
+            target=self._produce,
+            args=(self._gen, self._queue, tracing.current()),
             daemon=True, name=f"dl4j-prefetch-{self._loop}")
         self._thread.start()
 

@@ -477,11 +477,44 @@ class BertTrainer:
             lab_k.append(l_)
             w_k.append(w_)
         rng0 = jax.random.key(self._step + 1, impl="rbg")
+        import time
+
+        from deeplearning4j_tpu import telemetry
+
+        t_launch = (time.perf_counter() if telemetry.enabled()
+                    else None)
+        it0 = self._step
         losses, self.params, self.opt = self._multi_fn[repeats](
             self.params, self.opt, jnp.asarray(tokens_k, jnp.int32),
             np.stack(pos_k), np.stack(lab_k), np.stack(w_k), rng0,
             jnp.asarray(self._step, jnp.int32))
         self._step += k * repeats
+        if t_launch is not None:
+            # ISSUE 10 cost attribution: per-step FLOPs from the HLO
+            # cost model of the scanned module (lower-only, no second
+            # compile), published as dl4j_flops_per_step{executable=
+            # "bert"}; the live dl4j_mfu gauge uses the launch's
+            # dispatch wall from the SECOND launch on, when dispatch-
+            # queue backpressure makes it equal device time (the PR-1
+            # step-time argument — the first launch returns as soon as
+            # the work is enqueued and would overstate MFU wildly)
+            from deeplearning4j_tpu.telemetry import costmodel
+
+            n_steps = k * repeats
+            per_step = (time.perf_counter() - t_launch) / max(1, n_steps)
+            self._launches = getattr(self, "_launches", 0) + 1
+            # warm from the second launch on: dispatch-queue
+            # backpressure from launch N-1 makes the wall honest (the
+            # throttle inside attribute_launch additionally keeps an
+            # unmaterialized microsecond dispatch wall from printing an
+            # absurd over-peak MFU)
+            costmodel.attribute_launch(
+                "bert", self._multi_fn[repeats],
+                (self.params, self.opt,
+                 jnp.asarray(tokens_k, jnp.int32), np.stack(pos_k),
+                 np.stack(lab_k), np.stack(w_k), rng0,
+                 jnp.asarray(it0, jnp.int32)),
+                self, per_step, self._launches >= 2)
         return losses
 
     def train_step(self, tokens, labels):

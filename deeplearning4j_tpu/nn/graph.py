@@ -513,72 +513,99 @@ class ComputationGraph:
             pm.baseline_from(prec)
         if hm is not None:
             hm.precision = pm
-        for epoch_i in range(epochs):
-            batches, data = _prepare_batches(data, epoch_i, epochs)
-            for ds in batches:
-                # explicit ones masks keep the jit signature stable across
-                # masked/unmasked and padded batches (one executable)
-                inputs, labels, masks = self._feeds(ds, with_ones_masks=True)
-                n = next(iter(inputs.values())).shape[0]
-                if self._bucket is None or n > self._bucket:
-                    self._bucket = n
-                if n < self._bucket:
-                    for k in inputs:
-                        (inputs[k],), _, _ = _pad_to_bucket(
-                            [inputs[k]], np.ones((n,), np.float32),
-                            self._bucket)
-                    for k in labels:
-                        (labels[k],), masks[k], _ = _pad_to_bucket(
-                            [labels[k]], masks[k], self._bucket)
-                from deeplearning4j_tpu.nn.conf.configuration import (
-                    BackpropType)
+        # sampled trace root + step-time-throttled XLA cost attribution
+        # (ISSUE 10) — the MultiLayerNetwork.fit treatment, graph loop
+        from deeplearning4j_tpu.telemetry import costmodel, tracing
+        import sys as _sys
 
-                tbptt = (self.conf.backpropType == BackpropType.TruncatedBPTT
-                         and self.conf.tbpttLength
-                         and any(v.ndim == 3
-                                 and v.shape[2] > self.conf.tbpttLength
-                                 for v in inputs.values()))
-                if tele is not None:
-                    t_step = _time.perf_counter()
-                if tbptt:
-                    loss, params, states, opts, prec = self._fit_tbptt(
-                        params, states, opts, prec, inputs, labels, masks,
-                        base_key, hm=hm, pm=pm)
-                else:
-                    it_used = self._iteration
-                    rng = jax.random.fold_in(base_key, it_used)
-                    (loss, params, states, opts, health,
-                     prec) = self._train_step(
-                        params, states, opts, prec, inputs, labels, masks,
-                        rng, it_used)
-                    self._iteration += 1
-                if tele is not None:
-                    tele.record_step(_time.perf_counter() - t_step, n)
-                # rebind BEFORE the health monitor runs: its HALT policy
-                # raises out of fit() and the caller must find live
-                # params, not the buffers this step donated
-                self._params, self._states, self._opt_states = (
-                    params, states, opts)
-                self._prec_state = prec
-                if not tbptt:
-                    if pm is not None:
-                        pm.on_step(it_used, prec)   # before hm (skip set)
-                    if hm is not None:
-                        hm.on_step(it_used, health)
-                last = loss
-                if self._listeners:
-                    self._score = float(loss)
-                    for listener in self._listeners:
-                        listener.iterationDone(self, self._iteration,
-                                               self._epoch)
-            self._epoch += 1
-        if pm is not None:
-            pm.flush()   # before hm.flush: same-step skip handshake
-        if hm is not None:
-            hm.flush()   # drain the one-behind slot (HALT may raise here)
-        if last is not None:
-            self._score = float(last)
-        return self
+        tspan = tracing.trace_or_span("train.graph", loop="graph")
+        tspan.__enter__()
+        steps_seen = 0
+        try:
+            for epoch_i in range(epochs):
+                batches, data = _prepare_batches(data, epoch_i, epochs)
+                for ds in batches:
+                    # explicit ones masks keep the jit signature stable
+                    # across masked/unmasked and padded batches (one
+                    # executable)
+                    inputs, labels, masks = self._feeds(
+                        ds, with_ones_masks=True)
+                    n = next(iter(inputs.values())).shape[0]
+                    if self._bucket is None or n > self._bucket:
+                        self._bucket = n
+                    if n < self._bucket:
+                        for k in inputs:
+                            (inputs[k],), _, _ = _pad_to_bucket(
+                                [inputs[k]], np.ones((n,), np.float32),
+                                self._bucket)
+                        for k in labels:
+                            (labels[k],), masks[k], _ = _pad_to_bucket(
+                                [labels[k]], masks[k], self._bucket)
+                    from deeplearning4j_tpu.nn.conf.configuration import (
+                        BackpropType)
+
+                    tbptt = (self.conf.backpropType ==
+                             BackpropType.TruncatedBPTT
+                             and self.conf.tbpttLength
+                             and any(v.ndim == 3
+                                     and v.shape[2] > self.conf.tbpttLength
+                                     for v in inputs.values()))
+                    if tele is not None:
+                        t_step = _time.perf_counter()
+                    if tbptt:
+                        loss, params, states, opts, prec = self._fit_tbptt(
+                            params, states, opts, prec, inputs, labels,
+                            masks, base_key, hm=hm, pm=pm)
+                    else:
+                        it_used = self._iteration
+                        rng = jax.random.fold_in(base_key, it_used)
+                        (loss, params, states, opts, health,
+                         prec) = self._train_step(
+                            params, states, opts, prec, inputs, labels,
+                            masks, rng, it_used)
+                        self._iteration += 1
+                    if tele is not None:
+                        dt_step = _time.perf_counter() - t_step
+                        tele.record_step(dt_step, n,
+                                         exemplar=tspan.trace_id)
+                        if tspan and not tbptt:
+                            tracing.emit("train.step", tspan.ctx(),
+                                         t_step, t_step + dt_step,
+                                         step=it_used)
+                        steps_seen += 1
+                        if not tbptt:
+                            costmodel.maybe_attribute(
+                                tele, "graph", self._train_step,
+                                (params, states, opts, prec, inputs,
+                                 labels, masks, rng, it_used),
+                                self, steps_seen, dt_step)
+                    # rebind BEFORE the health monitor runs: its HALT
+                    # policy raises out of fit() and the caller must find
+                    # live params, not the buffers this step donated
+                    self._params, self._states, self._opt_states = (
+                        params, states, opts)
+                    self._prec_state = prec
+                    if not tbptt:
+                        if pm is not None:
+                            pm.on_step(it_used, prec)  # before hm
+                        if hm is not None:
+                            hm.on_step(it_used, health)
+                    last = loss
+                    if self._listeners:
+                        self._score = float(loss)
+                        for listener in self._listeners:
+                            listener.iterationDone(self, self._iteration,
+                                                   self._epoch)
+                self._epoch += 1
+            if pm is not None:
+                pm.flush()   # before hm.flush: same-step skip handshake
+            if hm is not None:
+                hm.flush()   # drain the one-behind slot (HALT may raise)
+            if last is not None:
+                self._score = float(last)
+            return self
+        finally:
+            tspan.__exit__(*_sys.exc_info())
 
     # -- inference -----------------------------------------------------------
     def _cast_for_inference(self, params):

@@ -368,6 +368,12 @@ class MultiLayerNetwork:
         pm = _precision.monitor_for("fit", self._precision_policy())
         if pm is not None:
             pm.baseline_from(self._prec_state)   # pre-launch count
+        import time as _time
+
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import costmodel
+
+        t_launch = _time.perf_counter() if telemetry.enabled() else None
         (losses, self._params, self._states, self._opt_states, healths,
          self._prec_state) = self._multi_step[key](
                 self._params, self._states, self._opt_states,
@@ -375,6 +381,25 @@ class MultiLayerNetwork:
                 jnp.asarray(self._iteration, jnp.int32))
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
+        if t_launch is not None:
+            # float(losses[-1]) materialized the launch, so this wall
+            # time covers the device work
+            n_steps = int(f_k.shape[0]) * repeats
+            per_step = (_time.perf_counter() - t_launch) / max(1, n_steps)
+            timed = getattr(self, "_multi_timed", None)
+            if timed is None:
+                timed = self._multi_timed = set()
+            # the FIRST launch of a (repeats, plan) key compiled inside
+            # the timed region, so its per-step wall is useless for MFU
+            # (10-100x understated): only a key already seen is warm
+            warm = key in timed
+            timed.add(key)
+            costmodel.attribute_launch(
+                "fit", self._multi_step[key],
+                (self._params, self._states, self._opt_states,
+                 self._prec_state, f_k, l_k, m_k, rng0,
+                 jnp.asarray(it0, jnp.int32)),
+                self, per_step, warm)
         if pm is not None:
             # publish from the launch's FINAL scaler state (already
             # materialized — we just read losses): scale gauge + the
@@ -452,6 +477,7 @@ class MultiLayerNetwork:
 
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
+        from deeplearning4j_tpu.telemetry import costmodel, tracing
         from deeplearning4j_tpu.telemetry import health as _health
 
         self._refresh_train_step()
@@ -478,6 +504,17 @@ class MultiLayerNetwork:
             pm.baseline_from(prec)
         if hm is not None:
             hm.precision = pm
+        # sampled trace root (ISSUE 10): NULL (falsy, no tracer calls)
+        # when telemetry/tracing is off or the head sampler said no;
+        # nests under an enclosing context (ElasticTrainer root) so
+        # checkpoints and ETL spans land in the same tree. Entered
+        # manually: the epoch loop below must stay at its indentation,
+        # and the finally below closes the span on every exit path.
+        import sys as _sys
+
+        tspan = tracing.trace_or_span("train.fit", loop="fit")
+        tspan.__enter__()
+        steps_seen = 0
         try:
             for epoch_i in range(epochs):
                 batches, data = _prepare_batches(data, epoch_i, epochs)
@@ -541,8 +578,22 @@ class MultiLayerNetwork:
                             it_used)
                         self._iteration += 1
                     if tele is not None:
-                        tele.record_step(_time.perf_counter() - t_step,
-                                         f.shape[0])
+                        dt_step = _time.perf_counter() - t_step
+                        tele.record_step(dt_step, f.shape[0],
+                                         exemplar=tspan.trace_id)
+                        if tspan and not tbptt:
+                            tracing.emit("train.step", tspan.ctx(),
+                                         t_step, t_step + dt_step,
+                                         step=it_used)
+                        steps_seen += 1
+                        if not tbptt:
+                            # locals were rebound to the step's
+                            # outputs, so shapes match what dispatched
+                            costmodel.maybe_attribute(
+                                tele, "fit", self._train_step,
+                                (params, states, opts, prec, f, l,
+                                 lmask, rng, it_used),
+                                self, steps_seen, dt_step)
                     # rebind before anything can observe donated buffers —
                     # including the health monitor, whose HALT policy raises
                     # out of fit(): the caller must find live params to
@@ -582,6 +633,7 @@ class MultiLayerNetwork:
                 self._score = float(last_loss)
             return self
         finally:
+            tspan.__exit__(*_sys.exc_info())
             # deterministic producer shutdown: a fit that raises
             # (HALT, preemption) must not leave a prefetch thread
             # racing the next attempt for the same base iterator

@@ -293,6 +293,15 @@ class ElasticTrainer:
                     else [])
         from deeplearning4j_tpu.resilience.async_ckpt import (
             mark_active, mark_idle)
+        from deeplearning4j_tpu.telemetry import tracing
+
+        # trace root for the WHOLE elastic run (ISSUE 10): the nested
+        # net.fit spans AND the checkpoint snapshot/write spans (taken
+        # from the in-loop listener hook) parent here, so one sampled
+        # run exports as one connected tree
+        tspan = tracing.trace_or_span("train.elastic", every=self.every)
+        tspan.__enter__()
+        import sys as _sys
 
         mark_active()   # checkpoint staleness judgements apply in here
         try:
@@ -320,6 +329,7 @@ class ElasticTrainer:
                 # the termination request instead of dropping it
                 raise PreemptionCheckpoint(final_path)
         finally:
+            tspan.__exit__(*_sys.exc_info())
             mark_idle()
             self.net.setListeners(*prior)
             signal.signal(signal.SIGTERM, old_term)

@@ -278,6 +278,15 @@ class ShardedTrainer:
             pm.baseline_from(prec)
         if hm is not None:
             hm.precision = pm
+        # sampled trace root + cost attribution (ISSUE 10): same
+        # treatment as MultiLayerNetwork.fit, loop="sharded"
+        import sys as _sys
+
+        from deeplearning4j_tpu.telemetry import costmodel, tracing
+
+        tspan = tracing.trace_or_span("train.sharded", loop="sharded")
+        tspan.__enter__()
+        steps_seen = 0
         try:
             for _ in range(epochs):
                 batch_iter = iter(_as_batches(data))
@@ -329,11 +338,31 @@ class ShardedTrainer:
                         # dispatch-queue backpressure makes its wall time
                         # equal the device step time in steady state (no
                         # sync added)
-                        with tele.step_span():
+                        sp = tele.step_span()
+                        sp.exemplar = tspan.trace_id
+                        t_step = time.perf_counter()
+                        with sp:
                             loss, params, states, opts, health, prec = \
                                 self._step_fn(params, states, opts, prec, f,
                                               l, mask, rng, it_used)
+                        dt_step = time.perf_counter() - t_step
+                        if tspan:
+                            tracing.emit("train.step", tspan.ctx(),
+                                         t_step, t_step + dt_step,
+                                         step=it_used)
                         tele.examples.inc(real)
+                        if tele.step_flops:
+                            # this loop records through the Timer span,
+                            # not record_step, so the live MFU gauge
+                            # refreshes here
+                            costmodel.publish_mfu("sharded",
+                                                  tele.step_flops,
+                                                  dt_step)
+                        steps_seen += 1
+                        costmodel.maybe_attribute(
+                            tele, "sharded", self._step_fn,
+                            (params, states, opts, prec, f, l, mask,
+                             rng, it_used), self, steps_seen, dt_step)
                     # rebind BEFORE the health monitor runs: its HALT policy
                     # raises out of fit() and the caller must find live
                     # params, not the buffers this step donated
@@ -353,6 +382,7 @@ class ShardedTrainer:
                                                    net._epoch)
                 net._epoch += 1
         finally:
+            tspan.__exit__(*_sys.exc_info())
             # deterministic producer shutdown (see
             # MultiLayerNetwork.fit): a raising fit must not
             # leave a prefetch thread racing the next attempt

@@ -307,7 +307,7 @@ class Snapshot:
 
     __slots__ = ("step", "params", "states", "opt_states", "prec",
                  "iteration", "epoch", "conf", "model_type",
-                 "save_updater", "taken_at")
+                 "save_updater", "taken_at", "trace")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -417,7 +417,12 @@ class AsyncCheckpointer:
         and start the device→host copies. This is the ONLY part of a
         checkpoint the train loop waits for."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.telemetry import tracing
 
+        # sampled training-trace context (None = unsampled): the
+        # snapshot span lands here; the ctx rides the Snapshot so the
+        # background writer's span joins the SAME tree (ISSUE 10)
+        trace_ctx = tracing.current()
         t0 = time.perf_counter()
         tree = {"p": net._params, "s": net._states}
         if self.save_updater:
@@ -437,11 +442,16 @@ class AsyncCheckpointer:
                         if isinstance(net, ComputationGraph)
                         else "MultiLayerNetwork"),
             save_updater=self.save_updater,
-            taken_at=time.time())
+            taken_at=time.time(),
+            trace=trace_ctx)
+        t1 = time.perf_counter()
+        if trace_ctx is not None:
+            tracing.emit("ckpt.snapshot", trace_ctx, t0, t1,
+                         step=int(step))
         reg = _registry()
         if reg is not None:
             reg.histogram("dl4j_ckpt_snapshot_seconds",
-                          SNAPSHOT_HELP).observe(time.perf_counter() - t0)
+                          SNAPSHOT_HELP).observe(t1 - t0)
         return snap
 
     def submit(self, snap: Snapshot):
@@ -593,6 +603,13 @@ class AsyncCheckpointer:
             # non-writers fall through: identical instrument sets on
             # every host (multi-host aggregate contract)
         dt = time.perf_counter() - t0
+        if getattr(snap, "trace", None) is not None:
+            from deeplearning4j_tpu.telemetry import tracing
+
+            # background-writer half of the checkpoint, parented to the
+            # training trace the snapshot rode in on (cross-thread)
+            tracing.emit("ckpt.write", snap.trace, t0, t0 + dt,
+                         step=snap.step, mode="async")
         note_commit(path, snap.step, dt, "async")
         try:
             self._rotate()

@@ -141,6 +141,7 @@ class AdmissionController:
                     f"({b.standing}/{cap} standing, budget "
                     f"{b.budget}); retry in {retry:.2f}s",
                     retry_after=retry, priority=priority)
+                standing = b.standing
             else:
                 b.standing += 1
                 shed = None
@@ -148,6 +149,18 @@ class AdmissionController:
             if inst is not None:
                 inst.shed(priority)
                 inst.request("shed")
+            # a shed is a POLICY decision: the flight recorder names
+            # the model, class, standing load, and (when the request
+            # was sampled) its trace id — an incident dump says who
+            # was turned away, not just how many (ISSUE 10 satellite)
+            from deeplearning4j_tpu.telemetry import flight, tracing
+
+            ctx = tracing.current()
+            flight.record("shed", model=model, priority=priority,
+                          standing=standing,
+                          retry_after=round(shed.retry_after, 4),
+                          trace_id=(ctx.trace_id if ctx is not None
+                                    else None))
             raise shed
         return Ticket(self, model, priority)
 

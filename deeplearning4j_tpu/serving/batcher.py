@@ -43,7 +43,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from deeplearning4j_tpu.serving.buckets import pad_rows, pad_time
-from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry import flight, tracing
 
 # process-wide request ids: every request carries one so flight-recorder
 # serving summaries (ISSUE 3) correlate with client-side logs
@@ -64,9 +64,11 @@ class ServingShutdown(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "n", "t", "future", "t_enqueue", "deadline",
-                 "req_id", "model", "started", "priority")
+                 "req_id", "model", "started", "priority", "trace",
+                 "t_open", "t_formed")
 
-    def __init__(self, x, deadline, model=None, priority="normal"):
+    def __init__(self, x, deadline, model=None, priority="normal",
+                 trace=None):
         self.x = x
         self.n = x.shape[0]
         # real trailing time length of sequence inputs: results slice
@@ -79,6 +81,16 @@ class _Request:
         self.model = model
         self.started = False   # set_running already done (replica re-run)
         self.priority = priority
+        # sampled-trace context captured at submit (None = unsampled):
+        # rides the request across the batcher/replica threads so
+        # run_batch can emit the queue/coalesce/replica-queue/execute
+        # phase spans retroactively (ISSUE 10)
+        self.trace = trace
+        self.t_open = None     # coalescer popped this batch's head
+        self.t_formed = None   # batch closed / handed to the executor
+
+    def trace_id(self):
+        return self.trace.trace_id if self.trace is not None else None
 
     def expired(self, now):
         return self.deadline is not None and now > self.deadline
@@ -89,6 +101,8 @@ class _Request:
         would fold the execute time into the queue wait."""
         if queue_s is None:
             queue_s = time.perf_counter() - self.t_enqueue
+        if self.trace is not None:   # sampled: the event names its trace
+            extra.setdefault("trace_id", self.trace.trace_id)
         flight.record("serving", req_id=self.req_id, model=self.model,
                       outcome=outcome, rows=self.n,
                       queue_s=round(queue_s, 6), **extra)
@@ -183,7 +197,26 @@ def run_batch(entry, batch, inst, servable=None, replica=None):
         # queue-wait histogram and skew exactly the signal the
         # timeout_queued/timeout_execute split is meant to clean up
         for r in first_run:
-            inst.queue_wait.observe(now - r.t_enqueue)
+            inst.queue_wait.observe(now - r.t_enqueue,
+                                    exemplar=r.trace_id())
+    for r in first_run:
+        if r.trace is None:
+            continue
+        # retroactive phase spans (ISSUE 10): the request's wall time
+        # decomposes into queue-wait (enqueue -> the coalescer popped
+        # this batch's head), coalesce (the max-latency window the
+        # batch held open), and — executor mode — replica-queue (batch
+        # formed -> a replica worker picked it up)
+        t_open = min(r.t_open if r.t_open is not None else now, now)
+        t_formed = min(r.t_formed if r.t_formed is not None else now, now)
+        tracing.emit("serving.queue_wait", r.trace, r.t_enqueue,
+                     max(r.t_enqueue, t_open), req_id=r.req_id)
+        tracing.emit("serving.coalesce", r.trace,
+                     max(r.t_enqueue, t_open), t_formed,
+                     batch_rows=total)
+        if replica is not None:
+            tracing.emit("serving.replica_queue", r.trace, t_formed,
+                         now, replica=replica)
     try:
         if live[0].t is not None:
             # sequence inputs may differ in trailing length within
@@ -201,9 +234,17 @@ def run_batch(entry, batch, inst, servable=None, replica=None):
                                                servable=servable)
         dt = time.perf_counter() - t0
         if inst is not None:
-            inst.execute.observe(dt)
+            inst.execute.observe(
+                dt, exemplar=next((r.trace_id() for r in live
+                                   if r.trace is not None), None))
             inst.dispatch.inc(n_dispatch)
             inst.occupancy.set(total / max(n_padded, 1))
+        for r in live:
+            if r.trace is not None:
+                tracing.emit("serving.execute", r.trace, t0, t0 + dt,
+                             batch_rows=total, dispatches=n_dispatch,
+                             **({} if replica is None
+                                else {"replica": replica}))
         done_at = time.perf_counter()
         off = 0
         for r in live:
@@ -298,8 +339,11 @@ class DynamicBatcher:
             timeout = self.default_timeout
         deadline = (time.perf_counter() + timeout
                     if timeout is not None else None)
+        # the caller's sampled trace context (None when unsampled or
+        # telemetry disabled — zero tracer calls either way) crosses
+        # to the worker/replica threads on the request itself
         req = _Request(x, deadline, model=self.entry.name,
-                       priority=priority)
+                       priority=priority, trace=tracing.current())
         inst = self._instruments_fn()
         try:
             with self._submit_lock:
@@ -389,6 +433,7 @@ class DynamicBatcher:
                 continue
             if head is self._SENTINEL:
                 return
+            t_open = time.perf_counter()   # coalescing window opens
             if self._closed:
                 # graceful shutdown: in-flight work completed, queued
                 # requests fail fast instead of executing
@@ -405,7 +450,7 @@ class DynamicBatcher:
                 if nxt is None:
                     break
                 if nxt is self._SENTINEL:
-                    self._execute(batch, total)
+                    self._execute(batch, total, t_open)
                     return
                 if nxt.expired(time.perf_counter()):
                     nxt.fail(ServingTimeout("timed out in queue"),
@@ -419,12 +464,17 @@ class DynamicBatcher:
                     break
                 batch.append(nxt)
                 total += nxt.n
-            self._execute(batch, total)
+            self._execute(batch, total, t_open)
 
-    def _execute(self, batch, total):
+    def _execute(self, batch, total, t_open=None):
         inst = self._instruments_fn()
         if inst is not None:
             inst.depth.set(self._q.qsize())
+        t_formed = time.perf_counter()
+        for r in batch:
+            if r.trace is not None:   # phase stamps for run_batch spans
+                r.t_open = t_open
+                r.t_formed = t_formed
         if self.executor is not None:
             # pure-coalescer mode: hand the formed batch to the
             # work-stealing scheduler; padding/dispatch/split runs on a

@@ -54,7 +54,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry import flight, tracing
 
 
 class DecodeError(RuntimeError):
@@ -361,7 +361,8 @@ def _layer_norm(x, g, b, eps):
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "future", "stream",
-                 "slot", "ptr", "generated", "t_submit", "req_id")
+                 "slot", "ptr", "generated", "t_submit", "req_id",
+                 "trace", "spans_emitted", "t_suppressed")
     _END = object()
 
     def __init__(self, prompt, max_new, eos_id, req_id):
@@ -377,6 +378,11 @@ class _DecodeRequest:
         self.generated: list[int] = []
         self.t_submit = time.perf_counter()
         self.req_id = req_id
+        # sampled-trace context captured at submit (None = unsampled):
+        # the engine thread emits per-token-boundary child spans to it
+        self.trace = tracing.current()
+        self.spans_emitted = 0     # per-boundary spans so far
+        self.t_suppressed = None   # first boundary past the span cap
 
     def tokens(self, timeout=None):
         """Generator of tokens as they decode (terminates with the
@@ -414,9 +420,16 @@ class DecodeEngine:
     """
 
     def __init__(self, model, name="decode", pending_size=64,
-                 max_new_limit=1024, instruments=None):
+                 max_new_limit=1024, instruments=None,
+                 wedge_timeout=30.0):
         self.model = model
         self.name = name
+        # /healthz wedge detection (ISSUE 10 satellite): with sequences
+        # in flight, a token boundary is expected at least this often —
+        # an engine stuck inside one step longer than this reports the
+        # decoder section "degraded" (still 200)
+        self.wedge_timeout = float(wedge_timeout)
+        self._last_boundary = None
         # hard per-request generation cap, enforced for EVERY model:
         # paged models are also bounded by max_len/pool, but a
         # page-less RNN model has no natural ceiling — without this an
@@ -521,6 +534,23 @@ class DecodeEngine:
     def active_slots(self) -> int:
         return len(self._active)
 
+    def health(self) -> dict:
+        """Liveness detail for /healthz: active/waiting counts plus
+        wedge detection — sequences in flight but no token boundary
+        for longer than ``wedge_timeout`` means a slot is stuck inside
+        a device step (or the engine thread died mid-decode)."""
+        active = len(self._active)
+        last = self._last_boundary
+        age = (time.monotonic() - last) if last is not None else None
+        wedged = bool(active and age is not None
+                      and age > self.wedge_timeout)
+        return {"active": active,
+                "waiting": self._pending.qsize() + len(self._waiting),
+                "boundary_age_seconds": (round(age, 3)
+                                         if age is not None else None),
+                "wedged": wedged,
+                "degraded": wedged or not self._thread.is_alive()}
+
     def close(self, timeout=5.0):
         self._closed = True
         self._wake.set()
@@ -565,13 +595,27 @@ class DecodeEngine:
             self._state = self.model.reset_slot(self._state, slot)
             self._active[slot] = req
             admitted += 1
+            if req.trace is not None:
+                # submit -> slot join: the decode analog of queue-wait
+                tracing.emit("decode.queue", req.trace, req.t_submit,
+                             time.perf_counter(), slot=slot,
+                             req_id=req.req_id)
             flight.record("decode_join", model=self.name,
                           req_id=req.req_id, slot=slot,
                           prompt=len(req.prompt), max_new=req.max_new)
         return admitted
 
+    # per-request ceiling on per-boundary spans; the remainder folds
+    # into one aggregate decode.tokens span at finish
+    boundary_span_cap = 64
+
     def _finish(self, req, error=None):
         slot = req.slot
+        if req.trace is not None and req.t_suppressed is not None:
+            tracing.emit("decode.tokens", req.trace, req.t_suppressed,
+                         time.perf_counter(), slot=slot,
+                         boundaries=(len(req.prompt) + len(req.generated)
+                                     - 1 - req.spans_emitted))
         self._active.pop(slot, None)
         if self._kv is not None:
             self._kv.release(slot)
@@ -593,9 +637,11 @@ class DecodeEngine:
         while not self._closed:
             self._admit()
             if not self._active:
+                self._last_boundary = None   # idle: nothing to wedge
                 self._wake.wait(0.05)
                 self._wake.clear()
                 continue
+            self._last_boundary = time.monotonic()
             tokens = np.zeros((S,), np.int32)
             pos = np.zeros((S,), np.int32)
             # snapshot: close() may clear _active concurrently
@@ -606,6 +652,7 @@ class DecodeEngine:
                     tokens[slot] = req.generated[-1]
                 pos[slot] = req.ptr
             table = np.ascontiguousarray(self._table)
+            t_b0 = time.perf_counter()
             try:
                 nxt, self._state = self.model.step(
                     self._state, tokens, pos, table)
@@ -615,9 +662,31 @@ class DecodeEngine:
                     self._finish(req, error=RuntimeError(
                         f"decode step failed: {type(e).__name__}: {e}"))
                 continue
+            t_b1 = time.perf_counter()
+            self._last_boundary = time.monotonic()
             inst = self._instruments_fn()
             n_decoded = 0
             for slot, req in list(self._active.items()):
+                prefilling = req.ptr + 1 < len(req.prompt)
+                if req.trace is not None:
+                    # one child span per token boundary this sequence
+                    # took part in (ISSUE 10): prefill and decode
+                    # interleave through the same executable, and the
+                    # span name says which phase this boundary was.
+                    # Capped per request: a near-max_new generation
+                    # would otherwise evict every concurrent trace
+                    # (including its own early spans) from the bounded
+                    # ring — boundaries past the cap aggregate into
+                    # one decode.tokens span at finish.
+                    if req.spans_emitted < self.boundary_span_cap:
+                        req.spans_emitted += 1
+                        tracing.emit(
+                            "decode.prefill" if prefilling
+                            else "decode.token",
+                            req.trace, t_b0, t_b1, slot=slot,
+                            pos=req.ptr)
+                    elif req.t_suppressed is None:
+                        req.t_suppressed = t_b0
                 req.ptr += 1
                 if req.ptr < len(req.prompt):
                     continue            # still prefilling

@@ -86,6 +86,9 @@ class ModelRegistry:
               else as_servable(model, example_shape, dtype,
                                input_name=input_name,
                                output_name=output_name))
+        # names the servable's bucket executables in the ISSUE 10
+        # cost-attribution gauges (dl4j_flops_per_step / _executable_bytes)
+        sv.cost_label = f"{name}:v{int(version)}"
         ladder = ladder if ladder is not None else self.default_ladder
         if isinstance(ladder, (list, tuple)):
             ladder = BucketLadder(ladder)

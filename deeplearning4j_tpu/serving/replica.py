@@ -230,15 +230,16 @@ class ReplicaSet:
     # -- worker side ---------------------------------------------------------
     def _next_task_locked(self, me):
         """Own queue first (FIFO head); else steal from the tail of the
-        longest sibling queue."""
+        longest sibling queue. Returns (task, victim_name_or_None) —
+        a non-None victim means the task was stolen."""
         if me.queue:
-            return me.queue.popleft(), False
+            return me.queue.popleft(), None
         if self.steal:
             victims = [r for r in self.replicas if r is not me and r.queue]
             if victims:
                 victim = max(victims, key=lambda r: len(r.queue))
-                return victim.queue.pop(), True
-        return None, False
+                return victim.queue.pop(), victim.name
+        return None, None
 
     def _worker_loop(self, me):
         try:
@@ -274,11 +275,30 @@ class ReplicaSet:
     # while healthy siblings idle
     ERROR_BREAKER = 3
 
+    @staticmethod
+    def _task_trace(task):
+        """Trace id of the first sampled request in a batch (None when
+        nothing in it was sampled) — incident flight events name the
+        span tree they belong to (ISSUE 10 satellite)."""
+        for r in task.requests:
+            ctx = getattr(r, "trace", None)
+            if ctx is not None:
+                return ctx.trace_id
+        return None
+
     def _run_task(self, me, task, stolen):
         inst = task.inst
-        if stolen and inst is not None and \
-                getattr(inst, "steals", None) is not None:
-            inst.steals.inc()
+        if stolen is not None:
+            if inst is not None and \
+                    getattr(inst, "steals", None) is not None:
+                inst.steals.inc()
+            # the steal names its actors: a flight dump after an
+            # incident says WHICH replica drained WHOSE queue, not
+            # just that steals happened
+            flight.record("steal", model=self.entry.name,
+                          replica=me.name, victim=stolen,
+                          batch_rows=sum(r.n for r in task.requests),
+                          trace_id=self._task_trace(task))
         task.attempts += 1
         try:
             errored = run_batch(self.entry, task.requests, inst,
@@ -287,7 +307,8 @@ class ReplicaSet:
             me.dead = True
             flight.record("replica_death", model=self.entry.name,
                           replica=me.name, error=str(e),
-                          attempt=task.attempts)
+                          attempt=task.attempts, reason="death",
+                          trace_id=self._task_trace(task))
             self._requeue(me, task, e)
             return
         if not errored:
@@ -304,7 +325,8 @@ class ReplicaSet:
                 f"({me.consec_errors} consecutive failed dispatches)")
             flight.record("replica_death", model=self.entry.name,
                           replica=me.name, error=str(death),
-                          attempt=task.attempts)
+                          attempt=task.attempts, reason="breaker",
+                          trace_id=self._task_trace(task))
             self._requeue(me, None, death)
 
     def _requeue(self, me, task, death):

@@ -55,7 +55,15 @@ class Servable:
     step that rebinds the params re-places them exactly once). This is
     what lets a ReplicaSet run N copies of one model on N devices
     without the copies sharing a dispatch queue.
+
+    `cost_label` (set by ModelRegistry.register) names this servable in
+    the ISSUE 10 cost-attribution gauges: every AOT-compiled bucket
+    publishes its HLO FLOPs and executable memory footprint as
+    ``dl4j_flops_per_step`` / ``dl4j_executable_bytes`` with
+    ``executable="<name>:v<version>:<shape>"``.
     """
+
+    cost_label = None
 
     def __init__(self, example_shape, dtype=np.float32):
         if example_shape is None:
@@ -134,6 +142,21 @@ class Servable:
         """Adapt the traced function's output back to one array."""
         return _np(y)
 
+    def _note_cost(self, shape, exe):
+        """Publish this bucket executable's cost/memory analysis
+        (ISSUE 10): AOT warmup is the one place the Compiled object is
+        in hand, so attribution is free of extra lowers."""
+        if self.cost_label is None:
+            return
+        from deeplearning4j_tpu import telemetry
+
+        if not telemetry.enabled():
+            return
+        from deeplearning4j_tpu.telemetry import costmodel
+
+        label = f"{self.cost_label}:{'x'.join(str(d) for d in shape)}"
+        costmodel.executable_cost(label, exe)
+
     # -- AOT warmup ---------------------------------------------------------
     def compile_shape(self, shape: tuple):
         """Lower + compile the inference function for one concrete input
@@ -143,6 +166,7 @@ class Servable:
             return self._compiled[shape]
         spec = self._input(self._input_spec(shape))
         exe = self._jit_fn().lower(*self._placed_args(), spec).compile()
+        self._note_cost(shape, exe)
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]
@@ -262,6 +286,7 @@ class SameDiffServable(Servable):
         params, consts, rng = self._placed_args()
         spec = self._input(self._input_spec(shape))
         exe = self._jit_fn().lower(spec, params, consts, rng).compile()
+        self._note_cost(shape, exe)
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]

@@ -24,6 +24,7 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import tracing
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher, ServingTimeout, execute_plan)
 from deeplearning4j_tpu.serving.buckets import BucketLadder, unpad
@@ -125,8 +126,7 @@ class InferenceSession:
         engine = self.decoder(name)
         ticket = None
         if self.admission is not None:
-            ticket = self.admission.admit(name, priority,
-                                          inst=self._inst(name))
+            ticket = self._admit_traced(name, priority)
         try:
             req = engine.submit(prompt, max_new_tokens, eos_id=eos_id)
             if ticket is not None:
@@ -173,6 +173,30 @@ class InferenceSession:
             inst = telemetry.serving_instruments(name)
             self._instruments[name] = inst
         return inst
+
+    def _admit_traced(self, name, priority):
+        """admission.admit with a span on the sampled path: the ticket
+        decision is the first hop of the request's span tree (sheds
+        raise and the span records status=error, naming the 429)."""
+        ctx = tracing.current()
+        if ctx is None:
+            return self.admission.admit(name, priority,
+                                        inst=self._inst(name))
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            ticket = self.admission.admit(name, priority,
+                                          inst=self._inst(name))
+        except Exception as e:
+            tracing.emit("serving.admission", ctx, t0,
+                         _time.perf_counter(), status="error",
+                         priority=priority,
+                         error=f"{type(e).__name__}: {e}")
+            raise
+        tracing.emit("serving.admission", ctx, t0, _time.perf_counter(),
+                     priority=priority)
+        return ticket
 
     def _batcher(self, name, entry) -> DynamicBatcher:
         """One batcher per served (name, version): pinned-version
@@ -235,8 +259,7 @@ class InferenceSession:
         entry, x, single = self._prep(name, features, version)
         ticket = None
         if self.admission is not None:
-            ticket = self.admission.admit(name, priority,
-                                          inst=self._inst(name))
+            ticket = self._admit_traced(name, priority)
         try:
             future = self._batcher(name, entry).submit(
                 x, timeout=timeout, priority=priority)
@@ -313,6 +336,37 @@ class InferenceSession:
         return y[0] if single else y
 
     # -- introspection / lifecycle -------------------------------------------
+    def health_details(self) -> dict:
+        """Replica-set and decode-engine liveness for /healthz
+        (ISSUE 10 satellite): a dead replica or a decode slot wedged
+        past its deadline marks the matching section degraded —
+        reported as status "degraded", still HTTP 200 (capacity is
+        reduced, traffic still flows)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+            decoders = dict(self._decoders)
+        out: dict = {}
+        replica_sets = {}
+        for (name, version), b in batchers.items():
+            if b.executor is None:
+                continue
+            reps = b.executor.replicas
+            dead = [r.name for r in reps if r.dead]
+            replica_sets[f"{name}:v{version}"] = {
+                "replicas": len(reps), "live": len(reps) - len(dead),
+                "dead": dead, "degraded": bool(dead)}
+        if replica_sets:
+            out["replica_sets"] = replica_sets
+        decs = {}
+        for name, engine in decoders.items():
+            try:
+                decs[name] = engine.health()
+            except Exception:  # a closing engine must not break healthz
+                continue
+        if decs:
+            out["decoders"] = decs
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             out = {}

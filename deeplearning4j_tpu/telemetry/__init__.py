@@ -17,12 +17,21 @@ Quick use::
     agg = telemetry.aggregate_snapshot()     # cross-host min/max/mean/sum
     telemetry.flight.dump("/tmp/flight.jsonl")
 
+ISSUE 10 adds sampled end-to-end tracing (`telemetry.tracing`:
+request/step span trees with W3C traceparent propagation, exported at
+GET /debug/traces, exemplars on latency histograms) and XLA cost-model
+attribution (`telemetry.costmodel`: dl4j_flops_per_step /
+dl4j_executable_bytes / a live dl4j_mfu gauge from cost_analysis() at
+step-lower / AOT-warmup time).
+
 Disabling (`telemetry.disable()`) removes every per-step registry call
 from the training loops — they check the flag once per fit() — and
 compiles the health stats OUT of the jitted step (pre-health output
-structure, bit-identical math)."""
+structure, bit-identical math); the same switch means zero tracer
+calls per step and per request."""
 
-from deeplearning4j_tpu.telemetry import aggregate, flight, health, prometheus
+from deeplearning4j_tpu.telemetry import (
+    aggregate, costmodel, flight, health, prometheus, tracing)
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
 from deeplearning4j_tpu.telemetry.flight import FlightRecorder
 from deeplearning4j_tpu.telemetry.health import (
@@ -41,8 +50,8 @@ __all__ = [
     "HealthMonitor", "Histogram", "LoopInstruments", "MetricsListener",
     "MetricsRegistry", "SECONDS_BUCKETS", "STEP_HELP",
     "ServingInstruments", "Timer", "aggregate", "aggregate_snapshot",
-    "collect_device_memory", "disable", "enable", "enabled",
+    "collect_device_memory", "costmodel", "disable", "enable", "enabled",
     "etl_instruments", "flight", "get_registry", "health", "log_buckets",
     "loop_instruments", "prometheus", "serving_instruments",
-    "set_registry", "span",
+    "set_registry", "span", "tracing",
 ]

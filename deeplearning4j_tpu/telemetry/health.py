@@ -557,6 +557,21 @@ def healthz(serving=None):
     payload = {"status": status, "live": True, "ready": ready,
                "loops": loops, "divergence": div, "serving": serving_info}
     degraded = False
+    if serving_info is not None and hasattr(serving, "health_details"):
+        # replica-set + decode-engine liveness (ISSUE 10 satellite): a
+        # dead replica or a wedged decode slot degrades the process —
+        # still HTTP 200, capacity is reduced but traffic flows
+        try:
+            details = serving.health_details() or {}
+        except Exception:
+            log.exception("serving health_details failed")
+            details = {}
+        for section in ("replica_sets", "decoders"):
+            rows = details.get(section)
+            if rows:
+                serving_info[section] = rows
+                degraded = degraded or any(
+                    bool(v.get("degraded")) for v in rows.values())
     with _lock:   # a first-commit registration may race this scrape
         providers = sorted(_healthz_providers.items())
     for name, fn in providers:

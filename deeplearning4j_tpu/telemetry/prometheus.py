@@ -31,9 +31,27 @@ def _labels_text(labels):
     return "{" + inner + "}"
 
 
-def render(registry=None, collect_system=True) -> str:
+def _exemplar_text(child, idx):
+    """OpenMetrics exemplar suffix for one bucket, or '' (ISSUE 10:
+    latency buckets carry the trace id of the last sampled observation
+    that landed in them, so a p99 bucket links to a span tree at
+    GET /debug/traces)."""
+    ex = getattr(child, "exemplars", None)
+    if not ex or idx not in ex:
+        return ""
+    trace_id, value, ts = ex[idx]
+    return (f' # {{trace_id="{_escape_label(str(trace_id))}"}} '
+            f"{fmt_float(value)} {ts:.3f}")
+
+
+def render(registry=None, collect_system=True, exemplars=False) -> str:
     """The whole registry in Prometheus text exposition. With
-    collect_system, on-demand gauges (device memory) refresh first."""
+    collect_system, on-demand gauges (device memory) refresh first.
+    ``exemplars=True`` appends OpenMetrics-style exemplar suffixes to
+    histogram bucket lines (``/metrics?exemplars=1`` — an explicit
+    debug opt-in: this exposition is 0.0.4, not full OpenMetrics, so
+    the suffix is never served to an unsuspecting scraper; parse()
+    tolerates both forms)."""
     reg = registry or get_registry()
     if collect_system and enabled():
         collect_device_memory(reg)
@@ -44,12 +62,16 @@ def render(registry=None, collect_system=True) -> str:
         for labels, child in fam.children():
             if fam.kind == "histogram":
                 acc = 0
-                for bound, c in zip(child.buckets, child.counts):
+                for i, (bound, c) in enumerate(zip(child.buckets,
+                                                   child.counts)):
                     acc += c
                     lt = _labels_text(labels + (("le", fmt_float(bound)),))
-                    lines.append(f"{fam.name}_bucket{lt} {acc}")
+                    ex = _exemplar_text(child, i) if exemplars else ""
+                    lines.append(f"{fam.name}_bucket{lt} {acc}{ex}")
                 lt = _labels_text(labels + (("le", "+Inf"),))
-                lines.append(f"{fam.name}_bucket{lt} {child.count}")
+                ex = (_exemplar_text(child, len(child.buckets))
+                      if exemplars else "")
+                lines.append(f"{fam.name}_bucket{lt} {child.count}{ex}")
                 lines.append(f"{fam.name}_sum{_labels_text(labels)} "
                              f"{fmt_float(child.sum)}")
                 lines.append(f"{fam.name}_count{_labels_text(labels)} "

@@ -207,6 +207,11 @@ class Histogram(_Family):
         # counts[i] = observations <= buckets[i]; counts[-1] = +Inf bucket
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
+        # bucket_index -> (trace_id, value, wall_ts): the last sampled
+        # trace that landed in each bucket (OpenMetrics exemplars —
+        # ISSUE 10: a p99 bucket links to a concrete span tree). Lazily
+        # allocated; never part of snapshot()/aggregation.
+        self.exemplars = None
 
     def _make_child(self):
         return Histogram(self.name, buckets=self.buckets)
@@ -214,10 +219,16 @@ class Histogram(_Family):
     def _reset_self(self):
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
+        self.exemplars = None
 
-    def observe(self, value):
-        self.counts[bisect_right(self.buckets, value)] += 1
+    def observe(self, value, exemplar=None):
+        idx = bisect_right(self.buckets, value)
+        self.counts[idx] += 1
         self.sum += value
+        if exemplar is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[idx] = (exemplar, value, time.time())
 
     @property
     def count(self):
@@ -235,11 +246,12 @@ class Timer:
     covers. Reusable (one observation per with-block); also usable
     standalone with histogram=None as a pure trace annotation."""
 
-    __slots__ = ("histogram", "name", "_t0", "_ann")
+    __slots__ = ("histogram", "name", "exemplar", "_t0", "_ann")
 
     def __init__(self, histogram, name):
         self.histogram = histogram
         self.name = name
+        self.exemplar = None   # trace id attached to the observation
         self._t0 = 0.0
         self._ann = None
 
@@ -260,7 +272,7 @@ class Timer:
             self._ann.__exit__(*exc)
             self._ann = None
         if self.histogram is not None:
-            self.histogram.observe(dt)
+            self.histogram.observe(dt, exemplar=self.exemplar)
         return False
 
 
@@ -390,10 +402,12 @@ class LoopInstruments:
     via loop_instruments(); None when telemetry is disabled, so the
     disabled loop body performs zero registry calls."""
 
-    __slots__ = ("step", "etl", "examples", "loop")
+    __slots__ = ("step", "etl", "examples", "loop", "_registry",
+                 "step_flops")
 
     def __init__(self, registry, loop):
         self.loop = loop
+        self._registry = registry
         self.step = registry.histogram(
             "dl4j_step_seconds", STEP_HELP, ("loop",)).labels(loop=loop)
         self.etl = registry.histogram(
@@ -401,15 +415,28 @@ class LoopInstruments:
         self.examples = registry.counter(
             "dl4j_examples_total", EXAMPLES_HELP, ("loop",)).labels(
                 loop=loop)
+        self.step_flops = None   # set via note_flops (costmodel)
 
     def step_span(self):
         """TraceAnnotation+timer around the step dispatch region."""
         return Timer(self.step, f"dl4j_step/{self.loop}")
 
-    def record_step(self, seconds, examples=0):
-        self.step.observe(seconds)
+    def note_flops(self, flops):
+        """Attach the loop's cost-model FLOPs-per-step (ISSUE 10):
+        every subsequent record_step refreshes the live dl4j_mfu
+        gauge."""
+        if flops:
+            self.step_flops = float(flops)
+
+    def record_step(self, seconds, examples=0, exemplar=None):
+        self.step.observe(seconds, exemplar=exemplar)
         if examples:
             self.examples.inc(examples)
+        if self.step_flops:
+            from deeplearning4j_tpu.telemetry import costmodel
+
+            costmodel.publish_mfu(self.loop, self.step_flops, seconds,
+                                  registry=self._registry)
 
     def record_etl_wait(self, seconds):
         self.etl.observe(seconds)

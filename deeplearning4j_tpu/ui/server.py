@@ -89,7 +89,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/data":
             body = json.dumps(self.server.ui._sessions()).encode()
             ctype = "application/json"
-        elif self.path == "/metrics":
+        elif self.path == "/metrics" or \
+                self.path.startswith("/metrics?"):
             from deeplearning4j_tpu.telemetry import prometheus
 
             try:
@@ -101,7 +102,20 @@ class _Handler(BaseHTTPRequestHandler):
                 async_ckpt.refresh_metrics()
             except Exception:
                 pass
-            body = prometheus.render().encode()
+            # /metrics?exemplars=1 appends OpenMetrics-STYLE exemplar
+            # suffixes to histogram buckets (trace ids, ISSUE 10) — an
+            # explicit operator opt-in, NOT Accept negotiation: a
+            # default Prometheus scrape advertises openmetrics-text in
+            # Accept, and claiming that content type for a body this
+            # exposition does not fully implement (no '# EOF', counter
+            # families keep their _total names) would fail every
+            # default scrape. The plain scrape stays bare 0.0.4.
+            from urllib.parse import parse_qs, urlsplit
+
+            query = parse_qs(urlsplit(self.path).query)
+            exemplars = (query.get("exemplars") or ["0"])[0] not in (
+                "0", "false", "")
+            body = prometheus.render(exemplars=exemplars).encode()
             ctype = prometheus.CONTENT_TYPE
         elif self.path == "/healthz":
             # liveness + readiness: divergence state, last-step age,
@@ -116,6 +130,18 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu.telemetry import flight
 
             self._respond(flight.get_recorder().dump_jsonl().encode(),
+                          ctype="application/x-ndjson")
+            return
+        elif self.path.startswith("/debug/traces"):
+            # span-tree export (ISSUE 10): the whole ring as JSONL, or
+            # one trace via /debug/traces?trace_id=<32hex>
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import tracing
+
+            query = parse_qs(urlsplit(self.path).query)
+            tid = (query.get("trace_id") or [None])[0]
+            self._respond(tracing.export_jsonl(trace_id=tid).encode(),
                           ctype="application/x-ndjson")
             return
         elif self.path.startswith("/serving/"):
@@ -141,25 +167,45 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         from deeplearning4j_tpu.serving import http as shttp
+        from deeplearning4j_tpu.telemetry import tracing
 
         name = shttp.parse_predict_path(self.path)
         handler = shttp.handle_predict
+        kind = "predict"
         if name is None:
             name = shttp.parse_decode_path(self.path)
             handler = shttp.handle_decode
+            kind = "decode"
         if name is None:
             self._respond(b'{"error": "not found"}', status=404)
             return
+        # W3C trace propagation (ISSUE 10): join an upstream trace (or
+        # head-sample a new one) and hand the decision back in the
+        # response traceparent; the request's context flows to the
+        # batcher/replica/decode threads via the serving request objects
+        root = tracing.start_trace(
+            f"http.{kind}", traceparent=self.headers.get("traceparent"),
+            model=name)
+        headers = ({"traceparent": root.traceparent()}
+                   if root is not None else {})
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
-            out = handler(self.server.ui._serving, name, body)
+            with (root or tracing.NULL):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    out = handler(self.server.ui._serving, name, body)
+                except shttp.HttpError as e:
+                    # attribute BEFORE the span exits: finish() hands
+                    # the attrs to the export ring
+                    if root is not None:
+                        root.set_attr(http_status=e.status)
+                    raise
         except shttp.HttpError as e:
             # shed responses carry Retry-After (admission control)
             self._respond(shttp.error_body(e), status=e.status,
-                          headers=e.headers)
+                          headers={**e.headers, **headers})
             return
-        self._respond(out)
+        self._respond(out, headers=headers)
 
     def log_message(self, *args):  # quiet
         pass

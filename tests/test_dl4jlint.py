@@ -402,6 +402,50 @@ class TestTelemetryGateRule:
         """
         assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
 
+    def test_flags_ungated_tracer(self, tmp_path):
+        # ISSUE 10: raw tracer emission outside telemetry/ without an
+        # enabled()/sampler gate breaks the zero-tracer-calls-when-
+        # disabled contract exactly like an ungated registry call
+        src = """
+            from deeplearning4j_tpu.telemetry import tracing
+
+            def note_phase(start, end):
+                tracing.get_tracer().emit(
+                    "phase", "tid", "pid", start, end)
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_tracer_gate_does_not_cover_registry(self, tmp_path):
+        # gates are per emitter kind: a sampler gate must not un-flag a
+        # raw registry emission in the same function (the PR-1 contract
+        # violation the rule originally existed to catch)
+        src = """
+            from deeplearning4j_tpu import telemetry
+            from deeplearning4j_tpu.telemetry import tracing
+
+            def record():
+                if tracing.current() is None:
+                    return
+                telemetry.get_registry().counter(
+                    "dl4j_x_total", "h").inc()
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_sampler_gated_tracer(self, tmp_path):
+        # the sampler IS a gate: current() returns None when disabled
+        # or unsampled, so guarding on it keeps the disabled path at
+        # zero tracer calls
+        clean = """
+            from deeplearning4j_tpu.telemetry import tracing
+
+            def note_phase(start, end):
+                if tracing.current() is None:
+                    return
+                tracing.get_tracer().emit(
+                    "phase", "tid", "pid", start, end)
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
 
 class TestAtomicCommitRule:
     def test_flags_direct_checkpoint_write(self, tmp_path):
