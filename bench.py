@@ -1234,6 +1234,152 @@ def bench_trace_overhead(steps_per_epoch=8, epochs=30, trials=5,
     }
 
 
+def bench_compile_ledger(steps_per_epoch=8, epochs=10, rounds=20):
+    """ISSUE 11: what the compile ledger + HLO audit cost on the hot
+    paths.
+
+    The ONLY per-step difference between ledger-on and ledger-off is
+    the loops' ``compile_ledger.note_step`` call (steady state: one
+    thread-local read), so the headline is measured where it is
+    actually measurable: the note_step seam is microbenchmarked
+    exactly as the fit loop invokes it (same arg tuple, policy label,
+    window) and reported as a percentage of the fit loop's measured
+    median step time. A whole-fit on/off differential is ALSO recorded
+    (paired back-to-back rounds, order alternated, median ratio) as
+    ``fit_paired_median_pct`` — context only: this container's
+    wall-clock jitter (±1.5% between adjacent 0.1 s windows) dwarfs a
+    sub-0.1% effect, which is precisely why the seam measurement is
+    the acceptance number (<= 1%). One warmup ladder is also timed
+    with the audit on vs off — the eager as_text+parse cost per AOT
+    bucket, paid at warmup (never on the request path)."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import BucketLadder, ModelRegistry
+    from deeplearning4j_tpu.telemetry import compile_ledger
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).list()
+            .layer(DenseLayer.Builder().nIn(128).nOut(256)
+                   .activation("relu").build())
+            .layer(OutputLayer.Builder().nOut(10).activation("softmax")
+                   .lossFunction(LossFunction.MCXENT).build())
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    batches = [(rng.normal(size=(64, 128)).astype(np.float32),
+                np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)])
+               for _ in range(steps_per_epoch)]
+
+    modes = {
+        "ledger_on": lambda: (telemetry.enable(),
+                              compile_ledger.configure(enabled=True)),
+        "ledger_off": lambda: (telemetry.enable(),
+                               compile_ledger.configure(enabled=False)),
+        "telemetry_disabled": lambda: (telemetry.disable(),),
+    }
+    walls = {m: [] for m in modes}
+
+    def measure(mode):
+        modes[mode]()
+        t0 = time.perf_counter()
+        net.fit(batches, epochs)
+        dt = time.perf_counter() - t0
+        walls[mode].append(dt)
+        return dt
+
+    def warm_ladder(audit_on):
+        compile_ledger.configure(enabled=audit_on)
+        reg = ModelRegistry()
+        t0 = time.perf_counter()
+        # a fresh registration AOT-compiles the whole ladder (jax's
+        # AOT cache makes repeats cheap, so the FIRST arm pays the
+        # backend compiles — run audit-off first so the audit arm
+        # isolates as_text+parse+ledger, not XLA)
+        reg.register(f"ledger_bench_{int(audit_on)}", net,
+                     example_shape=(128,),
+                     ladder=BucketLadder((1, 8, 64)), warmup=True)
+        return time.perf_counter() - t0
+
+    ratios = []
+    try:
+        telemetry.enable()
+        net.fit(batches, 2)            # warm the step executable
+        for i in range(rounds):
+            on_first = i % 2 == 0      # alternate order per round
+            first, second = (("ledger_on", "ledger_off") if on_first
+                             else ("ledger_off", "ledger_on"))
+            t_first = measure(first)
+            t_second = measure(second)
+            t_on, t_off = ((t_first, t_second) if on_first
+                           else (t_second, t_first))
+            ratios.append(t_on / t_off)
+        modes["telemetry_disabled"]()
+        net.fit(batches, 2)            # warm the disabled step plan
+        for _ in range(rounds // 4):
+            measure("telemetry_disabled")
+        telemetry.enable()
+        warm_off = warm_ladder(False)
+        warm_on = warm_ladder(True)
+        records = len(compile_ledger.get_ledger().describe())
+    finally:
+        telemetry.enable()
+        compile_ledger.configure(enabled=True)
+    ratios.sort()
+    median_ratio = ratios[len(ratios) // 2]
+    steps_s = {m: round(steps_per_epoch * epochs / min(walls[m]), 1)
+               for m in modes}
+
+    # the seam itself, measured as the fit loop calls it: steady-state
+    # note_step against a warmed site (one thread-local read)
+    from deeplearning4j_tpu.telemetry import compile_ledger as _cl
+
+    _cl.configure(enabled=True)
+    telemetry.enable()
+    import jax as _jax
+
+    step_fn = net._train_step
+    f0, l0 = batches[0]
+    lmask0 = np.ones((f0.shape[0],), np.float32)
+    note_args = (net._params, net._states, net._opt_states,
+                 net._prec_state, f0, l0, lmask0,
+                 _jax.random.key(0), 0)
+    _cl.note_step("bench_seam", step_fn, note_args)   # warm the path
+    n_calls = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        _cl.note_step("bench_seam", step_fn, note_args,
+                      policy="float32/h10", window=(0.0, 1.0))
+    note_us = (time.perf_counter() - t0) / n_calls * 1e6
+    median_step_s = sorted(walls["ledger_on"])[
+        len(walls["ledger_on"]) // 2] / (steps_per_epoch * epochs)
+    seam_pct = 100.0 * (note_us * 1e-6) / median_step_s
+    return {
+        "metric": "compile_ledger_overhead_pct",
+        "value": round(seam_pct, 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "note_step_us": round(note_us, 2),
+        "median_step_ms": round(median_step_s * 1e3, 3),
+        "fit_paired_median_pct": round(100.0 * (median_ratio - 1.0), 2),
+        "steps_per_s": steps_s,
+        "warmup_audit_on_s": round(warm_on, 4),
+        "warmup_audit_off_s": round(warm_off, 4),
+        "ledger_records": records,
+        "steps_per_round": steps_per_epoch * epochs,
+        "rounds": rounds,
+        "note": ("MLP 128-256-10 batch 64 fit loop; value = measured "
+                 "steady-state note_step seam cost (the ONLY per-step "
+                 "ledger-on/off difference) as % of the measured "
+                 "median step time (acceptance <= 1%). "
+                 "fit_paired_median_pct is the whole-fit paired-round "
+                 "differential — context only, dominated by ±1.5% "
+                 "container wall jitter. warmup_audit_*_s: a 3-bucket "
+                 "AOT ladder warmup with the eager HLO audit on vs off "
+                 "(audit cost is paid at warmup, never per request)"),
+    }
+
+
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
@@ -1245,7 +1391,8 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("health_overhead", bench_health_overhead),
                ("precision", bench_precision),
                ("resilience", bench_resilience),
-               ("trace_overhead", bench_trace_overhead)]
+               ("trace_overhead", bench_trace_overhead),
+               ("compile_ledger", bench_compile_ledger)]
 
 
 def _merge_bench_all(results, path="BENCH_ALL.json"):

@@ -213,8 +213,11 @@ class Project:
     """All modules under analysis + shared config.
 
     config keys used by rules:
-      docs_text    OBSERVABILITY.md text for metric-drift (auto-loaded
-                   from <root>/docs/OBSERVABILITY.md when present)
+      docs_text           OBSERVABILITY.md text for metric-drift and
+                          route-drift (auto-loaded from
+                          <root>/docs/OBSERVABILITY.md when present)
+      serving_docs_text   SERVING.md text for route-drift (auto-loaded
+                          from <root>/docs/SERVING.md when present)
     """
 
     def __init__(self, modules, root, config=None):
@@ -265,9 +268,11 @@ def load_project(paths, root=None, config=None) -> Project:
             print(f"dl4jlint: syntax error in {f}: {e}",
                   file=sys.stderr)
     project = Project(modules, root, config)
-    if "docs_text" not in project.config:
-        docs = os.path.join(root, "docs", "OBSERVABILITY.md")
-        if os.path.exists(docs):
-            with open(docs, "r", encoding="utf-8") as f:
-                project.config["docs_text"] = f.read()
+    for key, name in (("docs_text", "OBSERVABILITY.md"),
+                      ("serving_docs_text", "SERVING.md")):
+        if key not in project.config:
+            docs = os.path.join(root, "docs", name)
+            if os.path.exists(docs):
+                with open(docs, "r", encoding="utf-8") as f:
+                    project.config[key] = f.read()
     return project

@@ -10,6 +10,7 @@ from deeplearning4j_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     lock_order,
     metric_drift,
+    route_drift,
     telemetry_gate,
     threads,
 )

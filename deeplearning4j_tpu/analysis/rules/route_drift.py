@@ -1,0 +1,84 @@
+"""route-drift: every /debug/* and /serving/* HTTP route is documented.
+
+Origin (ISSUE 11 satellite): the metric-drift rule keeps dashboards
+honest, but the debug/serving ROUTE surface had no equivalent — PR 9
+added ``/debug/traces`` and PR 11 adds ``/debug/compiles`` +
+``/debug/hlo/<key>``, and an undocumented route is an endpoint
+operators cannot find during an incident. This rule finds every route
+literal the UI server's handlers actually dispatch on (string
+constants compared against ``self.path`` or passed to a
+``path.startswith(...)`` check) and requires each ``/debug/...`` /
+``/serving/...`` route to appear in docs/OBSERVABILITY.md or
+docs/SERVING.md (cross-link: docs/OBSERVABILITY.md "Route drift").
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from deeplearning4j_tpu.analysis.core import Rule, Severity, register
+from deeplearning4j_tpu.analysis.model import call_chain
+
+_ROUTE_RE = re.compile(r"^/(debug|serving)/")
+
+
+def _mentions_path(node) -> bool:
+    """Does this expression reference something called ``path``
+    (``self.path``, ``self.path.rstrip(...)``, a bare ``path`` arg)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "path":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "path":
+            return True
+    return False
+
+
+def dispatched_routes(mod):
+    """[(route, node)] for literal routes the module dispatches on:
+    ``<path expr> == "/route"`` comparisons and
+    ``<path expr>.startswith("/route")`` calls."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Compare):
+            parts = [node.left] + list(node.comparators)
+            if not any(_mentions_path(p) for p in parts):
+                continue
+            for p in parts:
+                if isinstance(p, ast.Constant) and \
+                        isinstance(p.value, str) and \
+                        _ROUTE_RE.match(p.value):
+                    out.append((p.value, p))
+        elif isinstance(node, ast.Call):
+            chain = call_chain(node.func)
+            if not chain or chain[-1] != "startswith" or \
+                    not _mentions_path(node.func):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        _ROUTE_RE.match(arg.value):
+                    out.append((arg.value, arg))
+    return out
+
+
+@register
+class RouteDriftRule(Rule):
+    name = "route-drift"
+    severity = Severity.ERROR
+    description = ("/debug/* or /serving/* route dispatched by an HTTP "
+                   "handler but missing from docs/OBSERVABILITY.md and "
+                   "docs/SERVING.md (ISSUE 11 satellite)")
+
+    def check_module(self, mod, project):
+        docs = (project.config.get("docs_text", "") + "\n"
+                + project.config.get("serving_docs_text", ""))
+        for route, node in dispatched_routes(mod):
+            # substring match: "/debug/hlo/" is documented as
+            # "/debug/hlo/<key>", query-string variants as their base
+            if route in docs:
+                continue
+            yield self.finding(
+                mod, node,
+                f"route {route!r} is dispatched here but documented in "
+                f"neither docs/OBSERVABILITY.md nor docs/SERVING.md")

@@ -496,7 +496,11 @@ class ComputationGraph:
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.telemetry import health as _health
 
-        self._refresh_train_step()
+        plan = self._refresh_train_step()
+        # compile-ledger policy label (ISSUE 11): precision policy +
+        # health build plan, both compiled into the step
+        policy_label = (f"{self._precision_policy().name}"
+                        f"/h{int(plan.collect)}{int(plan.skip)}")
         params, states, opts = self._params, self._states, self._opt_states
         prec = self._prec_state
         base_key = jax.random.key(self.conf.seed + 1)
@@ -515,7 +519,8 @@ class ComputationGraph:
             hm.precision = pm
         # sampled trace root + step-time-throttled XLA cost attribution
         # (ISSUE 10) — the MultiLayerNetwork.fit treatment, graph loop
-        from deeplearning4j_tpu.telemetry import costmodel, tracing
+        from deeplearning4j_tpu.telemetry import (
+            compile_ledger, costmodel, tracing)
         import sys as _sys
 
         tspan = tracing.trace_or_span("train.graph", loop="graph")
@@ -579,6 +584,15 @@ class ComputationGraph:
                                 (params, states, opts, prec, inputs,
                                  labels, masks, rng, it_used),
                                 self, steps_seen, dt_step)
+                            # recompile forensics (ISSUE 11): one
+                            # thread-local read unless this step
+                            # actually compiled
+                            compile_ledger.note_step(
+                                "graph", self._train_step,
+                                (params, states, opts, prec, inputs,
+                                 labels, masks, rng, it_used),
+                                policy=policy_label,
+                                window=(t_step, t_step + dt_step))
                     # rebind BEFORE the health monitor runs: its HALT
                     # policy raises out of fit() and the caller must find
                     # live params, not the buffers this step donated

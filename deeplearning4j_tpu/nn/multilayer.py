@@ -477,10 +477,16 @@ class MultiLayerNetwork:
 
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
-        from deeplearning4j_tpu.telemetry import costmodel, tracing
+        from deeplearning4j_tpu.telemetry import (
+            compile_ledger, costmodel, tracing)
         from deeplearning4j_tpu.telemetry import health as _health
 
-        self._refresh_train_step()
+        plan = self._refresh_train_step()
+        # the compile-ledger policy label: precision policy + the health
+        # build plan, both compiled INTO the step — a change in either
+        # recompiles, and forensics should name it policy_change
+        policy_label = (f"{self._precision_policy().name}"
+                        f"/h{int(plan.collect)}{int(plan.skip)}")
         data, _prefetcher = self._wrap_prefetch(data)
         params, states, opts = self._params, self._states, self._opt_states
         prec = self._prec_state
@@ -594,6 +600,16 @@ class MultiLayerNetwork:
                                 (params, states, opts, prec, f, l,
                                  lmask, rng, it_used),
                                 self, steps_seen, dt_step)
+                            # recompile forensics (ISSUE 11): steady
+                            # state is one thread-local read — only a
+                            # backend compile during this step builds
+                            # and diffs the signature
+                            compile_ledger.note_step(
+                                "fit", self._train_step,
+                                (params, states, opts, prec, f, l,
+                                 lmask, rng, it_used),
+                                policy=policy_label,
+                                window=(t_step, t_step + dt_step))
                     # rebind before anything can observe donated buffers —
                     # including the health monitor, whose HALT policy raises
                     # out of fit(): the caller must find live params to

@@ -282,7 +282,13 @@ class ShardedTrainer:
         # treatment as MultiLayerNetwork.fit, loop="sharded"
         import sys as _sys
 
-        from deeplearning4j_tpu.telemetry import costmodel, tracing
+        from deeplearning4j_tpu.telemetry import (
+            compile_ledger, costmodel, tracing)
+
+        # compile-ledger policy label (ISSUE 11): precision policy +
+        # health build plan, both compiled into the sharded step
+        policy_label = (f"{net._precision_policy().name}"
+                        f"/h{int(plan.collect)}{int(plan.skip)}")
 
         tspan = tracing.trace_or_span("train.sharded", loop="sharded")
         tspan.__enter__()
@@ -363,6 +369,13 @@ class ShardedTrainer:
                             tele, "sharded", self._step_fn,
                             (params, states, opts, prec, f, l, mask,
                              rng, it_used), self, steps_seen, dt_step)
+                        # recompile forensics (ISSUE 11): one
+                        # thread-local read unless this step compiled
+                        compile_ledger.note_step(
+                            "sharded", self._step_fn,
+                            (params, states, opts, prec, f, l, mask,
+                             rng, it_used), policy=policy_label,
+                            window=(t_step, t_step + dt_step))
                     # rebind BEFORE the health monitor runs: its HALT policy
                     # raises out of fit() and the caller must find live
                     # params, not the buffers this step donated

@@ -142,40 +142,58 @@ class Servable:
         """Adapt the traced function's output back to one array."""
         return _np(y)
 
-    def _note_cost(self, shape, exe):
-        """Publish this bucket executable's cost/memory analysis
-        (ISSUE 10): AOT warmup is the one place the Compiled object is
-        in hand, so attribution is free of extra lowers."""
-        if self.cost_label is None:
-            return
+    def _ledger_site(self) -> str:
+        return self.cost_label or f"servable:{type(self).__name__}"
+
+    def _note_compiled(self, shape, exe, seconds):
+        """Publish one freshly-built bucket executable: cost/memory
+        attribution (ISSUE 10 — registry-named servables only, the
+        gauges key on cost_label) plus a compile-ledger record with the
+        eager HLO audit (ISSUE 11 — every servable: warmup is the one
+        place the Compiled object is in hand)."""
         from deeplearning4j_tpu import telemetry
 
         if not telemetry.enabled():
             return
-        from deeplearning4j_tpu.telemetry import costmodel
+        from deeplearning4j_tpu.telemetry import compile_ledger, costmodel
 
-        label = f"{self.cost_label}:{'x'.join(str(d) for d in shape)}"
-        costmodel.executable_cost(label, exe)
+        if self.cost_label is not None:
+            label = f"{self.cost_label}:{'x'.join(str(d) for d in shape)}"
+            costmodel.executable_cost(label, exe)
+        compile_ledger.record_executable(
+            self._ledger_site(), exe, ((shape, str(self.dtype)),),
+            seconds=seconds, bucketed=True,
+            sharding="" if self.device is None else str(self.device))
 
     # -- AOT warmup ---------------------------------------------------------
     def compile_shape(self, shape: tuple):
         """Lower + compile the inference function for one concrete input
         shape (idempotent)."""
+        import time as _time
+
         shape = tuple(shape)
         if shape in self._compiled:
             return self._compiled[shape]
         spec = self._input(self._input_spec(shape))
+        t0 = _time.perf_counter()
         exe = self._jit_fn().lower(*self._placed_args(), spec).compile()
-        self._note_cost(shape, exe)
+        self._note_compiled(shape, exe, _time.perf_counter() - t0)
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]
 
     def warmup(self, ladder: BucketLadder) -> list[tuple]:
-        """AOT-compile every ladder shape; returns the warmed shapes."""
+        """AOT-compile every ladder shape; returns the warmed shapes.
+        Progress is visible in the /healthz ``compile`` section while
+        the ladder is mid-warmup (ISSUE 11 satellite)."""
+        from deeplearning4j_tpu.telemetry import compile_ledger
+
         shapes = ladder.shapes(self.example_shape)
-        for s in shapes:
-            self.compile_shape(s)
+        with compile_ledger.warmup_scope(self._ledger_site(),
+                                         len(shapes)) as progress:
+            for s in shapes:
+                self.compile_shape(s)
+                progress.step()
         return shapes
 
     @property
@@ -280,13 +298,16 @@ class SameDiffServable(Servable):
         return _np(y[self.output_name])
 
     def compile_shape(self, shape):
+        import time as _time
+
         shape = tuple(shape)
         if shape in self._compiled:
             return self._compiled[shape]
         params, consts, rng = self._placed_args()
         spec = self._input(self._input_spec(shape))
+        t0 = _time.perf_counter()
         exe = self._jit_fn().lower(spec, params, consts, rng).compile()
-        self._note_cost(shape, exe)
+        self._note_compiled(shape, exe, _time.perf_counter() - t0)
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]

@@ -24,14 +24,25 @@ attribution (`telemetry.costmodel`: dl4j_flops_per_step /
 dl4j_executable_bytes / a live dl4j_mfu gauge from cost_analysis() at
 step-lower / AOT-warmup time).
 
+ISSUE 11 adds compile-side observability: `telemetry.compile_ledger`
+(an executable ledger keyed by step/serving site with recompile
+forensics — structured causes diffed from argument signatures, compile
+seconds off the jax.monitoring hook, HLO fingerprints, exported at
+GET /debug/compiles) and `telemetry.hlo_audit` (fusion / unfused-dot /
+collective / remat / largest-buffer audit of each ledgered
+executable's optimized HLO, at GET /debug/hlo/<key> and
+tools/hloaudit.py).
+
 Disabling (`telemetry.disable()`) removes every per-step registry call
 from the training loops — they check the flag once per fit() — and
 compiles the health stats OUT of the jitted step (pre-health output
 structure, bit-identical math); the same switch means zero tracer
-calls per step and per request."""
+calls per step and per request, and zero compile-ledger calls per
+step."""
 
 from deeplearning4j_tpu.telemetry import (
-    aggregate, costmodel, flight, health, prometheus, tracing)
+    aggregate, compile_ledger, costmodel, flight, health, hlo_audit,
+    prometheus, tracing)
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
 from deeplearning4j_tpu.telemetry.flight import FlightRecorder
 from deeplearning4j_tpu.telemetry.health import (
@@ -50,8 +61,9 @@ __all__ = [
     "HealthMonitor", "Histogram", "LoopInstruments", "MetricsListener",
     "MetricsRegistry", "SECONDS_BUCKETS", "STEP_HELP",
     "ServingInstruments", "Timer", "aggregate", "aggregate_snapshot",
-    "collect_device_memory", "costmodel", "disable", "enable", "enabled",
-    "etl_instruments", "flight", "get_registry", "health", "log_buckets",
-    "loop_instruments", "prometheus", "serving_instruments",
-    "set_registry", "span", "tracing",
+    "collect_device_memory", "compile_ledger", "costmodel", "disable",
+    "enable", "enabled", "etl_instruments", "flight", "get_registry",
+    "health", "hlo_audit", "log_buckets", "loop_instruments",
+    "prometheus", "serving_instruments", "set_registry", "span",
+    "tracing",
 ]

@@ -634,6 +634,15 @@ def _install_compile_hook():
             flight.record("compile", seconds=round(seconds, 6))
         except Exception:
             pass  # the flight recorder must never break jit either
+        try:
+            # compile-ledger attribution (ISSUE 11): mark this thread
+            # so the site live on it (fit-loop note_step / servable
+            # warmup) can claim the compile seconds
+            from deeplearning4j_tpu.telemetry import compile_ledger
+
+            compile_ledger.note_backend_compile(seconds)
+        except Exception:
+            pass  # the ledger must never break jit either
 
     monitoring.register_event_duration_secs_listener(_on_duration)
 

@@ -14,7 +14,10 @@ readiness: serving warmup done, last-step age, divergence state) and
 "/debug/flightrecorder" (the telemetry.flight ring buffer as JSONL).
 ISSUE 5: /healthz readiness detail gains the resilience section
 (supervisor state + checkpoint staleness — "degraded", still 200) and
-/metrics refreshes the checkpoint-age gauge at scrape time."""
+/metrics refreshes the checkpoint-age gauge at scrape time. ISSUE 11:
+"/debug/compiles" (the compile ledger: every train-step/serving
+compile with forensic cause, compile seconds, HLO fingerprint) and
+"/debug/hlo/<key>" (the per-executable fusion/remat audit)."""
 
 from __future__ import annotations
 
@@ -131,6 +134,38 @@ class _Handler(BaseHTTPRequestHandler):
 
             self._respond(flight.get_recorder().dump_jsonl().encode(),
                           ctype="application/x-ndjson")
+            return
+        elif self.path.startswith("/debug/hlo/"):
+            # per-executable HLO audit (ISSUE 11): fusion/collective/
+            # remat/buffer stats for one ledgered executable; step-site
+            # records compile lazily on first ask (cached after)
+            from urllib.parse import unquote
+
+            from deeplearning4j_tpu.telemetry import compile_ledger
+
+            key = unquote(self.path[len("/debug/hlo/"):])
+            audit = compile_ledger.get_ledger().audit(key)
+            if audit is None:
+                self._respond(b'{"error": "unknown ledger key"}',
+                              status=404)
+                return
+            self._respond(json.dumps(audit).encode())
+            return
+        elif self.path.startswith("/debug/compiles"):
+            # the compile ledger (ISSUE 11): every train-step compile
+            # and AOT serving warmup, newest first, with forensic cause
+            # + compile seconds + HLO fingerprint; ?site= filters.
+            # Read-only and served whether or not telemetry is
+            # currently enabled (incident dumps outlive a disable())
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import compile_ledger
+
+            query = parse_qs(urlsplit(self.path).query)
+            site = (query.get("site") or [None])[0]
+            body = json.dumps(
+                compile_ledger.get_ledger().describe(site=site)).encode()
+            self._respond(body)
             return
         elif self.path.startswith("/debug/traces"):
             # span-tree export (ISSUE 10): the whole ring as JSONL, or

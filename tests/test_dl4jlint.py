@@ -780,12 +780,12 @@ class TestFramework:
         for e in bl.entries.values():
             assert e["reason"] == "triaged"
 
-    def test_all_eight_rules_registered(self):
+    def test_all_nine_rules_registered(self):
         names = set(all_rules())
         assert names == {
             "collective-thread", "jit-purity", "donation-use-after",
             "telemetry-gate", "atomic-commit", "lock-order",
-            "thread-hygiene", "metric-drift"}
+            "thread-hygiene", "metric-drift", "route-drift"}
 
     def test_cli_exits_nonzero_on_finding(self, tmp_path):
         f = tmp_path / "bad.py"
@@ -809,7 +809,7 @@ class TestFramework:
 class TestRepoGate:
     def test_full_repo_clean_and_fast(self):
         """`python tools/dl4jlint.py deeplearning4j_tpu/` exits 0
-        against the committed baseline, with >=8 rules active, in
+        against the committed baseline, with >=9 rules active, in
         <30 s — the analyzer must never become the slow part of
         tier-1."""
         t0 = time.monotonic()
@@ -819,7 +819,7 @@ class TestRepoGate:
             capture_output=True, text=True, cwd=str(ROOT))
         dt = time.monotonic() - t0
         assert proc.returncode == 0, proc.stdout + proc.stderr
-        assert "8 rules" in proc.stdout
+        assert "9 rules" in proc.stdout
         assert dt < 30.0, f"dl4jlint took {dt:.1f}s (budget 30s)"
 
     def test_committed_baseline_entries_have_reasons(self):

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""benchdiff: CI gate over the bench trajectory (ISSUE 11 satellite).
+
+Compares a fresh ``bench.py`` row set against the authoritative
+BENCH_ALL.json and exits non-zero on a >10% regression on any matching
+platform-suffixed key — the trajectory was previously eyeballed; this
+makes it a gate.
+
+Usage::
+
+    python bench.py --only word2vec,serving_latency   # merges fresh rows
+    python tools/benchdiff.py fresh.json              # fresh vs BENCH_ALL.json
+    python tools/benchdiff.py fresh.json --base BENCH_ALL.json --threshold 0.1
+
+``fresh.json`` is either a BENCH_ALL-style map (already platform-
+suffixed) or a raw ``{name: row}`` result map; raw keys are normalized
+exactly the way ``bench._merge_bench_all`` does it (a row measured on a
+non-TPU backend lands under ``<name>_<platform>``), so a CPU run never
+gates against a chip row. Direction comes from the row itself: rows in
+%, ms, or seconds (overhead, latency, stall fractions) regress UP;
+throughput rows (images/sec, tokens/sec, steps/s) regress DOWN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASE = os.path.join(ROOT, "BENCH_ALL.json")
+
+_LOWER_IS_BETTER_UNITS = {"%", "ms", "s", "seconds", "ratio"}
+_LOWER_IS_BETTER_HINTS = ("overhead", "latency", "stall", "_ms", "_pct",
+                          "_seconds", "wait", "ratio")
+
+
+def lower_is_better(row) -> bool:
+    unit = str(row.get("unit", "")).lower()
+    metric = str(row.get("metric", "")).lower()
+    if unit in _LOWER_IS_BETTER_UNITS:
+        return True
+    # "x (bf16/fp32 step time; <1 is a speedup)"-style ratio units
+    if unit == "x" or unit.startswith("x "):
+        return True
+    return any(h in metric for h in _LOWER_IS_BETTER_HINTS)
+
+
+def normalize_keys(rows: dict) -> dict:
+    """Apply bench._merge_bench_all's platform-suffix convention to a
+    raw {name: row} result map (idempotent on already-suffixed keys)."""
+    out = {}
+    for key, row in rows.items():
+        if not isinstance(row, dict):
+            continue
+        platform = str(row.get("platform", "tpu"))
+        if platform != "tpu" and not key.endswith(f"_{platform}"):
+            key = f"{key}_{platform}"
+        out[key] = row
+    return out
+
+
+def compare(fresh: dict, base: dict, threshold: float = 0.10) -> list:
+    """[{key, old, new, change_pct, regression}] for every key present
+    in both row sets with a numeric ``value``. ``change_pct`` is signed
+    so that POSITIVE means worse (direction-normalized); ``regression``
+    marks relative changes past the threshold — except percent-unit
+    rows (overhead acceptances measured near zero), which gate on one
+    absolute percentage point and report ``change_pct`` in points."""
+    fresh = normalize_keys(fresh)
+    out = []
+    for key in sorted(set(fresh) & set(base)):
+        new_row, old_row = fresh[key], base[key]
+        if not isinstance(old_row, dict):
+            continue
+        new_v, old_v = new_row.get("value"), old_row.get("value")
+        if not isinstance(new_v, (int, float)) or \
+                not isinstance(old_v, (int, float)):
+            continue
+        if str(old_row.get("unit", "")) != "%" and not old_v:
+            continue   # relative change against zero is undefined
+        if str(old_row.get("unit", "")) == "%":
+            # overhead/acceptance rows measure near (or at) zero, where
+            # relative change is pure noise (a 0.2% -> 0.5% drift is
+            # "+150%"): percent-unit rows gate on direction-normalized
+            # absolute percentage POINTS instead, one point = the
+            # standard <=1% acceptance band these rows carry
+            worse = (new_v - old_v) if lower_is_better(old_row) \
+                else (old_v - new_v)
+            regression = worse > 1.0
+        else:
+            worse = (new_v - old_v) / abs(old_v)
+            if not lower_is_better(old_row):
+                worse = -worse
+            worse = 100.0 * worse
+            regression = worse > 100.0 * threshold
+        out.append({
+            "key": key,
+            "old": old_v,
+            "new": new_v,
+            "unit": old_row.get("unit"),
+            "change_pct": round(worse, 2),
+            "regression": regression,
+        })
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", help="fresh bench rows (JSON map)")
+    ap.add_argument("--base", default=DEFAULT_BASE,
+                    help="baseline row set (default: BENCH_ALL.json)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression gate as a fraction (default 0.10)")
+    args = ap.parse_args(argv)
+    with open(args.fresh) as f:
+        fresh = json.load(f)
+    with open(args.base) as f:
+        base = json.load(f)
+    rows = compare(fresh, base, threshold=args.threshold)
+    if not rows:
+        print("benchdiff: no matching keys between fresh rows and "
+              f"{os.path.basename(args.base)} — nothing gated")
+        return 0
+    regressions = [r for r in rows if r["regression"]]
+    for r in rows:
+        tag = "REGRESSION" if r["regression"] else "ok"
+        kind = "points" if r["unit"] == "%" else "%"
+        print(f"[{tag:>10}] {r['key']}: {r['old']} -> {r['new']} "
+              f"{r['unit'] or ''} ({r['change_pct']:+.1f} {kind} "
+              f"direction-normalized, + is worse)")
+    if regressions:
+        print(f"benchdiff: {len(regressions)} regression(s) past "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    print(f"benchdiff: {len(rows)} matching row(s), none past "
+          f"{args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
