@@ -839,6 +839,96 @@ def bench_serving_load(duration=2.0, deadline_ms=30.0,
     }
 
 
+def bench_decode(prompt_len=256, max_new=32, n_requests=6):
+    """ISSUE 12: open-loop decode bench over the v2 engine arms —
+    plain (PR-8 per-token prefill), chunked prefill, prefix-cache hit,
+    and speculative decoding — recording tokens/s and TTFT p50/p99
+    per arm plus the boundary counts that explain them. One tiny
+    transformer pair (draft = half-width) so the row measures the
+    ENGINE (boundary bookkeeping, dispatch count, adoption), not the
+    model. benchdiff direction: the headline value is tokens/s
+    (higher is better); the per-arm ttft_*_ms details are
+    informational."""
+    from deeplearning4j_tpu.serving import (
+        DecodeEngine, SpeculativeConfig, TransformerDecodeModel)
+
+    def mk(hidden=64, n_layers=2, seed=5):
+        return TransformerDecodeModel.init(
+            vocab=256, hidden=hidden, n_layers=n_layers, n_heads=2,
+            max_len=prompt_len + max_new + 64, max_slots=4, page=32,
+            max_pages_per_slot=(prompt_len + max_new + 63) // 32 + 1,
+            seed=seed)
+
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(0, 256, size=prompt_len))
+    prompts = [shared + list(rng.integers(0, 256, size=4 + i))
+               for i in range(n_requests)]
+
+    def run_arm(engine, reuse_prefix=False):
+        # sequential requests: TTFT is the number this bench exists
+        # to move, and queueing other requests would pollute it
+        if reuse_prefix:
+            # seed the prefix cache OUTSIDE the timed window — its
+            # tokens don't count, so its wall time must not either
+            engine.decode(prompts[0], max_new, timeout=600.0)
+        ttfts, boundaries = [], []
+        t0 = time.perf_counter()
+        n_tokens = 0
+        for prompt in prompts:
+            req = engine.submit(prompt, max_new)
+            t_sub = time.perf_counter()
+            stream = req.tokens(timeout=600.0)
+            next(stream)
+            ttfts.append(time.perf_counter() - t_sub)
+            n_tokens += 1 + sum(1 for _ in stream)
+            boundaries.append(req.ttft_boundaries)
+        wall = time.perf_counter() - t0
+        engine.close()
+        lat = np.asarray(ttfts) * 1e3
+        return {
+            "tokens_per_s": round(n_tokens / wall, 1),
+            "ttft_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "ttft_p99_ms": round(float(np.percentile(lat, 99)), 2),
+            "ttft_boundaries_p50": int(np.median(boundaries)),
+        }
+
+    arms = {}
+    arms["plain"] = run_arm(DecodeEngine(mk(), name="b-plain").warmup())
+    arms["chunked"] = run_arm(
+        DecodeEngine(mk(), name="b-chunk", chunk=64).warmup())
+    arms["prefix_hit"] = run_arm(
+        DecodeEngine(mk(), name="b-prefix", chunk=64,
+                     prefix_cache=True).warmup(),
+        reuse_prefix=True)
+    draft = TransformerDecodeModel.init(
+        vocab=256, hidden=32, n_layers=1, n_heads=2,
+        max_len=prompt_len + max_new + 64, max_slots=4, page=32,
+        max_pages_per_slot=(prompt_len + max_new + 63) // 32 + 1,
+        seed=5)
+    arms["speculative"] = run_arm(
+        DecodeEngine(mk(), name="b-spec", chunk=64, prefix_cache=True,
+                     speculative=SpeculativeConfig(draft=draft, k=4))
+        .warmup(), reuse_prefix=True)
+    return {
+        "metric": "decode_tokens_per_s",
+        "value": arms["plain"]["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "arms": arms,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "note": (f"v2 decode arms on a tiny {prompt_len}-token-prompt "
+                 "transformer pair; headline value = plain-arm "
+                 "tokens/s (benchdiff: higher is better; ttft_*_ms "
+                 "and boundary counts are informational — chunked/"
+                 "prefix/speculative arms should dominate plain on "
+                 "TTFT boundaries everywhere). CAVEAT: CPU row is "
+                 "host-bound (dispatch overhead ~ kernel time at "
+                 "this model size) — re-record on chip "
+                 "(`python bench.py --only decode`)"),
+    }
+
+
 def bench_health_overhead(steps=80, repeats=3):
     """ISSUE 3 smoke: per-step cost of the in-step health stats + host
     publication. Three modes on the SAME architecture (fresh net each,
@@ -1388,6 +1478,7 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("word2vec", bench_word2vec),
                ("serving_latency", bench_serving_latency),
                ("serving_load", bench_serving_load),
+               ("decode", bench_decode),
                ("health_overhead", bench_health_overhead),
                ("precision", bench_precision),
                ("resilience", bench_resilience),

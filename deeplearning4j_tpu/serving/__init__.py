@@ -43,6 +43,8 @@ from deeplearning4j_tpu.serving.buckets import (
     unpad)
 from deeplearning4j_tpu.serving.decode import (
     DecodeEngine, PagedKVCache, RnnDecodeModel, TransformerDecodeModel)
+from deeplearning4j_tpu.serving.prefill import ChunkedPrefill
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
 from deeplearning4j_tpu.serving.replica import Replica, ReplicaDeath, \
     ReplicaSet
@@ -50,14 +52,19 @@ from deeplearning4j_tpu.serving.servable import (
     FnServable, GraphServable, NetworkServable, SameDiffServable, Servable,
     as_servable)
 from deeplearning4j_tpu.serving.session import InferenceSession
+from deeplearning4j_tpu.serving.speculative import (
+    SpeculativeConfig, SpeculativeDecoder)
 
 __all__ = [
-    "AdmissionController", "BucketLadder", "DEFAULT_BATCH_BUCKETS",
+    "AdmissionController", "BucketLadder", "ChunkedPrefill",
+    "DEFAULT_BATCH_BUCKETS",
     "DecodeEngine", "DynamicBatcher", "FnServable", "GraphServable",
     "InferenceSession", "ModelNotFound", "ModelRegistry",
-    "NetworkServable", "PagedKVCache", "QueueFullError", "Replica",
+    "NetworkServable", "PagedKVCache", "PrefixCache", "QueueFullError",
+    "Replica",
     "ReplicaDeath", "ReplicaSet", "RnnDecodeModel", "SameDiffServable",
     "Servable", "ServingShutdown", "ServingTimeout", "ShedError",
+    "SpeculativeConfig", "SpeculativeDecoder",
     "TransformerDecodeModel", "as_servable", "execute_plan",
     "pad_batch", "pad_rows", "pad_time", "run_batch", "unpad",
 ]

@@ -54,7 +54,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from deeplearning4j_tpu.telemetry import flight, tracing
+from deeplearning4j_tpu.telemetry import compile_ledger, flight, tracing
 
 
 class DecodeError(RuntimeError):
@@ -76,7 +76,16 @@ class PagedKVCache:
     idle slots, so the device pool must hold ``n_pages + 1`` pages).
     Allocation is all-up-front per sequence: `reserve()` either grants
     every page the sequence can ever touch or refuses — admission
-    control at the slot boundary instead of mid-decode eviction."""
+    control at the slot boundary instead of mid-decode eviction.
+
+    Pages are REFCOUNTED (ISSUE 12): a slot's reservation holds one
+    reference per page, and the cross-request `PrefixCache`
+    (serving/prefix_cache.py) holds its own reference on pages it has
+    published. A page returns to the free pool only when its last
+    reference drops — so a finished request's shared-prefix pages
+    stay resident for the next request to adopt, and `release()`
+    after `clear()`-ing the cache provably returns the pool to fully
+    free (the leak assertion in tests)."""
 
     def __init__(self, n_pages, page, max_pages_per_slot, max_slots):
         if page < 1 or n_pages < 1:
@@ -90,6 +99,7 @@ class PagedKVCache:
         self.table = np.zeros((max_slots, self.max_pages_per_slot),
                               np.int32)
         self._owned: dict[int, list[int]] = {}
+        self._ref: dict[int, int] = {}
 
     def pages_for(self, total_len: int) -> int:
         return math.ceil(total_len / self.page)
@@ -99,17 +109,32 @@ class PagedKVCache:
         return need <= len(self._free) and \
             need <= self.max_pages_per_slot
 
-    def reserve(self, slot: int, total_len: int):
+    def reserve(self, slot: int, total_len: int, adopted=()):
+        """Grant every page ``slot`` can ever touch: ``adopted`` pages
+        (shared, refcount bumped — the prefix-cache hit) fill the
+        leading table entries in position order, fresh pages cover the
+        suffix. Refuses rather than partially grants."""
         need = self.pages_for(total_len)
+        adopted = list(adopted)
         if need > self.max_pages_per_slot:
             raise DecodeError(
                 f"sequence of {total_len} tokens needs {need} pages > "
                 f"max_pages_per_slot={self.max_pages_per_slot}")
-        if need > len(self._free):
+        if len(adopted) > need:
             raise DecodeError(
-                f"KV pool exhausted: need {need} pages, "
+                f"adopting {len(adopted)} pages for a {need}-page "
+                f"sequence")
+        fresh_need = need - len(adopted)
+        if fresh_need > len(self._free):
+            raise DecodeError(
+                f"KV pool exhausted: need {fresh_need} fresh pages, "
                 f"{len(self._free)} free")
-        pages = [self._free.pop() for _ in range(need)]
+        if 0 in adopted:
+            raise DecodeError("scratch page 0 is never sharable")
+        fresh = [self._free.pop() for _ in range(fresh_need)]
+        pages = adopted + fresh
+        for p in pages:
+            self._ref[p] = self._ref.get(p, 0) + 1
         self._owned[slot] = pages
         self.table[slot, :] = 0
         self.table[slot, :need] = pages
@@ -117,12 +142,42 @@ class PagedKVCache:
 
     def release(self, slot: int):
         pages = self._owned.pop(slot, [])
-        self._free.extend(reversed(pages))
+        for p in reversed(pages):
+            self.decref(p)
         self.table[slot, :] = 0
+
+    def retain(self, page: int):
+        """An extra reference (the prefix cache publishing a page)."""
+        if page == 0:
+            raise DecodeError("scratch page 0 is never sharable")
+        self._ref[page] = self._ref.get(page, 0) + 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; the page returns to the free pool when
+        nobody holds it anymore. Returns True when freed."""
+        n = self._ref.get(page, 0) - 1
+        if n > 0:
+            self._ref[page] = n
+            return False
+        self._ref.pop(page, None)
+        self._free.append(page)
+        return True
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def owned(self, slot: int) -> list:
+        """The slot's page list in position order (adopted prefix
+        first) — what the prefix cache publishes from."""
+        return list(self._owned.get(slot, ()))
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.n_pages - len(self._free)
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +212,7 @@ class RnnDecodeModel:
         self.vocab = int(vocab) if vocab is not None else int(self.n_in)
         self._dtype = net.conf.dtype
         self._jit_step = jax.jit(self._fn)
+        self._jit_masked = jax.jit(self.masked_fn)
         # slot is a TRACED scalar: one reset executable serves every
         # slot (a static slot arg would compile per slot index and
         # break the zero-steady-state-recompiles contract)
@@ -180,6 +236,24 @@ class RnnDecodeModel:
             .astype(jnp.int32)
         return nxt, new_state
 
+    def masked_fn(self, params, state, tokens, pos, table, active):
+        """The step math gated per slot: inactive rows keep their
+        recurrent carries bitwise (``jnp.where`` on the carry rows).
+        Active rows compute exactly ``_fn`` — the chunk-prefill loop
+        body (serving/prefill.py) composes this, which is what makes
+        chunked prefill bit-identical to the per-token path."""
+        import jax.numpy as jnp
+
+        nxt, new_state = self._fn(params, state, tokens, pos, table)
+        out = list(new_state)
+        for i in self._rec:
+            out[i] = {
+                k: jnp.where(
+                    active.reshape((-1,) + (1,) * (v.ndim - 1)),
+                    v, state[i][k])
+                for k, v in new_state[i].items()}
+        return jnp.where(active, nxt, -1), out
+
     def _reset_fn(self, state, slot):
         import jax.numpy as jnp
 
@@ -189,9 +263,26 @@ class RnnDecodeModel:
                       for k, v in state[i].items()}
         return out
 
-    def step(self, state, tokens, pos, table):
-        return self._jit_step(self.net._params, state, tokens, pos,
-                              table)
+    def params_for_step(self):
+        # read live from the net at every dispatch (never captured)
+        return self.net._params
+
+    def step(self, state, tokens, pos, table, site=None):
+        args = (self.net._params, state, tokens, pos, table)
+        out = self._jit_step(*args)
+        if site is not None:
+            compile_ledger.note_step(site, self._jit_step, args,
+                                     donation=())
+        return out
+
+    def step_masked(self, state, tokens, pos, table, active, site=None):
+        args = (self.net._params, state, tokens, pos, table,
+                np.ascontiguousarray(active, dtype=bool))
+        out = self._jit_masked(*args)
+        if site is not None:
+            compile_ledger.note_step(site, self._jit_masked, args,
+                                     donation=())
+        return out
 
     def reset_slot(self, state, slot):
         return self._jit_reset(state, np.int32(slot))
@@ -232,6 +323,7 @@ class TransformerDecodeModel:
         self.eps = eps
         self.n_layers = len(params["layers"])
         self._jit_step = jax.jit(self._fn)
+        self._jit_masked = jax.jit(self.masked_fn)
 
     @classmethod
     def from_bert(cls, params, cfg, **kw):
@@ -305,6 +397,29 @@ class TransformerDecodeModel:
         return o / jnp.maximum(l, 1e-30)[..., None]
 
     def _fn(self, params, state, tokens, pos, table):
+        import jax.numpy as jnp
+
+        S = self.max_slots
+        pidx = table[jnp.arange(S), pos // self.page]   # [S] write page
+        return self._apply(params, state, tokens, pos, table, pidx)
+
+    def masked_fn(self, params, state, tokens, pos, table, active):
+        """The step math with inactive slots routed to scratch: their
+        pool writes land on page 0 and their outputs are -1, while an
+        active row computes bit-exactly what ``_fn`` computes (same
+        [S]-shaped row-wise math) — the property the chunk-prefill /
+        verify block executable (serving/prefill.py) is built on."""
+        import jax.numpy as jnp
+
+        S = self.max_slots
+        pos = jnp.where(active, pos, 0)
+        pidx = jnp.where(active,
+                         table[jnp.arange(S), pos // self.page], 0)
+        nxt, new_state = self._apply(params, state, tokens, pos, table,
+                                     pidx)
+        return jnp.where(active, nxt, -1), new_state
+
+    def _apply(self, params, state, tokens, pos, table, pidx):
         import jax
         import jax.numpy as jnp
 
@@ -313,7 +428,6 @@ class TransformerDecodeModel:
         ln = lambda x, p: _layer_norm(x, p["g"], p["b"], self.eps)  # noqa: E731
         h = params["tok_emb"][tokens] + params["pos_emb"][pos]
         h = ln(h, params["emb_ln"])
-        pidx = table[jnp.arange(S), pos // self.page]   # [S] write page
         off = pos % self.page
         new_k, new_v = [], []
         for li, lp in enumerate(params["layers"]):
@@ -337,8 +451,25 @@ class TransformerDecodeModel:
         new_state = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
         return nxt, new_state
 
-    def step(self, state, tokens, pos, table):
-        return self._jit_step(self.params, state, tokens, pos, table)
+    def params_for_step(self):
+        return self.params
+
+    def step(self, state, tokens, pos, table, site=None):
+        args = (self.params, state, tokens, pos, table)
+        out = self._jit_step(*args)
+        if site is not None:
+            compile_ledger.note_step(site, self._jit_step, args,
+                                     donation=())
+        return out
+
+    def step_masked(self, state, tokens, pos, table, active, site=None):
+        args = (self.params, state, tokens, pos, table,
+                np.ascontiguousarray(active, dtype=bool))
+        out = self._jit_masked(*args)
+        if site is not None:
+            compile_ledger.note_step(site, self._jit_masked, args,
+                                     donation=())
+        return out
 
     def reset_slot(self, state, slot):
         # stale page contents are unreachable once the page table drops
@@ -362,7 +493,8 @@ def _layer_norm(x, g, b, eps):
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "future", "stream",
                  "slot", "ptr", "generated", "t_submit", "req_id",
-                 "trace", "spans_emitted", "t_suppressed")
+                 "trace", "spans_emitted", "t_suppressed",
+                 "ttft_boundaries", "published", "t_first")
     _END = object()
 
     def __init__(self, prompt, max_new, eos_id, req_id):
@@ -383,6 +515,12 @@ class _DecodeRequest:
         self.trace = tracing.current()
         self.spans_emitted = 0     # per-boundary spans so far
         self.t_suppressed = None   # first boundary past the span cap
+        # TTFT accounting (ISSUE 12): engine boundaries this request
+        # rode before its first token — the number chunked prefill
+        # and prefix adoption exist to shrink
+        self.ttft_boundaries = 0
+        self.published = False     # prompt pages in the prefix cache
+        self.t_first = None        # wall time of the first token
 
     def tokens(self, timeout=None):
         """Generator of tokens as they decode (terminates with the
@@ -417,11 +555,31 @@ class DecodeEngine:
     - `warmup()` runs one throwaway step + slot reset so every
       executable exists before traffic; after it, `dl4j_compile_total`
       stays flat (asserted in tests).
+
+    ISSUE 12 layers (all default-off, composable):
+
+    - ``chunk=N``: chunked prefill — prompts retire in N-token blocks
+      through a second ``[max_slots, N]`` executable at each boundary
+      (serving/prefill.py), cutting TTFT boundaries from
+      O(prompt_len) to O(prompt_len / N) while decoding slots keep
+      streaming; bit-identical to the per-token path by construction;
+    - ``prefix_cache=True``: completed full prompt pages are
+      refcounted and published under a rolling token-prefix hash
+      (serving/prefix_cache.py); a request with a matching prefix
+      adopts the pages and prefills only its suffix. Admission counts
+      cache-idle pages as reclaimable — the PR-8 head-of-line wedge
+      fix;
+    - ``speculative=SpeculativeConfig(draft, k)``: a draft model
+      proposes k tokens per boundary, verified in one call through
+      the block executable, with acceptance-EWMA fallback to plain
+      decode (serving/speculative.py). Greedy output is identical to
+      target-only decode.
     """
 
     def __init__(self, model, name="decode", pending_size=64,
                  max_new_limit=1024, instruments=None,
-                 wedge_timeout=30.0):
+                 wedge_timeout=30.0, chunk=None, prefix_cache=False,
+                 speculative=None, backlog_timeout=120.0):
         self.model = model
         self.name = name
         # /healthz wedge detection (ISSUE 10 satellite): with sequences
@@ -449,6 +607,77 @@ class DecodeEngine:
                                     model.max_slots)
         self._table = (self._kv.table if self._kv is not None
                        else np.zeros((model.max_slots, 1), np.int32))
+        # -- decode v2 layers (ISSUE 12), all default-off ------------------
+        self._spec = None
+        if speculative is not None:
+            from deeplearning4j_tpu.serving.speculative import (
+                SpeculativeConfig, SpeculativeDecoder)
+
+            cfg = (speculative if isinstance(speculative,
+                                             SpeculativeConfig)
+                   else SpeculativeConfig(draft=speculative))
+            if self._kv is None:
+                raise DecodeError("speculative decoding needs a paged "
+                                  "target model (the verifier rides "
+                                  "the block executable over the "
+                                  "paged pool)")
+            if getattr(cfg.draft, "vocab", None) != model.vocab:
+                raise DecodeError(
+                    f"draft vocab {getattr(cfg.draft, 'vocab', None)} "
+                    f"!= target vocab {model.vocab}")
+            if cfg.draft.max_slots != model.max_slots:
+                raise DecodeError(
+                    f"draft max_slots {cfg.draft.max_slots} != target "
+                    f"max_slots {model.max_slots}")
+            # the draft lane mirrors the target's page accounting:
+            # equal page size keeps adoption depths in one unit, and a
+            # pool at least as roomy keeps every submit-side limit
+            # check (which consults only the target) valid for the
+            # draft too — a smaller draft pool would re-introduce the
+            # head-of-line wedge on the mirror lane
+            if cfg.draft.page != model.page:
+                raise DecodeError(
+                    f"draft page {cfg.draft.page} != target page "
+                    f"{model.page}")
+            if cfg.draft.max_pages_per_slot < model.max_pages_per_slot \
+                    or cfg.draft.n_pages < model.n_pages:
+                raise DecodeError(
+                    f"draft pool (max_pages_per_slot="
+                    f"{cfg.draft.max_pages_per_slot}, n_pages="
+                    f"{cfg.draft.n_pages}) smaller than the target's "
+                    f"({model.max_pages_per_slot}, {model.n_pages})")
+            if chunk is None:
+                # verify width doubles as the prefill block: ONE block
+                # executable total (the lean-kernel default)
+                chunk = cfg.k + 1
+            self._spec = SpeculativeDecoder(
+                cfg, chunk, name, prefix_cache=bool(prefix_cache))
+        self._block = None
+        if chunk is not None:
+            from deeplearning4j_tpu.serving.prefill import ChunkedPrefill
+
+            self._block = ChunkedPrefill(model, chunk)
+        self._pcache = None
+        if prefix_cache:
+            from deeplearning4j_tpu.serving.prefix_cache import (
+                PrefixCache)
+
+            if self._kv is None:
+                raise DecodeError("prefix caching needs a paged model "
+                                  "(KV pages are what gets shared)")
+            self._pcache = (prefix_cache if isinstance(prefix_cache,
+                                                       PrefixCache)
+                            else PrefixCache(self._kv.page))
+        self.backlog_timeout = float(backlog_timeout)
+        # duck-typed models (tests, foreign adapters) may predate the
+        # ledger-site kwarg on step() — detect once, not per boundary
+        import inspect
+
+        try:
+            self._step_takes_site = "site" in inspect.signature(
+                model.step).parameters
+        except (TypeError, ValueError):
+            self._step_takes_site = False
         self._closed = False
         self._warmed = False
         self._ids = 0
@@ -518,14 +747,39 @@ class DecodeEngine:
                            eos_id=eos_id).result(timeout=timeout)
 
     def warmup(self):
-        """Compile the step + reset executables with a throwaway
-        iteration, leaving the engine state untouched (slot 0's carry
-        is re-reset afterwards). Steady state adds zero compiles."""
+        """Compile the full executable set with throwaway iterations,
+        leaving the engine state untouched (slot 0's carry is re-reset
+        afterwards; block warmups run with all counts zero). Every
+        executable lands in the compile ledger under a
+        ``decode:<name>:*`` site, so the zero-steady-state-recompile
+        invariant is ledger-assertable for the whole set: token step +
+        chunk prefill + verify + draft step + draft prefill (tests)."""
+        if compile_ledger.enabled():
+            # the jax.monitoring hook installs on first registry use;
+            # without it the warmup compiles below would never be
+            # attributed to their decode:* ledger sites
+            from deeplearning4j_tpu.telemetry import (registry
+                                                      as _registry)
+
+            _registry.get_registry()
         state = self.model.reset_slot(self._state, 0)
         tokens = np.zeros((self.model.max_slots,), np.int32)
         pos = np.zeros((self.model.max_slots,), np.int32)
-        self.model.step(state, tokens, pos,
-                        np.ascontiguousarray(self._table))
+        # a REAL copy, not ascontiguousarray (which aliases an
+        # already-contiguous table): admission mutates the table
+        # between boundaries, and jax may zero-copy numpy inputs
+        table = self._table.copy()
+        self._model_step(state, tokens, pos, table)
+        if self._block is not None:
+            self._block.warmup(self._state, table,
+                               site=f"decode:{self.name}:prefill")
+            if self._spec is not None and \
+                    self._spec.k + 1 != self._block.chunk:
+                self._block.warmup(self._state, table,
+                                   widths=(self._spec.k + 1,),
+                                   site=f"decode:{self.name}:verify")
+        if self._spec is not None:
+            self._spec.warmup()
         self._state = self.model.reset_slot(self._state, 0)
         self._warmed = True
         return self
@@ -534,22 +788,67 @@ class DecodeEngine:
     def active_slots(self) -> int:
         return len(self._active)
 
+    def _backlog_age(self):
+        """Age of the oldest request still waiting for its first token
+        (queued, head-blocked, or mid-prefill) — the chunked-prefill
+        backlog signal for /healthz."""
+        oldest = None
+        for req in list(self._waiting):
+            if oldest is None or req.t_submit < oldest:
+                oldest = req.t_submit
+        for req in list(self._active.values()):
+            if not req.generated and (oldest is None
+                                      or req.t_submit < oldest):
+                oldest = req.t_submit
+        return (time.perf_counter() - oldest) if oldest is not None \
+            else None
+
     def health(self) -> dict:
         """Liveness detail for /healthz: active/waiting counts plus
         wedge detection — sequences in flight but no token boundary
         for longer than ``wedge_timeout`` means a slot is stuck inside
-        a device step (or the engine thread died mid-decode)."""
+        a device step (or the engine thread died mid-decode). ISSUE 12
+        adds prefix-cache occupancy/hit-rate, the prefill backlog age
+        (degraded past ``backlog_timeout`` — boundaries may be
+        advancing while a starved request never reaches its first
+        token), KV-page occupancy, and speculation state — all
+        degraded-not-503, the PR-9 contract."""
         active = len(self._active)
         last = self._last_boundary
         age = (time.monotonic() - last) if last is not None else None
         wedged = bool(active and age is not None
                       and age > self.wedge_timeout)
-        return {"active": active,
-                "waiting": self._pending.qsize() + len(self._waiting),
-                "boundary_age_seconds": (round(age, 3)
-                                         if age is not None else None),
-                "wedged": wedged,
-                "degraded": wedged or not self._thread.is_alive()}
+        backlog = self._backlog_age()
+        starved = bool(backlog is not None
+                       and backlog > self.backlog_timeout)
+        out = {"active": active,
+               "waiting": self._pending.qsize() + len(self._waiting),
+               "boundary_age_seconds": (round(age, 3)
+                                        if age is not None else None),
+               "wedged": wedged,
+               "degraded": (wedged or starved
+                            or not self._thread.is_alive())}
+        if self._block is not None:
+            out["prefill"] = {
+                "chunk": self._block.chunk,
+                "backlog": sum(
+                    1 for r in list(self._active.values())
+                    if not r.generated) + len(self._waiting),
+                "oldest_age_seconds": (round(backlog, 3)
+                                       if backlog is not None
+                                       else None),
+                "starved": starved}
+        if self._kv is not None:
+            out["kv_pages"] = {"total": self._kv.n_pages,
+                               "free": self._kv.free_pages,
+                               "occupancy": round(
+                                   self._kv.used_pages
+                                   / self._kv.n_pages, 4)}
+        if self._pcache is not None:
+            out["prefix_cache"] = self._pcache.stats()
+        if self._spec is not None:
+            out["speculative"] = self._spec.health()
+        return out
 
     def close(self, timeout=5.0):
         self._closed = True
@@ -571,11 +870,40 @@ class DecodeEngine:
             req.stream.put(_DecodeRequest._END)
 
     # -- engine side ---------------------------------------------------------
+    def _page_plan(self, req):
+        """Admission plan for the head-of-line request, or None when
+        it must wait. Consults the prefix cache twice over (ISSUE 12
+        satellite: the PR-8 head-of-line wedge): matched pages are
+        ADOPTED instead of reserved, and pages held only by the cache
+        (refcount==1, idle) count as reclaimable — a request that fits
+        the pool no longer blocks the FIFO just because idle cached
+        pages are sitting on the free list's budget."""
+        from deeplearning4j_tpu.serving.prefix_cache import (
+            plan_admission)
+
+        total = len(req.prompt) + req.max_new
+        plan = plan_admission(self._kv, self._pcache, req.prompt, total)
+        if plan is None:
+            return None
+        if self._spec is not None:
+            # the draft lane must never adopt DEEPER than the target
+            # skips (the suffix prefill would write into shared draft
+            # pages); shallower is fine — quality cost only
+            dplan = self._spec.plan(req.prompt, total,
+                                    max_adopt=len(plan["adopt"]))
+            if dplan is None:
+                return None
+            return plan, dplan
+        return plan, None
+
     def _admit(self):
         """Move pending requests into free slots at this token
         boundary. The submit queue drains into an engine-private FIFO
         first, so a request that can't get its KV pages yet
         head-blocks (fairness) without races against submit()."""
+        from deeplearning4j_tpu.serving.prefix_cache import (
+            apply_admission)
+
         while True:
             try:
                 self._waiting.append(self._pending.get_nowait())
@@ -584,14 +912,54 @@ class DecodeEngine:
         admitted = 0
         while self._free_slots and self._waiting:
             req = self._waiting[0]
-            if self._kv is not None and not self._kv.can_reserve(
-                    len(req.prompt) + req.max_new):
-                break   # head-of-line waits for pages: strict FIFO
+            plan = None
+            if self._kv is not None:
+                plan = self._page_plan(req)
+                if plan is None:
+                    break   # head-of-line waits for pages: strict FIFO
             self._waiting.pop(0)
             slot = self._free_slots.pop()
             req.slot = slot
+            adopted = 0
             if self._kv is not None:
-                self._kv.reserve(slot, len(req.prompt) + req.max_new)
+                tplan, dplan = plan
+                total = len(req.prompt) + req.max_new
+                try:
+                    adopted = apply_admission(self._kv, self._pcache,
+                                              tplan, slot, total)
+                    if dplan is not None:
+                        self._spec.admit(slot, total, dplan,
+                                         target_adopted=adopted)
+                except Exception as e:
+                    # defensive: a lane-accounting failure must fail
+                    # THIS request, never the engine thread (a dead
+                    # loop wedges every queued request silently)
+                    self._kv.release(slot)
+                    if self._spec is not None:
+                        self._spec.release(slot)
+                    self._free_slots.append(slot)
+                    req.slot = None
+                    if not req.future.done():
+                        req.future.set_exception(DecodeError(
+                            f"admission failed: "
+                            f"{type(e).__name__}: {e}"))
+                    req.stream.put(_DecodeRequest._END)
+                    continue
+                if adopted:
+                    # the adopted pages already hold this prefix's KV:
+                    # prefill starts at the suffix (>= 1 prompt token
+                    # always remains — match() never covers the last)
+                    req.ptr = adopted * self._kv.page
+                if self._pcache is not None:
+                    inst = self._instruments_fn()
+                    if adopted:
+                        self._pcache.hits += 1
+                        if inst is not None:
+                            inst.prefix_hits.inc()
+                    else:
+                        self._pcache.misses += 1
+                        if inst is not None:
+                            inst.prefix_misses.inc()
             self._state = self.model.reset_slot(self._state, slot)
             self._active[slot] = req
             admitted += 1
@@ -602,7 +970,8 @@ class DecodeEngine:
                              req_id=req.req_id)
             flight.record("decode_join", model=self.name,
                           req_id=req.req_id, slot=slot,
-                          prompt=len(req.prompt), max_new=req.max_new)
+                          prompt=len(req.prompt), max_new=req.max_new,
+                          adopted_pages=adopted)
         return admitted
 
     # per-request ceiling on per-boundary spans; the remainder folds
@@ -619,6 +988,8 @@ class DecodeEngine:
         self._active.pop(slot, None)
         if self._kv is not None:
             self._kv.release(slot)
+        if self._spec is not None:
+            self._spec.release(slot)
         self._free_slots.append(slot)
         if error is not None:
             if not req.future.done():
@@ -632,8 +1003,266 @@ class DecodeEngine:
                       seconds=round(time.perf_counter() - req.t_submit,
                                     6))
 
-    def _loop(self):
+    def _model_step(self, state, tokens, pos, table):
+        if self._step_takes_site:
+            return self.model.step(state, tokens, pos, table,
+                                   site=f"decode:{self.name}:step")
+        return self.model.step(state, tokens, pos, table)
+
+    def clear_prefix_cache(self):
+        """Drop every cached prefix chain (both lanes), releasing the
+        cache's page references — after every request has finished,
+        the pool provably returns to fully free (the leak test)."""
+        n = 0
+        if self._pcache is not None and self._kv is not None:
+            n = self._pcache.clear(self._kv)
+        if self._spec is not None:
+            n += self._spec.clear_prefix_cache()
+        return n
+
+    def _publish(self, req, slot):
+        """Put the request's full prompt pages into the prefix cache —
+        once, at the boundary where its prompt is fully written."""
+        if self._pcache is None or req.published or \
+                req.ptr < len(req.prompt):
+            return
+        req.published = True
+        n_full = len(req.prompt) // self._kv.page
+        if not n_full:
+            return
+        owned = self._kv.owned(slot)
+        if len(owned) >= n_full:
+            self._pcache.publish(self._kv, req.prompt, owned[:n_full])
+        if self._spec is not None:
+            self._spec.publish(req.prompt, slot)
+
+    def _emit_token(self, req, tok, inst):
+        """Append one generated token, stream it, observe TTFT on the
+        first. Returns True when the request just finished."""
+        req.generated.append(tok)
+        req.stream.put(tok)
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
+            if inst is not None:
+                inst.ttft.observe(req.t_first - req.t_submit)
+        return (len(req.generated) >= req.max_new
+                or (req.eos_id is not None and tok == req.eos_id))
+
+    def _prefill_boundary(self, inst) -> bool:
+        """Boundary phase 1 (ISSUE 12 tentpole a): retire up to
+        ``chunk`` prompt tokens per prefilling slot through the block
+        executable — always leaving the final prompt token for the
+        emitting phase, so first-token emission stays on the
+        per-token/verify path. Returns False when the dispatch failed
+        (every request was failed, skip phase 2)."""
+        todo = {s: r for s, r in list(self._active.items())
+                if r.ptr < len(r.prompt) - 1}
+        if not todo:
+            return True
         S = self.model.max_slots
+        C = self._block.chunk
+        blocks = np.zeros((S, C), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        counts = np.zeros((S,), np.int32)
+        for slot, req in todo.items():
+            n = min(C, len(req.prompt) - 1 - req.ptr)
+            blocks[slot, :n] = req.prompt[req.ptr:req.ptr + n]
+            pos0[slot] = req.ptr
+            counts[slot] = n
+        # a REAL copy, not ascontiguousarray (which aliases an
+        # already-contiguous table): admission mutates the table
+        # between boundaries, and jax may zero-copy numpy inputs
+        table = self._table.copy()
+        t_b0 = time.perf_counter()
+        try:
+            _, self._state = self._block.run(
+                self._state, blocks, pos0, counts, table,
+                site=f"decode:{self.name}:prefill")
+            if self._spec is not None:
+                self._spec.prefill(blocks, pos0, counts)
+        except Exception as e:
+            for req in list(self._active.values()):
+                self._finish(req, error=RuntimeError(
+                    f"chunk prefill failed: {type(e).__name__}: {e}"))
+            return False
+        t_b1 = time.perf_counter()
+        self._last_boundary = time.monotonic()
+        for slot, req in todo.items():
+            if self._active.get(slot) is not req:
+                continue
+            req.ptr += int(counts[slot])
+            if req.trace is not None and \
+                    req.spans_emitted < self.boundary_span_cap:
+                req.spans_emitted += 1
+                tracing.emit("decode.prefill_chunk", req.trace, t_b0,
+                             t_b1, slot=slot,
+                             tokens=int(counts[slot]), pos=req.ptr)
+        return True
+
+    def _step_boundary(self, inst):
+        """One per-token boundary through the step executable — the
+        PR-8 path, semantics unchanged: every active slot advances one
+        token (prefilling slots feed their next prompt token)."""
+        S = self.model.max_slots
+        tokens = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        # snapshot: close() may clear _active concurrently
+        for slot, req in list(self._active.items()):
+            if req.ptr < len(req.prompt):
+                tokens[slot] = req.prompt[req.ptr]
+            else:
+                tokens[slot] = req.generated[-1]
+            pos[slot] = req.ptr
+            active[slot] = True
+        # a REAL copy, not ascontiguousarray (which aliases an
+        # already-contiguous table): admission mutates the table
+        # between boundaries, and jax may zero-copy numpy inputs
+        table = self._table.copy()
+        t_b0 = time.perf_counter()
+        try:
+            nxt, self._state = self._model_step(self._state, tokens,
+                                                pos, table)
+            nxt = np.asarray(nxt)
+            if self._spec is not None:
+                # fallback boundaries keep the draft pool in sync so
+                # a later speculation probe proposes from real context
+                self._spec.track(tokens, pos, active)
+        except Exception as e:
+            for req in list(self._active.values()):
+                self._finish(req, error=RuntimeError(
+                    f"decode step failed: {type(e).__name__}: {e}"))
+            return
+        t_b1 = time.perf_counter()
+        self._last_boundary = time.monotonic()
+        n_decoded = 0
+        for slot, req in list(self._active.items()):
+            prefilling = req.ptr + 1 < len(req.prompt)
+            if req.trace is not None:
+                # one child span per token boundary this sequence
+                # took part in (ISSUE 10): prefill and decode
+                # interleave through the same executable, and the
+                # span name says which phase this boundary was.
+                # Capped per request: a near-max_new generation
+                # would otherwise evict every concurrent trace
+                # (including its own early spans) from the bounded
+                # ring — boundaries past the cap aggregate into
+                # one decode.tokens span at finish.
+                if req.spans_emitted < self.boundary_span_cap:
+                    req.spans_emitted += 1
+                    tracing.emit(
+                        "decode.prefill" if prefilling
+                        else "decode.token",
+                        req.trace, t_b0, t_b1, slot=slot,
+                        pos=req.ptr)
+                elif req.t_suppressed is None:
+                    req.t_suppressed = t_b0
+            req.ptr += 1
+            self._publish(req, slot)
+            if req.ptr < len(req.prompt):
+                continue            # still prefilling
+            tok = int(nxt[slot])
+            done = self._emit_token(req, tok, inst)
+            n_decoded += 1
+            if self._spec is not None and inst is not None:
+                inst.accepted("fallback", 1)
+            if done:
+                self._finish(req)
+        if inst is not None:
+            inst.tokens.inc(n_decoded)
+
+    def _speculative_boundary(self, inst):
+        """Boundary phase 2, speculative (ISSUE 12 tentpole c): the
+        draft proposes k tokens per decoding slot, the target verifies
+        the whole block in ONE call through the chunk executable, and
+        the accepted prefix (plus the verifier's own next token — the
+        free one) is emitted. Greedy-identical to plain decode, up to
+        k+1 tokens per boundary."""
+        S = self.model.max_slots
+        ready = {s: r for s, r in list(self._active.items())
+                 if r.ptr >= len(r.prompt) - 1}
+        if not ready:       # everyone still prefilling: plain boundary
+            self._step_boundary(inst)
+            return
+        V = self._spec.k + 1
+        feed = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for slot, req in ready.items():
+            feed[slot] = (req.prompt[req.ptr]
+                          if req.ptr < len(req.prompt)
+                          else req.generated[-1])
+            pos[slot] = req.ptr
+            active[slot] = True
+        # a REAL copy, not ascontiguousarray (which aliases an
+        # already-contiguous table): admission mutates the table
+        # between boundaries, and jax may zero-copy numpy inputs
+        table = self._table.copy()
+        t_b0 = time.perf_counter()
+        try:
+            drafts = self._spec.propose(feed, pos, active)
+            blocks = np.zeros((S, V), np.int32)
+            counts = np.zeros((S,), np.int32)
+            for slot, req in ready.items():
+                c = min(V, req.max_new - len(req.generated))
+                blocks[slot, 0] = feed[slot]
+                if c > 1:
+                    blocks[slot, 1:c] = drafts[slot, :c - 1]
+                counts[slot] = c
+            outs, self._state = self._block.run(
+                self._state, blocks, pos, counts, table,
+                site=f"decode:{self.name}:verify")
+        except Exception as e:
+            for req in list(self._active.values()):
+                self._finish(req, error=RuntimeError(
+                    f"speculative decode failed: "
+                    f"{type(e).__name__}: {e}"))
+            return
+        t_b1 = time.perf_counter()
+        self._last_boundary = time.monotonic()
+        n_decoded = 0
+        for slot, req in ready.items():
+            if self._active.get(slot) is not req:
+                continue
+            c = int(counts[slot])
+            if c < 1:
+                continue
+            # o_0 is the target's answer to the real last token (always
+            # valid); each later o_j is valid iff the draft proposal fed
+            # at j matched o_{j-1} — the greedy acceptance rule
+            m = 1
+            while m < c and \
+                    int(blocks[slot, m]) == int(outs[slot, m - 1]):
+                m += 1
+            self._spec.observe(m, c)
+            if inst is not None:
+                inst.accepted("accepted", m)
+                if c > m:
+                    inst.accepted("rejected", c - m)
+            if req.trace is not None and \
+                    req.spans_emitted < self.boundary_span_cap:
+                req.spans_emitted += 1
+                tracing.emit("decode.speculate", req.trace, t_b0, t_b1,
+                             slot=slot, drafted=c - 1, accepted=m,
+                             pos=req.ptr)
+            # rejected positions were written past the accepted point
+            # in both pools — above the causal mask until the true
+            # tokens overwrite those same positions (no rollback)
+            req.ptr += m
+            self._publish(req, slot)
+            done = False
+            for j in range(m):
+                done = self._emit_token(req, int(outs[slot, j]), inst)
+                n_decoded += 1
+                if done:
+                    break
+            if done:
+                self._finish(req)
+        self._spec.boundary_done()
+        if inst is not None:
+            inst.tokens.inc(n_decoded)
+
+    def _loop(self):
         while not self._closed:
             self._admit()
             if not self._active:
@@ -642,61 +1271,22 @@ class DecodeEngine:
                 self._wake.clear()
                 continue
             self._last_boundary = time.monotonic()
-            tokens = np.zeros((S,), np.int32)
-            pos = np.zeros((S,), np.int32)
-            # snapshot: close() may clear _active concurrently
-            for slot, req in list(self._active.items()):
-                if req.ptr < len(req.prompt):
-                    tokens[slot] = req.prompt[req.ptr]
-                else:
-                    tokens[slot] = req.generated[-1]
-                pos[slot] = req.ptr
-            table = np.ascontiguousarray(self._table)
-            t_b0 = time.perf_counter()
-            try:
-                nxt, self._state = self.model.step(
-                    self._state, tokens, pos, table)
-                nxt = np.asarray(nxt)
-            except Exception as e:
-                for req in list(self._active.values()):
-                    self._finish(req, error=RuntimeError(
-                        f"decode step failed: {type(e).__name__}: {e}"))
-                continue
-            t_b1 = time.perf_counter()
-            self._last_boundary = time.monotonic()
+            for req in list(self._active.values()):
+                if not req.generated:
+                    req.ttft_boundaries += 1
             inst = self._instruments_fn()
-            n_decoded = 0
-            for slot, req in list(self._active.items()):
-                prefilling = req.ptr + 1 < len(req.prompt)
-                if req.trace is not None:
-                    # one child span per token boundary this sequence
-                    # took part in (ISSUE 10): prefill and decode
-                    # interleave through the same executable, and the
-                    # span name says which phase this boundary was.
-                    # Capped per request: a near-max_new generation
-                    # would otherwise evict every concurrent trace
-                    # (including its own early spans) from the bounded
-                    # ring — boundaries past the cap aggregate into
-                    # one decode.tokens span at finish.
-                    if req.spans_emitted < self.boundary_span_cap:
-                        req.spans_emitted += 1
-                        tracing.emit(
-                            "decode.prefill" if prefilling
-                            else "decode.token",
-                            req.trace, t_b0, t_b1, slot=slot,
-                            pos=req.ptr)
-                    elif req.t_suppressed is None:
-                        req.t_suppressed = t_b0
-                req.ptr += 1
-                if req.ptr < len(req.prompt):
-                    continue            # still prefilling
-                tok = int(nxt[slot])
-                req.generated.append(tok)
-                req.stream.put(tok)
-                n_decoded += 1
-                if len(req.generated) >= req.max_new or \
-                        (req.eos_id is not None and tok == req.eos_id):
-                    self._finish(req)
+            if self._block is not None and \
+                    not self._prefill_boundary(inst):
+                continue
+            if self._spec is not None and any(
+                    r.ptr >= len(r.prompt) - 1
+                    for r in list(self._active.values())) \
+                    and self._spec.speculate_now():
+                self._speculative_boundary(inst)
+            else:
+                self._step_boundary(inst)
             if inst is not None:
-                inst.tokens.inc(n_decoded)
                 inst.slots.set(len(self._active))
+                if self._kv is not None:
+                    inst.kv_occupancy.set(
+                        self._kv.used_pages / max(1, self._kv.n_pages))

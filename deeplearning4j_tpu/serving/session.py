@@ -90,14 +90,17 @@ class InferenceSession:
         """Attach a continuous-batching DecodeEngine under `name`
         (POST /serving/v1/models/<name>:decode). `model` is a
         DecodeModel (RnnDecodeModel / TransformerDecodeModel) or an
-        already-built DecodeEngine."""
+        already-built DecodeEngine. Engine kwargs pass through —
+        ``chunk=64`` (chunked prefill), ``prefix_cache=True``,
+        ``speculative=SpeculativeConfig(draft, k)`` (ISSUE 12)."""
         from deeplearning4j_tpu.serving.decode import DecodeEngine
 
         if isinstance(model, DecodeEngine):
             engine = model
         else:
             engine = DecodeEngine(model, name=name,
-                                  instruments=lambda: self._inst(name))
+                                  instruments=lambda: self._inst(name),
+                                  **kw)
         if warmup and not engine._warmed:
             engine.warmup()
         with self._lock:

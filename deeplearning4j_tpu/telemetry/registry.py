@@ -528,6 +528,20 @@ SERVING_SHED_HELP = ("Requests shed by admission control, by priority "
 SERVING_TOKENS_HELP = "Tokens emitted by continuous-batching decode"
 SERVING_SLOTS_HELP = ("Decode slots currently occupied by in-flight "
                       "sequences")
+SERVING_PREFIX_HITS_HELP = ("Decode admissions that adopted cached "
+                            "prefix KV pages (prefill skipped for the "
+                            "shared prefix)")
+SERVING_PREFIX_MISSES_HELP = ("Decode admissions with no cached "
+                              "prefix pages to adopt")
+DECODE_TTFT_HELP = ("Seconds from decode submit to the request's "
+                    "first emitted token")
+DECODE_ACCEPTED_HELP = ("Speculative-decode tokens by outcome: "
+                        "accepted (emitted via a verify call), "
+                        "rejected (drafted but refuted), fallback "
+                        "(emitted by plain decode while speculation "
+                        "is in acceptance fallback)")
+SERVING_KV_OCCUPANCY_HELP = ("Fraction of the paged decode KV pool "
+                             "currently reserved (0..1)")
 
 
 class ServingInstruments:
@@ -537,7 +551,9 @@ class ServingInstruments:
 
     __slots__ = ("model", "_requests", "queue_wait", "execute",
                  "occupancy", "dispatch", "depth", "steals",
-                 "_replica_load", "_shed", "tokens", "slots")
+                 "_replica_load", "_shed", "tokens", "slots",
+                 "prefix_hits", "prefix_misses", "ttft", "_accepted",
+                 "kv_occupancy")
 
     def __init__(self, registry, model):
         self.model = model
@@ -574,6 +590,21 @@ class ServingInstruments:
         self.slots = registry.gauge(
             "dl4j_serving_decode_slots", SERVING_SLOTS_HELP,
             ("model",)).labels(model=model)
+        self.prefix_hits = registry.counter(
+            "dl4j_serving_prefix_hits_total", SERVING_PREFIX_HITS_HELP,
+            ("model",)).labels(model=model)
+        self.prefix_misses = registry.counter(
+            "dl4j_serving_prefix_misses_total",
+            SERVING_PREFIX_MISSES_HELP, ("model",)).labels(model=model)
+        self.ttft = registry.histogram(
+            "dl4j_decode_ttft_seconds", DECODE_TTFT_HELP,
+            ("model",)).labels(model=model)
+        self._accepted = registry.counter(
+            "dl4j_decode_accepted_tokens_total", DECODE_ACCEPTED_HELP,
+            ("model", "outcome"))
+        self.kv_occupancy = registry.gauge(
+            "dl4j_serving_kv_page_occupancy",
+            SERVING_KV_OCCUPANCY_HELP, ("model",)).labels(model=model)
 
     def request(self, outcome):
         self._requests.labels(model=self.model, outcome=outcome).inc()
@@ -584,6 +615,9 @@ class ServingInstruments:
 
     def shed(self, priority):
         self._shed.labels(model=self.model, priority=priority).inc()
+
+    def accepted(self, outcome, n=1):
+        self._accepted.labels(model=self.model, outcome=outcome).inc(n)
 
 
 def serving_instruments(model):

@@ -431,6 +431,43 @@ class TestTelemetryGateRule:
         """
         assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
 
+    def test_flags_ungated_prefix_cache_emission(self, tmp_path):
+        # ISSUE 12: the decode-v2 emission sites (prefix hits/misses,
+        # TTFT, accepted tokens, KV occupancy) are new places the
+        # zero-calls-when-disabled contract could silently erode — a
+        # raw registry emission in an admit-path helper with no gate
+        # must be flagged
+        src = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_prefix_adoption(adopted):
+                name = ("dl4j_serving_prefix_hits_total" if adopted
+                        else "dl4j_serving_prefix_misses_total")
+                telemetry.get_registry().counter(
+                    name, "h", ("model",)).labels(model="m").inc()
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_instrument_bundle_gated_prefix_emission(
+            self, tmp_path):
+        # the idiom the engine actually uses: serving_instruments()
+        # returns None when telemetry is disabled, so guarding on the
+        # bundle IS the gate (serving_instruments is in the rule's
+        # registry-gate set)
+        clean = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_prefix_adoption(adopted):
+                inst = telemetry.serving_instruments("m")
+                if inst is None:
+                    return
+                name = ("dl4j_serving_prefix_hits_total" if adopted
+                        else "dl4j_serving_prefix_misses_total")
+                telemetry.get_registry().counter(
+                    name, "h", ("model",)).labels(model="m").inc()
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
     def test_near_miss_sampler_gated_tracer(self, tmp_path):
         # the sampler IS a gate: current() returns None when disabled
         # or unsampled, so guarding on it keeps the disabled path at
