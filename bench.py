@@ -605,6 +605,16 @@ def bench_serving_latency(n_requests=300):
     }
 
 
+def _host_bound() -> bool:
+    """True off-chip: the row's value reflects host capacity (cores,
+    scheduler, dispatch overhead), not the model math — benchdiff
+    skips regression-gating host-bound rows on non-chip platforms
+    (ISSUE 13 satellite; the ROADMAP 'meaningless off-chip' debt)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def bench_serving_load(duration=2.0, deadline_ms=30.0,
                        rows_per_request=16):
     """ISSUE 8: open-loop load generator for the multi-replica serving
@@ -813,6 +823,7 @@ def bench_serving_load(duration=2.0, deadline_ms=30.0,
         "value": ratio,
         "unit": f"x single-batcher rows/s at {deadline_ms:.0f}ms deadline",
         "vs_baseline": None,
+        "host_bound": _host_bound(),
         "saturation_rows_per_s": sat,
         "sweep": results,
         "overload": {
@@ -914,6 +925,7 @@ def bench_decode(prompt_len=256, max_new=32, n_requests=6):
         "value": arms["plain"]["tokens_per_s"],
         "unit": "tokens/s",
         "vs_baseline": None,
+        "host_bound": _host_bound(),
         "arms": arms,
         "prompt_len": prompt_len,
         "max_new": max_new,
@@ -1082,6 +1094,7 @@ def bench_precision(steps=60, repeats=3, n_requests=200):
         "value": round(bf16_ms / fp32_ms, 4),
         "unit": "x (bf16_mixed/fp32 step time; <1 is a speedup)",
         "vs_baseline": None,
+        "host_bound": _host_bound(),
         "step_ms_fp32": round(fp32_ms, 4),
         "step_ms_bf16_mixed": round(bf16_ms, 4),
         "serving_p50_ms_fp32": round(float(p50_f), 3),
@@ -1470,6 +1483,62 @@ def bench_compile_ledger(steps_per_epoch=8, epochs=10, rounds=20):
     }
 
 
+def bench_coldstart():
+    """ISSUE 13: cold vs warm process start through the persistent
+    executable store (tools/coldstart.py). Every trial is a REAL
+    subprocess restart: a 3-bucket serving registration and a
+    Supervisor kill-and-resume, each cold (empty store) then warm.
+    Zero-compile warm starts are ledger-asserted (causes all
+    cache_hit), not inferred from timing."""
+    import pathlib
+    import sys as _sys
+
+    tools = str(pathlib.Path(__file__).resolve().parent / "tools")
+    if tools not in _sys.path:
+        _sys.path.insert(0, tools)
+    import coldstart
+
+    report = coldstart.run_report()
+    s, r = report["serving"], report["resume"]
+    return {
+        "metric": "coldstart_warm_registration_seconds",
+        "value": s["warm"]["register_seconds"],
+        "unit": "s",
+        "vs_baseline": None,
+        # the children run on the host platform regardless of the
+        # parent's backend (a bench parent holding the chip cannot
+        # hand it to 5 subprocesses), so the row is pinned to cpu and
+        # is host-bound by construction: compile/deserialize walls
+        # scale with host CPU + filesystem, not the model math
+        "platform": "cpu",
+        "host_bound": True,
+        "serving_cold_s": s["cold"]["register_seconds"],
+        "serving_warm_s": s["warm"]["register_seconds"],
+        "serving_speedup_x": s["speedup"],
+        "serving_warm_compiles": s["warm"]["compiles"],
+        "serving_warm_causes": s["warm"]["causes"],
+        "resume_cold_s": r["cold"]["resume_seconds"],
+        "resume_warm_s": r["warm"]["resume_seconds"],
+        "resume_speedup_x": r["speedup"],
+        "resume_warm_compiles": r["warm"]["compiles"],
+        "resume_warm_fit_causes": r["warm"]["fit_causes"],
+        "resume_params_bit_identical":
+            r["warm"]["params_sha"] == r["cold"]["params_sha"],
+        "store_entries": len(report["store_contents"]),
+        "store_bytes": sum(e["bytes"]
+                           for e in report["store_contents"]),
+        "note": ("subprocess-measured (fresh interpreter per trial): "
+                 "8x384 MLP, (1,8,32) serving ladder, 2-epoch "
+                 "supervised fit killed after epoch 1. Acceptance: "
+                 "warm registration >= 5x faster than cold AND zero "
+                 "XLA compiles warm (ledger causes all cache_hit). "
+                 "Resume wall includes checkpoint restore + weight-"
+                 "init compiles, so its ratio is structurally "
+                 "smaller; the step acquisition itself shrinks from "
+                 "a >1s compile to a ~15ms deserialize"),
+    }
+
+
 ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("resnet50", bench_resnet50),
                ("resnet50_etl", bench_resnet_etl),
@@ -1483,7 +1552,8 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("precision", bench_precision),
                ("resilience", bench_resilience),
                ("trace_overhead", bench_trace_overhead),
-               ("compile_ledger", bench_compile_ledger)]
+               ("compile_ledger", bench_compile_ledger),
+               ("coldstart", bench_coldstart)]
 
 
 def _merge_bench_all(results, path="BENCH_ALL.json"):

@@ -25,8 +25,14 @@ import re
 from deeplearning4j_tpu.analysis.core import Rule, Severity, register
 from deeplearning4j_tpu.analysis.model import call_chain
 
-_CKPT_PATH = re.compile(r"ckpt|checkpoint|manifest|shard_",
-                        re.IGNORECASE)
+# "store_path"/".xc"/"executable_store" extend the protocol to the
+# ISSUE 13 persistent executable store: a torn serialized executable
+# under its real name would be deserialized by the next warm restart
+# (the payload hash rejects it, but the commit protocol is what keeps
+# the PREVIOUS good entry in place)
+_CKPT_PATH = re.compile(
+    r"ckpt|checkpoint|manifest|shard_|store_path|executable_store|\.xc\b",
+    re.IGNORECASE)
 _TMPISH = re.compile(r"tmp|temp", re.IGNORECASE)
 _PROTOCOL = {"atomic_save", "replace", "rename"}
 

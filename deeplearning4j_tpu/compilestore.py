@@ -1,0 +1,736 @@
+"""Persistent executable store: serialized AOT artifacts for zero-compile
+restarts (ISSUE 13 tentpole).
+
+Every process start pays the full XLA ladder again: a serving replica
+re-compiles every bucket of every registered model before ``ready()``,
+and a Supervisor resume re-compiles the train step it was running one
+crash earlier. This module makes "an executable is compiled once per
+signature per machine, ever" the invariant instead:
+
+- **content-addressed entries**: a compiled executable is serialized
+  (``jax.experimental.serialize_executable``) and committed under a
+  key derived from everything that determines the program — the
+  PR-11 compile-ledger abstract signature (per-leaf shapes/dtypes,
+  donation, sharding, precision/health policy label), a *program
+  digest* (the model's configuration JSON where the caller has one,
+  else the lowered HLO fingerprint), the package **code epoch** (a
+  digest of this package's own sources — a code change can never
+  serve a stale program), the jax version, the backend platform +
+  device kind, and the process XLA flags;
+- **atomic commits**: entries are written through the shared
+  ``utils/checkpoint.atomic_save`` tmp + ``os.replace`` protocol, so a
+  crash mid-write leaves a ``.tmp`` remnant, never a torn entry;
+- **reject, never serve wrong**: an entry whose magic / header /
+  payload hash / machine identity does not check out is deleted and
+  the site falls back to compile-and-overwrite — a mismatched or
+  truncated artifact is never loaded;
+- **LRU size cap**: reads bump the entry mtime; ``put`` evicts the
+  oldest entries past ``max_bytes``.
+
+Two consumption seams:
+
+- :func:`resolve` — the AOT seam (``Servable.compile_shape`` and the
+  coldstart tool): give it a lower-thunk and a signature, get back a
+  loaded executable plus ``{"store": hit|miss|reject|off, "mode":
+  compile|deserialize}`` info for the ledger's ``cache_hit`` /
+  ``cache_reject`` forensics;
+- :class:`StoredJit` — the train-step seam: wraps the jitted step the
+  fit/graph/sharded loops build, resolves each new argument signature
+  through the store, and dispatches the loaded executable directly
+  (the jit dispatch cache is a separate cache — see servable.py). A
+  warm restart's first step deserializes in milliseconds instead of
+  recompiling in seconds, which is what lets the Supervisor watchdog
+  shrink its post-resume grace.
+
+The store is OFF unless pointed at a directory — ``configure(root=...)``
+or ``DL4J_EXECUTABLE_STORE=/path`` — so default-configured processes
+(and the existing test matrix) see byte-identical behavior. Multi-host
+processes keep it off: serialized SPMD executables bake in a device
+assignment this module does not yet reconcile across process sets.
+
+Telemetry: each resolve observes ``dl4j_compile_seconds{mode}`` and the
+ledger grows matching ``cache_hit`` / ``cache_reject`` causes;
+``GET /debug/compiles`` serves :func:`describe` as its ``store``
+section. All emission is gated on the telemetry master switch — the
+store itself (disk cache) keeps working with telemetry disabled, it
+just stops narrating.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+
+ENV_ROOT = "DL4J_EXECUTABLE_STORE"
+ENV_EPOCH = "DL4J_STORE_CODE_EPOCH"
+
+_MAGIC = b"DL4JXC01"
+_FORMAT = 1
+_SUFFIX = ".xc"
+DEFAULT_MAX_BYTES = 2 << 30   # 2 GiB of serialized executables
+
+SECONDS_HELP = ("Executable acquisition seconds by mode: a real XLA "
+                "backend compile vs a deserialize from the persistent "
+                "executable store")
+
+_state = {"store": None, "configured": False}
+_lock = threading.Lock()
+_epoch_lock = threading.Lock()
+_code_epoch = None
+
+
+def configure(root=None, max_bytes=None, enabled=None):
+    """Point the process at a store directory (or disable with
+    ``enabled=False``). ``root=None`` keeps the current/env root."""
+    with _lock:
+        store = _state["store"]
+        if enabled is False:
+            _state["store"] = None
+            _state["configured"] = True
+            return None
+        if root is not None:
+            store = ExecutableStore(root, max_bytes=max_bytes
+                                    if max_bytes is not None
+                                    else DEFAULT_MAX_BYTES)
+        elif store is not None and max_bytes is not None:
+            store.max_bytes = int(max_bytes)
+        _state["store"] = store
+        _state["configured"] = True
+        return store
+
+
+def get_store():
+    """The process store, or None when unconfigured. First ask checks
+    the ``DL4J_EXECUTABLE_STORE`` env seam."""
+    store = _state["store"]
+    if store is None and not _state["configured"]:
+        with _lock:
+            if _state["store"] is None and not _state["configured"]:
+                root = os.environ.get(ENV_ROOT)
+                if root:
+                    _state["store"] = ExecutableStore(root)
+                _state["configured"] = True
+            store = _state["store"]
+    return store
+
+
+def _prewarm_epoch():
+    """Start the code-epoch stat sweep on a background thread: on slow
+    container filesystems it costs tens of ms, and computing it while
+    the caller is still initializing jax keeps it off the first
+    resolve's timed path. code_epoch() itself stays the source of
+    truth (idempotent; the GIL makes the global publish safe)."""
+    if _code_epoch is None:
+        threading.Thread(target=code_epoch, daemon=True,
+                         name="dl4j-store-epoch").start()
+
+
+def enabled() -> bool:
+    """Store is live: configured AND single-process (serialized SPMD
+    executables bake in a device assignment; multi-host reconciliation
+    is future work — documented in docs/SERVING.md)."""
+    if get_store() is None:
+        return False
+    try:
+        import jax
+
+        return jax.process_count() == 1
+    except Exception:
+        return False
+
+
+def is_warm(sites=None) -> bool:
+    """True when the store holds at least one entry — the Supervisor's
+    hint that a resume will deserialize instead of recompile. With
+    ``sites``, only entries whose recorded site starts with one of the
+    given names count (a shared store full of OTHER jobs' serving
+    ladders must not promise a train-step hit)."""
+    store = get_store()
+    if store is None or not enabled():
+        return False
+    if sites is None:
+        return bool(store.entry_count())
+    return any(s.startswith(tuple(sites)) for s in store.sites())
+
+
+def describe() -> dict:
+    """The /debug/compiles ``store`` section: hit/reject/put counters,
+    entries and bytes on disk; ``{"enabled": False}`` when off."""
+    store = get_store()
+    if store is None:
+        return {"enabled": False}
+    d = store.describe()
+    d["enabled"] = enabled()
+    return d
+
+
+def code_epoch() -> str:
+    """Digest of this package's own .py sources — (path, size,
+    mtime_ns) per file, not contents, so the first resolve costs one
+    stat sweep (~ms), not a full read+hash of the tree. A changed
+    layer/step implementation changes every key, so a stale executable
+    compiled from old code can never be served for new code; a mere
+    re-checkout that bumps mtimes costs a spurious miss, which
+    compile-and-overwrite self-heals. Overridable via
+    ``DL4J_STORE_CODE_EPOCH`` (pinned deployments that version their
+    store directory out of band)."""
+    global _code_epoch
+    if _code_epoch is not None:
+        return _code_epoch
+    with _epoch_lock:   # prewarm thread + first resolve: sweep once
+        if _code_epoch is not None:
+            return _code_epoch
+        pinned = os.environ.get(ENV_EPOCH)
+        if pinned:
+            _code_epoch = pinned
+            return _code_epoch
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        for dirpath, dirnames, filenames in sorted(os.walk(pkg)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                h.update(f"{os.path.relpath(path, pkg)}:{st.st_size}:"
+                         f"{st.st_mtime_ns}".encode())
+        _code_epoch = h.hexdigest()[:16]
+        return _code_epoch
+
+
+_machine_key = None
+
+
+def machine_key() -> dict:
+    """Everything about THIS process that changes what XLA emits for
+    the same program: jax version, backend platform, device kind, and
+    the process XLA flags. Computed once — none of it changes within
+    a process."""
+    global _machine_key
+    if _machine_key is None:
+        import jax
+
+        dev = jax.devices()[0]
+        _machine_key = {
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+            "epoch": code_epoch(),
+        }
+    return _machine_key
+
+
+def entry_key(sig, program) -> str:
+    """Content address for one executable: machine identity + program
+    digest + abstract signature, canonically serialized and hashed."""
+    ident = {
+        "machine": machine_key(),
+        "program": str(program),
+        "args": [[list(s), d] for s, d in sig.args],
+        "donation": list(sig.donation),
+        "policy": sig.policy,
+        "sharding": sig.sharding,
+    }
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class StoreReject(Exception):
+    """A store entry failed validation (bad magic/header/hash/machine)
+    and was removed; the caller compiles and overwrites."""
+
+
+class ExecutableStore:
+    """Disk half of the store: validated entry files under
+    ``root/<key[:2]>/<key>.xc``, atomic commits, LRU eviction. All
+    methods are host-side; nothing here touches a device."""
+
+    def __init__(self, root, max_bytes: int = DEFAULT_MAX_BYTES):
+        self.root = str(root)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "rejects": 0, "puts": 0,
+                      "evictions": 0, "put_failures": 0}
+        os.makedirs(self.root, exist_ok=True)
+        _prewarm_epoch()
+
+    def _store_path(self, key: str) -> str:
+        return os.path.join(self.root, key[:2], key + _SUFFIX)
+
+    def _count(self, stat):
+        with self._lock:
+            self.stats[stat] += 1
+
+    # -- entries -------------------------------------------------------------
+    def get(self, key: str):
+        """(header, payload) for a valid entry; None on miss; raises
+        :class:`StoreReject` after deleting a corrupt/stale entry —
+        mismatched artifacts are never returned."""
+        path = self._store_path(key)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            self._count("misses")
+            return None
+        try:
+            header, payload = self._validate(key, raw)
+        except StoreReject:
+            self._count("rejects")
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            raise
+        self._count("hits")
+        try:
+            os.utime(path)   # LRU: reads refresh the entry
+        except OSError:
+            pass
+        return header, payload
+
+    def _validate(self, key, raw):
+        if len(raw) < len(_MAGIC) + 4 or not raw.startswith(_MAGIC):
+            raise StoreReject("bad magic")
+        hlen = int.from_bytes(raw[8:12], "big")
+        if len(raw) < 12 + hlen:
+            raise StoreReject("truncated header")
+        try:
+            header = json.loads(raw[12:12 + hlen])
+        except ValueError as e:
+            raise StoreReject(f"unparseable header: {e}") from None
+        if header.get("format") != _FORMAT:
+            raise StoreReject(f"format {header.get('format')}")
+        if header.get("key") != key:
+            raise StoreReject("key mismatch")
+        if header.get("machine") != machine_key():
+            # stale: another jax/backend/code epoch wrote this key
+            # (possible only via hash collision or a moved store dir)
+            raise StoreReject("machine identity mismatch")
+        payload = raw[12 + hlen:]
+        if len(payload) != header.get("payload_len"):
+            raise StoreReject("truncated payload")
+        digest = hashlib.sha256(payload).hexdigest()
+        if digest != header.get("payload_sha256"):
+            raise StoreReject("payload hash mismatch")
+        return header, payload
+
+    def put(self, key: str, payload: bytes, site: str = "",
+            fingerprint=None, signature=None):
+        """Commit one serialized executable (tmp + os.replace via the
+        shared atomic_save seam), then evict past the size cap."""
+        from deeplearning4j_tpu.utils.checkpoint import atomic_save
+
+        header = {
+            "format": _FORMAT,
+            "key": key,
+            "machine": machine_key(),
+            "site": site,
+            "hlo_fingerprint": fingerprint,
+            "signature": signature,
+            "payload_len": len(payload),
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "created": round(time.time(), 3),
+        }
+        head = json.dumps(header, sort_keys=True).encode()
+        blob = _MAGIC + len(head).to_bytes(4, "big") + head + payload
+        path = self._store_path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+
+        def write(tmp):
+            with open(tmp, "wb") as f:
+                f.write(blob)
+
+        atomic_save(path, write)
+        self._count("puts")
+        self._evict()
+        return path
+
+    # -- maintenance ---------------------------------------------------------
+    def _entries(self):
+        """[(path, mtime, bytes)] for every committed entry file."""
+        out = []
+        for dirpath, _, filenames in os.walk(self.root):
+            for fn in filenames:
+                if not fn.endswith(_SUFFIX):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append((path, st.st_mtime, st.st_size))
+        return out
+
+    def _evict(self):
+        entries = self._entries()
+        total = sum(b for _, _, b in entries)
+        if total <= self.max_bytes:
+            return
+        for path, _, size in sorted(entries, key=lambda e: e[1]):
+            if total <= self.max_bytes:
+                break
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            self._count("evictions")
+
+    def entry_count(self) -> int:
+        return len(self._entries())
+
+    def sites(self) -> set:
+        """Recorded sites of every entry, from header bytes only (no
+        payload reads/validation — is_warm is a hint, not a promise;
+        a keyed get() still rejects anything invalid)."""
+        out = set()
+        for path, _, _ in self._entries():
+            try:
+                with open(path, "rb") as f:
+                    head = f.read(12)
+                    if not head.startswith(_MAGIC):
+                        continue
+                    hlen = int.from_bytes(head[8:12], "big")
+                    if hlen > (1 << 20):
+                        continue
+                    header = json.loads(f.read(hlen))
+            except (OSError, ValueError):
+                continue
+            out.add(str(header.get("site", "")))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(b for _, _, b in self._entries())
+
+    def contents(self) -> list:
+        """Header summaries of every valid entry, newest first (the
+        coldstart tool's report; corrupt entries are listed as such
+        without being deleted — only a keyed read rejects)."""
+        rows = []
+        for path, mtime, size in sorted(self._entries(),
+                                        key=lambda e: -e[1]):
+            key = os.path.basename(path)[:-len(_SUFFIX)]
+            row = {"key": key, "bytes": size,
+                   "mtime": round(mtime, 3)}
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                header, _ = self._validate(key, raw)
+                row.update(site=header.get("site"),
+                           hlo_fingerprint=header.get("hlo_fingerprint"),
+                           created=header.get("created"))
+            except (StoreReject, OSError) as e:
+                row["invalid"] = str(e)
+            rows.append(row)
+        return rows
+
+    def describe(self) -> dict:
+        with self._lock:
+            stats = dict(self.stats)
+        return {
+            "root": self.root,
+            "entries": self.entry_count(),
+            "bytes_on_disk": self.total_bytes(),
+            "max_bytes": self.max_bytes,
+            **stats,
+        }
+
+    def clear(self):
+        for path, _, _ in self._entries():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# resolution: one seam shared by the serving AOT path and StoredJit
+# ---------------------------------------------------------------------------
+
+def _observe_seconds(mode, seconds):
+    if not _registry.enabled():
+        return
+    try:
+        fam = _registry.get_registry().histogram(
+            "dl4j_compile_seconds", SECONDS_HELP, ("mode",))
+        fam.local = True   # per-host compile history: scrape-only
+        fam.labels(mode=mode).observe(seconds)
+    except Exception:
+        pass   # stub registries must not break a compile site
+
+
+def _serialize(compiled) -> bytes:
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _deserialize(payload: bytes):
+    from jax.experimental import serialize_executable as se
+
+    return se.deserialize_and_load(*pickle.loads(payload))
+
+
+def resolve(site, lower_thunk, sig, program=None):
+    """Acquire the executable for ``sig`` at ``site``: deserialize a
+    validated store entry when one exists, else compile (through
+    ``lower_thunk()``) and commit the serialized result.
+
+    ``program`` is the caller's digest of everything that determines
+    the traced program beyond the signature (a model's configuration
+    JSON + adapter label). Callers without one pass None: the lowered
+    module's HLO fingerprint is used instead — always sound, but the
+    warm path then pays a re-trace per executable.
+
+    Returns ``(executable, info)`` with info keys ``store``
+    (hit|miss|reject), ``mode`` (compile|deserialize), ``seconds``,
+    ``key``, and ``hlo_fingerprint`` when known."""
+    from deeplearning4j_tpu.telemetry import flight, hlo_audit
+
+    store = get_store()
+    if store is None or not enabled():
+        t0 = time.perf_counter()
+        exe = lower_thunk().compile()
+        seconds = time.perf_counter() - t0
+        _observe_seconds("compile", seconds)
+        return exe, {"store": "off", "mode": "compile",
+                     "seconds": seconds, "key": None,
+                     "hlo_fingerprint": None}
+    lowered = None
+    fingerprint = None
+    if program is None:
+        lowered = lower_thunk()
+        fingerprint = hlo_audit.fingerprint(lowered.as_text())
+        program = f"hlo:{fingerprint}"
+    key = entry_key(sig, program)
+    info = {"store": "miss", "mode": "compile", "key": key,
+            "hlo_fingerprint": fingerprint}
+    entry = None
+    try:
+        entry = store.get(key)
+    except StoreReject as e:
+        info["store"] = "reject"
+        info["reject_reason"] = str(e)
+        flight.record("compile_store_reject", site=site, key=key,
+                      reason=str(e))
+    if entry is not None:
+        header, payload = entry
+        t0 = time.perf_counter()
+        try:
+            exe = _deserialize(payload)
+        except Exception as e:
+            # an unloadable payload is a reject like any other: drop
+            # the entry, compile, overwrite. get() already counted the
+            # validated read as a hit — reclassify it, so one event is
+            # one stat and hits+misses+rejects reconciles with resolves
+            info["store"] = "reject"
+            info["reject_reason"] = f"deserialize: {type(e).__name__}"
+            flight.record("compile_store_reject", site=site, key=key,
+                          reason=info["reject_reason"])
+            try:
+                os.remove(store._store_path(key))
+            except OSError:
+                pass
+            with store._lock:
+                store.stats["hits"] -= 1
+                store.stats["rejects"] += 1
+        else:
+            seconds = time.perf_counter() - t0
+            info.update(store="hit", mode="deserialize",
+                        seconds=seconds,
+                        hlo_fingerprint=header.get("hlo_fingerprint"))
+            _observe_seconds("deserialize", seconds)
+            return exe, info
+    if lowered is None:
+        lowered = lower_thunk()
+        fingerprint = hlo_audit.fingerprint(lowered.as_text())
+        info["hlo_fingerprint"] = fingerprint
+    t0 = time.perf_counter()
+    exe = lowered.compile()
+    seconds = time.perf_counter() - t0
+    info["seconds"] = seconds
+    _observe_seconds("compile", seconds)
+    try:
+        store.put(key, _serialize(exe), site=site,
+                  fingerprint=info["hlo_fingerprint"],
+                  signature={"n_args": len(sig.args),
+                             "policy": sig.policy,
+                             "sharding": sig.sharding})
+    except Exception as e:
+        # a full disk / unserializable executable must not break the
+        # compile path — the site just stays cold-start-expensive
+        store._count("put_failures")
+        flight.record("compile_store_put_failure", site=site, key=key,
+                      error=f"{type(e).__name__}: {e}")
+    return exe, info
+
+
+# ---------------------------------------------------------------------------
+# StoredJit: the train-step seam
+# ---------------------------------------------------------------------------
+
+def _args_signature(args, donation, policy):
+    """The compile-ledger Signature of a concrete argument pytree
+    (single-device step sites: sharding rides in the machine key)."""
+    from deeplearning4j_tpu.telemetry import compile_ledger
+
+    return compile_ledger.signature_of(args, donation=donation,
+                                       policy=policy)
+
+
+class _ResolvedStep:
+    """One resolved (signature -> executable) slot. ``own_first`` marks
+    a DESERIALIZED executable with donation whose first call must
+    deep-clone the donated args into fresh XLA-owned buffers first:
+    jax's in-process ``Compiled`` call path copies a donated input
+    whose buffer is host-borrowed (zero-copied numpy — exactly what a
+    checkpoint-restored ``setParams`` produces on CPU), but the
+    ``deserialize_and_load`` call path does not, and donating a
+    borrowed buffer through it corrupts the shared backing store
+    (observed as a segfault on the SECOND step after a resume). After
+    the first call every chained arg is this executable's own output
+    — an XLA-owned buffer — so the clone runs exactly once."""
+
+    __slots__ = ("exe", "cloner", "donation", "own_first")
+
+    def __init__(self, exe, cloner, donation, own_first):
+        self.exe = exe
+        self.cloner = cloner
+        self.donation = donation
+        self.own_first = own_first
+
+    def __call__(self, *args):
+        if self.own_first:
+            if self.cloner is not None:
+                owned = self.cloner(*(args[i] for i in self.donation))
+                args = list(args)
+                for j, i in enumerate(self.donation):
+                    args[i] = owned[j]
+                args = tuple(args)
+            out = self.exe(*args)
+            # disarm only AFTER a successful call: a transient raise
+            # here must leave the clone armed for the caller's retry,
+            # or the retry would donate the borrowed originals
+            self.own_first = False
+            return out
+        return self.exe(*args)
+
+
+class StoredJit:
+    """Wraps one jitted step function; per-signature dispatch goes to
+    an AOT executable resolved through the store (the jit dispatch
+    cache cannot be pre-seeded — see servable.py). The steady-state
+    cost is one leaf walk to key the signature; resolution happens
+    once per signature per process.
+
+    Exposes ``lower`` (delegated) so the costmodel/ledger seams that
+    receive this object keep working unchanged."""
+
+    def __init__(self, jitted, site, program=None, policy=None,
+                 donation=(0, 1, 2)):
+        self._jitted = jitted
+        self._site = site
+        self._program = program
+        self._policy = policy
+        self._donation = tuple(donation or ())
+        self._exes = {}
+        self._last = None
+        self._resolve_lock = threading.Lock()
+
+    def lower(self, *args, **kw):
+        return self._jitted.lower(*args, **kw)
+
+    def __call__(self, *args):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+        key = tuple(
+            (tuple(getattr(x, "shape", ())),
+             str(getattr(x, "dtype", type(x).__name__)))
+            for x in leaves)
+        last = self._last
+        if last is not None and last[0] == key:
+            return last[1](*args)
+        slot = self._exes.get(key)
+        if slot is None:
+            slot = self._resolve(key, args)
+        self._last = (key, slot)
+        return slot(*args)
+
+    def _clone_exe(self, args):
+        """The donated-subtree deep-clone executable, itself resolved
+        through the store (its own entry is written on the COLD path
+        too, so a warm restart needs zero compiles even for the
+        clone)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.telemetry import compile_ledger
+
+        donated = tuple(args[i] for i in self._donation)
+        cloner = jax.jit(
+            lambda *t: jax.tree_util.tree_map(jnp.copy, t))
+        sig = compile_ledger.signature_of(donated, donation=(),
+                                          policy="own-clone")
+        exe, _ = resolve(f"{self._site}:own",
+                         lambda: cloner.lower(*donated), sig,
+                         program="own-clone:v1")
+        return exe
+
+    def _resolve(self, key, args):
+        from deeplearning4j_tpu.telemetry import compile_ledger
+
+        with self._resolve_lock:
+            slot = self._exes.get(key)
+            if slot is not None:
+                return slot
+            sig = compile_ledger.Signature(
+                args=key, donation=self._donation,
+                policy=str(self._policy or ""), sharding="")
+            exe, info = resolve(
+                self._site, lambda: self._jitted.lower(*args), sig,
+                program=self._program)
+            cloner = None
+            if self._donation:
+                try:
+                    cloner = self._clone_exe(args)
+                except Exception:
+                    cloner = None
+                if cloner is None and info["mode"] == "deserialize":
+                    # no clone executable means the deserialized
+                    # executable cannot be called safely with donation
+                    # (see _ResolvedStep): fall back to a real compile
+                    # — slower, never wrong
+                    exe = self._jitted.lower(*args).compile()
+                    info = dict(info, store="miss", mode="compile")
+            if info["store"] in ("hit", "reject"):
+                # hit: no backend compile fired, so the fit loop's
+                # note_step will not record — the ledger entry (cause
+                # cache_hit) is written here. reject: a compile DID
+                # fire; claim its thread-local seconds here so the
+                # loop's note_step cannot double-record it under a
+                # classify cause — one ledger record per event
+                compile_ledger.note_store(
+                    self._site, self, args, sig, store=info["store"],
+                    mode=info["mode"], seconds=info.get("seconds"),
+                    fingerprint=info.get("hlo_fingerprint"))
+            slot = _ResolvedStep(
+                exe, cloner, self._donation,
+                own_first=bool(self._donation)
+                and info["mode"] == "deserialize")
+            self._exes[key] = slot
+            return slot

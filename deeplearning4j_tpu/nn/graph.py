@@ -246,15 +246,31 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _policy_label(self, plan):
+        return (f"{self._precision_policy().name}"
+                f"/h{int(plan.collect)}{int(plan.skip)}")
+
     def _refresh_train_step(self):
         """(re)build the compiled step when missing or when the health
         build plan changed (see MultiLayerNetwork._refresh_train_step)."""
+        from deeplearning4j_tpu import compilestore
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = _health.build_plan(self._listeners)
         if self._train_step is None or \
                 getattr(self, "_train_step_plan", None) != plan:
-            self._train_step = self._build_train_step(plan)
+            step = self._build_train_step(plan)
+            if compilestore.enabled():
+                # ISSUE 13: warm restarts deserialize instead of
+                # recompiling (program digest = full graph conf)
+                step = compilestore.StoredJit(
+                    step, "graph",
+                    program=(f"train:ComputationGraph:"
+                             f"{self.conf.to_json()}"
+                             f":policy={self._policy_label(plan)}"),
+                    policy=self._policy_label(plan),
+                    donation=(0, 1, 2))
+            self._train_step = step
             self._train_step_plan = plan
         return plan
 
@@ -497,10 +513,7 @@ class ComputationGraph:
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = self._refresh_train_step()
-        # compile-ledger policy label (ISSUE 11): precision policy +
-        # health build plan, both compiled into the step
-        policy_label = (f"{self._precision_policy().name}"
-                        f"/h{int(plan.collect)}{int(plan.skip)}")
+        policy_label = self._policy_label(plan)
         params, states, opts = self._params, self._states, self._opt_states
         prec = self._prec_state
         base_key = jax.random.key(self.conf.seed + 1)

@@ -287,16 +287,41 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _policy_label(self, plan):
+        """The compile-ledger/executable-store policy label: precision
+        policy + health build plan, both compiled INTO the step — a
+        change in either recompiles, and forensics should name it
+        policy_change."""
+        return (f"{self._precision_policy().name}"
+                f"/h{int(plan.collect)}{int(plan.skip)}")
+
+    def _step_program(self, plan, kind="train"):
+        """Executable-store program digest: the configuration JSON is
+        the full architecture + updater spec (weights are arguments),
+        and the policy label covers what else is compiled in."""
+        return (f"{kind}:MultiLayerNetwork:{self.conf.to_json()}"
+                f":policy={self._policy_label(plan)}")
+
     def _refresh_train_step(self):
         """(re)build the compiled step when missing or when the health
         build plan changed (telemetry/health toggled, policy changed) —
         the plan is compiled into the step, so it must invalidate."""
+        from deeplearning4j_tpu import compilestore
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = _health.build_plan(self._listeners)
         if self._train_step is None or \
                 getattr(self, "_train_step_plan", None) != plan:
-            self._train_step = self._build_train_step(plan)
+            step = self._build_train_step(plan)
+            if compilestore.enabled():
+                # ISSUE 13: a warm restart's first step deserializes
+                # this signature's executable from the persistent
+                # store (milliseconds) instead of recompiling
+                step = compilestore.StoredJit(
+                    step, "fit", program=self._step_program(plan),
+                    policy=self._policy_label(plan),
+                    donation=(0, 1, 2))
+            self._train_step = step
             self._train_step_plan = plan
         return plan
 
@@ -352,7 +377,17 @@ class MultiLayerNetwork:
             self._multi_step = {}
         key = (repeats, plan)
         if key not in self._multi_step:
-            self._multi_step[key] = self._build_multi_step(repeats, plan)
+            many = self._build_multi_step(repeats, plan)
+            from deeplearning4j_tpu import compilestore
+
+            if compilestore.enabled():
+                many = compilestore.StoredJit(
+                    many, "fit:multi",
+                    program=self._step_program(plan, kind="multi")
+                    + f":repeats={repeats}",
+                    policy=self._policy_label(plan),
+                    donation=(0, 1, 2))
+            self._multi_step[key] = many
         # keep device-resident stacks on device (a _host_array bounce
         # would round-trip the whole [K,B,...] block D2H then H2D)
         f_k = _unwrap(features_k) if isinstance(
@@ -482,11 +517,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = self._refresh_train_step()
-        # the compile-ledger policy label: precision policy + the health
-        # build plan, both compiled INTO the step — a change in either
-        # recompiles, and forensics should name it policy_change
-        policy_label = (f"{self._precision_policy().name}"
-                        f"/h{int(plan.collect)}{int(plan.skip)}")
+        policy_label = self._policy_label(plan)
         data, _prefetcher = self._wrap_prefetch(data)
         params, states, opts = self._params, self._states, self._opt_states
         prec = self._prec_state

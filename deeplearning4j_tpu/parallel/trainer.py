@@ -256,7 +256,26 @@ class ShardedTrainer:
             self.place_params()
         plan = _health.build_plan(net._listeners)
         if self._step_fn is None or self._step_plan != plan:
-            self._step_fn = self._build_step(plan)
+            step = self._build_step(plan)
+            from deeplearning4j_tpu import compilestore
+
+            if compilestore.enabled():
+                # ISSUE 13: the mesh topology is part of the program
+                # digest — a sharded executable bakes in its device
+                # assignment, so a differently-shaped mesh must miss
+                step = compilestore.StoredJit(
+                    step, "sharded",
+                    program=(f"train:ShardedTrainer:"
+                             f"{net.conf.to_json()}"
+                             f":mesh={sorted(self.mesh.shape.items())}"
+                             f":ndev={self.mesh.devices.size}"
+                             f":specs={self.param_specs!r}"
+                             f":policy={net._precision_policy().name}"
+                             f"/h{int(plan.collect)}{int(plan.skip)}"),
+                    policy=(f"{net._precision_policy().name}"
+                            f"/h{int(plan.collect)}{int(plan.skip)}"),
+                    donation=(0, 1, 2))
+            self._step_fn = step
             self._step_plan = plan
         data, _prefetcher = self._wrap_prefetch(data)
         assemble = (host_sharded_batch

@@ -39,7 +39,7 @@ import time
 from deeplearning4j_tpu.telemetry.health import DivergenceError
 
 __all__ = ["Supervisor", "SupervisorConfig", "RestartBudgetExceeded",
-           "Watchdog", "status"]
+           "Watchdog", "status", "resume_grace"]
 
 RESTARTS_HELP = ("Supervised training restarts by reason "
                  "(preemption|stall|divergence|exception)")
@@ -175,6 +175,33 @@ class Watchdog:
             return
 
 
+# executable-store sites that hold TRAIN-step executables — what a
+# supervised resume actually needs warm (serving ladders don't count)
+TRAIN_STEP_SITES = ("fit", "graph", "sharded")
+
+
+def resume_grace(cfg):
+    """The watchdog's pre-first-iteration grace for one attempt.
+    ``cfg.stall_warmup`` wins when set; otherwise a WARM executable
+    store tightens the default (ISSUE 13): the post-resume "recompile"
+    is a deserialize (milliseconds), so granting the default 30 s
+    compile allowance would let a genuinely stalled resume hide inside
+    it — the grace drops to the ordinary stall timeout (floor 5 s for
+    checkpoint-restore I/O). Warmth is judged on TRAIN-step entries
+    only, and the run loop falls back to the cold grace after a
+    warmup-phase stall (a store that misses anyway — config changed,
+    shared dir holding someone else's program — costs at most one
+    restart, never the whole budget). None lets the Watchdog apply its
+    cold default of ``max(timeout, 30)``."""
+    if cfg.stall_warmup is not None:
+        return cfg.stall_warmup
+    from deeplearning4j_tpu import compilestore
+
+    if compilestore.is_warm(sites=TRAIN_STEP_SITES):
+        return max(float(cfg.stall_timeout), 5.0)
+    return None
+
+
 class Supervisor:
     """Run a checkpointed fit to completion across failures.
 
@@ -212,6 +239,11 @@ class Supervisor:
         from deeplearning4j_tpu.resilience import async_ckpt
 
         async_ckpt._ensure_provider()
+        # start the executable store's code-epoch sweep now (background,
+        # no-op when unconfigured): a resume should find it ready
+        from deeplearning4j_tpu import compilestore
+
+        compilestore.get_store()
 
     # -- metrics -------------------------------------------------------------
     def _count_restart(self, reason, step):
@@ -257,19 +289,30 @@ class Supervisor:
         wrapped = self.faults.wrap_data(data) if self.faults else data
         _set_status(state="starting", restarts=0, last_reason=None,
                     max_restarts=cfg.max_restarts)
+        # a stall BEFORE the first iteration means the warm-store
+        # tightened grace (resume_grace) was wrong for this program —
+        # the store missed and the step really was compiling; the next
+        # attempt reverts to the cold grace so a misjudged hint costs
+        # one restart, not the budget
+        warmup_stalled = False
         while True:
+            from deeplearning4j_tpu import compilestore
+
             trainer, resumed = self._build_trainer()
             net = trainer.net
             if resumed:
                 flight.record("resume", step=net._iteration,
-                              attempt=self.restarts + 1)
+                              attempt=self.restarts + 1,
+                              store_warm=compilestore.is_warm())
             wd = None
             prior = list(net._listeners)
             if cfg.stall_timeout:
+                grace = cfg.stall_warmup if warmup_stalled \
+                    else resume_grace(cfg)
                 wd = Watchdog(cfg.stall_timeout, cfg.stall_poll,
                               abort_event=(self.faults.abort_event
                                            if self.faults else None),
-                              warmup_grace=cfg.stall_warmup)
+                              warmup_grace=grace)
                 net.setListeners(*(prior + [wd.listener()]))
                 wd.start()
             _set_status(state="running", restarts=self.restarts,
@@ -301,6 +344,8 @@ class Supervisor:
             finally:
                 if wd is not None:
                     wd.stop()
+                    warmup_stalled = (wd.stalled
+                                      and not wd._seen_progress)
                 net.setListeners(*prior)
                 if self.faults is not None:
                     self.faults.abort_event.clear()

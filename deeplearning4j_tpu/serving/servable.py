@@ -145,12 +145,22 @@ class Servable:
     def _ledger_site(self) -> str:
         return self.cost_label or f"servable:{type(self).__name__}"
 
-    def _note_compiled(self, shape, exe, seconds):
+    def _program_digest(self):
+        """Digest of everything beyond the input signature that
+        determines the traced program, when the adapter can state it
+        (a network's configuration JSON). None means the executable
+        store falls back to the lowered HLO fingerprint — always
+        sound, but the warm path then pays a re-trace."""
+        return None
+
+    def _note_compiled(self, shape, exe, seconds, info=None):
         """Publish one freshly-built bucket executable: cost/memory
         attribution (ISSUE 10 — registry-named servables only, the
         gauges key on cost_label) plus a compile-ledger record with the
         eager HLO audit (ISSUE 11 — every servable: warmup is the one
-        place the Compiled object is in hand)."""
+        place the Compiled object is in hand). ``info`` is the
+        executable-store outcome (ISSUE 13): a ``hit`` ledgers as
+        ``cache_hit``, a ``reject`` as ``cache_reject``."""
         from deeplearning4j_tpu import telemetry
 
         if not telemetry.enabled():
@@ -160,24 +170,50 @@ class Servable:
         if self.cost_label is not None:
             label = f"{self.cost_label}:{'x'.join(str(d) for d in shape)}"
             costmodel.executable_cost(label, exe)
+        info = info or {}
         compile_ledger.record_executable(
             self._ledger_site(), exe, ((shape, str(self.dtype)),),
             seconds=seconds, bucketed=True,
-            sharding="" if self.device is None else str(self.device))
+            sharding="" if self.device is None else str(self.device),
+            store=info.get("store"), mode=info.get("mode", "compile"),
+            fingerprint=info.get("hlo_fingerprint"))
 
     # -- AOT warmup ---------------------------------------------------------
+    def _lower_shape(self, shape):
+        """Lower the inference function for one concrete input shape
+        (subclasses with a different lowering arg order override)."""
+        spec = self._input(self._input_spec(shape))
+        return self._jit_fn().lower(*self._placed_args(), spec)
+
+    def _store_sig(self, shape):
+        from deeplearning4j_tpu.telemetry import compile_ledger
+
+        return compile_ledger.Signature(
+            args=((tuple(shape), str(self.dtype)),), donation=(),
+            policy="",
+            sharding="" if self.device is None else str(self.device))
+
     def compile_shape(self, shape: tuple):
-        """Lower + compile the inference function for one concrete input
-        shape (idempotent)."""
+        """Acquire the inference executable for one concrete input
+        shape (idempotent): deserialize it from the persistent
+        executable store when warm (ISSUE 13 — zero XLA compiles on a
+        warm restart), else lower + compile (and commit the serialized
+        result for the next process)."""
         import time as _time
+
+        from deeplearning4j_tpu import compilestore
 
         shape = tuple(shape)
         if shape in self._compiled:
             return self._compiled[shape]
-        spec = self._input(self._input_spec(shape))
         t0 = _time.perf_counter()
-        exe = self._jit_fn().lower(*self._placed_args(), spec).compile()
-        self._note_compiled(shape, exe, _time.perf_counter() - t0)
+        if compilestore.enabled():
+            exe, info = compilestore.resolve(
+                self._ledger_site(), lambda: self._lower_shape(shape),
+                self._store_sig(shape), program=self._program_digest())
+        else:
+            exe, info = self._lower_shape(shape).compile(), None
+        self._note_compiled(shape, exe, _time.perf_counter() - t0, info)
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]
@@ -230,6 +266,12 @@ class NetworkServable(Servable):
     def _call_args(self):
         return (self.net._params, self.net._states)
 
+    def _program_digest(self):
+        # the configuration JSON is the full architecture (weights are
+        # call args, not constants): same conf + dtype => same program
+        return (f"infer:MultiLayerNetwork:{self.net.conf.to_json()}"
+                f":dtype={self.dtype}")
+
 
 class GraphServable(Servable):
     """ComputationGraph (single input / single output)."""
@@ -267,6 +309,10 @@ class GraphServable(Servable):
     def _input(self, x):
         return {self._in: x}
 
+    def _program_digest(self):
+        return (f"infer:ComputationGraph:{self.graph.conf.to_json()}"
+                f":in={self._in}:out={self._out}:dtype={self.dtype}")
+
 
 class SameDiffServable(Servable):
     """SameDiff graph: serve one placeholder -> one output variable."""
@@ -297,20 +343,11 @@ class SameDiffServable(Servable):
     def _output(self, y):
         return _np(y[self.output_name])
 
-    def compile_shape(self, shape):
-        import time as _time
-
-        shape = tuple(shape)
-        if shape in self._compiled:
-            return self._compiled[shape]
+    def _lower_shape(self, shape):
+        # SameDiff's traced fn takes the input dict FIRST
         params, consts, rng = self._placed_args()
         spec = self._input(self._input_spec(shape))
-        t0 = _time.perf_counter()
-        exe = self._jit_fn().lower(spec, params, consts, rng).compile()
-        self._note_compiled(shape, exe, _time.perf_counter() - t0)
-        with self._lock:
-            self._compiled.setdefault(shape, exe)
-        return self._compiled[shape]
+        return self._jit_fn().lower(spec, params, consts, rng)
 
     def infer(self, x):
         x = np.ascontiguousarray(x, dtype=self.dtype)

@@ -47,6 +47,13 @@ class InferenceSession:
         self._instruments: dict = {}   # per-model bundle, built once
         self._lock = threading.Lock()
         self._closed = False
+        # a session exists to compile-and-serve: touching the
+        # executable store now starts its code-epoch sweep in the
+        # background, off the first warmup's timed path (no-op when
+        # the store is unconfigured)
+        from deeplearning4j_tpu import compilestore
+
+        compilestore.get_store()
 
     # -- registry passthrough ------------------------------------------------
     def register(self, name, model, replicas=None, devices=None, **kw):

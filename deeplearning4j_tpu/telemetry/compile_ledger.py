@@ -59,7 +59,10 @@ CAUSE_HELP = ("Compile-ledger records by step/serving site and "
               "forensic cause (first_compile|new_bucket|"
               "shape_change(dim=N)|shape_change(rank)|dtype_change|"
               "donation_change|policy_change|sharding_change|rewarm|"
-              "unknown)")
+              "cache_hit|cache_reject|unknown). cache_hit = the "
+              "executable was deserialized from the persistent store "
+              "(zero XLA compiles); cache_reject = a corrupt/stale "
+              "store entry was dropped and the site recompiled")
 
 _state = {"enabled": True, "ledger": None}
 _lock = threading.Lock()
@@ -270,7 +273,7 @@ class CompileLedger:
 
     # -- recording -----------------------------------------------------------
     def _new_record(self, st, site, sig, cause, changed, kind, seconds,
-                    fingerprint, flops):
+                    fingerprint, flops, mode="compile", store=None):
         st["seq"] += 1
         # ':' not '#': these keys ride in /debug/hlo/<key> URLs, and a
         # '#' would be stripped client-side as a fragment
@@ -278,6 +281,7 @@ class CompileLedger:
         rec = {
             "key": key, "site": site, "seq": st["seq"],
             "ts": round(time.time(), 6), "kind": kind, "cause": cause,
+            "mode": mode, "store": store,
             "changed": list(changed),
             "compile_seconds": (round(seconds, 6)
                                 if seconds is not None else None),
@@ -345,19 +349,59 @@ class CompileLedger:
         self._emit(rec, window)
         return rec
 
-    def record_executable(self, site, compiled, sig, seconds=None,
-                          bucketed=True, window=None):
-        """One AOT-compiled executable (serving warmup seam, hloaudit
-        CLI): the Compiled object is in hand, so the audit and the
-        optimized-HLO fingerprint are captured eagerly."""
-        audit = None
-        try:
-            audit = hlo_audit.audit_compiled(compiled)
-        except Exception:
-            audit = None
+    def observe_store(self, site, jitted, args, sig, cause, mode,
+                      seconds=None, fingerprint=None):
+        """One store-resolved train-step executable (StoredJit seam):
+        a ``cache_hit`` fires no backend-compile event, so the loop's
+        ``note_step`` stays silent and the forensic record is written
+        here; a ``cache_reject`` records the recompile under its store
+        cause (the StoredJit caller has already claimed the compile
+        seconds off the thread buffer, so ``note_step`` cannot
+        double-record it)."""
         with self._lock:
             st = self._site(site)
-            if sig in st["seen"]:
+            ref = st["fn_ref"]
+            if ref is None or ref() is not jitted:
+                st["seen"] = {}
+                st["fn_ref"] = weakref.ref(jitted)
+            store = "hit" if cause == "cache_hit" else "reject"
+            rec = self._new_record(st, site, sig, cause, [], "step",
+                                   seconds, fingerprint, None,
+                                   mode=mode, store=store)
+        try:
+            self._lazy[rec["key"]] = (weakref.ref(jitted),
+                                      _abstract_args(args))
+        except Exception:
+            pass
+        self._emit(rec)
+        return rec
+
+    def record_executable(self, site, compiled, sig, seconds=None,
+                          bucketed=True, window=None, store=None,
+                          mode="compile", fingerprint=None):
+        """One AOT-compiled executable (serving warmup seam, hloaudit
+        CLI): the Compiled object is in hand, so the audit and the
+        optimized-HLO fingerprint are captured eagerly. ``store``/
+        ``mode`` carry the executable-store outcome: a ``hit`` is
+        recorded as ``cache_hit`` (the rewarm/new-bucket taxonomy
+        names *re*compiles — a deserialize is neither), a ``reject``
+        as ``cache_reject``. Store hits skip the eager HLO audit —
+        parsing the module text would put compile-scale work back on
+        the warm path the store exists to remove; /debug/hlo audits
+        the retained executable on demand instead."""
+        audit = None
+        if store != "hit":
+            try:
+                audit = hlo_audit.audit_compiled(compiled)
+            except Exception:
+                audit = None
+        with self._lock:
+            st = self._site(site)
+            if store == "hit":
+                cause, changed = "cache_hit", []
+            elif store == "reject":
+                cause, changed = "cache_reject", []
+            elif sig in st["seen"]:
                 cause, changed = "rewarm", []
                 st["last"] = sig
             else:
@@ -365,9 +409,17 @@ class CompileLedger:
                                           bucketed=bucketed)
             rec = self._new_record(
                 st, site, sig, cause, changed, "aot", seconds,
-                (audit or {}).get("hlo_fingerprint"),
-                (audit or {}).get("flops"))
+                (audit or {}).get("hlo_fingerprint") or fingerprint,
+                (audit or {}).get("flops"), mode=mode, store=store)
             rec["audit"] = audit
+            if audit is None:
+                try:
+                    # lazy direct-audit handle (store hits): avals=None
+                    # marks "audit the retained executable itself"
+                    self._lazy[rec["key"]] = (weakref.ref(compiled),
+                                              None)
+                except Exception:
+                    pass
         self._emit(rec, window)
         return rec
 
@@ -450,8 +502,13 @@ class CompileLedger:
         if jitted is None:
             return {"error": "step function was garbage-collected"}
         try:
-            audit = hlo_audit.audit_compiled(
-                jitted.lower(*avals).compile())
+            if avals is None:
+                # store-hit AOT record: the retained executable is
+                # audited directly (no relowering to do)
+                audit = hlo_audit.audit_compiled(jitted)
+            else:
+                audit = hlo_audit.audit_compiled(
+                    jitted.lower(*avals).compile())
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
         with self._lock:
@@ -491,15 +548,40 @@ def note_step(site, jitted, args, policy=None, donation=(0, 1, 2),
                                      seconds=seconds, window=window)
 
 
+def note_store(site, jitted, args, sig, store, mode, seconds=None,
+               fingerprint=None):
+    """The StoredJit seam (compilestore): a store ``hit`` writes the
+    ``cache_hit`` forensic record the silent monitoring hook cannot
+    (deserializing fires no backend compile); a ``reject`` claims the
+    recompile's thread-local seconds and records ``cache_reject`` —
+    so the loop's later ``note_step`` finds an empty buffer and one
+    event yields exactly one ledger record."""
+    if not enabled():
+        return None
+    if store == "reject":
+        consumed = consume_backend_compiles()
+        if consumed is not None:
+            seconds = consumed
+        cause = "cache_reject"
+    else:
+        cause = "cache_hit"
+    return get_ledger().observe_store(site, jitted, args, sig, cause,
+                                      mode, seconds=seconds,
+                                      fingerprint=fingerprint)
+
+
 def record_executable(site, compiled, args_sig, seconds=None,
                       donation=(), policy=None, sharding=None,
-                      bucketed=True):
+                      bucketed=True, store=None, mode="compile",
+                      fingerprint=None):
     """The AOT seam (Servable.compile_shape, tools/hloaudit.py):
     ``args_sig`` is the abstract input signature as ((shape, dtype),
     ...) leaves. Backend-compile events pending on this thread are
     consumed and preferred over the caller's wall-clock ``seconds``
     (the wall includes lowering; a cache-hit rebuild has no events and
-    keeps the tiny wall, which is the honest number)."""
+    keeps the tiny wall, which is the honest number). ``store``/
+    ``mode``/``fingerprint`` carry the executable-store outcome when
+    the site resolved through compilestore."""
     if not enabled():
         return None
     consumed = consume_backend_compiles()
@@ -512,7 +594,9 @@ def record_executable(site, compiled, args_sig, seconds=None,
         sharding=str(sharding or ""))
     return get_ledger().record_executable(site, compiled, sig,
                                           seconds=seconds,
-                                          bucketed=bucketed)
+                                          bucketed=bucketed,
+                                          store=store, mode=mode,
+                                          fingerprint=fingerprint)
 
 
 # ---------------------------------------------------------------------------

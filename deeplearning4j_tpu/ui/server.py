@@ -155,16 +155,22 @@ class _Handler(BaseHTTPRequestHandler):
             # the compile ledger (ISSUE 11): every train-step compile
             # and AOT serving warmup, newest first, with forensic cause
             # + compile seconds + HLO fingerprint; ?site= filters.
-            # Read-only and served whether or not telemetry is
-            # currently enabled (incident dumps outlive a disable())
+            # ISSUE 13 adds the executable-store section (hits/rejects/
+            # bytes on disk). Read-only and served whether or not
+            # telemetry is currently enabled (incident dumps outlive a
+            # disable())
             from urllib.parse import parse_qs, urlsplit
 
+            from deeplearning4j_tpu import compilestore
             from deeplearning4j_tpu.telemetry import compile_ledger
 
             query = parse_qs(urlsplit(self.path).query)
             site = (query.get("site") or [None])[0]
-            body = json.dumps(
-                compile_ledger.get_ledger().describe(site=site)).encode()
+            body = json.dumps({
+                "records": compile_ledger.get_ledger().describe(
+                    site=site),
+                "store": compilestore.describe(),
+            }).encode()
             self._respond(body)
             return
         elif self.path.startswith("/debug/traces"):

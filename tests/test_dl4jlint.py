@@ -496,6 +496,29 @@ class TestAtomicCommitRule:
         """
         assert len(rules_of(lint(tmp_path, src), "atomic-commit")) == 1
 
+    def test_flags_raw_executable_store_write(self, tmp_path):
+        # ISSUE 13 satellite: the rule must see the executable-store
+        # write path — a raw open() committing a serialized executable
+        # under its real .xc name bypasses the tmp+replace protocol
+        src = """
+            def save_entry(root, key, blob):
+                with open(root + "/" + key + ".xc", "wb") as f:
+                    f.write(blob)
+        """
+        assert len(rules_of(lint(tmp_path, src), "atomic-commit")) == 1
+
+    def test_near_miss_store_write_via_atomic_save(self, tmp_path):
+        clean = """
+            from deeplearning4j_tpu.utils.checkpoint import atomic_save
+
+            def save_entry(root, key, blob):
+                def write(tmp):
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                atomic_save(root + "/" + key + ".xc", write)
+        """
+        assert rules_of(lint(tmp_path, clean), "atomic-commit") == []
+
     def test_near_miss_tmp_replace_protocol(self, tmp_path):
         clean = """
             import os
