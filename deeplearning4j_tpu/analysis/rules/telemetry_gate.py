@@ -13,11 +13,12 @@ an explicit ``if telemetry.enabled():`` before ``get_registry()``, or
 and gate internally and hand back None/NULL contexts the hot path
 guards on.
 
-This rule flags a raw ``get_registry()`` or ``get_tracer()`` call in a
-function (outside ``telemetry/`` itself and the analyzer) that
-contains no ``enabled()``/sampler-gate check — the class of drift that
-silently re-introduces per-step observability overhead on the disabled
-path.
+This rule flags a raw ``get_registry()``, ``get_tracer()``, or
+``get_memledger()`` (ISSUE 14: the HBM ownership ledger's raw handle)
+call in a function (outside ``telemetry/`` itself and the analyzer)
+that contains no ``enabled()``/sampler-gate check — the class of drift
+that silently re-introduces per-step observability overhead on the
+disabled path.
 """
 
 from __future__ import annotations
@@ -36,8 +37,19 @@ _TRACER_GATES = {"enabled", "enable",
                  # caller guards on
                  "start_trace", "trace_or_span", "current",
                  "current_ids", "sample_interval"}
+# memledger gates (ISSUE 14): `claim()`/`claim_for_owner()` gate
+# internally (None when disabled — the registrars' idiom); the
+# error/planner surfaces (raise_if_oom / oom_error / plan_capacity)
+# are error-path or admission-time, never steady-state emission, so
+# they gate too. NOT in the set: bare generic names like `release` —
+# `lock.release()` is pervasive in this codebase and would silently
+# un-flag real ungated emissions (gates match on the final call name)
+_MEMLEDGER_GATES = {"enabled", "enable", "claim", "claim_for_owner",
+                    "raise_if_oom", "oom_error", "plan_capacity",
+                    "release_prefix"}
 _EMITTER_GATES = {"get_registry": _REGISTRY_GATES,
-                  "get_tracer": _TRACER_GATES}
+                  "get_tracer": _TRACER_GATES,
+                  "get_memledger": _MEMLEDGER_GATES}
 _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 
 
@@ -45,10 +57,10 @@ _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 class TelemetryGateRule(Rule):
     name = "telemetry-gate"
     severity = Severity.ERROR
-    description = ("get_registry()/get_tracer() in a function with no "
-                   "enabled()/sampler gate — breaks the zero-"
-                   "observability-calls-when-disabled contract "
-                   "(PR 1, PR 10)")
+    description = ("get_registry()/get_tracer()/get_memledger() in a "
+                   "function with no enabled()/sampler gate — breaks "
+                   "the zero-observability-calls-when-disabled "
+                   "contract (PR 1, PR 10, PR 14)")
 
     def check_module(self, mod, project):
         rel = mod.rel

@@ -62,6 +62,20 @@ class DeviceBatch:
         self.real = real
 
 
+def _staged_bytes(staged) -> int:
+    """Device bytes one staged batch pins (DeviceBatch or DataSet)."""
+    from deeplearning4j_tpu.telemetry import memledger
+
+    if isinstance(staged, DeviceBatch):
+        return memledger.tree_bytes(
+            (staged.features, staged.labels, staged.mask))
+    try:
+        return memledger.tree_bytes(
+            (staged.getFeatures(), staged.getLabels()))
+    except Exception:
+        return 0
+
+
 class DevicePrefetcher(DataSetIterator):
     """Background host->device staging around any DataSetIterator.
 
@@ -97,6 +111,7 @@ class DevicePrefetcher(DataSetIterator):
         self._closed = False
         self._tele = None
         self._tele_bound = False
+        self._mem_claim = None   # HBM ledger claim for staged batches
 
     # -- delegation ----------------------------------------------------------
     def getLabels(self):
@@ -143,9 +158,13 @@ class DevicePrefetcher(DataSetIterator):
     def _produce(self, gen, q, trace_ctx):
         import time as _time
 
-        from deeplearning4j_tpu.telemetry import tracing
+        from deeplearning4j_tpu.telemetry import memledger, tracing
 
         prepare = self._prepare or self._default_prepare
+        # one flag check per producer generation (the loop_instruments
+        # idiom): with telemetry disabled the loop body never computes
+        # staged bytes nor touches the ledger
+        claim_pending = memledger.enabled()
         try:
             # the consumer's sampled trace context (captured at _start)
             # becomes current on THIS producer thread, so base-iterator
@@ -169,6 +188,17 @@ class DevicePrefetcher(DataSetIterator):
                             and isinstance(staged, DeviceBatch):
                         staged.features = self._device_transform(
                             staged.features)
+                    if claim_pending:
+                        # HBM ledger (ISSUE 14): up to depth + 1 staged
+                        # device batches are pinned by this prefetcher
+                        # (depth queued + one in flight) — a capacity
+                        # claim stated once per producer generation
+                        claim_pending = False
+                        self._mem_claim = memledger.claim(
+                            "prefetch", self._loop,
+                            nbytes=(_staged_bytes(staged)
+                                    * (self._depth + 1)),
+                            depth=self._depth, basis="depth x batch")
                     if trace_ctx is not None:
                         tracing.emit("prefetch.prepare", trace_ctx,
                                      t_prep, _time.perf_counter(),
@@ -181,7 +211,17 @@ class DevicePrefetcher(DataSetIterator):
                             continue
         except Exception as e:  # surfaced at next()
             if self._gen == gen:
-                self._error = e
+                # the comment above is load-bearing: an OOM in
+                # device_put here IS a real bug — route it through the
+                # typed DeviceOomError + flight `oom` forensics
+                # (ISSUE 14 satellite) instead of a generic prepare
+                # error, so the consumer's next() names the site, the
+                # requested bytes, and the top HBM claims
+                from deeplearning4j_tpu.telemetry import memledger
+
+                self._error = memledger.oom_error(
+                    e, site="prefetch.device_put",
+                    loop=self._loop) or e
         finally:
             while self._gen == gen:
                 try:
@@ -217,6 +257,11 @@ class DevicePrefetcher(DataSetIterator):
                 t.join(timeout=0.05)
         self._thread = None
         self._queue = None
+        if self._mem_claim is not None:
+            # the staged buffers are dropped with the queue: the claim
+            # goes with them (restated by the next producer generation)
+            self._mem_claim.release()
+            self._mem_claim = None
 
     # -- consumer ------------------------------------------------------------
     def hasNext(self):

@@ -533,8 +533,16 @@ class ComputationGraph:
         # sampled trace root + step-time-throttled XLA cost attribution
         # (ISSUE 10) — the MultiLayerNetwork.fit treatment, graph loop
         from deeplearning4j_tpu.telemetry import (
-            compile_ledger, costmodel, tracing)
+            compile_ledger, costmodel, memledger, tracing)
         import sys as _sys
+
+        # HBM ownership claim (ISSUE 14): same contract as the
+        # multilayer loop — per-net key, None when disabled, one
+        # gauge-set per step
+        mem = None if tele is None else memledger.claim_for_owner(
+            self, "train", "graph",
+            tree={"p": params, "s": states, "o": opts, "prec": prec},
+            model=type(self).__name__)
 
         tspan = tracing.trace_or_span("train.graph", loop="graph")
         tspan.__enter__()
@@ -570,22 +578,33 @@ class ComputationGraph:
                                      for v in inputs.values()))
                     if tele is not None:
                         t_step = _time.perf_counter()
-                    if tbptt:
-                        loss, params, states, opts, prec = self._fit_tbptt(
-                            params, states, opts, prec, inputs, labels,
-                            masks, base_key, hm=hm, pm=pm)
-                    else:
-                        it_used = self._iteration
-                        rng = jax.random.fold_in(base_key, it_used)
-                        (loss, params, states, opts, health,
-                         prec) = self._train_step(
-                            params, states, opts, prec, inputs, labels,
-                            masks, rng, it_used)
-                        self._iteration += 1
+                    try:
+                        if tbptt:
+                            loss, params, states, opts, prec = \
+                                self._fit_tbptt(
+                                    params, states, opts, prec, inputs,
+                                    labels, masks, base_key, hm=hm, pm=pm)
+                        else:
+                            it_used = self._iteration
+                            rng = jax.random.fold_in(base_key, it_used)
+                            (loss, params, states, opts, health,
+                             prec) = self._train_step(
+                                params, states, opts, prec, inputs,
+                                labels, masks, rng, it_used)
+                            self._iteration += 1
+                    except Exception as e:
+                        # OOM forensics (ISSUE 14): typed error + flight
+                        # event naming this seam and the top HBM claims
+                        memledger.raise_if_oom(e, site="train.graph",
+                                               step=self._iteration)
+                        raise
                     if tele is not None:
                         dt_step = _time.perf_counter() - t_step
                         tele.record_step(dt_step, n,
                                          exemplar=tspan.trace_id)
+                        if mem is not None:
+                            # steady state: ONE gauge-set per step
+                            mem.touch()
                         if tspan and not tbptt:
                             tracing.emit("train.step", tspan.ctx(),
                                          t_step, t_step + dt_step,

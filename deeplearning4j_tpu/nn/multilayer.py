@@ -409,11 +409,18 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.telemetry import costmodel
 
         t_launch = _time.perf_counter() if telemetry.enabled() else None
-        (losses, self._params, self._states, self._opt_states, healths,
-         self._prec_state) = self._multi_step[key](
-                self._params, self._states, self._opt_states,
-                self._prec_state, f_k, l_k, m_k, rng0,
-                jnp.asarray(self._iteration, jnp.int32))
+        try:
+            (losses, self._params, self._states, self._opt_states,
+             healths, self._prec_state) = self._multi_step[key](
+                    self._params, self._states, self._opt_states,
+                    self._prec_state, f_k, l_k, m_k, rng0,
+                    jnp.asarray(self._iteration, jnp.int32))
+        except Exception as e:
+            from deeplearning4j_tpu.telemetry import memledger
+
+            memledger.raise_if_oom(e, site="train.fitMultiBatch",
+                                   step=self._iteration)
+            raise
         self._iteration += int(f_k.shape[0]) * repeats
         self._score = float(losses[-1])
         if t_launch is not None:
@@ -513,7 +520,7 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu import telemetry
         from deeplearning4j_tpu.datasets.prefetch import DeviceBatch
         from deeplearning4j_tpu.telemetry import (
-            compile_ledger, costmodel, tracing)
+            compile_ledger, costmodel, memledger, tracing)
         from deeplearning4j_tpu.telemetry import health as _health
 
         plan = self._refresh_train_step()
@@ -526,6 +533,15 @@ class MultiLayerNetwork:
         # one flag check per fit(): with telemetry disabled tele is None
         # and the loop body makes zero registry calls per step
         tele = telemetry.loop_instruments("fit")
+        # HBM ownership claim (ISSUE 14): params + updater state +
+        # loss-scale state, keyed to THIS net (two nets fitting through
+        # the same loop label must not re-state one claim). None when
+        # disabled — the loop guards on the handle, so the per-step
+        # touch() (ONE gauge-set) compiles out
+        mem = None if tele is None else memledger.claim_for_owner(
+            self, "train", "fit",
+            tree={"p": params, "s": states, "o": opts, "prec": prec},
+            model=type(self).__name__)
         # same contract for health: hm is None when health/telemetry is
         # off, and the jitted step then returns no health array at all
         hm = _health.monitor_for("fit", self._layer_labels(),
@@ -602,22 +618,35 @@ class MultiLayerNetwork:
                              and f.shape[2] > self.conf.tbpttLength)
                     if tele is not None:
                         t_step = _time.perf_counter()
-                    if tbptt:
-                        loss, params, states, opts, prec = self._fit_tbptt(
-                            params, states, opts, prec, f, l, lmask, base_key,
-                            hm=hm, pm=pm)
-                    else:
-                        it_used = self._iteration
-                        rng = jax.random.fold_in(base_key, it_used)
-                        (loss, params, states, opts, health,
-                         prec) = self._train_step(
-                            params, states, opts, prec, f, l, lmask, rng,
-                            it_used)
-                        self._iteration += 1
+                    try:
+                        if tbptt:
+                            loss, params, states, opts, prec = \
+                                self._fit_tbptt(
+                                    params, states, opts, prec, f, l,
+                                    lmask, base_key, hm=hm, pm=pm)
+                        else:
+                            it_used = self._iteration
+                            rng = jax.random.fold_in(base_key, it_used)
+                            (loss, params, states, opts, health,
+                             prec) = self._train_step(
+                                params, states, opts, prec, f, l, lmask,
+                                rng, it_used)
+                            self._iteration += 1
+                    except Exception as e:
+                        # OOM forensics (ISSUE 14): an allocation
+                        # failure inside the step becomes a typed
+                        # DeviceOomError naming this seam and the top
+                        # HBM claims; everything else re-raises as-is
+                        memledger.raise_if_oom(e, site="train.fit",
+                                               step=self._iteration)
+                        raise
                     if tele is not None:
                         dt_step = _time.perf_counter() - t_step
                         tele.record_step(dt_step, f.shape[0],
                                          exemplar=tspan.trace_id)
+                        if mem is not None:
+                            # steady state: ONE gauge-set per step
+                            mem.touch()
                         if tspan and not tbptt:
                             tracing.emit("train.step", tspan.ctx(),
                                          t_step, t_step + dt_step,

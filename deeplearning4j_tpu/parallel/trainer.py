@@ -309,6 +309,16 @@ class ShardedTrainer:
         policy_label = (f"{net._precision_policy().name}"
                         f"/h{int(plan.collect)}{int(plan.skip)}")
 
+        from deeplearning4j_tpu.telemetry import memledger
+
+        # HBM ownership claim (ISSUE 14): the sharded replicas of
+        # params/updater/loss-scale state, keyed to the NET — None when
+        # disabled, one gauge-set per step (the multilayer contract)
+        mem = None if tele is None else memledger.claim_for_owner(
+            net, "train", "sharded",
+            tree={"p": params, "s": states, "o": opts, "prec": prec},
+            mesh=str(sorted(self.mesh.shape.items())))
+
         tspan = tracing.trace_or_span("train.sharded", loop="sharded")
         tspan.__enter__()
         steps_seen = 0
@@ -354,9 +364,16 @@ class ShardedTrainer:
                     it_used = net._iteration
                     rng = jax.random.fold_in(base_key, it_used)
                     if tele is None:
-                        loss, params, states, opts, health, prec = \
-                            self._step_fn(params, states, opts, prec, f, l,
-                                          mask, rng, it_used)
+                        try:
+                            loss, params, states, opts, health, prec = \
+                                self._step_fn(params, states, opts, prec,
+                                              f, l, mask, rng, it_used)
+                        except Exception as e:
+                            # OOM forensics (ISSUE 14): typed error +
+                            # flight event naming this seam
+                            memledger.raise_if_oom(
+                                e, site="train.sharded", step=it_used)
+                            raise
                     else:
                         # the span is also a TraceAnnotation, so the host
                         # step region lines up with XPlane device traces;
@@ -366,11 +383,20 @@ class ShardedTrainer:
                         sp = tele.step_span()
                         sp.exemplar = tspan.trace_id
                         t_step = time.perf_counter()
-                        with sp:
-                            loss, params, states, opts, health, prec = \
-                                self._step_fn(params, states, opts, prec, f,
-                                              l, mask, rng, it_used)
+                        try:
+                            with sp:
+                                loss, params, states, opts, health, \
+                                    prec = self._step_fn(
+                                        params, states, opts, prec, f,
+                                        l, mask, rng, it_used)
+                        except Exception as e:
+                            memledger.raise_if_oom(
+                                e, site="train.sharded", step=it_used)
+                            raise
                         dt_step = time.perf_counter() - t_step
+                        if mem is not None:
+                            # steady state: ONE gauge-set per step
+                            mem.touch()
                         if tspan:
                             tracing.emit("train.step", tspan.ctx(),
                                          t_step, t_step + dt_step,

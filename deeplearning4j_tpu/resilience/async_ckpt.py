@@ -307,7 +307,7 @@ class Snapshot:
 
     __slots__ = ("step", "params", "states", "opt_states", "prec",
                  "iteration", "epoch", "conf", "model_type",
-                 "save_updater", "taken_at", "trace")
+                 "save_updater", "taken_at", "trace", "mem_claim")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -429,8 +429,24 @@ class AsyncCheckpointer:
             tree["o"] = net._opt_states
         if getattr(net, "_prec_state", None):
             tree["prec"] = net._prec_state
-        clone = _clone_to_device(tree)
-        _start_host_copies(clone)
+        from deeplearning4j_tpu.telemetry import memledger
+
+        try:
+            clone = _clone_to_device(tree)
+            _start_host_copies(clone)
+        except Exception as e:
+            # OOM forensics (ISSUE 14): the snapshot clone doubles the
+            # training state for a moment — the classic last-straw
+            # allocation. Name the seam and the top HBM claims.
+            memledger.raise_if_oom(e, site="ckpt.snapshot",
+                                   step=int(step))
+            raise
+        # HBM ledger claim: the clone pins a full copy of the training
+        # state until the background writer commits it
+        mem_claim = memledger.claim(
+            "checkpoint",
+            f"snapshot:{os.path.basename(self.dir)}:{int(step)}",
+            tree=clone, step=int(step))
         snap = Snapshot(
             step=int(step),
             params=clone["p"], states=clone["s"],
@@ -443,7 +459,8 @@ class AsyncCheckpointer:
                         else "MultiLayerNetwork"),
             save_updater=self.save_updater,
             taken_at=time.time(),
-            trace=trace_ctx)
+            trace=trace_ctx,
+            mem_claim=mem_claim)
         t1 = time.perf_counter()
         if trace_ctx is not None:
             tracing.emit("ckpt.snapshot", trace_ctx, t0, t1,
@@ -472,6 +489,11 @@ class AsyncCheckpointer:
                     flight.record("checkpoint_superseded",
                                   step=self._pending.step,
                                   by_step=snap.step)
+                    # the superseded clone is dropped here: its HBM
+                    # claim goes with it (ISSUE 14)
+                    if getattr(self._pending, "mem_claim", None) \
+                            is not None:
+                        self._pending.mem_claim.release()
                 else:
                     while self._pending is not None and not self._closing:
                         self._cond.wait(0.05)
@@ -541,6 +563,10 @@ class AsyncCheckpointer:
                     log.exception("unexpected async checkpoint failure")
                 self._error = e
             finally:
+                # written or failed, the clone is no longer pinned by
+                # this writer: release its HBM claim (ISSUE 14)
+                if getattr(snap, "mem_claim", None) is not None:
+                    snap.mem_claim.release()
                 with self._cond:
                     self._busy = False
                     self._cond.notify_all()

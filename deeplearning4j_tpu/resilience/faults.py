@@ -39,7 +39,8 @@ import time
 
 __all__ = [
     "FaultError", "InjectedCrash", "InjectedDataError",
-    "InjectedCheckpointIOError", "FaultPlan", "FaultInjector",
+    "InjectedCheckpointIOError", "InjectedOom", "FaultPlan",
+    "FaultInjector",
 ]
 
 
@@ -60,12 +61,27 @@ class InjectedCheckpointIOError(FaultError, OSError):
     """Simulated storage failure inside a checkpoint write/commit."""
 
 
+class InjectedOom(FaultError, RuntimeError):
+    """Simulated device allocation failure: the message mimics XLA's
+    ``RESOURCE_EXHAUSTED`` shape so the ISSUE 14 OOM-forensics seams
+    (``memledger.is_oom`` / ``raise_if_oom``) treat it exactly like the
+    real thing — the fault-injected half of proving the typed
+    DeviceOomError + flight ``oom`` path at every instrumented seam."""
+
+    def __init__(self, nbytes=1 << 34, where="injected"):
+        self.nbytes = int(nbytes)
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory allocating "
+            f"{self.nbytes} bytes ({where}).")
+
+
 # event kinds
 PREEMPT = "preempt"
 CRASH = "crash"
 STALL = "stall"
 IO_ERROR = "io_error"
 DATA_ERROR = "data_error"
+OOM = "oom"
 
 
 class _Event:
@@ -137,6 +153,15 @@ class FaultPlan:
         self._events.append(_Event(DATA_ERROR, batch, times))
         return self
 
+    def oom_at(self, batch, nbytes=1 << 34, times=1):
+        """Raise :class:`InjectedOom` (a RESOURCE_EXHAUSTED-shaped
+        allocation failure) when the data path serves global batch
+        ordinal ``batch`` — through ``wrap_data`` + a DevicePrefetcher
+        this exercises the prefetch ``device_put`` seam's ISSUE 14 OOM
+        forensics end to end."""
+        self._events.append(_Event(OOM, batch, times, nbytes=int(nbytes)))
+        return self
+
     def random_steps(self, n, max_step):
         """``n`` deterministic pseudo-random steps in ``[1, max_step]``
         drawn from this plan's seed (soak tests)."""
@@ -200,7 +225,7 @@ class FaultPlan:
 
     def on_batch(self):
         """Called by the data wrapper per served batch; raises when the
-        global ordinal has an armed data error."""
+        global ordinal has an armed data error (or injected OOM)."""
         with self._lock:
             ordinal = self._batches_drawn
             self._batches_drawn += 1
@@ -208,6 +233,10 @@ class FaultPlan:
         if ev is not None:
             raise InjectedDataError(
                 f"injected data-iterator failure at batch {ordinal}")
+        ev = self._take(OOM, ordinal)
+        if ev is not None:
+            raise InjectedOom(nbytes=ev.args["nbytes"],
+                              where=f"batch {ordinal}")
 
     # -- adapters ------------------------------------------------------------
     def listener(self):

@@ -270,6 +270,16 @@ def run_batch(entry, batch, inst, servable=None, replica=None):
     except ReplicaDeath:
         raise                     # scheduler re-queues; futures stay live
     except Exception as e:  # surface the device error to every caller
+        # OOM forensics (ISSUE 14): an allocation failure during the
+        # coalesced dispatch fails the requests with the typed
+        # DeviceOomError (flight `oom` event names this seam, the
+        # requested bytes, and the top HBM claims)
+        from deeplearning4j_tpu.telemetry import memledger
+
+        oom = memledger.oom_error(e, site="serving.run_batch",
+                                  model=entry.name)
+        if oom is not None:
+            e = oom
         for r in live:
             if not r.future.done():
                 r.future.set_exception(e)

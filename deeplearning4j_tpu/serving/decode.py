@@ -180,6 +180,34 @@ class PagedKVCache:
         return self.n_pages - len(self._free)
 
 
+def _boundary_error(e, site, what):
+    """The engine-boundary failure an affected request sees: a typed
+    DeviceOomError (plus a flight ``oom`` event naming the site and the
+    top HBM claims) when the dispatch died on an allocation, else the
+    usual RuntimeError wrapper."""
+    from deeplearning4j_tpu.telemetry import memledger
+
+    err = memledger.oom_error(e, site=site)
+    if err is not None:
+        return err
+    return RuntimeError(f"{what}: {type(e).__name__}: {e}")
+
+
+def _pool_bytes_estimate(model):
+    """Bytes a decode model's state (KV pool / carries) will pin, via
+    ``jax.eval_shape`` over ``init_state`` — a host-side trace, nothing
+    allocated yet. None when the model cannot be shape-evaluated (the
+    ISSUE 14 planner then refuses to guess)."""
+    import jax
+
+    from deeplearning4j_tpu.telemetry import memledger
+
+    try:
+        return memledger.tree_bytes(jax.eval_shape(model.init_state))
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # decode models
 # ---------------------------------------------------------------------------
@@ -599,8 +627,35 @@ class DecodeEngine:
         self._waiting: list = []   # engine-side FIFO (page head-block)
         self._active: dict[int, _DecodeRequest] = {}
         self._free_slots = list(range(model.max_slots - 1, -1, -1))
-        self._state = model.init_state()
+        # admission-time capacity planning (ISSUE 14): validate the KV
+        # pool bytes against live device headroom BEFORE allocating it
+        # — a structured CapacityError beats an opaque mid-init OOM.
+        # eval_shape is a host-side trace: nothing is allocated yet;
+        # both it and the plan are skipped when no device capacity is
+        # knowable (the engine allocates on the default device, so
+        # that is the device the judgement scopes to)
+        from deeplearning4j_tpu.telemetry import memledger
+
+        self._plan_device = memledger.device_label()
+        if getattr(model, "uses_pages", False) and \
+                memledger.capacity_known(device=self._plan_device):
+            pool_est = _pool_bytes_estimate(model)
+            if pool_est is not None:
+                memledger.plan_capacity(
+                    f"decode:{name}:kv", pool_est,
+                    detail={"lane": "target", "pages": model.n_pages,
+                            "page": model.page,
+                            "slots": model.max_slots},
+                    device=self._plan_device)
+        try:
+            self._state = model.init_state()
+        except Exception as e:
+            memledger.raise_if_oom(e, site=f"decode:{name}:kv",
+                                   lane="target")
+            raise
         self._kv = None
+        self._pool_bytes = memledger.tree_bytes(self._state)
+        self._mem_claim = None   # registered at the END of __init__
         if getattr(model, "uses_pages", False):
             self._kv = PagedKVCache(model.n_pages, model.page,
                                     model.max_pages_per_slot,
@@ -609,6 +664,7 @@ class DecodeEngine:
                        else np.zeros((model.max_slots, 1), np.int32))
         # -- decode v2 layers (ISSUE 12), all default-off ------------------
         self._spec = None
+        self._draft_mem_claim = None
         if speculative is not None:
             from deeplearning4j_tpu.serving.speculative import (
                 SpeculativeConfig, SpeculativeDecoder)
@@ -650,8 +706,25 @@ class DecodeEngine:
                 # verify width doubles as the prefill block: ONE block
                 # executable total (the lean-kernel default)
                 chunk = cfg.k + 1
-            self._spec = SpeculativeDecoder(
-                cfg, chunk, name, prefix_cache=bool(prefix_cache))
+            # the draft lane's mirror pool is validated and claimed
+            # exactly like the target's (ISSUE 14)
+            if memledger.capacity_known(device=self._plan_device):
+                draft_est = _pool_bytes_estimate(cfg.draft)
+                if draft_est is not None:
+                    memledger.plan_capacity(
+                        f"decode:{name}:kv", draft_est,
+                        detail={"lane": "draft",
+                                "pages": cfg.draft.n_pages,
+                                "page": cfg.draft.page,
+                                "slots": cfg.draft.max_slots},
+                        device=self._plan_device)
+            try:
+                self._spec = SpeculativeDecoder(
+                    cfg, chunk, name, prefix_cache=bool(prefix_cache))
+            except Exception as e:
+                memledger.raise_if_oom(e, site=f"decode:{name}:kv",
+                                       lane="draft")
+                raise
         self._block = None
         if chunk is not None:
             from deeplearning4j_tpu.serving.prefill import ChunkedPrefill
@@ -681,6 +754,19 @@ class DecodeEngine:
         self._closed = False
         self._warmed = False
         self._ids = 0
+        # HBM ledger claims registered LAST (ISSUE 14): any validation
+        # raise above must not leak a claim for an engine that never
+        # existed — the pools are only pinned once this line is reached
+        self._mem_claim = memledger.claim(
+            "kv_cache", f"{name}:target", nbytes=self._pool_bytes,
+            slots=model.max_slots,
+            pages=getattr(model, "n_pages", None))
+        if self._spec is not None:
+            self._draft_mem_claim = memledger.claim(
+                "kv_cache", f"{name}:draft",
+                nbytes=self._spec.pool_bytes,
+                slots=self._spec.model.max_slots,
+                pages=self._spec.model.n_pages)
         # serializes submit(): the capacity check and the req-id
         # counter both race under concurrent HTTP handler threads
         self._submit_lock = threading.Lock()
@@ -839,11 +925,18 @@ class DecodeEngine:
                                        else None),
                 "starved": starved}
         if self._kv is not None:
+            # the pool in BYTES beside page occupancy (ISSUE 14
+            # satellite): the device pool holds n_pages + 1 pages
+            # (page 0 = scratch), so per-page bytes divide by that
+            per_page = self._pool_bytes // (self._kv.n_pages + 1)
             out["kv_pages"] = {"total": self._kv.n_pages,
                                "free": self._kv.free_pages,
                                "occupancy": round(
                                    self._kv.used_pages
-                                   / self._kv.n_pages, 4)}
+                                   / self._kv.n_pages, 4),
+                               "pool_bytes": self._pool_bytes,
+                               "used_bytes": per_page
+                               * self._kv.used_pages}
         if self._pcache is not None:
             out["prefix_cache"] = self._pcache.stats()
         if self._spec is not None:
@@ -854,6 +947,11 @@ class DecodeEngine:
         self._closed = True
         self._wake.set()
         self._thread.join(timeout)
+        # the pools die with the engine: release their HBM claims
+        if self._mem_claim is not None:
+            self._mem_claim.release()
+        if self._draft_mem_claim is not None:
+            self._draft_mem_claim.release()
         # fail everything still pending or active
         leftovers = list(self._active.values()) + list(self._waiting)
         self._active.clear()
@@ -1081,9 +1179,12 @@ class DecodeEngine:
             if self._spec is not None:
                 self._spec.prefill(blocks, pos0, counts)
         except Exception as e:
+            # OOM forensics (ISSUE 14): a device allocation failure at
+            # this boundary fails the requests with the typed error
+            err = _boundary_error(e, f"decode:{self.name}:prefill",
+                                  "chunk prefill failed")
             for req in list(self._active.values()):
-                self._finish(req, error=RuntimeError(
-                    f"chunk prefill failed: {type(e).__name__}: {e}"))
+                self._finish(req, error=err)
             return False
         t_b1 = time.perf_counter()
         self._last_boundary = time.monotonic()
@@ -1129,9 +1230,10 @@ class DecodeEngine:
                 # a later speculation probe proposes from real context
                 self._spec.track(tokens, pos, active)
         except Exception as e:
+            err = _boundary_error(e, f"decode:{self.name}:step",
+                                  "decode step failed")
             for req in list(self._active.values()):
-                self._finish(req, error=RuntimeError(
-                    f"decode step failed: {type(e).__name__}: {e}"))
+                self._finish(req, error=err)
             return
         t_b1 = time.perf_counter()
         self._last_boundary = time.monotonic()
@@ -1213,10 +1315,10 @@ class DecodeEngine:
                 self._state, blocks, pos, counts, table,
                 site=f"decode:{self.name}:verify")
         except Exception as e:
+            err = _boundary_error(e, f"decode:{self.name}:verify",
+                                  "speculative decode failed")
             for req in list(self._active.values()):
-                self._finish(req, error=RuntimeError(
-                    f"speculative decode failed: "
-                    f"{type(e).__name__}: {e}"))
+                self._finish(req, error=err)
             return
         t_b1 = time.perf_counter()
         self._last_boundary = time.monotonic()

@@ -34,6 +34,28 @@ class _Entry:
         self.warmup_seconds = None
 
     def warmup(self):
+        # admission-time capacity planning (ISSUE 14): sum the ladder's
+        # estimated footprint against live device headroom BEFORE the
+        # first compile — a structured CapacityError instead of a
+        # mid-ladder OOM after minutes of warmup (dl4j_compile_total
+        # provably flat on rejection, ledger-asserted in tests). The
+        # judgement is scoped to the servable's pinned device when it
+        # has one (a busy neighbor device must not veto this one), and
+        # skipped outright — estimate included — when no device
+        # capacity is knowable (unconfigured deployments pay nothing)
+        from deeplearning4j_tpu.telemetry import memledger
+
+        dev = (None if self.servable.device is None
+               else memledger.device_label(self.servable.device))
+        if memledger.capacity_known(device=dev):
+            from deeplearning4j_tpu.serving.servable import (
+                estimate_warmup_bytes)
+
+            est = estimate_warmup_bytes(self.servable, self.ladder)
+            if est is not None:
+                memledger.plan_capacity(
+                    f"serving:{self.name}:v{self.version}",
+                    est["total"], detail=est, device=dev)
         t0 = time.perf_counter()
         self.servable.warmup(self.ladder)
         self.warmup_seconds = time.perf_counter() - t0
@@ -94,9 +116,37 @@ class ModelRegistry:
             ladder = BucketLadder(ladder)
         entry = _Entry(name, version, sv, ladder)
         with self._lock:
+            replaced = self._models.get(name, {}).get(entry.version)
             self._models.setdefault(name, {})[entry.version] = entry
+        if replaced is not None and replaced.servable is not sv:
+            # a same-(name, version) replace retires the old servable:
+            # its HBM claims go with it — BEFORE the new warmup, which
+            # re-states the same ledger keys (releasing after would
+            # delete the new servable's claims)
+            release = getattr(replaced.servable,
+                              "release_memory_claims", None)
+            if callable(release):
+                release()
         if warmup:
-            entry.warmup()
+            try:
+                entry.warmup()
+            except Exception:
+                # a rejected (or otherwise failed) warmup must not
+                # leave the un-warmed entry live in the registry — the
+                # next predict would lazily compile and hit exactly
+                # the mid-traffic OOM the planner refused. Roll the
+                # insertion back (the replaced same-version entry, if
+                # any, is restored; its claims re-state on next use).
+                with self._lock:
+                    versions = self._models.get(name, {})
+                    if versions.get(entry.version) is entry:
+                        if replaced is not None:
+                            versions[entry.version] = replaced
+                        else:
+                            del versions[entry.version]
+                            if not versions:
+                                self._models.pop(name, None)
+                raise
         return entry
 
     def unregister(self, name, version=None):
@@ -104,11 +154,19 @@ class ModelRegistry:
             if name not in self._models:
                 raise ModelNotFound(name)
             if version is None:
+                dropped = list(self._models[name].values())
                 del self._models[name]
             else:
+                dropped = [self._models[name][int(version)]]
                 del self._models[name][int(version)]
                 if not self._models[name]:
                     del self._models[name]
+        # the dropped versions' executables are no longer served: their
+        # HBM ledger claims go with them (ISSUE 14)
+        for e in dropped:
+            release = getattr(e.servable, "release_memory_claims", None)
+            if callable(release):
+                release()
 
     def get(self, name, version=None) -> _Entry:
         with self._lock:

@@ -123,6 +123,11 @@ class ReplicaSet:
         self.replicas = [
             Replica(self, i, d, entry.servable.for_device(d))
             for i, d in enumerate(devices)]
+        for r in self.replicas:
+            # per-replica HBM claim names: device-sharing clones
+            # (CPU round-robin oversubscription) must not collapse
+            # their pinned-args claims onto one ledger key
+            r.servable.mem_label = r.name
         if warmup and entry.warmed:
             # the source servable was AOT-warmed; each replica clone
             # owns a per-device executable cache and warms its own
@@ -154,6 +159,7 @@ class ReplicaSet:
             self._work.notify_all()
         for r in self.replicas:
             r.join(max(0.0, deadline - time.perf_counter()) + 1.0)
+        self._release_memory_claims()
 
     def close(self, timeout=5.0):
         """Fail-fast: queued batches fail with ServingShutdown.
@@ -171,6 +177,15 @@ class ReplicaSet:
                          "shutdown")
         for r in self.replicas:
             r.join(timeout)
+        self._release_memory_claims()
+
+    def _release_memory_claims(self):
+        """The replica clones' pinned args and per-device executables
+        die with the set: drop their HBM ledger claims (ISSUE 14)."""
+        for r in self.replicas:
+            release = getattr(r.servable, "release_memory_claims", None)
+            if callable(release):
+                release()
 
     def _drain_locked(self, replica):
         out = list(replica.queue)

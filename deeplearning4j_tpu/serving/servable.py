@@ -77,6 +77,11 @@ class Servable:
         # ride along to pin their ids — see _placed_args
         self._placed = (None, None, None)
         self._compiled = {}
+        self._mem_claims = []   # HBM ledger claims this servable owns
+        # distinguishes claims of device-sharing clones (a ReplicaSet
+        # round-robin-oversubscribed on CPU pins one arg copy PER
+        # replica — same device label must not collapse them)
+        self.mem_label = None
         self._lock = threading.Lock()
 
     def for_device(self, device) -> "Servable":
@@ -91,6 +96,7 @@ class Servable:
         clone.device = device
         clone._placed = (None, None, None)
         clone._compiled = {}
+        clone._mem_claims = []
         clone._lock = threading.Lock()
         return clone
 
@@ -113,7 +119,33 @@ class Servable:
 
             cached = jax.device_put(args, self.device)
             self._placed = (key, args, cached)   # one swap: thread-safe
+            # HBM ledger (ISSUE 14): the pinned per-replica arg copy is
+            # real device memory this replica owns; re-placement (a
+            # training step rebound the params) re-states the claim
+            from deeplearning4j_tpu.telemetry import memledger
+
+            c = memledger.claim(
+                "replica_args",
+                f"{self._ledger_site()}@{self._mem_suffix()}",
+                tree=cached, device=self.device)
+            if c is not None and c not in self._mem_claims:
+                self._mem_claims.append(c)
         return cached
+
+    def _mem_suffix(self) -> str:
+        from deeplearning4j_tpu.telemetry import memledger
+
+        label = memledger._device_label(self.device)
+        return label if not self.mem_label else \
+            f"{label}:{self.mem_label}"
+
+    def release_memory_claims(self):
+        """Drop this servable's HBM ledger claims (executables + pinned
+        replica args) — called when a replica retires or a registry
+        entry is unregistered."""
+        claims, self._mem_claims = self._mem_claims, []
+        for c in claims:
+            c.release()
 
     def _input_spec(self, shape):
         """ShapeDtypeStruct for one input shape, carrying the pinned
@@ -177,6 +209,34 @@ class Servable:
             sharding="" if self.device is None else str(self.device),
             store=info.get("store"), mode=info.get("mode", "compile"),
             fingerprint=info.get("hlo_fingerprint"))
+        # HBM ledger (ISSUE 14): claim this bucket executable's
+        # footprint from the real memory_analysis — temp + output +
+        # code are what the executable itself pins (arguments are the
+        # params/inputs, owned by their own claims) — with the full
+        # breakdown in the claim meta
+        from deeplearning4j_tpu.telemetry import memledger
+
+        try:
+            mem = exe.memory_analysis()
+        except Exception:
+            mem = None
+        if mem is not None:
+            parts = {kind: int(getattr(mem, attr, 0) or 0)
+                     for kind, attr in
+                     (("argument", "argument_size_in_bytes"),
+                      ("output", "output_size_in_bytes"),
+                      ("temp", "temp_size_in_bytes"),
+                      ("code", "generated_code_size_in_bytes"))}
+            name = (f"{self._ledger_site()}:"
+                    f"{'x'.join(str(d) for d in shape)}")
+            if self.device is not None:
+                name += f"@{self._mem_suffix()}"
+            c = memledger.claim(
+                "executable", name,
+                nbytes=parts["temp"] + parts["output"] + parts["code"],
+                device=self.device, **parts)
+            if c is not None and c not in self._mem_claims:
+                self._mem_claims.append(c)
 
     # -- AOT warmup ---------------------------------------------------------
     def _lower_shape(self, shape):
@@ -217,6 +277,26 @@ class Servable:
         with self._lock:
             self._compiled.setdefault(shape, exe)
         return self._compiled[shape]
+
+    def estimate_shape_bytes(self, shape):
+        """Pre-compile footprint estimate for one bucket shape
+        (ISSUE 14 admission planner): ``(input_bytes, output_bytes)``
+        via ``jax.eval_shape`` — a host-side trace, never an XLA
+        compile. None when this adapter cannot be shape-evaluated (the
+        planner then refuses to guess)."""
+        import jax
+
+        from deeplearning4j_tpu.telemetry import memledger
+
+        try:
+            spec = self._input(
+                jax.ShapeDtypeStruct(tuple(shape), self.dtype))
+            out = jax.eval_shape(self._jit_fn(), *self._call_args(),
+                                 spec)
+            return (memledger.tree_bytes(spec),
+                    memledger.tree_bytes(out))
+        except Exception:
+            return None
 
     def warmup(self, ladder: BucketLadder) -> list[tuple]:
         """AOT-compile every ladder shape; returns the warmed shapes.
@@ -349,6 +429,21 @@ class SameDiffServable(Servable):
         spec = self._input(self._input_spec(shape))
         return self._jit_fn().lower(spec, params, consts, rng)
 
+    def estimate_shape_bytes(self, shape):
+        import jax
+
+        from deeplearning4j_tpu.telemetry import memledger
+
+        try:
+            spec = self._input(
+                jax.ShapeDtypeStruct(tuple(shape), self.dtype))
+            out = jax.eval_shape(self._jit_fn(), spec,
+                                 *self._call_args())
+            return (memledger.tree_bytes(spec),
+                    memledger.tree_bytes(out))
+        except Exception:
+            return None
+
     def infer(self, x):
         x = np.ascontiguousarray(x, dtype=self.dtype)
         exe = self._compiled.get(x.shape)
@@ -372,6 +467,35 @@ class FnServable(Servable):
 
     def _call_args(self):
         return ()
+
+
+def estimate_warmup_bytes(servable, ladder) -> dict | None:
+    """Pre-compile footprint of a full ladder warmup (ISSUE 14
+    admission planner): the servable's call-arg bytes (params, counted
+    once — every bucket shares them) plus per-bucket input + output
+    bytes from ``jax.eval_shape``. A deliberate *lower bound* — XLA
+    temp buffers are unknowable before compile — that still catches
+    the order-of-magnitude mistakes (a ladder that cannot possibly
+    fit) before the first compile burns minutes and then OOMs
+    mid-ladder. None when the servable cannot be shape-evaluated."""
+    from deeplearning4j_tpu.telemetry import memledger
+
+    shapes = ladder.shapes(servable.example_shape)
+    buckets = {}
+    total = 0
+    for s in shapes:
+        est = servable.estimate_shape_bytes(s)
+        if est is None:
+            return None
+        in_b, out_b = est
+        buckets["x".join(str(d) for d in s)] = in_b + out_b
+        total += in_b + out_b
+    try:
+        param_bytes = memledger.tree_bytes(servable._call_args())
+    except Exception:
+        param_bytes = 0
+    return {"param_bytes": param_bytes, "buckets": buckets,
+            "total": total + param_bytes, "basis": "eval_shape"}
 
 
 def as_servable(model, example_shape=None, dtype=None,

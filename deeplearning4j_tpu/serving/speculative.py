@@ -85,6 +85,11 @@ class SpeculativeDecoder:
                                 model.max_slots)
         self._pcache = PrefixCache(model.page) if prefix_cache else None
         self._state = model.init_state()
+        from deeplearning4j_tpu.telemetry import memledger
+
+        # the draft lane's pinned pool bytes: health() reports them
+        # beside the target's, and the engine claims them (ISSUE 14)
+        self.pool_bytes = memledger.tree_bytes(self._state)
         self._block = ChunkedPrefill(model, chunk)
         self._ewma = None
         self._boundaries = 0
@@ -231,7 +236,16 @@ class SpeculativeDecoder:
                "acceptance_ewma": (round(self._ewma, 4)
                                    if self._ewma is not None else None),
                "boundaries": self._boundaries,
-               "k": self.k}
+               "k": self.k,
+               # the draft lane's KV pool in BYTES, not just page
+               # occupancy (ISSUE 14 satellite): both lanes of
+               # /healthz name their pinned device memory
+               "kv_pages": {
+                   "total": self._kv.n_pages,
+                   "free": self._kv.free_pages,
+                   "pool_bytes": self.pool_bytes,
+                   "used_bytes": (self.pool_bytes // (self._kv.n_pages + 1))
+                   * self._kv.used_pages}}
         if self._pcache is not None:
             out["prefix_cache"] = self._pcache.stats()
         return out

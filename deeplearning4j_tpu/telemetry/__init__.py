@@ -33,6 +33,16 @@ collective / remat / largest-buffer audit of each ledgered
 executable's optimized HLO, at GET /debug/hlo/<key> and
 tools/hloaudit.py).
 
+ISSUE 14 adds device-memory observability: `telemetry.memledger` —
+the HBM ownership ledger (every memory-pinning subsystem registers a
+categorized claim, reconciled against device.memory_stats() into
+dl4j_device_memory_claimed_bytes plus an unattributed residual at
+GET /debug/memory and /healthz), OOM forensics (typed DeviceOomError +
+flight `oom` events naming site / requested bytes / top claims at the
+train, serving, decode, prefetch, and snapshot seams), and
+admission-time capacity planning (structured CapacityError before any
+compile or pool allocation).
+
 Disabling (`telemetry.disable()`) removes every per-step registry call
 from the training loops — they check the flag once per fit() — and
 compiles the health stats OUT of the jitted step (pre-health output
@@ -42,7 +52,9 @@ step."""
 
 from deeplearning4j_tpu.telemetry import (
     aggregate, compile_ledger, costmodel, flight, health, hlo_audit,
-    prometheus, tracing)
+    memledger, prometheus, tracing)
+from deeplearning4j_tpu.telemetry.memledger import (
+    CapacityError, DeviceOomError)
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
 from deeplearning4j_tpu.telemetry.flight import FlightRecorder
 from deeplearning4j_tpu.telemetry.health import (
@@ -56,7 +68,8 @@ from deeplearning4j_tpu.telemetry.registry import (
     serving_instruments, set_registry, span)
 
 __all__ = [
-    "BYTES_BUCKETS", "Counter", "DivergenceError", "ETL_HELP",
+    "BYTES_BUCKETS", "CapacityError", "Counter", "DeviceOomError",
+    "DivergenceError", "ETL_HELP",
     "EtlInstruments", "FlightRecorder", "Gauge", "HealthConfig",
     "HealthMonitor", "Histogram", "LoopInstruments", "MetricsListener",
     "MetricsRegistry", "SECONDS_BUCKETS", "STEP_HELP",
@@ -64,6 +77,6 @@ __all__ = [
     "collect_device_memory", "compile_ledger", "costmodel", "disable",
     "enable", "enabled", "etl_instruments", "flight", "get_registry",
     "health", "hlo_audit", "log_buckets", "loop_instruments",
-    "prometheus", "serving_instruments", "set_registry", "span",
-    "tracing",
+    "memledger", "prometheus", "serving_instruments", "set_registry",
+    "span", "tracing",
 ]

@@ -17,7 +17,11 @@ ISSUE 5: /healthz readiness detail gains the resilience section
 /metrics refreshes the checkpoint-age gauge at scrape time. ISSUE 11:
 "/debug/compiles" (the compile ledger: every train-step/serving
 compile with forensic cause, compile seconds, HLO fingerprint) and
-"/debug/hlo/<key>" (the per-executable fusion/remat audit)."""
+"/debug/hlo/<key>" (the per-executable fusion/remat audit). ISSUE 14:
+"/debug/memory" (the HBM ownership ledger: claims table, per-device
+claimed-vs-in-use reconciliation with the unattributed residual, and
+planner headroom), and /metrics refreshes the claimed-bytes gauges at
+scrape time."""
 
 from __future__ import annotations
 
@@ -105,6 +109,15 @@ class _Handler(BaseHTTPRequestHandler):
                 async_ckpt.refresh_metrics()
             except Exception:
                 pass
+            try:
+                # the HBM ownership gauges (ISSUE 14) reconcile claims
+                # against device.memory_stats() at scrape time — the
+                # unattributed residual is a census, never a step cost
+                from deeplearning4j_tpu.telemetry import memledger
+
+                memledger.refresh_metrics()
+            except Exception:
+                pass
             # /metrics?exemplars=1 appends OpenMetrics-STYLE exemplar
             # suffixes to histogram buckets (trace ids, ISSUE 10) — an
             # explicit operator opt-in, NOT Accept negotiation: a
@@ -172,6 +185,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "store": compilestore.describe(),
             }).encode()
             self._respond(body)
+            return
+        elif self.path.startswith("/debug/memory"):
+            # the HBM ownership ledger (ISSUE 14): the full claims
+            # table, the per-device claimed-vs-in-use reconciliation
+            # (incl. the unattributed residual), and the capacity
+            # planner's view (headroom, budget, degradation floor).
+            # Read-only and served whether or not telemetry is
+            # currently enabled (incident dumps outlive a disable())
+            from deeplearning4j_tpu.telemetry import memledger
+
+            self._respond(json.dumps(memledger.describe()).encode())
             return
         elif self.path.startswith("/debug/traces"):
             # span-tree export (ISSUE 10): the whole ring as JSONL, or

@@ -468,6 +468,36 @@ class TestTelemetryGateRule:
         """
         assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
 
+    def test_flags_ungated_memledger_emission(self, tmp_path):
+        # ISSUE 14: the HBM-ledger emission sites (train-loop touch,
+        # prefetch staging, executable claims) are the newest places
+        # the zero-calls-when-disabled contract could erode — a raw
+        # get_memledger() emission in a step helper with no gate must
+        # be flagged
+        src = """
+            from deeplearning4j_tpu.telemetry import memledger
+
+            def note_step_memory(params):
+                memledger.get_memledger().publish_total(
+                    "train", "cpu:0")
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_claim_gated_memledger_emission(self, tmp_path):
+        # the idiom the registrars actually use: memledger.claim()
+        # gates internally (None when disabled), so calling it — or an
+        # explicit enabled() check before the raw handle — IS the gate
+        clean = """
+            from deeplearning4j_tpu.telemetry import memledger
+
+            def note_step_memory(params):
+                mem = memledger.claim("train", "fit", tree=params)
+                if mem is None:
+                    return
+                memledger.get_memledger().publish_total("train", "cpu:0")
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
     def test_near_miss_sampler_gated_tracer(self, tmp_path):
         # the sampler IS a gate: current() returns None when disabled
         # or unsampled, so guarding on it keeps the disabled path at

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""memreport: the offline HBM-ownership claims-table dump (ISSUE 14).
+
+Two modes (the hloaudit.py pattern — a standalone CLI over the same
+telemetry subsystem the runtime exports):
+
+- ``--url http://host:port`` scrapes a live server's
+  ``GET /debug/memory`` and pretty-prints the reconciled table;
+- default: builds a small train + serve + decode workload IN PROCESS
+  (a dense net fit, a warmed bucket ladder, a paged decode engine),
+  so every shipped registrar category has a live claim, then prints
+  the claims table, the per-device claimed-vs-in-use reconciliation
+  (with the ``unattributed`` residual), and the planner's headroom
+  view.
+
+Usage::
+
+    python tools/memreport.py
+    python tools/memreport.py --url http://127.0.0.1:9000
+    python tools/memreport.py --json out.json
+
+Nothing here touches a training/serving hot path: the demo workload is
+unit-scale and the census is the same scrape-time reconciliation the
+/metrics handler runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n / 1.0:.1f} {unit}")
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def fetch(url: str) -> dict:
+    from urllib.request import urlopen
+
+    with urlopen(url.rstrip("/") + "/debug/memory", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def build_demo() -> dict:
+    """Exercise every shipped registrar, then census (in process)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import InferenceSession
+    from deeplearning4j_tpu.serving.decode import (DecodeEngine,
+                                                   TransformerDecodeModel)
+    from deeplearning4j_tpu.telemetry import memledger
+
+    conf = (NeuralNetConfiguration.Builder().seed(7).list()
+            .layer(DenseLayer.Builder().nIn(32).nOut(64).build())
+            .layer(OutputLayer.Builder().nIn(64).nOut(8).build())
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 32).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rng.randint(0, 8, 16)]
+    net.fit([(X, y)], 2)                      # -> train claim
+
+    session = InferenceSession()
+    session.register("memreport", net, example_shape=(32,),
+                     ladder=[1, 8], warmup=True)   # -> executable claims
+
+    model = TransformerDecodeModel.init(
+        vocab=64, hidden=32, n_layers=2, n_heads=2, max_len=64,
+        max_slots=4, page=8, max_pages_per_slot=4)
+    engine = DecodeEngine(model, name="memreport")  # -> kv_cache claim
+    engine.warmup()
+
+    snap = memledger.describe()
+    engine.close()
+    session.close()
+    return snap
+
+
+def render(snap: dict) -> str:
+    lines = ["HBM ownership ledger", "=" * 64]
+    claims = snap.get("claims", [])
+    if not claims:
+        lines.append("(no live claims)")
+    else:
+        w = max(len(f"{c['category']}/{c['name']}") for c in claims)
+        for c in claims:
+            key = f"{c['category']}/{c['name']}"
+            lines.append(f"  {key:<{w}}  {_fmt_bytes(c['bytes']):>12}"
+                         f"  on {c['device']}")
+    lines.append("")
+    lines.append("per-device reconciliation")
+    lines.append("-" * 64)
+    for dev, row in sorted(snap.get("devices", {}).items()):
+        lines.append(f"  {dev} (source: {row.get('source', '?')})")
+        for cat, b in sorted(row.get("claimed", {}).items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"    {cat:<14} {_fmt_bytes(b):>12}")
+        if row.get("in_use") is not None:
+            lines.append(f"    {'in_use':<14} "
+                         f"{_fmt_bytes(row['in_use']):>12}")
+        if row.get("unattributed") is not None:
+            lines.append(f"    {'unattributed':<14} "
+                         f"{_fmt_bytes(row['unattributed']):>12}")
+        if row.get("limit"):
+            lines.append(f"    {'limit':<14} "
+                         f"{_fmt_bytes(row['limit']):>12}")
+    lines.append("")
+    lines.append(f"planner headroom: "
+                 f"{_fmt_bytes(snap.get('headroom_bytes'))}"
+                 f"  (budget {_fmt_bytes(snap.get('budget_bytes'))},"
+                 f" degrade floor "
+                 f"{_fmt_bytes(snap.get('min_headroom_bytes'))})")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="scrape a live /debug/memory instead "
+                                  "of building the in-process demo")
+    ap.add_argument("--json", dest="json_out",
+                    help="also write the raw census JSON here")
+    args = ap.parse_args(argv)
+    snap = fetch(args.url) if args.url else build_demo()
+    print(render(snap))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(snap, f, indent=2)
+        print(f"\nraw census written to {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
