@@ -30,7 +30,10 @@ from deeplearning4j_tpu.analysis.core import Rule, Severity, register
 # particular also names telemetry.span, a pure TraceAnnotation that
 # gates nothing, so it appears in neither set
 _REGISTRY_GATES = {"enabled", "enable", "loop_instruments",
-                   "etl_instruments", "serving_instruments"}
+                   "etl_instruments", "serving_instruments",
+                   # ISSUE 15: the fleet router's bundle factory gates
+                   # internally (None when disabled) like the others
+                   "fleet_instruments"}
 _TRACER_GATES = {"enabled", "enable",
                  # tracer-side gates (ISSUE 10): each samples/gates
                  # internally and returns a None/NULL handle the

@@ -1,0 +1,57 @@
+"""Fleet tier: a multi-process serving router (ISSUE 15 tentpole).
+
+Everything below `serving/` runs ONE process: an InferenceSession, its
+batchers/replicas, one UIServer. The production shape (ROADMAP item 3)
+is a fleet — a thin router in front of N worker processes, each a full
+UIServer + InferenceSession:
+
+- :mod:`fleet.worker` — the worker process entry point
+  (``python -m deeplearning4j_tpu.fleet.worker``): builds servables
+  from a JSON spec, serves them on a UIServer, and exposes the
+  versioned-registry admin seam (:register / :unregister) rollouts
+  push through;
+- :mod:`fleet.router` — :class:`FleetRouter`: spawns/adopts workers,
+  polls their /healthz + load gauges, routes :predict/:decode to the
+  least-loaded ready worker with a retry budget (a worker death never
+  surfaces to the client), ejects on consecutive transport failures
+  (the PR-8 circuit-breaker shape) and re-admits on recovered healthz;
+- :mod:`fleet.rollout` — :class:`RolloutController`: canary a vN+1
+  model spec on one worker, mirror a traffic fraction to it, compare
+  p99 + output agreement against the incumbent via PR-1 histogram
+  snapshots, then promote worker-by-worker or auto-roll back — every
+  decision a flight event;
+- :mod:`fleet.capture` — :class:`TrafficCapture`: head-sampled live
+  requests into a replayable on-disk dataset
+  (:class:`CaptureReplayIterator` is a DataSetIterator), the first hop
+  of the train-from-traffic loop.
+
+See docs/FLEET.md for the architecture and the rollout state machine.
+"""
+
+from deeplearning4j_tpu.fleet.capture import (
+    CaptureReplayIterator, TrafficCapture)
+from deeplearning4j_tpu.fleet.rollout import (
+    ROLLOUT_STATES, RolloutController)
+from deeplearning4j_tpu.fleet.router import (
+    FleetRouter, WorkerHandle, spawn_local_workers)
+
+# fleet.worker is ALSO the `python -m deeplearning4j_tpu.fleet.worker`
+# entry point: importing it eagerly here would make runpy warn (module
+# in sys.modules before -m executes it), so its exports resolve lazily
+_WORKER_EXPORTS = ("LinearServable", "WorkerAdmin", "build_servable")
+
+
+def __getattr__(name):
+    if name in _WORKER_EXPORTS:
+        from deeplearning4j_tpu.fleet import worker
+
+        return getattr(worker, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "CaptureReplayIterator", "FleetRouter", "LinearServable",
+    "ROLLOUT_STATES", "RolloutController", "TrafficCapture",
+    "WorkerAdmin", "WorkerHandle", "build_servable",
+    "spawn_local_workers",
+]

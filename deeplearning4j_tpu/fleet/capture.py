@@ -1,0 +1,156 @@
+"""Traffic capture: live requests → replayable training data
+(ISSUE 15 — the first hop of the train-from-traffic loop, ROADMAP
+item 3c).
+
+The router samples successful ``:predict`` requests (deterministic
+1-in-N head sampling, the PR-9 tracing shape — the keep/drop decision
+is one modulo, an unsampled request costs one counter tick) into a
+bounded in-memory ring. Each record keeps the request's ``instances``
+AND the fleet's ``predictions`` — the served model's answers are free
+distillation labels, which is what makes the capture a *dataset*
+rather than a log.
+
+``save()`` commits the ring as canonical JSONL (sorted keys, fixed
+separators, tmp + os.replace) so the same ring always produces the
+same bytes; :class:`CaptureReplayIterator` re-feeds a saved file as a
+standard DataSetIterator whose arrays are bit-identical run to run —
+JSON doubles round-trip exactly, and the float32 cast is the same cast
+the serving path applied. Determinism is asserted in
+tests/test_fleet.py (capture → save → replay → re-save byte-identical).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from collections import deque
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator
+
+
+class TrafficCapture:
+    """Bounded, head-sampled request ring. ``maybe_record`` is the
+    router hot-path entry: one counter tick when not sampled; one JSON
+    parse + one deque append when sampled. Never raises — a malformed
+    body is the client's problem, not the capture's."""
+
+    def __init__(self, sample_interval=1, max_records=1024):
+        self.sample_interval = max(1, int(sample_interval))
+        self.max_records = int(max_records)
+        self._records: deque = deque(maxlen=self.max_records)
+        self._counter = itertools.count()
+        self._seq = itertools.count(1)
+        self._sampled = 0
+        self._lock = threading.Lock()
+
+    def maybe_record(self, model, body, response_body, inst=None):
+        if next(self._counter) % self.sample_interval:
+            return None
+        try:
+            payload = json.loads(body or b"")
+            resp = json.loads(response_body or b"")
+            instances = payload["instances"]
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return None
+        rec = {"model": model, "instances": instances,
+               "predictions": resp.get("predictions"),
+               "version": resp.get("version")}
+        with self._lock:
+            rec["seq"] = next(self._seq)
+            self._records.append(rec)
+            self._sampled += 1
+        if inst is not None:
+            inst.captured.inc()
+        return rec
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._records)
+
+    def __len__(self):
+        return len(self._records)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"sample_interval": self.sample_interval,
+                    "max_records": self.max_records,
+                    "sampled": self._sampled,
+                    "buffered": len(self._records)}
+
+    def save(self, path) -> str:
+        """Commit the ring as canonical JSONL (sorted keys, fixed
+        separators — the same ring always serializes to the same
+        bytes) via tmp + os.replace, so a reader never sees a torn
+        file."""
+        recs = self.records()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, sort_keys=True,
+                                   separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+def load_capture(path) -> list:
+    """The saved records, in capture order."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class CaptureReplayIterator(DataSetIterator):
+    """Replay a saved capture as a DataSetIterator: features are the
+    captured ``instances``, labels the fleet's ``predictions``
+    (distillation targets), both float32 — ready for
+    ``net.fit(iterator)`` / ElasticTrainer on the training mesh.
+    ``model=`` filters a multi-model capture; records missing
+    predictions replay with ``labels=None``."""
+
+    def __init__(self, path, batch_size=32, model=None,
+                 dtype=np.float32):
+        super().__init__(batch_size)
+        self.path = path
+        self.model = model
+        recs = [r for r in load_capture(path)
+                if model is None or r.get("model") == model]
+        # one request = one or more examples; flatten in capture order
+        feats, labels = [], []
+        for r in recs:
+            inst = r.get("instances") or []
+            preds = r.get("predictions")
+            feats.extend(inst)
+            labels.extend(preds if preds is not None
+                          else [None] * len(inst))
+        self._batches = []
+        for i in range(0, len(feats), batch_size):
+            fb = np.asarray(feats[i:i + batch_size], dtype=dtype)
+            lb = labels[i:i + batch_size]
+            has_labels = all(l is not None for l in lb) and lb
+            self._batches.append(
+                (fb, np.asarray(lb, dtype=dtype) if has_labels
+                 else None))
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+        self._peek = None
+
+    def _next_batch(self):
+        if self._pos >= len(self._batches):
+            return None
+        f, l = self._batches[self._pos]
+        self._pos += 1
+        return DataSet(f, l)
+
+    def totalExamples(self) -> int:
+        return sum(f.shape[0] for f, _ in self._batches)

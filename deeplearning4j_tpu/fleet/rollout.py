@@ -1,0 +1,391 @@
+"""Rolling updates with a regression-gated canary (ISSUE 15).
+
+The versioned re-register seam has existed since PR 2 (re-register a
+(name, version) = rolling update) and PR 8 swept replica specs through
+it — but nothing DECIDED whether vN+1 deserved the traffic. This module
+is that decision, as a small state machine:
+
+    idle ──start()──► canary ──agree+p99 ok──► promoting ──► complete
+                        │                         │
+                        └──regression─────────────┴──► rolled_back
+
+- **canary**: the spec is pushed to ONE worker through the admin route
+  (``:register``). While canarying (and promoting), the router pins
+  regular traffic for the model to the incumbent version — clients
+  keep getting vN until the fleet-wide cutover, and mixed-version
+  answers cannot happen mid-promotion. A configurable fraction of live
+  :predict traffic is MIRRORED (deterministic 1-in-N, the PR-9
+  head-sampling shape) to the canary worker pinned at vN+1 on a
+  background thread — the client's latency never includes the mirror;
+- **the verdict**: mirrored answers feed two PR-1 log-bucket Histograms
+  (incumbent hop latency vs canary latency) and an output-agreement
+  count (byte-equal JSON ``predictions``). After ``min_samples``
+  mirrors: regression ⇔ canary p99 > ``p99_ratio`` × incumbent p99, or
+  agreement < ``min_agreement``, or any mirror transport/HTTP errors
+  beyond budget. Histogram p99 is read from bucket counts
+  (:func:`histogram_quantile`) — the same snapshot shape Prometheus
+  sees;
+- **promote**: push the spec worker-by-worker (each must be up before
+  its push), then unpin — the registry's newest-version default makes
+  vN+1 live everywhere at once from the router's point of view;
+- **rollback**: ``:unregister`` vN+1 from every worker that received
+  it; the registry falls back to vN (newest remaining). Every
+  transition and the final decision are flight events, and
+  ``dl4j_fleet_rollout_state`` tracks the machine numerically.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue
+import threading
+import time
+
+from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry.registry import Histogram, log_buckets
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# gauge encoding for dl4j_fleet_rollout_state (docs/OBSERVABILITY.md)
+ROLLOUT_STATES = {"idle": 0, "canary": 1, "promoting": 2,
+                  "complete": 3, "rolled_back": -1}
+_TERMINAL = ("complete", "rolled_back")
+
+# finer than the default SECONDS_BUCKETS (per_decade=12 → 1.21× bound
+# steps): the p99-vs-p99 verdict is quantized to bucket bounds, and a
+# coarse ladder would alias a healthy canary into a "regression" one
+# bucket up
+_LATENCY_BUCKETS = log_buckets(1e-4, 10.0, per_decade=12)
+
+
+def histogram_quantile(hist, q=0.99):
+    """The smallest bucket upper bound covering quantile ``q`` of a
+    PR-1 cumulative Histogram — how Prometheus would read the same
+    snapshot. 0.0 when empty; the top finite bound for +Inf-bucket
+    observations."""
+    total = hist.count
+    if total == 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for bound, c in zip(hist.buckets, hist.counts):
+        acc += c
+        if acc >= target:
+            return bound
+    return hist.buckets[-1]
+
+
+class RolloutController:
+    """One rollout of ``spec`` as ``name`` version ``version`` across
+    ``router``'s fleet. Built via :meth:`FleetRouter.start_rollout`."""
+
+    def __init__(self, router, name, spec, version, fraction=0.25,
+                 min_samples=20, p99_ratio=2.0, min_agreement=0.999,
+                 max_mirror_errors=2, push_timeout=60.0):
+        self.router = router
+        self.name = name
+        self.spec = spec
+        self.version = int(version)
+        self.fraction = float(fraction)
+        self.min_samples = int(min_samples)
+        self.p99_ratio = float(p99_ratio)
+        self.min_agreement = float(min_agreement)
+        self.max_mirror_errors = int(max_mirror_errors)
+        self.push_timeout = float(push_timeout)
+        self.state = "idle"
+        self.history = ["idle"]
+        self.incumbent_version = None
+        self.canary = None          # WorkerHandle
+        self.pushed = []            # worker names serving vN+1
+        self.decision = None
+        self._mirrors = 0
+        self._agree = 0
+        self._errors = 0
+        self._hist_incumbent = Histogram(
+            "rollout_incumbent_seconds", buckets=_LATENCY_BUCKETS)
+        self._hist_canary = Histogram(
+            "rollout_canary_seconds", buckets=_LATENCY_BUCKETS)
+        self._counter = itertools.count()
+        self._interval = max(1, round(1.0 / max(self.fraction, 1e-6)))
+        self._lock = threading.Lock()
+        self._closing = threading.Event()
+        self._queue = queue.Queue(maxsize=64)
+        self._thread = threading.Thread(
+            target=self._mirror_loop, daemon=True,
+            name=f"dl4j-fleet-mirror-{name}")
+
+    # -- state ---------------------------------------------------------------
+    def terminal(self) -> bool:
+        return self.state in _TERMINAL
+
+    def _set_state(self, state):
+        with self._lock:
+            self.state = state
+            self.history.append(state)
+        flight.record("rollout_state", model=self.name,
+                      version=self.version, state=state)
+        inst = self.router._inst()
+        if inst is not None:
+            inst.rollout_state.set(ROLLOUT_STATES[state])
+
+    def pins(self, name) -> bool:
+        """While canarying/promoting, regular traffic for the rollout
+        model stays pinned to the incumbent version."""
+        return (name == self.name
+                and self.state in ("canary", "promoting")
+                and self.incumbent_version is not None)
+
+    def pin_body(self, body):
+        """Add ``"version": incumbent`` to an unpinned request body.
+        An explicit client pin — and anything unparsable — passes
+        through untouched."""
+        try:
+            payload = json.loads(body or b"")
+        except (ValueError, UnicodeDecodeError):
+            return body
+        if not isinstance(payload, dict) or "version" in payload:
+            return body
+        payload["version"] = self.incumbent_version
+        return json.dumps(payload).encode()
+
+    # -- admin pushes --------------------------------------------------------
+    def _push(self, w):
+        from deeplearning4j_tpu.fleet.router import _http
+
+        body = json.dumps({"spec": self.spec, "version": self.version,
+                           "warmup": True}).encode()
+        status, _, rb = _http(
+            f"{w.url}/serving/v1/models/{self.name}:register",
+            body=body, timeout=self.push_timeout)
+        if status != 200:
+            raise RuntimeError(
+                f"push to {w.name} failed: HTTP {status} "
+                f"{rb[:200]!r}")
+
+    def _retract(self, w):
+        from deeplearning4j_tpu.fleet.router import (
+            TransportFailure, _http)
+
+        body = json.dumps({"version": self.version}).encode()
+        try:
+            _http(f"{w.url}/serving/v1/models/{self.name}:unregister",
+                  body=body, timeout=self.push_timeout)
+        except TransportFailure:
+            pass   # a dead worker has nothing serving to retract
+
+    def start(self):
+        """Push to the canary worker and open the mirror window."""
+        from deeplearning4j_tpu.fleet.router import TransportFailure
+
+        with self.router._lock:
+            live = [w for w in self.router.workers if w.up]
+            incumbent = max(
+                (m.get("version") or 0 for w in live for m in w.models
+                 if m.get("name") == self.name), default=0)
+        if not live:
+            raise RuntimeError("no live worker to canary on")
+        if incumbent < 1:
+            raise RuntimeError(
+                f"model {self.name!r} is not served by any live "
+                f"worker — nothing to roll out against")
+        if self.version <= incumbent:
+            raise ValueError(
+                f"rollout version {self.version} must exceed the "
+                f"incumbent v{incumbent}")
+        self.incumbent_version = incumbent
+        self.canary = live[0]
+        # enter the pinning state BEFORE the push: registration on the
+        # canary worker makes vN+1 its newest version immediately, and
+        # an unpinned client request routed there during the push/
+        # warmup window would otherwise be served the canary build
+        # before the rollout has even started judging it
+        self._set_state("canary")
+        try:
+            self._push(self.canary)
+        except (TransportFailure, RuntimeError) as e:
+            self._rollback(f"canary push failed: {e}", self._stats())
+            raise
+        self.pushed = [self.canary.name]
+        self._thread.start()
+        flight.record("rollout_start", model=self.name,
+                      version=self.version,
+                      incumbent=self.incumbent_version,
+                      canary=self.canary.name, fraction=self.fraction,
+                      min_samples=self.min_samples)
+        return self
+
+    def close(self):
+        self._closing.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=self.push_timeout)
+
+    # -- mirroring -----------------------------------------------------------
+    def on_primary(self, name, body, response_body, latency):
+        """Router hot-path hook after a successful :predict: enqueue
+        every Nth request for mirroring. Never blocks — a full mirror
+        queue drops the sample (bounded, like the trace ring)."""
+        if name != self.name or self.state != "canary":
+            return
+        if next(self._counter) % self._interval:
+            return
+        try:
+            self._queue.put_nowait((body, response_body, latency))
+        except queue.Full:
+            pass
+
+    def _mirror_loop(self):
+        while not self._closing.is_set():
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None or self.state != "canary":
+                continue
+            try:
+                self._mirror_one(*item)
+                if self.state == "canary" \
+                        and self._mirrors >= self.min_samples:
+                    self._decide()
+            except Exception as e:
+                # a dead mirror thread would wedge the rollout in
+                # canary (and pin clients to vN forever): fail SAFE
+                # by rolling back instead
+                log.exception("rollout mirror loop failed")
+                if not self.terminal():
+                    self._rollback(f"mirror loop error: "
+                                   f"{type(e).__name__}: {e}",
+                                   self._stats())
+            if self.terminal():
+                return
+
+    def _mirror_one(self, body, primary_body, primary_latency):
+        from deeplearning4j_tpu.fleet.router import (
+            TransportFailure, _http)
+
+        inst = self.router._inst()
+        try:
+            payload = json.loads(body)
+            payload["version"] = self.version
+            mirror_body = json.dumps(payload).encode()
+        except (ValueError, UnicodeDecodeError, TypeError):
+            return   # unparsable primary: not a comparison sample
+        t0 = time.perf_counter()
+        try:
+            status, _, rb = _http(
+                f"{self.canary.url}/serving/v1/models/"
+                f"{self.name}:predict", body=mirror_body,
+                timeout=self.router.request_timeout)
+        except TransportFailure as e:
+            status, rb = None, str(e).encode()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._mirrors += 1
+            if status != 200:
+                self._errors += 1
+                verdict = "error"
+            else:
+                self._hist_incumbent.observe(primary_latency)
+                self._hist_canary.observe(dt)
+                try:
+                    agree = (json.loads(rb)["predictions"]
+                             == json.loads(primary_body)["predictions"])
+                except (ValueError, KeyError, TypeError):
+                    agree = False
+                if agree:
+                    self._agree += 1
+                verdict = "agree" if agree else "disagree"
+        if inst is not None:
+            inst.mirror(verdict)
+
+    # -- the decision --------------------------------------------------------
+    def _stats(self):
+        with self._lock:
+            compared = self._mirrors - self._errors
+            return {
+                "mirrors": self._mirrors,
+                "errors": self._errors,
+                "agreement": (self._agree / compared if compared
+                              else 0.0),
+                "p99_incumbent": histogram_quantile(
+                    self._hist_incumbent),
+                "p99_canary": histogram_quantile(self._hist_canary),
+            }
+
+    def _decide(self):
+        s = self._stats()
+        regressed = []
+        if s["errors"] > self.max_mirror_errors:
+            regressed.append(f"{s['errors']} mirror errors")
+        if s["agreement"] < self.min_agreement:
+            regressed.append(
+                f"agreement {s['agreement']:.4f} < "
+                f"{self.min_agreement}")
+        # floor the incumbent p99 at one bucket so a ~0ms incumbent
+        # cannot declare every canary a latency regression
+        floor = max(s["p99_incumbent"], _LATENCY_BUCKETS[0])
+        if s["p99_canary"] > self.p99_ratio * floor:
+            regressed.append(
+                f"p99 {s['p99_canary']:.4f}s > {self.p99_ratio}x "
+                f"incumbent {s['p99_incumbent']:.4f}s")
+        flight.record("rollout_decision", model=self.name,
+                      version=self.version,
+                      verdict="rollback" if regressed else "promote",
+                      reasons=regressed, **s)
+        if regressed:
+            self._rollback("; ".join(regressed), s)
+        else:
+            self._promote(s)
+
+    def _promote(self, stats):
+        from deeplearning4j_tpu.fleet.router import TransportFailure
+
+        self._set_state("promoting")
+        # EVERY worker, not just the currently-up ones: skipping an
+        # ejected worker and declaring "complete" would leave it
+        # serving vN when it is readmitted — permanent version skew
+        # with no reconciler. A fleet that cannot take the push
+        # everywhere rolls back instead; retry when it is whole.
+        with self.router._lock:
+            rest = [w for w in self.router.workers
+                    if w.name not in self.pushed]
+        for w in rest:
+            flight.record("rollout_promote", model=self.name,
+                          version=self.version, worker=w.name)
+            try:
+                self._push(w)
+            except (TransportFailure, RuntimeError) as e:
+                self._rollback(f"promotion push to {w.name} "
+                               f"failed: {e}", stats)
+                return
+            self.pushed.append(w.name)
+        self.decision = {"verdict": "promote", **stats}
+        self._set_state("complete")
+        flight.record("rollout_complete", model=self.name,
+                      version=self.version, workers=list(self.pushed),
+                      **stats)
+
+    def _rollback(self, reason, stats):
+        self.decision = {"verdict": "rollback", "reason": reason,
+                         **stats}
+        # retract vN+1 BEFORE flipping terminal: the router unpins
+        # only once every worker's newest version is vN again
+        for wname in list(self.pushed):
+            w = next((w for w in self.router.workers
+                      if w.name == wname), None)
+            if w is not None:
+                self._retract(w)
+        self._set_state("rolled_back")
+        flight.record("rollout_rollback", model=self.name,
+                      version=self.version, reason=reason,
+                      restored=self.incumbent_version, **stats)
+
+    def describe(self):
+        return {"model": self.name, "version": self.version,
+                "incumbent": self.incumbent_version,
+                "state": self.state, "history": list(self.history),
+                "canary": None if self.canary is None
+                else self.canary.name,
+                "pushed": list(self.pushed),
+                "decision": self.decision, **self._stats()}

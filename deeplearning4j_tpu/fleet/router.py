@@ -1,0 +1,704 @@
+"""FleetRouter: the thin HTTP front of the multi-process serving tier
+(ISSUE 15 tentpole).
+
+The router owns no model and runs no device work — it spawns (or
+adopts) N worker processes, each a full UIServer + InferenceSession
+(:mod:`fleet.worker`), and makes them one logical serving endpoint:
+
+- **discovery**: a poll thread GETs each worker's ``/healthz``
+  (readiness + the compile/memory/decoder sections prior PRs put
+  there), ``/serving/v1/models`` (the merged model list the router
+  re-serves), and ``/metrics`` (the ``dl4j_serving_replica_load`` /
+  ``dl4j_serving_queue_depth`` gauges that feed load-aware picks);
+- **routing**: ``POST /serving/v1/models/<name>:predict`` / ``:decode``
+  forwards to the ready worker with the least (router-side in-flight,
+  polled queue load). The request body and the worker's response pass
+  through the hop unmodified — a 429's ``Retry-After`` and a 504's
+  body reach the client byte-for-byte, and an upstream ``traceparent``
+  is forwarded as-is so router + worker spans land in ONE trace;
+- **death containment**: a transport failure (connection refused/reset,
+  a SIGKILLed worker mid-batch) is retried on another worker within a
+  retry budget — the client sees the survivor's answer, never the
+  death. Consecutive transport failures trip the PR-8 circuit-breaker
+  shape (:data:`FleetRouter.BREAKER`): the worker is ejected from
+  routing and re-admitted when its ``/healthz`` reports ready again.
+  Every ejection/readmission is a flight event;
+- **observability**: ``dl4j_fleet_*`` metrics (docs/OBSERVABILITY.md),
+  a ``/healthz`` fleet section (degraded — still HTTP 200 — while any
+  worker is ejected), and ``GET /debug/fleet`` (workers, rollout state,
+  capture stats).
+
+HTTP-policy note: worker HTTP *responses* (429 shed, 504 timeout, 400,
+500) are answers, not failures — they pass through and never count
+toward the breaker or the retry budget. Only transport-level failures
+(the worker did not answer) are retried; predict/decode are
+idempotent, so a retry never double-charges anything.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import flight, tracing
+from deeplearning4j_tpu.serving import http as shttp
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# response headers that cross the hop back to the client; everything
+# hop-by-hop (Connection, Server, Date, Content-Length is recomputed)
+# stays at the router
+_PASS_HEADERS = ("retry-after", "traceparent", "content-type")
+
+# transport-level failure classes: the worker did not answer (refused,
+# reset mid-read, timed out at connect). urllib's HTTPError is NOT here
+# on purpose — that is a worker *answer* and passes through.
+_TRANSPORT_ERRORS = (urllib.error.URLError, ConnectionError,
+                     http.client.HTTPException, socket.timeout, OSError)
+
+
+class TransportFailure(RuntimeError):
+    """The worker did not produce an HTTP response (dead process,
+    refused connection, reset mid-body). The only retryable class."""
+
+
+class WorkerHandle:
+    """Router-side record of one worker process. All mutable state is
+    guarded by the router's single lock (the ReplicaSet discipline:
+    one mutex keeps the lock-order rule trivially satisfiable)."""
+
+    __slots__ = ("name", "url", "proc", "up", "ready", "consec_failures",
+                 "inflight", "polled_load", "models", "last_health",
+                 "ejected_at", "last_error")
+
+    def __init__(self, name, url, proc=None):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.proc = proc
+        self.up = True
+        self.ready = None         # unknown until the first healthz poll
+        self.consec_failures = 0
+        self.inflight = 0
+        self.polled_load = 0.0
+        self.models = []
+        self.last_health = None
+        self.ejected_at = None
+        self.last_error = None
+
+    def describe(self):
+        return {
+            "url": self.url, "up": self.up, "ready": self.ready,
+            "pid": None if self.proc is None else self.proc.pid,
+            "consec_failures": self.consec_failures,
+            "inflight": self.inflight, "load": self.polled_load,
+            "ejected_at": self.ejected_at, "last_error": self.last_error,
+        }
+
+
+def _http(url, body=None, headers=None, timeout=10.0, method=None):
+    """(status, headers dict, body bytes) for one worker call. Raises
+    :class:`TransportFailure` when no HTTP response came back; a
+    non-2xx response returns normally (pass-through semantics)."""
+    req = urllib.request.Request(
+        url, data=body, headers=dict(headers or {}),
+        method=method or ("POST" if body is not None else "GET"))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers.items()), resp.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, dict(e.headers.items()), e.read()
+    except _TRANSPORT_ERRORS as e:
+        raise TransportFailure(f"{type(e).__name__}: {e}") from None
+
+
+def _parse_gauge_sum(text, name) -> float:
+    """Sum of one gauge family's samples from a Prometheus text
+    exposition (the router's cheap load probe — no client library)."""
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue   # a longer name sharing the prefix
+        try:
+            value = float(line.rsplit(None, 1)[1])
+        except (ValueError, IndexError):
+            continue
+        if value >= 0:   # -1 = dead replica sentinel, not load
+            total += value
+    return total
+
+
+def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
+                        extra_env=None, admission_budget=None,
+                        max_latency=0.0):
+    """Spawn N worker processes serving ``spec`` (a JSON-able dict,
+    see fleet/worker.py), wait until every one reports a bound port
+    AND a ready /healthz, and return their :class:`WorkerHandle` list.
+    On any startup failure the already-started processes are killed."""
+    import subprocess
+
+    base_dir = base_dir or tempfile.mkdtemp(prefix="dl4j_fleet_")
+    os.makedirs(base_dir, exist_ok=True)
+    spec_path = os.path.join(base_dir, "fleet_spec.json")
+    tmp = spec_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec, f)
+    os.replace(tmp, spec_path)
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    handles, procs = [], []
+    try:
+        for i in range(int(n)):
+            port_file = os.path.join(base_dir, f"w{i}.port")
+            try:
+                os.remove(port_file)
+            except OSError:
+                pass
+            cmd = [sys.executable, "-m",
+                   "deeplearning4j_tpu.fleet.worker",
+                   "--spec", spec_path, "--port", "0",
+                   "--port-file", port_file,
+                   "--max-latency", str(max_latency)]
+            if admission_budget is not None:
+                cmd += ["--admission-budget", str(admission_budget)]
+            procs.append((i, port_file, subprocess.Popen(cmd, env=env)))
+        deadline = time.monotonic() + timeout
+        for i, port_file, proc in procs:
+            port = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"fleet worker w{i} exited rc={proc.returncode} "
+                        f"before binding a port")
+                try:
+                    with open(port_file) as f:
+                        port = int(f.read().strip())
+                    break
+                except (OSError, ValueError):
+                    time.sleep(0.05)
+            if port is None:
+                raise TimeoutError(f"fleet worker w{i} never bound "
+                                   f"a port within {timeout}s")
+            handles.append(WorkerHandle(f"w{i}",
+                                        f"http://127.0.0.1:{port}",
+                                        proc=proc))
+        for w in handles:   # block until warmed: no cold compile in
+            while True:     # any first request's latency path
+                try:
+                    status, _, body = _http(w.url + "/healthz",
+                                            timeout=2.0)
+                except TransportFailure:
+                    status, body = 0, b""
+                if status == 200:
+                    w.ready = True
+                    w.last_health = json.loads(body)
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet worker {w.name} never became ready")
+                time.sleep(0.05)
+    except Exception:
+        for _, _, proc in procs:
+            proc.kill()
+        raise
+    return handles
+
+
+class FleetRouter:
+    """The fleet front door. ``workers`` is a list of
+    :class:`WorkerHandle` (or bare base URLs to adopt). ``start()``
+    binds the router's HTTP server and starts the poll thread;
+    ``close()`` stops both (and terminates spawned worker processes
+    when ``owns_workers``)."""
+
+    # consecutive transport failures before a worker is ejected from
+    # routing — the PR-8 replica breaker shape at process granularity
+    # (a dead worker refuses instantly; without the breaker its ~0
+    # in-flight count would keep attracting least-loaded picks)
+    BREAKER = 3
+
+    def __init__(self, workers, poll_interval=0.25, retry_budget=2,
+                 request_timeout=60.0, poll_timeout=2.0, capture=None,
+                 owns_workers=False):
+        self.workers = [w if isinstance(w, WorkerHandle)
+                        else WorkerHandle(f"w{i}", w)
+                        for i, w in enumerate(workers)]
+        if not self.workers:
+            raise ValueError("a fleet needs at least one worker")
+        self.poll_interval = float(poll_interval)
+        self.retry_budget = int(retry_budget)
+        self.request_timeout = float(request_timeout)
+        self.poll_timeout = float(poll_timeout)
+        self.capture = capture
+        self.owns_workers = owns_workers
+        self.port = None
+        self._rollout = None
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+        self._poll_thread = None
+        self._stop = threading.Event()
+        self._instruments = None
+
+    # -- telemetry -----------------------------------------------------------
+    def _inst(self):
+        """The bound FleetInstruments bundle, or None while telemetry
+        is disabled (re-checked per call; the bundle builds once)."""
+        if not telemetry.enabled():
+            return None
+        if self._instruments is None:
+            self._instruments = telemetry.fleet_instruments()
+        return self._instruments
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, port=0):
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", int(port)),
+                                          _RouterHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.router = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dl4j-fleet-router")
+        self._thread.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name="dl4j-fleet-poll")
+        self._poll_thread.start()
+        inst = self._inst()
+        if inst is not None:
+            for w in self.workers:
+                inst.worker_up(w.name).set(1.0 if w.up else 0.0)
+        flight.record("fleet_start", port=self.port,
+                      workers=[w.name for w in self.workers])
+        log.info("fleet router on http://127.0.0.1:%d (%d workers)",
+                 self.port, len(self.workers))
+        return self
+
+    def close(self, timeout=5.0):
+        self._stop.set()
+        if self._rollout is not None:
+            self._rollout.close()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout)
+            self._poll_thread = None
+        if self.owns_workers:
+            for w in self.workers:
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.terminate()
+            for w in self.workers:
+                if w.proc is not None:
+                    try:
+                        w.proc.wait(timeout)
+                    except Exception:
+                        w.proc.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- worker state --------------------------------------------------------
+    def _pick(self, tried):
+        """The ready worker with the least load, excluding ``tried``;
+        increments its in-flight count under the lock (the caller MUST
+        pair with :meth:`_done`). ``ready is False`` (worker said it is
+        warming/diverged) excludes; ``None`` (not yet polled) does not
+        — a just-adopted fleet must route before its first poll."""
+        with self._lock:
+            live = [w for w in self.workers
+                    if w.up and w.name not in tried
+                    and w.ready is not False]
+            if not live:
+                return None
+            w = min(live, key=lambda w: (w.inflight, w.polled_load,
+                                         w.name))
+            w.inflight += 1
+            return w
+
+    def _done(self, w):
+        with self._lock:
+            w.inflight -= 1
+
+    def _note_transport_failure(self, w, err):
+        """Breaker input: under the lock, bump the consecutive count
+        and eject at the threshold (or instantly when the spawned
+        process is dead — no point waiting out the breaker on a
+        corpse)."""
+        proc_dead = w.proc is not None and w.proc.poll() is not None
+        with self._lock:
+            w.consec_failures += 1
+            w.last_error = str(err)
+            eject = w.up and (proc_dead
+                              or w.consec_failures >= self.BREAKER)
+            if eject:
+                w.up = False
+                w.ready = None
+                w.ejected_at = time.time()
+        if eject:
+            flight.record("worker_ejected", worker=w.name,
+                          error=str(err),
+                          consec_failures=w.consec_failures,
+                          proc_dead=proc_dead)
+            log.warning("fleet worker %s ejected (%s)", w.name, err)
+            inst = self._inst()
+            if inst is not None:
+                inst.worker_up(w.name).set(0.0)
+
+    def _note_success(self, w):
+        with self._lock:
+            w.consec_failures = 0
+
+    def _readmit(self, w, payload):
+        with self._lock:
+            was_down = not w.up
+            w.up = True
+            w.ready = bool(payload.get("ready"))
+            w.consec_failures = 0
+            w.ejected_at = None
+        if was_down:
+            flight.record("worker_readmitted", worker=w.name)
+            log.info("fleet worker %s readmitted", w.name)
+            inst = self._inst()
+            if inst is not None:
+                inst.worker_up(w.name).set(1.0)
+
+    # -- the poll thread -----------------------------------------------------
+    def _poll_loop(self):
+        while not self._stop.wait(self.poll_interval):
+            for w in list(self.workers):
+                if self._stop.is_set():
+                    return
+                self._poll_worker(w)
+
+    def _poll_worker(self, w):
+        try:
+            status, _, body = _http(w.url + "/healthz",
+                                    timeout=self.poll_timeout)
+            payload = json.loads(body)
+        except (TransportFailure, ValueError) as e:
+            if w.up:
+                self._note_transport_failure(w, e)
+            return
+        # /healthz answered: 200 = ready, 503 = live but warming or
+        # diverged — the worker stays routable-on-recovery either way
+        if status == 200:
+            self._readmit(w, payload)
+        else:
+            with self._lock:
+                w.ready = False
+        with self._lock:
+            w.last_health = payload
+        if not w.up:
+            return
+        try:
+            _, _, mbody = _http(w.url + "/serving/v1/models",
+                                timeout=self.poll_timeout)
+            models = json.loads(mbody).get("models", [])
+            _, _, raw = _http(w.url + "/metrics",
+                              timeout=self.poll_timeout)
+            text = raw.decode()
+            load = (_parse_gauge_sum(text, "dl4j_serving_queue_depth")
+                    + _parse_gauge_sum(text,
+                                       "dl4j_serving_replica_load"))
+        except (TransportFailure, ValueError, UnicodeDecodeError) as e:
+            self._note_transport_failure(w, e)
+            return
+        with self._lock:
+            w.models = models
+            w.polled_load = load
+        self._note_success(w)
+
+    # -- request path --------------------------------------------------------
+    def handle_request(self, name, kind, path, body, in_headers):
+        """Route one :predict/:decode. Returns (status, headers, body)
+        — the worker's answer passed through. Raises
+        :class:`serving.http.HttpError` for router-origin errors (503
+        no live worker, 502 retry budget exhausted)."""
+        inst = self._inst()
+        rollout = self._rollout
+        if rollout is not None and kind == "predict" \
+                and rollout.pins(name):
+            body = rollout.pin_body(body)
+        tp = in_headers.get("traceparent")
+        fwd = {"Content-Type": "application/json"}
+        if tp:
+            # forwarded UNMODIFIED: the worker joins the same trace id,
+            # so router + worker spans compose into one tree
+            fwd["traceparent"] = tp
+        root = tracing.start_trace(f"fleet.{kind}", traceparent=tp,
+                                   model=name)
+        with (root or tracing.NULL):
+            return self._route(name, kind, path, body, fwd, inst,
+                               rollout, root)
+
+    def _route(self, name, kind, path, body, fwd, inst, rollout, root):
+        tried = set()
+        retries = 0
+        while True:
+            w = self._pick(tried)
+            if w is None:
+                if inst is not None:
+                    inst.request("none", "no_worker")
+                raise shttp.HttpError(
+                    503, "no live fleet worker available")
+            t0 = time.perf_counter()
+            try:
+                try:
+                    status, rh, rb = _http(
+                        w.url + path, body=body, headers=fwd,
+                        timeout=self.request_timeout)
+                finally:
+                    self._done(w)
+            except TransportFailure as e:
+                self._note_transport_failure(w, e)
+                tried.add(w.name)
+                if inst is not None:
+                    inst.request(w.name, "transport")
+                if retries < self.retry_budget:
+                    retries += 1
+                    if inst is not None:
+                        inst.retries.inc()
+                    flight.record("fleet_retry", worker=w.name,
+                                  model=name, error=str(e),
+                                  attempt=retries)
+                    continue
+                raise shttp.HttpError(
+                    502, f"fleet: no worker reachable for {name!r} "
+                         f"after {retries} retries: {e}")
+            dt = time.perf_counter() - t0
+            self._note_success(w)
+            if root:
+                root.set_attr(worker=w.name, http_status=status,
+                              retries=retries)
+            if inst is not None:
+                inst.hop(w.name).observe(dt)
+                inst.request(w.name, _outcome(status))
+            if status == 200 and kind == "predict":
+                if self.capture is not None:
+                    self.capture.maybe_record(name, body, rb, inst=inst)
+                if rollout is not None:
+                    rollout.on_primary(name, body, rb, dt)
+            out = {k: v for k, v in rh.items()
+                   if k.lower() in _PASS_HEADERS}
+            return status, out, rb
+
+    # -- rollout -------------------------------------------------------------
+    def start_rollout(self, name, spec, version, **kw):
+        """Begin a canary rollout of ``spec`` as ``name`` version
+        ``version`` (see fleet/rollout.py). One at a time: the
+        previous rollout must be terminal."""
+        from deeplearning4j_tpu.fleet.rollout import RolloutController
+
+        with self._lock:
+            cur = self._rollout
+            if cur is not None and not cur.terminal():
+                raise RuntimeError(
+                    f"a rollout is already active (state {cur.state})")
+        ctl = RolloutController(self, name, spec, version, **kw)
+        self._rollout = ctl
+        try:
+            ctl.start()
+        except Exception:
+            # a rollout that never reached canary must not wedge the
+            # one-at-a-time gate
+            if not ctl.terminal():
+                self._rollout = None
+            raise
+        return ctl
+
+    @property
+    def rollout(self):
+        return self._rollout
+
+    # -- GET surfaces --------------------------------------------------------
+    def merged_models(self):
+        """Union of the live workers' model rows by (name, version) —
+        the router's GET /serving/v1/models payload."""
+        rows = {}
+        with self._lock:
+            for w in self.workers:
+                if not w.up:
+                    continue
+                for m in w.models:
+                    rows.setdefault((m.get("name"), m.get("version")),
+                                    m)
+        return [rows[k] for k in sorted(
+            rows, key=lambda k: (str(k[0]), -(k[1] or 0)))]
+
+    def healthz(self):
+        """(payload, status) for the router's /healthz: ready while at
+        least one worker is routable; DEGRADED — still 200 — while any
+        worker is ejected (capacity reduced, traffic flows)."""
+        with self._lock:
+            rows = {w.name: w.describe() for w in self.workers}
+            live = [w for w in self.workers if w.up]
+            routable = [w for w in live if w.ready is not False]
+        ready = bool(routable)
+        degraded = len(live) < len(self.workers)
+        status = ("degraded" if ready and degraded
+                  else "ok" if ready else "warming")
+        payload = {
+            "status": status, "live": True, "ready": ready,
+            "fleet": {"workers": rows, "size": len(self.workers),
+                      "routable": len(routable),
+                      "degraded": degraded},
+        }
+        if self._rollout is not None:
+            payload["rollout"] = self._rollout.describe()
+        return payload, (200 if ready else 503)
+
+    def describe(self):
+        """GET /debug/fleet payload."""
+        with self._lock:
+            workers = {w.name: w.describe() for w in self.workers}
+        out = {"workers": workers,
+               "retry_budget": self.retry_budget,
+               "breaker": self.BREAKER}
+        if self._rollout is not None:
+            out["rollout"] = self._rollout.describe()
+        if self.capture is not None:
+            out["capture"] = self.capture.describe()
+        return out
+
+
+def _outcome(status) -> str:
+    if status == 200:
+        return "ok"
+    if status == 429:
+        return "shed"
+    if status == 504:
+        return "timeout"
+    if 400 <= status < 500:
+        return "client_error"
+    return "upstream_error"
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpuFleet/1.0"
+
+    def _respond(self, body, status=200, ctype="application/json",
+                 headers=None):
+        self.send_response(status)
+        headers = dict(headers or {})
+        if not any(k.lower() == "content-type" for k in headers):
+            headers["Content-Type"] = ctype
+        headers["Content-Length"] = str(len(body))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        router = self.server.router
+        if self.path.rstrip("/") == shttp.MODELS_PATH:
+            self._respond(json.dumps(
+                {"models": router.merged_models()}).encode())
+        elif self.path == "/healthz":
+            payload, status = router.healthz()
+            self._respond(json.dumps(payload).encode(), status=status)
+        elif self.path == "/metrics" or self.path.startswith("/metrics?"):
+            from deeplearning4j_tpu.telemetry import prometheus
+
+            self._respond(prometheus.render().encode(),
+                          ctype=prometheus.CONTENT_TYPE)
+        elif self.path.startswith("/debug/fleet"):
+            self._respond(json.dumps(router.describe()).encode())
+        else:
+            self._respond(b'{"error": "not found"}', status=404)
+
+    def do_POST(self):
+        router = self.server.router
+        name = shttp.parse_predict_path(self.path)
+        kind = "predict"
+        if name is None:
+            name = shttp.parse_decode_path(self.path)
+            kind = "decode"
+        if name is None:
+            self._respond(b'{"error": "not found"}', status=404)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            status, headers, out = router.handle_request(
+                name, kind, self.path, body,
+                {"traceparent": self.headers.get("traceparent")})
+        except shttp.HttpError as e:
+            self._respond(shttp.error_body(e), status=e.status,
+                          headers=e.headers)
+            return
+        except Exception as e:   # router bug: answer, don't hang
+            log.exception("fleet router error on %s", self.path)
+            self._respond(shttp.error_body(shttp.HttpError(
+                500, f"{type(e).__name__}: {e}")), status=500)
+            return
+        self._respond(out, status=status, headers=headers)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def main(argv=None) -> int:
+    """Standalone router: spawn N workers from a spec and serve.
+
+        python -m deeplearning4j_tpu.fleet.router \\
+            --spec spec.json --workers 3 --port 9100
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description="fleet router")
+    p.add_argument("--spec", required=True)
+    p.add_argument("--workers", type=int, default=3)
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--adopt", default=None,
+                   help="comma-separated worker base URLs to adopt "
+                        "instead of spawning")
+    args = p.parse_args(argv)
+    if args.adopt:
+        handles = [WorkerHandle(f"w{i}", u) for i, u in
+                   enumerate(args.adopt.split(","))]
+        owns = False
+    else:
+        with open(args.spec) as f:
+            spec = json.load(f)
+        handles = spawn_local_workers(args.workers, spec)
+        owns = True
+    router = FleetRouter(handles, owns_workers=owns).start(args.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,212 @@
+"""Fleet worker: one serving process of the fleet tier (ISSUE 15).
+
+A worker is deliberately nothing new — a full :class:`InferenceSession`
+behind a full :class:`UIServer`, exactly the single-process stack every
+prior PR built, plus two fleet seams:
+
+- **spec-built models**: the router (and its rollouts) cannot ship live
+  Python objects across the process boundary, so models arrive as JSON
+  specs and :func:`build_servable` turns a spec into a servable in the
+  worker process. ``kind: "mlp"`` builds a real jitted
+  MultiLayerNetwork (cold start hits the PR-13 compile store);
+  ``kind: "linear"`` is the deterministic host-side stand-in the fleet
+  tests and the router-overhead bench lean on (y = scale·x + bias,
+  optional injected service delay — the knob a deliberately-regressed
+  canary uses);
+- **the admin surface**: :class:`WorkerAdmin` exposes the versioned
+  re-register seam (``POST /serving/v1/models/<name>:register`` /
+  ``:unregister`` on the worker's UIServer, serving/http.py) that
+  rolling updates push vN+1 specs through and rollbacks retract them.
+
+Run one with::
+
+    python -m deeplearning4j_tpu.fleet.worker \
+        --spec spec.json --port 0 --port-file /tmp/w0.port
+
+The worker writes its bound port to ``--port-file`` (tmp + rename, so a
+reader never sees a half-written file) once the server is up, then
+serves until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.servable import Servable, as_servable
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class LinearServable(Servable):
+    """Deterministic host-side servable: ``y = scale * x + bias`` in
+    float32, with an optional per-dispatch service delay. No device
+    work, no compile — which makes it exactly the model the fleet tier
+    wants for measuring its OWN overhead (the router hop must be
+    measured against a ~free model, PAPERS.md off-math-path rule) and
+    for bit-identical canary agreement checks across processes."""
+
+    def __init__(self, example_shape=(4,), scale=1.0, bias=0.0,
+                 delay_ms=0.0):
+        super().__init__(example_shape, dtype=np.float32)
+        self.scale = float(scale)
+        self.bias = float(bias)
+        self.delay_s = float(delay_ms) / 1e3
+
+    def warmup(self, ladder):
+        return []   # nothing to compile
+
+    def infer(self, x):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        return (x * np.float32(self.scale)
+                + np.float32(self.bias)).astype(np.float32)
+
+
+def _build_linear(spec):
+    return LinearServable(
+        example_shape=tuple(spec.get("example_shape", (4,))),
+        scale=spec.get("scale", 1.0), bias=spec.get("bias", 0.0),
+        delay_ms=spec.get("delay_ms", 0.0))
+
+
+def _build_mlp(spec):
+    """A real jitted network (the production worker path — its cold
+    warmup exercises the PR-13 executable store end to end)."""
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+
+    n_in = int(spec.get("n_in", 8))
+    n_out = int(spec.get("n_out", 4))
+    width = int(spec.get("width", 16))
+    b = (NeuralNetConfiguration.Builder().seed(int(spec.get("seed", 7)))
+         .list()
+         .layer(DenseLayer.Builder().nIn(n_in).nOut(width)
+                .activation("tanh").build())
+         .layer(OutputLayer.Builder().nOut(n_out).activation("softmax")
+                .lossFunction(LossFunction.MCXENT).build()))
+    net = MultiLayerNetwork(b.build()).init()
+    return as_servable(net, (n_in,), None)
+
+
+SPEC_BUILDERS = {"linear": _build_linear, "mlp": _build_mlp}
+
+
+def build_servable(spec) -> Servable:
+    """A Servable from a JSON-able spec dict: ``{"kind": ..., ...}``.
+    Raises ValueError on an unknown kind (HTTP 400 at the admin
+    route)."""
+    if not isinstance(spec, dict):
+        raise ValueError(f"model spec must be a dict, got {type(spec)}")
+    kind = spec.get("kind")
+    builder = SPEC_BUILDERS.get(kind)
+    if builder is None:
+        raise ValueError(
+            f"unknown model-spec kind {kind!r}; "
+            f"choose from {sorted(SPEC_BUILDERS)}")
+    return builder(spec)
+
+
+class WorkerAdmin:
+    """The worker-side half of the rollout seam: registers/unregisters
+    spec-built model versions on the worker's InferenceSession.
+    Attached to a UIServer via ``serveFleetAdmin`` — the router's
+    RolloutController talks to it over
+    ``POST /serving/v1/models/<name>:register`` / ``:unregister``."""
+
+    def __init__(self, session):
+        self.session = session
+
+    def register_spec(self, name, spec, version, warmup=True):
+        sv = build_servable(spec)
+        kw = {}
+        ladder = spec.get("ladder")
+        if ladder:
+            kw["ladder"] = tuple(int(b) for b in ladder)
+        return self.session.register(name, sv, version=int(version),
+                                     warmup=bool(warmup), **kw)
+
+    def unregister(self, name, version=None):
+        self.session.registry.unregister(
+            name, None if version is None else int(version))
+
+
+def _write_port_file(path, port):
+    """Commit the bound port via tmp + rename: the spawner polls this
+    file and must never read a torn value."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(int(port)))
+    os.replace(tmp, path)
+
+
+def serve(spec, port=0, port_file=None, max_latency=0.0,
+          admission_budget=None, stop_event=None):
+    """Build the session from ``spec`` and serve until ``stop_event``
+    is set (the testable core of main()). Returns the UIServer."""
+    from deeplearning4j_tpu.serving import (
+        AdmissionController, InferenceSession)
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    admission = (None if admission_budget is None
+                 else AdmissionController(default_budget=admission_budget))
+    session = InferenceSession(max_latency=max_latency,
+                               admission=admission)
+    admin = WorkerAdmin(session)
+    for m in spec.get("models", ()):
+        admin.register_spec(m["name"], m, m.get("version", 1),
+                            warmup=m.get("warmup", True))
+    # a fresh UIServer instance per worker process — the getInstance()
+    # singleton is a same-process convenience the fleet must not share
+    server = UIServer()
+    server.serveModels(session).serveFleetAdmin(admin).start(port=port)
+    if port_file:
+        _write_port_file(port_file, server.port)
+    log.info("fleet worker pid=%d serving on port %d", os.getpid(),
+             server.port)
+    if stop_event is not None:
+        stop_event.wait()
+        server.stop()
+        session.close()
+    return server
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="fleet worker: UIServer + InferenceSession from a "
+                    "JSON model spec")
+    p.add_argument("--spec", required=True,
+                   help="JSON file: {\"models\": [{name, version, "
+                        "kind, ...}]}")
+    p.add_argument("--port", type=int, default=0,
+                   help="bind port (0 = OS-assigned)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here once serving")
+    p.add_argument("--max-latency", type=float, default=0.0,
+                   help="batcher coalescing window (seconds)")
+    p.add_argument("--admission-budget", type=int, default=None,
+                   help="attach an AdmissionController with this "
+                        "per-model concurrency budget")
+    args = p.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    serve(spec, port=args.port, port_file=args.port_file,
+          max_latency=args.max_latency,
+          admission_budget=args.admission_budget, stop_event=stop)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

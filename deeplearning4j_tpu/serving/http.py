@@ -34,6 +34,8 @@ from deeplearning4j_tpu.serving.registry import ModelNotFound
 
 PREDICT_SUFFIX = ":predict"
 DECODE_SUFFIX = ":decode"
+REGISTER_SUFFIX = ":register"
+UNREGISTER_SUFFIX = ":unregister"
 MODELS_PATH = "/serving/v1/models"
 
 
@@ -45,23 +47,90 @@ class HttpError(Exception):
         self.headers = dict(headers or {})
 
 
+def _parse_suffix_path(path: str, suffix: str):
+    if not path.startswith(MODELS_PATH + "/") or \
+            not path.endswith(suffix):
+        return None
+    name = path[len(MODELS_PATH) + 1:-len(suffix)]
+    return name or None
+
+
 def parse_predict_path(path: str):
     """'/serving/v1/models/<name>:predict' -> name, or None when the
     path is not a predict route."""
-    if not path.startswith(MODELS_PATH + "/") or \
-            not path.endswith(PREDICT_SUFFIX):
-        return None
-    name = path[len(MODELS_PATH) + 1:-len(PREDICT_SUFFIX)]
-    return name or None
+    return _parse_suffix_path(path, PREDICT_SUFFIX)
 
 
 def parse_decode_path(path: str):
     """'/serving/v1/models/<name>:decode' -> name, or None."""
-    if not path.startswith(MODELS_PATH + "/") or \
-            not path.endswith(DECODE_SUFFIX):
-        return None
-    name = path[len(MODELS_PATH) + 1:-len(DECODE_SUFFIX)]
-    return name or None
+    return _parse_suffix_path(path, DECODE_SUFFIX)
+
+
+def parse_register_path(path: str):
+    """'/serving/v1/models/<name>:register' -> name, or None. The
+    fleet-admin seam (ISSUE 15): rollouts push spec-built model
+    versions through the worker's versioned registry."""
+    return _parse_suffix_path(path, REGISTER_SUFFIX)
+
+
+def parse_unregister_path(path: str):
+    """'/serving/v1/models/<name>:unregister' -> name, or None."""
+    return _parse_suffix_path(path, UNREGISTER_SUFFIX)
+
+
+def handle_register(admin, name: str, body: bytes) -> bytes:
+    """POST /serving/v1/models/<name>:register — register a model
+    version from a JSON spec (fleet rollouts, docs/FLEET.md):
+
+        {"spec": {"kind": "linear", ...}, "version": 2,
+         "warmup": true}
+        -> {"model": ..., "version": 2, "warmed": true}
+    """
+    if admin is None:
+        raise HttpError(404, "no fleet admin attached "
+                             "(UIServer.serveFleetAdmin(admin))")
+    try:
+        payload = json.loads(body or b"")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HttpError(400, f"malformed JSON body: {e}") from None
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("spec"), dict) or \
+            "version" not in payload:
+        raise HttpError(400, 'body must be {"spec": {...}, '
+                             '"version": N}')
+    try:
+        entry = admin.register_spec(
+            name, payload["spec"], int(payload["version"]),
+            warmup=bool(payload.get("warmup", True)))
+    except (ValueError, TypeError) as e:
+        raise HttpError(400, str(e)) from None
+    except Exception as e:
+        raise HttpError(500, f"{type(e).__name__}: {e}") from None
+    return json.dumps({"model": name, "version": entry.version,
+                       "warmed": entry.warmed}).encode()
+
+
+def handle_unregister(admin, name: str, body: bytes) -> bytes:
+    """POST /serving/v1/models/<name>:unregister — retract one version
+    (rollout rollback) or every version: {"version": 2} / {}."""
+    if admin is None:
+        raise HttpError(404, "no fleet admin attached "
+                             "(UIServer.serveFleetAdmin(admin))")
+    try:
+        payload = json.loads(body or b"{}")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HttpError(400, f"malformed JSON body: {e}") from None
+    version = payload.get("version") if isinstance(payload, dict) \
+        else None
+    try:
+        admin.unregister(name, version)
+    except ModelNotFound as e:
+        raise HttpError(404, f"unknown model: {e}") from None
+    except (ValueError, TypeError) as e:
+        raise HttpError(400, str(e)) from None
+    except Exception as e:
+        raise HttpError(500, f"{type(e).__name__}: {e}") from None
+    return json.dumps({"model": name, "unregistered": version}).encode()
 
 
 def handle_decode(session, name: str, body: bytes) -> bytes:

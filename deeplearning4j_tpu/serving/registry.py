@@ -157,8 +157,14 @@ class ModelRegistry:
                 dropped = list(self._models[name].values())
                 del self._models[name]
             else:
-                dropped = [self._models[name][int(version)]]
-                del self._models[name][int(version)]
+                try:
+                    dropped = [self._models[name][int(version)]]
+                    del self._models[name][int(version)]
+                except KeyError:
+                    # a plain KeyError would map to HTTP 500 at the
+                    # admin route; a missing version is a 404 exactly
+                    # like a missing name
+                    raise ModelNotFound(f"{name}:{version}") from None
                 if not self._models[name]:
                     del self._models[name]
         # the dropped versions' executables are no longer served: their

@@ -61,21 +61,24 @@ from deeplearning4j_tpu.telemetry.health import (
     DivergenceError, HealthConfig, HealthMonitor)
 from deeplearning4j_tpu.telemetry.listener import MetricsListener
 from deeplearning4j_tpu.telemetry.registry import (
-    BYTES_BUCKETS, Counter, ETL_HELP, EtlInstruments, Gauge, Histogram,
-    LoopInstruments, MetricsRegistry, SECONDS_BUCKETS, STEP_HELP,
-    ServingInstruments, Timer, collect_device_memory, disable, enable,
-    enabled, etl_instruments, get_registry, log_buckets, loop_instruments,
-    serving_instruments, set_registry, span)
+    BYTES_BUCKETS, Counter, ETL_HELP, EtlInstruments, FleetInstruments,
+    Gauge, Histogram, LoopInstruments, MetricsRegistry, SECONDS_BUCKETS,
+    STEP_HELP, ServingInstruments, Timer, collect_device_memory, disable,
+    enable, enabled, etl_instruments, fleet_instruments, get_registry,
+    log_buckets, loop_instruments, serving_instruments, set_registry,
+    span)
 
 __all__ = [
     "BYTES_BUCKETS", "CapacityError", "Counter", "DeviceOomError",
     "DivergenceError", "ETL_HELP",
-    "EtlInstruments", "FlightRecorder", "Gauge", "HealthConfig",
+    "EtlInstruments", "FleetInstruments", "FlightRecorder", "Gauge",
+    "HealthConfig",
     "HealthMonitor", "Histogram", "LoopInstruments", "MetricsListener",
     "MetricsRegistry", "SECONDS_BUCKETS", "STEP_HELP",
     "ServingInstruments", "Timer", "aggregate", "aggregate_snapshot",
     "collect_device_memory", "compile_ledger", "costmodel", "disable",
-    "enable", "enabled", "etl_instruments", "flight", "get_registry",
+    "enable", "enabled", "etl_instruments", "fleet_instruments",
+    "flight", "get_registry",
     "health", "hlo_audit", "log_buckets", "loop_instruments",
     "memledger", "prometheus", "serving_instruments", "set_registry",
     "span", "tracing",

@@ -627,6 +627,74 @@ def serving_instruments(model):
     return ServingInstruments(get_registry(), model)
 
 
+# -- the fleet-router instrument set (ISSUE 15) ------------------------------
+
+FLEET_REQUESTS_HELP = ("Fleet requests routed, by worker and outcome "
+                       "(ok|shed|timeout|client_error|upstream_error|"
+                       "transport|no_worker)")
+FLEET_WORKER_UP_HELP = ("Router's view of a worker: 1 = routable, "
+                        "0 = ejected by the transport breaker or down")
+FLEET_RETRIES_HELP = ("Requests re-sent to a surviving worker after a "
+                      "transport failure (the client never saw the "
+                      "death)")
+FLEET_ROLLOUT_STATE_HELP = ("Rollout state machine position: -1 "
+                            "rolled_back, 0 idle, 1 canary, 2 "
+                            "promoting, 3 complete")
+FLEET_HOP_HELP = ("Router→worker hop seconds (forward + worker "
+                  "service + response read)")
+FLEET_MIRROR_HELP = ("Canary mirror comparisons by verdict "
+                     "(agree|disagree|error)")
+FLEET_CAPTURED_HELP = ("Live requests head-sampled into the traffic-"
+                       "capture ring (train-from-traffic)")
+
+
+class FleetInstruments:
+    """Bound fleet-router instruments (mirrors ServingInstruments:
+    obtained once per router, None when telemetry is disabled, so a
+    disabled router performs zero registry calls per request)."""
+
+    __slots__ = ("_requests", "_worker_up", "retries", "rollout_state",
+                 "_hop", "_mirror", "captured")
+
+    def __init__(self, registry):
+        self._requests = registry.counter(
+            "dl4j_fleet_requests_total", FLEET_REQUESTS_HELP,
+            ("worker", "outcome"))
+        self._worker_up = registry.gauge(
+            "dl4j_fleet_worker_up", FLEET_WORKER_UP_HELP, ("worker",))
+        self.retries = registry.counter(
+            "dl4j_fleet_retries_total", FLEET_RETRIES_HELP)
+        self.rollout_state = registry.gauge(
+            "dl4j_fleet_rollout_state", FLEET_ROLLOUT_STATE_HELP)
+        self._hop = registry.histogram(
+            "dl4j_fleet_request_seconds", FLEET_HOP_HELP, ("worker",))
+        self._mirror = registry.counter(
+            "dl4j_fleet_mirror_total", FLEET_MIRROR_HELP, ("verdict",))
+        self.captured = registry.counter(
+            "dl4j_fleet_captured_total", FLEET_CAPTURED_HELP)
+
+    def request(self, worker, outcome):
+        self._requests.labels(worker=worker, outcome=outcome).inc()
+
+    def worker_up(self, worker):
+        return self._worker_up.labels(worker=worker)
+
+    def hop(self, worker):
+        return self._hop.labels(worker=worker)
+
+    def mirror(self, verdict):
+        self._mirror.labels(verdict=verdict).inc()
+
+
+def fleet_instruments():
+    """The fleet-router instrument bundle, or None when telemetry is
+    disabled (the zero-cost-when-off contract, gate-listed in the
+    dl4jlint telemetry-gate rule)."""
+    if not _state["enabled"]:
+        return None
+    return FleetInstruments(get_registry())
+
+
 # -- compile visibility (jit-cache-miss hook) --------------------------------
 
 COMPILE_HELP = "XLA backend compiles observed in this process"

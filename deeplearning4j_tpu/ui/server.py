@@ -234,6 +234,26 @@ class _Handler(BaseHTTPRequestHandler):
         from deeplearning4j_tpu.serving import http as shttp
         from deeplearning4j_tpu.telemetry import tracing
 
+        # fleet-admin control plane (ISSUE 15): rollouts push/retract
+        # spec-built model versions through the versioned registry —
+        # 404 unless a WorkerAdmin is attached (serveFleetAdmin)
+        admin_name = shttp.parse_register_path(self.path)
+        admin_handler = shttp.handle_register
+        if admin_name is None:
+            admin_name = shttp.parse_unregister_path(self.path)
+            admin_handler = shttp.handle_unregister
+        if admin_name is not None:
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                out = admin_handler(self.server.ui._fleet_admin,
+                                    admin_name, body)
+            except shttp.HttpError as e:
+                self._respond(shttp.error_body(e), status=e.status,
+                              headers=e.headers)
+                return
+            self._respond(out)
+            return
         name = shttp.parse_predict_path(self.path)
         handler = shttp.handle_predict
         kind = "predict"
@@ -286,6 +306,7 @@ class UIServer:
         self._httpd = None
         self._thread = None
         self._serving = None
+        self._fleet_admin = None
         self.port = None
 
     @classmethod
@@ -319,6 +340,14 @@ class UIServer:
         """Attach an InferenceSession: enables POST
         /serving/v1/models/<name>:predict and GET /serving/v1/models."""
         self._serving = session
+        return self
+
+    def serveFleetAdmin(self, admin):
+        """Attach a fleet WorkerAdmin (ISSUE 15): enables the rollout
+        control plane — POST /serving/v1/models/<name>:register (a
+        model version from a JSON spec) and ...:unregister (retract a
+        version; rollback restores the incumbent)."""
+        self._fleet_admin = admin
         return self
 
     def start(self, port=9000, max_port_retries=16):

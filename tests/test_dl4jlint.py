@@ -714,6 +714,92 @@ class TestThreadHygieneRule:
         assert rules_of(lint(tmp_path, clean), "thread-hygiene") == []
 
 
+class TestFleetRouterFixtures:
+    """ISSUE 15 satellite: TP/near-miss pairs for the fleet router's
+    worker-poll threads (thread-hygiene) and its telemetry emitters
+    (telemetry-gate, incl. the new fleet_instruments gate entry)."""
+
+    def test_flags_unhygienic_poll_thread(self, tmp_path):
+        # the incident shape the fixture encodes: a router poll thread
+        # without an explicit daemon= hangs interpreter exit when a
+        # test crashes mid-poll, and an unjoined one leaves close()
+        # fire-and-forget — both halves of the rule must fire
+        src = """
+            import threading
+
+            class Router:
+                def start(self):
+                    self._poll_thread = threading.Thread(
+                        target=self._poll_loop)
+                    self._poll_thread.start()
+
+                def _poll_loop(self):
+                    pass
+        """
+        hits = rules_of(lint(tmp_path, src), "thread-hygiene")
+        msgs = "\n".join(h.message for h in hits)
+        assert "daemon" in msgs and "never .join()ed" in msgs
+        assert len(hits) == 2
+
+    def test_near_miss_router_poll_idiom_clean(self, tmp_path):
+        # the shape fleet/router.py actually uses: explicit daemon=,
+        # a stop event, and the poll thread joined in close()
+        clean = """
+            import threading
+
+            class Router:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._poll_thread = threading.Thread(
+                        target=self._poll_loop, daemon=True,
+                        name="dl4j-fleet-poll")
+                    self._poll_thread.start()
+
+                def _poll_loop(self):
+                    while not self._stop.wait(0.25):
+                        pass
+
+                def close(self):
+                    self._stop.set()
+                    self._poll_thread.join(timeout=5.0)
+        """
+        assert rules_of(lint(tmp_path, clean), "thread-hygiene") == []
+
+    def test_flags_ungated_fleet_emission(self, tmp_path):
+        # a raw registry emission on the routing hot path with no gate
+        # breaks the zero-calls-when-disabled contract (PR 1, extended
+        # to the fleet emitters in ISSUE 15)
+        src = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_routed(worker, outcome):
+                telemetry.get_registry().counter(
+                    "dl4j_fleet_requests_total", "h",
+                    ("worker", "outcome")).labels(
+                    worker=worker, outcome=outcome).inc()
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_fleet_instruments_bundle_is_the_gate(
+            self, tmp_path):
+        # the idiom the router uses: fleet_instruments() returns None
+        # when telemetry is disabled, so guarding on the bundle IS the
+        # gate (fleet_instruments is in the rule's registry-gate set)
+        clean = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_routed(worker, outcome):
+                inst = telemetry.fleet_instruments()
+                if inst is None:
+                    return
+                inst.request(worker, outcome)
+                telemetry.get_registry().gauge(
+                    "dl4j_fleet_worker_up", "h",
+                    ("worker",)).labels(worker=worker).set(1.0)
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+
 class TestMetricDriftRule:
     def test_flags_prefix_and_undocumented(self, tmp_path):
         src = """
