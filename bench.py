@@ -1687,11 +1687,20 @@ def bench_fleet(duration=1.2, deadline_ms=100.0, rows_per_request=1):
       with no rollout — the canary tax on client latency (mirrors ride
       a background thread, so the tax should be ~the pin rewrite);
     - router hop overhead: direct-to-worker vs through-router p50 at
-      light load.
+      light load, decomposed into the ISSUE 16 hop phases
+      (queue/execute/worker_other/transit from the workers'
+      Server-Timing headers) whose means must cover >=90% of the
+      router-hop mean;
+    - SLO-evaluation overhead: one time-series sample + burn-rate
+      evaluation over the populated registry, amortized per request at
+      the default sampling interval — must stay <=1% of request cost.
     """
     import threading
+    from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.fleet.router import (
         FleetRouter, TransportFailure, _http, spawn_local_workers)
+    from deeplearning4j_tpu.telemetry import slo as slo_mod
+    from deeplearning4j_tpu.telemetry import timeseries
 
     # the worker is made the bottleneck ON PURPOSE (20ms serial
     # service, ladder pinned to batch-1 so the batcher cannot coalesce
@@ -1796,7 +1805,71 @@ def bench_fleet(duration=1.2, deadline_ms=100.0, rows_per_request=1):
                 # router's added hop)
                 w = router.workers[0]
                 direct = open_loop(w.url, 10, 0.8)
+                before = telemetry.get_registry().snapshot()
                 routed = open_loop(url, 10, 0.8)
+                after = telemetry.get_registry().snapshot()
+
+                # hop decomposition (ISSUE 16): the router's own
+                # dl4j_fleet_hop_seconds deltas over the routed run —
+                # the phases partition the measured hop exactly, so
+                # their means must cover >=90% of the router-hop mean
+                # (the acceptance read; the residual is responses that
+                # carried no Server-Timing header)
+                def _delta(key):
+                    return after.get(key, 0.0) - before.get(key, 0.0)
+
+                phase_ms, phase_sum_s = {}, 0.0
+                for phase in ("queue", "execute", "worker_other",
+                              "transit"):
+                    psum = _delta(
+                        f'dl4j_fleet_hop_seconds_sum{{phase="{phase}"}}')
+                    pcount = _delta(
+                        f'dl4j_fleet_hop_seconds_count{{phase="{phase}"}}')
+                    phase_sum_s += psum
+                    phase_ms[phase] = round(
+                        psum / max(pcount, 1) * 1e3, 3)
+                hop_sum_s = hop_count = 0.0
+                for key, v in after.items():
+                    if key.startswith("dl4j_fleet_request_seconds_sum{"):
+                        hop_sum_s += v - before.get(key, 0.0)
+                    elif key.startswith(
+                            "dl4j_fleet_request_seconds_count{"):
+                        hop_count += v - before.get(key, 0.0)
+                hop_mean_ms = hop_sum_s / max(hop_count, 1) * 1e3
+                results["hop_decomposition"] = {
+                    "phase_mean_ms": phase_ms,
+                    "hop_mean_ms": round(hop_mean_ms, 3),
+                    "coverage": round(
+                        phase_sum_s / max(hop_sum_s, 1e-12), 4),
+                }
+
+                # SLO-evaluation overhead (ISSUE 16): one sampler tick
+                # + burn evaluation over this populated registry,
+                # amortized per request at the worker's default
+                # sampling interval and the measured 3-worker
+                # saturation — must be <=1% of the request's own cost
+                slo_mod.declare(slo_mod.Slo(
+                    "bench_hop", kind="latency",
+                    metric='dl4j_fleet_request_seconds{worker="w0"}',
+                    threshold=0.05, objective=0.99))
+                timeseries.sample_now()   # warm the ring
+                evals = 50
+                t0 = time.perf_counter()
+                for _ in range(evals):
+                    timeseries.sample_now()
+                eval_ms = (time.perf_counter() - t0) / evals * 1e3
+                slo_mod.remove("bench_hop")
+                interval = timeseries.DEFAULT_INTERVAL
+                per_req_ms = eval_ms / max(interval * sat, 1e-9)
+                results["slo_eval_overhead"] = {
+                    "sample_plus_evaluate_ms": round(eval_ms, 4),
+                    "interval_s": interval,
+                    "amortized_per_request_ms_at_saturation": round(
+                        per_req_ms, 6),
+                    "pct_of_direct_p50": round(
+                        per_req_ms / max(direct["p50_ms"], 1e-9) * 100,
+                        4),
+                }
                 results["hop_overhead_ms"] = round(
                     routed["p50_ms"] - direct["p50_ms"], 2)
         finally:
@@ -1818,7 +1891,12 @@ def bench_fleet(duration=1.2, deadline_ms=100.0, rows_per_request=1):
                  "50 rows/s), so the sweep measures the router's "
                  "scale-out and hop machinery, not model math; "
                  "rollout_in_progress compares client p99 at ~half "
-                 "saturation with a 25% canary mirror active vs none "
+                 "saturation with a 25% canary mirror active vs none; "
+                 "hop_decomposition attributes the routed hop to "
+                 "queue/execute/worker_other/transit via Server-Timing "
+                 "subtraction (coverage = attributed/hop time), and "
+                 "slo_eval_overhead amortizes one sample+evaluate tick "
+                 "per request at the default 5s interval "
                  "(`python bench.py --only fleet`)"),
     }
 

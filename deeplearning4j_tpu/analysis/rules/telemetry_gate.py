@@ -13,12 +13,13 @@ an explicit ``if telemetry.enabled():`` before ``get_registry()``, or
 and gate internally and hand back None/NULL contexts the hot path
 guards on.
 
-This rule flags a raw ``get_registry()``, ``get_tracer()``, or
-``get_memledger()`` (ISSUE 14: the HBM ownership ledger's raw handle)
-call in a function (outside ``telemetry/`` itself and the analyzer)
-that contains no ``enabled()``/sampler-gate check — the class of drift
-that silently re-introduces per-step observability overhead on the
-disabled path.
+This rule flags a raw ``get_registry()``, ``get_tracer()``,
+``get_memledger()`` (ISSUE 14: the HBM ownership ledger's raw handle),
+``get_sampler()``, or ``get_evaluator()`` (ISSUE 16: the time-series
+sampler's and SLO evaluator's raw handles) call in a function (outside
+``telemetry/`` itself and the analyzer) that contains no
+``enabled()``/sampler-gate check — the class of drift that silently
+re-introduces per-step observability overhead on the disabled path.
 """
 
 from __future__ import annotations
@@ -50,9 +51,26 @@ _TRACER_GATES = {"enabled", "enable",
 _MEMLEDGER_GATES = {"enabled", "enable", "claim", "claim_for_owner",
                     "raise_if_oom", "oom_error", "plan_capacity",
                     "release_prefix"}
+# time-series sampler gates (ISSUE 16): `sample_now()` gates
+# internally (None + zero registry calls when disabled) and is the
+# only registry-touching entry point; `configure`/`start`/`on_sample`
+# are setup-time, never per-request emission. The read-only query
+# surface (`describe`/`rate`/`quantile`) is deliberately NOT a gate:
+# reads are free of registry calls, but a raw get_sampler() next to
+# them in a hot path still deserves the enabled() idiom
+_TIMESERIES_GATES = {"enabled", "enable", "sample_now", "configure",
+                     "start", "on_sample"}
+# SLO evaluator gates (ISSUE 16): `evaluate()` gates internally (None
+# + zero registry/flight calls when disabled); `declare`/`remove` are
+# setup-time; `slo_instruments` is the bundle factory (None when
+# disabled) matching every other *_instruments
+_SLO_GATES = {"enabled", "enable", "evaluate", "declare", "remove",
+              "slo_instruments"}
 _EMITTER_GATES = {"get_registry": _REGISTRY_GATES,
                   "get_tracer": _TRACER_GATES,
-                  "get_memledger": _MEMLEDGER_GATES}
+                  "get_memledger": _MEMLEDGER_GATES,
+                  "get_sampler": _TIMESERIES_GATES,
+                  "get_evaluator": _SLO_GATES}
 _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 
 
@@ -60,10 +78,11 @@ _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 class TelemetryGateRule(Rule):
     name = "telemetry-gate"
     severity = Severity.ERROR
-    description = ("get_registry()/get_tracer()/get_memledger() in a "
-                   "function with no enabled()/sampler gate — breaks "
-                   "the zero-observability-calls-when-disabled "
-                   "contract (PR 1, PR 10, PR 14)")
+    description = ("get_registry()/get_tracer()/get_memledger()/"
+                   "get_sampler()/get_evaluator() in a function with "
+                   "no enabled()/sampler gate — breaks the "
+                   "zero-observability-calls-when-disabled contract "
+                   "(PR 1, PR 10, PR 14, PR 16)")
 
     def check_module(self, mod, project):
         rel = mod.rel

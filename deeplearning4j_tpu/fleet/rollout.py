@@ -83,7 +83,8 @@ class RolloutController:
 
     def __init__(self, router, name, spec, version, fraction=0.25,
                  min_samples=20, p99_ratio=2.0, min_agreement=0.999,
-                 max_mirror_errors=2, push_timeout=60.0):
+                 max_mirror_errors=2, push_timeout=60.0, slo=None,
+                 slo_burn_ratio=2.0):
         self.router = router
         self.name = name
         self.spec = spec
@@ -94,6 +95,15 @@ class RolloutController:
         self.min_agreement = float(min_agreement)
         self.max_mirror_errors = int(max_mirror_errors)
         self.push_timeout = float(push_timeout)
+        # SLO-burn judgment (ISSUE 16): with a declared latency
+        # objective (telemetry.slo.Slo), the canary is ALSO judged by
+        # how fast it burns that budget relative to the incumbent —
+        # a canary can pass the p99-ratio gate while pushing the tail
+        # past the threshold the operators actually promised
+        if slo is not None and slo.kind != "latency":
+            raise ValueError("rollout SLO judgment needs a latency SLO")
+        self.slo = slo
+        self.slo_burn_ratio = float(slo_burn_ratio)
         self.state = "idle"
         self.history = ["idle"]
         self.incumbent_version = None
@@ -303,7 +313,7 @@ class RolloutController:
     def _stats(self):
         with self._lock:
             compared = self._mirrors - self._errors
-            return {
+            out = {
                 "mirrors": self._mirrors,
                 "errors": self._errors,
                 "agreement": (self._agree / compared if compared
@@ -312,6 +322,17 @@ class RolloutController:
                     self._hist_incumbent),
                 "p99_canary": histogram_quantile(self._hist_canary),
             }
+            if self.slo is not None:
+                from deeplearning4j_tpu.telemetry.slo import (
+                    histogram_burn)
+
+                out["slo_burn_incumbent"] = round(histogram_burn(
+                    self._hist_incumbent, self.slo.threshold,
+                    self.slo.objective), 6)
+                out["slo_burn_canary"] = round(histogram_burn(
+                    self._hist_canary, self.slo.threshold,
+                    self.slo.objective), 6)
+            return out
 
     def _decide(self):
         s = self._stats()
@@ -329,6 +350,19 @@ class RolloutController:
             regressed.append(
                 f"p99 {s['p99_canary']:.4f}s > {self.p99_ratio}x "
                 f"incumbent {s['p99_incumbent']:.4f}s")
+        if self.slo is not None:
+            # burn floored at 1.0: an incumbent comfortably inside its
+            # budget (burn ~0) must not make every canary observation
+            # above threshold an automatic rollback — the canary only
+            # regresses by burning MORE than both the budget and
+            # slo_burn_ratio x the incumbent's burn
+            burn_floor = max(s["slo_burn_incumbent"], 1.0)
+            if s["slo_burn_canary"] > self.slo_burn_ratio * burn_floor:
+                regressed.append(
+                    f"slo burn {s['slo_burn_canary']:.3f} > "
+                    f"{self.slo_burn_ratio}x incumbent burn "
+                    f"{s['slo_burn_incumbent']:.3f} "
+                    f"({self.slo.name})")
         flight.record("rollout_decision", model=self.name,
                       version=self.version,
                       verdict="rollback" if regressed else "promote",

@@ -26,7 +26,21 @@ adopts) N worker processes, each a full UIServer + InferenceSession
 - **observability**: ``dl4j_fleet_*`` metrics (docs/OBSERVABILITY.md),
   a ``/healthz`` fleet section (degraded — still HTTP 200 — while any
   worker is ejected), and ``GET /debug/fleet`` (workers, rollout state,
-  capture stats).
+  capture stats);
+- **federation** (ISSUE 16): one scrape of ``GET /debug/fleet/metrics``
+  returns every live worker's families merged under a ``worker`` label
+  (plus the router's own under ``worker="router"``),
+  ``/debug/fleet/flight`` merges the worker flight rings with the
+  router's, ordered by the events' wall-clock ``ts``, and
+  ``/debug/fleet/traces?trace_id=`` fans out to the workers and
+  returns the stitched cross-process span tree — the router's
+  ``fleet.predict`` root plus the worker spans in ONE response;
+- **hop decomposition** (ISSUE 16): workers answer predicts with a
+  ``Server-Timing`` header (queue/execute from the per-request
+  instruments they already capture); the router subtracts it from the
+  measured hop to attribute the serialize+network+parse remainder,
+  publishes ``dl4j_fleet_hop_seconds{phase}``, and attaches the phases
+  to the ``fleet.predict`` span.
 
 HTTP-policy note: worker HTTP *responses* (429 shed, 504 timeout, 400,
 500) are answers, not failures — they pass through and never count
@@ -41,6 +55,7 @@ import http.client
 import json
 import logging
 import os
+import re
 import socket
 import sys
 import tempfile
@@ -59,7 +74,15 @@ log = logging.getLogger("deeplearning4j_tpu")
 # response headers that cross the hop back to the client; everything
 # hop-by-hop (Connection, Server, Date, Content-Length is recomputed)
 # stays at the router
-_PASS_HEADERS = ("retry-after", "traceparent", "content-type")
+_PASS_HEADERS = ("retry-after", "traceparent", "content-type",
+                 "server-timing")
+
+# the hop phases dl4j_fleet_hop_seconds decomposes into: queue/execute
+# are worker-reported (Server-Timing), worker_other is worker handler
+# time outside both (parse + serialize inside the worker), transit is
+# the remainder of the measured hop (router serialize + network + the
+# worker's HTTP accept) attributed by subtraction
+HOP_PHASES = ("queue", "execute", "worker_other", "transit")
 
 # transport-level failure classes: the worker did not answer (refused,
 # reset mid-read, timed out at connect). urllib's HTTPError is NOT here
@@ -140,6 +163,84 @@ def _parse_gauge_sum(text, name) -> float:
         if value >= 0:   # -1 = dead replica sentinel, not load
             total += value
     return total
+
+
+def _parse_server_timing(value) -> dict:
+    """``'queue;dur=0.123, execute;dur=4.5'`` -> phase seconds (dur is
+    milliseconds per the Server-Timing spec). Unparseable entries are
+    skipped — the header is advisory, never a failure."""
+    out = {}
+    for part in (value or "").split(","):
+        fields = [f.strip() for f in part.strip().split(";")]
+        if not fields or not fields[0]:
+            continue
+        for f in fields[1:]:
+            if f.startswith("dur="):
+                try:
+                    out[fields[0]] = float(f[4:]) / 1e3
+                except ValueError:
+                    pass
+    return out
+
+
+def _inject_worker_label(line, worker) -> str:
+    """One exposition sample line with ``worker="<name>"`` prepended to
+    its label set (added as the only label when there is none). A
+    pre-existing ``worker`` label (the router's own ``dl4j_fleet_*``
+    families) renames to ``exported_worker`` — the Prometheus
+    federation collision rule: the source label wins, the target's
+    survives under ``exported_``."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        end = line.find("}", brace)
+        labels = re.sub(r'(^|,)worker="', r'\1exported_worker="',
+                        line[brace + 1:end])
+        return (line[:brace + 1] + f'worker="{worker}",' + labels
+                + line[end:])
+    if space == -1:
+        return line
+    return line[:space] + f'{{worker="{worker}"}}' + line[space:]
+
+
+def _merge_expositions(sections) -> str:
+    """[(worker, exposition_text)] -> ONE exposition with every sample
+    under a ``worker`` label, grouped per family (the 0.0.4 format
+    requires a family's lines contiguous; HELP/TYPE render once, from
+    the first worker exporting the family). Two workers exporting the
+    same family/labels stay distinct samples — the injected worker
+    label disambiguates the collision."""
+    fams: dict = {}      # family -> {"meta": [help, type], "lines": []}
+    order: list = []
+    for worker, text in sections:
+        current = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name = line.split(None, 3)[2]
+                fam = fams.get(name)
+                if fam is None:
+                    fam = fams[name] = {"meta": [], "lines": []}
+                    order.append(name)
+                if line.startswith("# TYPE "):
+                    current = name
+                if len(fam["meta"]) < 2 and line not in fam["meta"]:
+                    fam["meta"].append(line)
+                continue
+            if current is None:     # sample before any TYPE: family by
+                sample = line.split("{", 1)[0].split(" ", 1)[0]  # name
+                current = sample
+                fams.setdefault(current, {"meta": [], "lines": []})
+                if current not in order:
+                    order.append(current)
+            fams[current]["lines"].append(
+                _inject_worker_label(line, worker))
+    out = []
+    for name in order:
+        out.extend(fams[name]["meta"])
+        out.extend(fams[name]["lines"])
+    return "\n".join(out) + "\n"
 
 
 def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
@@ -288,6 +389,11 @@ class FleetRouter:
         if inst is not None:
             for w in self.workers:
                 inst.worker_up(w.name).set(1.0 if w.up else 0.0)
+        # the always-on windowed-snapshot ring (ISSUE 16): router-side
+        # SLOs burn over dl4j_fleet_* rates/quantiles; process-wide, so
+        # close() deliberately leaves it running for other routers
+        from deeplearning4j_tpu.telemetry import timeseries
+        timeseries.start()
         flight.record("fleet_start", port=self.port,
                       workers=[w.name for w in self.workers])
         log.info("fleet router on http://127.0.0.1:%d (%d workers)",
@@ -423,7 +529,10 @@ class FleetRouter:
             _, _, mbody = _http(w.url + "/serving/v1/models",
                                 timeout=self.poll_timeout)
             models = json.loads(mbody).get("models", [])
-            _, _, raw = _http(w.url + "/metrics",
+            # ?name= (ISSUE 16 satellite): the poll only reads the two
+            # dl4j_serving_ load gauges — no point rendering, shipping,
+            # and scanning the full exposition every interval
+            _, _, raw = _http(w.url + "/metrics?name=dl4j_serving_",
                               timeout=self.poll_timeout)
             text = raw.decode()
             load = (_parse_gauge_sum(text, "dl4j_serving_queue_depth")
@@ -449,13 +558,19 @@ class FleetRouter:
                 and rollout.pins(name):
             body = rollout.pin_body(body)
         tp = in_headers.get("traceparent")
-        fwd = {"Content-Type": "application/json"}
-        if tp:
-            # forwarded UNMODIFIED: the worker joins the same trace id,
-            # so router + worker spans compose into one tree
-            fwd["traceparent"] = tp
         root = tracing.start_trace(f"fleet.{kind}", traceparent=tp,
                                    model=name)
+        fwd = {"Content-Type": "application/json"}
+        if root is not None:
+            # forward OUR span as the worker's parent: same trace id as
+            # the client's, so the worker's http.predict span nests
+            # under fleet.predict and /debug/fleet/traces can stitch
+            # the cross-process tree with correct parent edges
+            fwd["traceparent"] = root.traceparent()
+        elif tp:
+            # unsampled at the router: the client's header passes
+            # through unmodified (the worker honors its sampled flag)
+            fwd["traceparent"] = tp
         with (root or tracing.NULL):
             return self._route(name, kind, path, body, fwd, inst,
                                rollout, root)
@@ -468,8 +583,14 @@ class FleetRouter:
             if w is None:
                 if inst is not None:
                     inst.request("none", "no_worker")
+                # Retry-After (ISSUE 16 satellite): the soonest a dead
+                # worker can be readmitted is the next poll round, so
+                # that is when routing capacity can reappear — same
+                # contract as the admission controller's 429
                 raise shttp.HttpError(
-                    503, "no live fleet worker available")
+                    503, "no live fleet worker available",
+                    headers={"Retry-After":
+                             f"{max(self.poll_interval, 0.001):.3f}"})
             t0 = time.perf_counter()
             try:
                 try:
@@ -502,6 +623,31 @@ class FleetRouter:
             if inst is not None:
                 inst.hop(w.name).observe(dt)
                 inst.request(w.name, _outcome(status))
+            # hop decomposition (ISSUE 16): the worker's Server-Timing
+            # reports queue/execute/handler; subtraction attributes the
+            # rest of the measured hop — worker handler time outside
+            # the phases, then serialize+network+parse transit. The
+            # four phases sum to dt by construction.
+            st = next((v for k, v in rh.items()
+                       if k.lower() == "server-timing"), None)
+            if st:
+                phases = _parse_server_timing(st)
+                handler_s = min(phases.get("handler", dt), dt)
+                queue_s = phases.get("queue", 0.0)
+                execute_s = phases.get("execute", 0.0)
+                decomp = {
+                    "queue": queue_s,
+                    "execute": execute_s,
+                    "worker_other": max(
+                        handler_s - queue_s - execute_s, 0.0),
+                    "transit": max(dt - handler_s, 0.0),
+                }
+                if inst is not None:
+                    for phase in HOP_PHASES:
+                        inst.hop_phase(phase).observe(decomp[phase])
+                if root:
+                    root.set_attr(**{f"hop_{p}_s": round(decomp[p], 6)
+                                     for p in HOP_PHASES})
             if status == 200 and kind == "predict":
                 if self.capture is not None:
                     self.capture.maybe_record(name, body, rb, inst=inst)
@@ -572,6 +718,16 @@ class FleetRouter:
                       "routable": len(routable),
                       "degraded": degraded},
         }
+        # declared objectives (ISSUE 16): a burning SLO degrades the
+        # router — still HTTP 200, the burn informs operators while
+        # traffic keeps flowing (degraded-not-503, the PR-5 contract)
+        from deeplearning4j_tpu.telemetry import slo as slo_mod
+
+        slo_section = slo_mod.healthz_section()
+        if slo_section:
+            payload["slo"] = slo_section
+            if slo_section.get("degraded") and payload["status"] == "ok":
+                payload["status"] = "degraded"
         if self._rollout is not None:
             payload["rollout"] = self._rollout.describe()
         return payload, (200 if ready else 503)
@@ -588,6 +744,96 @@ class FleetRouter:
         if self.capture is not None:
             out["capture"] = self.capture.describe()
         return out
+
+    # -- federation (ISSUE 16): the fleet as ONE observability surface ------
+    def _fan_out(self, path):
+        """[(worker, body_bytes)] from GETting ``path`` on every live
+        worker; a worker that fails the fetch is skipped (federation is
+        best-effort — one dead worker must not blank the fleet view)."""
+        with self._lock:
+            live = [w for w in self.workers if w.up]
+        out = []
+        for w in live:
+            try:
+                status, _, body = _http(w.url + path,
+                                        timeout=self.poll_timeout)
+            except TransportFailure:
+                continue
+            if status == 200:
+                out.append((w, body))
+        return out
+
+    def fleet_metrics(self, name_prefix=None) -> str:
+        """GET /debug/fleet/metrics: every live worker's families plus
+        the router's own, merged into one exposition under a ``worker``
+        label — one scrape federates the fleet."""
+        from deeplearning4j_tpu.telemetry import prometheus
+
+        path = "/metrics" + (f"?name={name_prefix}" if name_prefix
+                             else "")
+        sections = [("router", prometheus.render(
+            name_prefix=name_prefix))]
+        for w, body in self._fan_out(path):
+            try:
+                sections.append((w.name, body.decode()))
+            except UnicodeDecodeError:
+                continue
+        return _merge_expositions(sections)
+
+    def fleet_flight(self) -> str:
+        """GET /debug/fleet/flight: the router's flight ring and every
+        live worker's, each event tagged ``worker`` and the whole merge
+        ordered by wall-clock ``ts`` (the cross-process field every
+        event carries as of ISSUE 16) — one incident timeline."""
+        events = [dict(e, worker="router")
+                  for e in flight.get_recorder().events()]
+        for w, body in self._fan_out("/debug/flightrecorder"):
+            for line in body.decode(errors="replace").splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    evt = json.loads(line)
+                except ValueError:
+                    continue
+                evt["worker"] = w.name
+                events.append(evt)
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        if not events:
+            return "\n"
+        return "\n".join(json.dumps(e, default=flight._json_default)
+                         for e in events) + "\n"
+
+    def fleet_traces(self, trace_id=None) -> str:
+        """GET /debug/fleet/traces[?trace_id=]: the stitched
+        cross-process span tree as JSONL — the router's spans (the
+        ``fleet.predict`` roots) plus every live worker's, tagged
+        ``worker`` and ordered by wall-clock ``ts``. Because the router
+        forwards its OWN traceparent to the worker, the worker spans'
+        parent ids point into the router's tree: one connected trace
+        per response, no per-worker hand-querying."""
+        records = []
+        for line in tracing.export_jsonl(trace_id=trace_id).splitlines():
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            rec["worker"] = "router"
+            records.append(rec)
+        path = "/debug/traces" + (f"?trace_id={trace_id}" if trace_id
+                                  else "")
+        for w, body in self._fan_out(path):
+            for line in body.decode(errors="replace").splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rec["worker"] = w.name
+                records.append(rec)
+        records.sort(key=lambda r: r.get("ts", 0.0))
+        if not records:
+            return "\n"
+        return "\n".join(json.dumps(r) for r in records) + "\n"
 
 
 def _outcome(status) -> str:
@@ -626,10 +872,54 @@ class _RouterHandler(BaseHTTPRequestHandler):
             payload, status = router.healthz()
             self._respond(json.dumps(payload).encode(), status=status)
         elif self.path == "/metrics" or self.path.startswith("/metrics?"):
+            from urllib.parse import parse_qs, urlsplit
+
             from deeplearning4j_tpu.telemetry import prometheus
 
-            self._respond(prometheus.render().encode(),
+            query = parse_qs(urlsplit(self.path).query)
+            name_prefix = (query.get("name") or [None])[0]
+            self._respond(
+                prometheus.render(name_prefix=name_prefix).encode(),
+                ctype=prometheus.CONTENT_TYPE)
+        elif self.path.startswith("/debug/fleet/metrics"):
+            # federation (ISSUE 16): the fleet's expositions merged
+            # under a worker label — ONE scrape for N+1 processes
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import prometheus
+
+            query = parse_qs(urlsplit(self.path).query)
+            name_prefix = (query.get("name") or [None])[0]
+            self._respond(router.fleet_metrics(name_prefix).encode(),
                           ctype=prometheus.CONTENT_TYPE)
+        elif self.path.startswith("/debug/fleet/flight"):
+            self._respond(router.fleet_flight().encode(),
+                          ctype="application/x-ndjson")
+        elif self.path.startswith("/debug/fleet/traces"):
+            from urllib.parse import parse_qs, urlsplit
+
+            query = parse_qs(urlsplit(self.path).query)
+            tid = (query.get("trace_id") or [None])[0]
+            self._respond(router.fleet_traces(tid).encode(),
+                          ctype="application/x-ndjson")
+        elif self.path.startswith("/debug/timeseries"):
+            # the router's own windowed-snapshot ring (same surface as
+            # the workers': ui/server.py)
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import timeseries
+
+            query = parse_qs(urlsplit(self.path).query)
+            window = (query.get("window") or [None])[0]
+            name = (query.get("name") or [None])[0]
+            try:
+                window = float(window) if window is not None else None
+            except ValueError:
+                self._respond(b'{"error": "window must be seconds"}',
+                              status=400)
+                return
+            self._respond(json.dumps(timeseries.describe(
+                window=window, name=name)).encode())
         elif self.path.startswith("/debug/fleet"):
             self._respond(json.dumps(router.describe()).encode())
         else:

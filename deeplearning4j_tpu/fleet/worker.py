@@ -165,6 +165,20 @@ def serve(spec, port=0, port_file=None, max_latency=0.0,
     for m in spec.get("models", ()):
         admin.register_spec(m["name"], m, m.get("version", 1),
                             warmup=m.get("warmup", True))
+    # fleet-wide SLOs (ISSUE 16): the spec can declare objectives and
+    # tune the always-on time-series sampler — evaluation ticks ride
+    # the sampler thread, breaches surface in the worker's /healthz
+    # (degraded-not-503) and flight ring, which the router federates
+    from deeplearning4j_tpu.telemetry import slo as slo_mod
+    from deeplearning4j_tpu.telemetry import timeseries
+
+    ts_spec = spec.get("timeseries") or {}
+    timeseries.configure(
+        interval=ts_spec.get("interval"),
+        capacity=ts_spec.get("capacity"))
+    for s in spec.get("slos", ()):
+        slo_mod.declare(slo_mod.Slo(**s))
+    timeseries.start()
     # a fresh UIServer instance per worker process — the getInstance()
     # singleton is a same-process convenience the fleet must not share
     server = UIServer()
@@ -175,6 +189,7 @@ def serve(spec, port=0, port_file=None, max_latency=0.0,
              server.port)
     if stop_event is not None:
         stop_event.wait()
+        timeseries.stop()
         server.stop()
         session.close()
     return server

@@ -260,6 +260,12 @@ def run_batch(entry, batch, inst, servable=None, replica=None):
                 r.fail(ServingTimeout("deadline passed mid-execute"),
                        inst, "timeout_execute")
                 continue
+            # per-request phase durations ride the future (read by
+            # session.predict(timing=) → the worker's Server-Timing
+            # header, ISSUE 16 hop decomposition); stamped BEFORE
+            # set_result so a waiter woken by the result sees them
+            r.future.dl4j_timing = {"queue": round(now - r.t_enqueue, 6),
+                                    "execute": round(dt, 6)}
             r.future.set_result(seg)
             if inst is not None:
                 inst.request("ok")

@@ -203,7 +203,11 @@ def handle_models(session) -> bytes:
     return json.dumps({"models": session.models()}).encode()
 
 
-def handle_predict(session, name: str, body: bytes) -> bytes:
+def handle_predict(session, name: str, body: bytes,
+                   timing=None) -> bytes:
+    """``timing`` (a dict) receives the request's queue/execute seconds
+    so the transport can answer with a Server-Timing header (ISSUE 16
+    hop decomposition)."""
     if session is None:
         raise HttpError(404, "no serving session attached "
                              "(UIServer.serveModels(session))")
@@ -229,7 +233,7 @@ def handle_predict(session, name: str, body: bytes) -> bytes:
         x = np.asarray(payload["instances"],
                        dtype=entry.servable.dtype)
         y = session.predict(name, x, timeout=timeout, version=version,
-                            priority=priority)
+                            priority=priority, timing=timing)
     except ModelNotFound as e:
         raise HttpError(404, f"unknown model: {e}") from None
     except ShedError as e:

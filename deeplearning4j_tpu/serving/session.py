@@ -291,28 +291,38 @@ class InferenceSession:
             if e is not None:
                 out.set_exception(e)
             else:
+                timing = getattr(f, "dl4j_timing", None)
+                if timing is not None:   # before set_result: see batcher
+                    out.dl4j_timing = timing
                 out.set_result(f.result()[0])
 
         future.add_done_callback(_done)
         return out
 
     def predict(self, name, features, timeout=None, batched=None,
-                version=None, priority="normal"):
+                version=None, priority="normal", timing=None):
         """Synchronous predict. `batched=False` bypasses the queue and
-        runs the bucketed servable on the calling thread."""
+        runs the bucketed servable on the calling thread. ``timing``
+        (a dict) is filled with the request's queue/execute seconds —
+        the already-captured per-request phases, surfaced so the HTTP
+        layer can return them in a Server-Timing header (ISSUE 16 hop
+        decomposition) without touching the registry."""
         if timeout is None:
             timeout = self.default_timeout
         use_batcher = self.batching if batched is None else batched
         if not use_batcher:
             return self._direct(name, features, version,
-                                priority=priority)
+                                priority=priority, timing=timing)
         t0 = time.perf_counter()
         future = self.predict_async(name, features, timeout=timeout,
                                     version=version, priority=priority)
         budget = (None if timeout is None
                   else max(0.0, timeout - (time.perf_counter() - t0)) + 0.25)
         try:
-            return future.result(timeout=budget)
+            out = future.result(timeout=budget)
+            if timing is not None:
+                timing.update(getattr(future, "dl4j_timing", None) or {})
+            return out
         except _FutureTimeout:
             # concurrent.futures.TimeoutError is NOT the builtin
             # TimeoutError before py3.11 — normalize so callers (and the
@@ -321,15 +331,16 @@ class InferenceSession:
                 f"request to {name!r} timed out after {timeout}s"
             ) from None
 
-    def _direct(self, name, features, version=None, priority="normal"):
+    def _direct(self, name, features, version=None, priority="normal",
+                timing=None):
         entry, x, single = self._prep(name, features, version)
         inst = self._inst(name)
         if self.admission is not None:
             with self.admission.admit(name, priority, inst=inst):
-                return self._direct_run(entry, x, single, inst)
-        return self._direct_run(entry, x, single, inst)
+                return self._direct_run(entry, x, single, inst, timing)
+        return self._direct_run(entry, x, single, inst, timing)
 
-    def _direct_run(self, entry, x, single, inst):
+    def _direct_run(self, entry, x, single, inst, timing=None):
         t = x.shape[-1] if x.ndim >= 3 else None
         t0 = time.perf_counter()
         try:
@@ -338,8 +349,11 @@ class InferenceSession:
             if inst is not None:
                 inst.request("error")
             raise
+        dt = time.perf_counter() - t0
+        if timing is not None:   # unbatched: no queue phase by design
+            timing.update({"queue": 0.0, "execute": round(dt, 6)})
         if inst is not None:
-            inst.execute.observe(time.perf_counter() - t0)
+            inst.execute.observe(dt)
             inst.dispatch.inc(n_dispatch)
             inst.request("ok")
         y = unpad(y, y.shape[0], t)

@@ -43,6 +43,15 @@ train, serving, decode, prefetch, and snapshot seams), and
 admission-time capacity planning (structured CapacityError before any
 compile or pool allocation).
 
+ISSUE 16 adds the time dimension and the fleet view:
+`telemetry.timeseries` (a bounded ring of periodic windowed snapshots —
+counters become rates, histograms become windowed p50/p99 — at
+GET /debug/timeseries) and `telemetry.slo` (declared latency /
+error-rate objectives evaluated by SRE-style multi-window burn rate
+over the ring: dl4j_slo_* metrics, slo_breach/slo_recovered flight
+events, a degraded-not-503 /healthz `slo` section, and a
+histogram-direct burn judge the rollout controller uses on canaries).
+
 Disabling (`telemetry.disable()`) removes every per-step registry call
 from the training loops — they check the flag once per fit() — and
 compiles the health stats OUT of the jitted step (pre-health output
@@ -52,7 +61,7 @@ step."""
 
 from deeplearning4j_tpu.telemetry import (
     aggregate, compile_ledger, costmodel, flight, health, hlo_audit,
-    memledger, prometheus, tracing)
+    memledger, prometheus, slo, timeseries, tracing)
 from deeplearning4j_tpu.telemetry.memledger import (
     CapacityError, DeviceOomError)
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
@@ -81,5 +90,5 @@ __all__ = [
     "flight", "get_registry",
     "health", "hlo_audit", "log_buckets", "loop_instruments",
     "memledger", "prometheus", "serving_instruments", "set_registry",
-    "span", "tracing",
+    "slo", "span", "timeseries", "tracing",
 ]

@@ -76,8 +76,11 @@ class FlightRecorder:
     def record(self, kind: str, **fields):
         if not _state["enabled"]:
             return None
+        # ts (wall) orders events ACROSS processes — the fleet router's
+        # /debug/fleet/flight merge-sorts worker rings by it; mono is
+        # the drift-free intra-process clock for interval arithmetic
         evt = {"seq": next(self._seq), "ts": round(time.time(), 6),
-               "kind": kind}
+               "mono": round(time.monotonic(), 6), "kind": kind}
         evt.update(fields)
         with self._lock:
             self._events.append(evt)

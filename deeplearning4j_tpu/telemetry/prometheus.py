@@ -44,19 +44,25 @@ def _exemplar_text(child, idx):
             f"{fmt_float(value)} {ts:.3f}")
 
 
-def render(registry=None, collect_system=True, exemplars=False) -> str:
+def render(registry=None, collect_system=True, exemplars=False,
+           name_prefix=None) -> str:
     """The whole registry in Prometheus text exposition. With
     collect_system, on-demand gauges (device memory) refresh first.
     ``exemplars=True`` appends OpenMetrics-style exemplar suffixes to
     histogram bucket lines (``/metrics?exemplars=1`` — an explicit
     debug opt-in: this exposition is 0.0.4, not full OpenMetrics, so
     the suffix is never served to an unsuspecting scraper; parse()
-    tolerates both forms)."""
+    tolerates both forms). ``name_prefix`` keeps only families whose
+    name starts with it (``/metrics?name=<prefix>`` — a selective
+    scraper like the fleet router's poll thread skips rendering and
+    parsing the families it never reads)."""
     reg = registry or get_registry()
     if collect_system and enabled():
         collect_device_memory(reg)
     lines = []
     for fam in reg.collect():
+        if name_prefix and not fam.name.startswith(name_prefix):
+            continue
         lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
         for labels, child in fam.children():

@@ -642,6 +642,13 @@ FLEET_ROLLOUT_STATE_HELP = ("Rollout state machine position: -1 "
                             "promoting, 3 complete")
 FLEET_HOP_HELP = ("Router→worker hop seconds (forward + worker "
                   "service + response read)")
+FLEET_HOP_PHASE_HELP = ("Router→worker hop seconds decomposed by phase "
+                        "(queue|execute|worker_other|transit) from the "
+                        "workers' Server-Timing header: queue/execute "
+                        "are worker-reported, worker_other is worker "
+                        "handler time outside both, transit is the "
+                        "serialize+network+parse remainder the router "
+                        "attributes by subtraction)")
 FLEET_MIRROR_HELP = ("Canary mirror comparisons by verdict "
                      "(agree|disagree|error)")
 FLEET_CAPTURED_HELP = ("Live requests head-sampled into the traffic-"
@@ -654,7 +661,7 @@ class FleetInstruments:
     disabled router performs zero registry calls per request)."""
 
     __slots__ = ("_requests", "_worker_up", "retries", "rollout_state",
-                 "_hop", "_mirror", "captured")
+                 "_hop", "_hop_phase", "_mirror", "captured")
 
     def __init__(self, registry):
         self._requests = registry.counter(
@@ -668,6 +675,8 @@ class FleetInstruments:
             "dl4j_fleet_rollout_state", FLEET_ROLLOUT_STATE_HELP)
         self._hop = registry.histogram(
             "dl4j_fleet_request_seconds", FLEET_HOP_HELP, ("worker",))
+        self._hop_phase = registry.histogram(
+            "dl4j_fleet_hop_seconds", FLEET_HOP_PHASE_HELP, ("phase",))
         self._mirror = registry.counter(
             "dl4j_fleet_mirror_total", FLEET_MIRROR_HELP, ("verdict",))
         self.captured = registry.counter(
@@ -681,6 +690,9 @@ class FleetInstruments:
 
     def hop(self, worker):
         return self._hop.labels(worker=worker)
+
+    def hop_phase(self, phase):
+        return self._hop_phase.labels(phase=phase)
 
     def mirror(self, verdict):
         self._mirror.labels(verdict=verdict).inc()

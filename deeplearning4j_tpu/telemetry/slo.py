@@ -1,0 +1,359 @@
+"""Declared service-level objectives evaluated by multi-window burn
+rate over the time-series ring (ISSUE 16 tentpole, piece 2).
+
+An SLO here is one of two shapes:
+
+- **latency**: "``objective`` of observations of this histogram sample
+  finish within ``threshold`` seconds" — the bad fraction over a window
+  comes from cumulative bucket-count deltas
+  (:func:`timeseries.bad_fraction`);
+- **error_rate**: "at most ``1 - objective`` of this counter's traffic
+  is bad" — ``bad`` selects the failure samples (substring match on the
+  sample key, e.g. ``outcome="transport"``), ``total`` selects the
+  denominator.
+
+Evaluation is the SRE multi-window burn rate: burn = bad_fraction /
+error_budget, computed over a **fast** and a **slow** window; a breach
+fires only when BOTH exceed ``burn_threshold`` (fast alone = noise
+spike, slow alone = old news), and recovery requires both to drop back.
+Each evaluation emits ``dl4j_slo_burn_rate{slo,window}`` /
+``dl4j_slo_healthy{slo}`` gauges and ``dl4j_slo_breaches_total{slo}``;
+transitions record ``slo_breach`` / ``slo_recovered`` flight events, and
+a registered /healthz provider reports the objectives as a ``slo``
+section (degraded-not-503: a burning budget informs operators, it does
+not stop traffic).
+
+The evaluator ticks from the time-series sampler's post-sample hook, so
+it inherits the sampler's cadence and its disabled contract: while
+telemetry is disabled nothing samples, nothing evaluates, zero registry
+calls (CountingStub-asserted).
+
+:func:`histogram_burn` is the window-free variant over a live PR-1
+Histogram — fleet/rollout.py uses it to judge a canary's burn against
+the incumbent's over the mirror histograms.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.telemetry import timeseries
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+SLO_BURN_HELP = ("SLO burn rate per evaluation window (bad_fraction / "
+                 "error_budget; 1.0 = burning exactly the budget, "
+                 "sustained >1 on fast AND slow windows = breach)")
+SLO_HEALTHY_HELP = "1 while the SLO is within budget, 0 while breached"
+SLO_BREACHES_HELP = "Breach transitions (healthy->breached) per SLO"
+
+# SRE-style defaults: a fast window for detection speed, a slow window
+# so a single spike cannot page, sized for in-process rings rather than
+# the textbook 5m/1h (the ring holds minutes, not hours)
+DEFAULT_FAST_WINDOW = 60.0
+DEFAULT_SLOW_WINDOW = 300.0
+DEFAULT_BURN_THRESHOLD = 1.0
+
+
+class SloInstruments:
+    """Bound SLO gauges/counters (mirrors ServingInstruments: obtained
+    per evaluation tick, None when telemetry is disabled)."""
+
+    __slots__ = ("_burn", "_healthy", "_breaches")
+
+    def __init__(self, registry):
+        self._burn = registry.gauge(
+            "dl4j_slo_burn_rate", SLO_BURN_HELP, ("slo", "window"))
+        self._healthy = registry.gauge(
+            "dl4j_slo_healthy", SLO_HEALTHY_HELP, ("slo",))
+        self._breaches = registry.counter(
+            "dl4j_slo_breaches_total", SLO_BREACHES_HELP, ("slo",))
+
+    def burn(self, slo, window):
+        return self._burn.labels(slo=slo, window=window)
+
+    def healthy(self, slo):
+        return self._healthy.labels(slo=slo)
+
+    def breaches(self, slo):
+        return self._breaches.labels(slo=slo)
+
+
+def slo_instruments():
+    """The SLO instrument bundle, or None when telemetry is disabled
+    (the zero-cost-when-off contract, gate-listed in dl4jlint)."""
+    if not _registry.enabled():
+        return None
+    return SloInstruments(_registry.get_registry())
+
+
+class Slo:
+    """One declared objective. ``kind`` is ``latency`` (histogram
+    sample ``metric`` + ``threshold`` seconds) or ``error_rate``
+    (``bad`` sample-key fragments over a ``total`` prefix)."""
+
+    __slots__ = ("name", "kind", "metric", "threshold", "objective",
+                 "bad", "total", "fast_window", "slow_window",
+                 "burn_threshold")
+
+    def __init__(self, name, kind="latency", metric=None, threshold=None,
+                 objective=0.99, bad=(), total=None,
+                 fast_window=DEFAULT_FAST_WINDOW,
+                 slow_window=DEFAULT_SLOW_WINDOW,
+                 burn_threshold=DEFAULT_BURN_THRESHOLD):
+        if kind not in ("latency", "error_rate"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        if kind == "latency" and (metric is None or threshold is None):
+            raise ValueError("latency SLO needs metric= and threshold=")
+        if kind == "error_rate" and (not bad or total is None):
+            raise ValueError("error_rate SLO needs bad= and total=")
+        if not 0.0 < objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        self.name = name
+        self.kind = kind
+        self.metric = metric
+        self.threshold = threshold
+        self.objective = float(objective)
+        self.bad = (bad,) if isinstance(bad, str) else tuple(bad)
+        self.total = total
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.burn_threshold = float(burn_threshold)
+
+    @property
+    def budget(self):
+        """The error budget: the tolerated bad fraction."""
+        return 1.0 - self.objective
+
+    def describe(self):
+        return {"name": self.name, "kind": self.kind,
+                "metric": self.metric, "threshold": self.threshold,
+                "objective": self.objective,
+                "fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "burn_threshold": self.burn_threshold}
+
+
+class SloEvaluator:
+    """Holds the declared objectives and their breach state; one
+    ``evaluate()`` pass per time-series sample."""
+
+    def __init__(self, sampler=None):
+        self._sampler = sampler
+        self._slos: dict = {}
+        self._status: dict = {}   # name -> last evaluation dict
+        self._lock = threading.Lock()
+        self._hooked = False
+
+    def _get_sampler(self):
+        return self._sampler or timeseries.get_sampler()
+
+    # -- declaration ---------------------------------------------------------
+    def declare(self, slo: Slo):
+        """Register (or replace) an objective and hook the evaluator
+        into the sampler tick + /healthz on first declaration."""
+        with self._lock:
+            self._slos[slo.name] = slo
+            self._status.setdefault(
+                slo.name, {"healthy": True, "burn": {}})
+        if not self._hooked:
+            self._hooked = True
+            self._get_sampler().on_sample(self.evaluate)
+            # deferred: health imports registry; slo must stay
+            # importable from telemetry/__init__ regardless of order
+            from deeplearning4j_tpu.telemetry import health
+            health.register_healthz_provider("slo", self.healthz_section)
+        return slo
+
+    def remove(self, name):
+        with self._lock:
+            self._slos.pop(name, None)
+            self._status.pop(name, None)
+
+    def slos(self):
+        with self._lock:
+            return list(self._slos.values())
+
+    # -- evaluation ----------------------------------------------------------
+    def _bad_fraction(self, slo, window, sampler):
+        """Windowed bad fraction for one objective, or None with no
+        traffic in the window (no traffic burns no budget)."""
+        if slo.kind == "latency":
+            bad, total = sampler.bad_fraction(
+                slo.metric, slo.threshold, window)
+            if bad is None or total == 0:
+                return None
+            return bad / total
+        # error_rate: windowed increases of the selected counters
+        pair = sampler._window_pair(window)
+        if pair is None:
+            return None
+        old, new = pair
+        bad = tot = 0.0
+
+        def _increase(key):
+            return max(new["values"].get(key, 0.0)
+                       - old["values"].get(key, 0.0), 0.0)
+
+        for key in new["values"]:
+            if not key.startswith(slo.total):
+                continue
+            inc = _increase(key)
+            tot += inc
+            if any(frag in key for frag in slo.bad):
+                bad += inc
+        if tot == 0:
+            return None
+        return bad / tot
+
+    def evaluate(self):
+        """One burn-rate pass over every declared objective. Returns
+        {name: result} or None while telemetry is disabled (zero
+        registry/flight calls on the disabled path)."""
+        if not _registry.enabled():
+            return None
+        sampler = self._get_sampler()
+        inst = slo_instruments()
+        results = {}
+        for slo in self.slos():
+            burns = {}
+            for window_name, window in (("fast", slo.fast_window),
+                                        ("slow", slo.slow_window)):
+                frac = self._bad_fraction(slo, window, sampler)
+                burns[window_name] = (
+                    None if frac is None
+                    else frac / max(slo.budget, 1e-9))
+            breached = all(
+                b is not None and b > slo.burn_threshold
+                for b in burns.values())
+            with self._lock:
+                st = self._status.setdefault(
+                    slo.name, {"healthy": True, "burn": {}})
+                was_healthy = st["healthy"]
+                # breach needs both windows hot; recovery needs both
+                # back under threshold (an inconclusive window — no
+                # traffic — holds the current state)
+                if was_healthy and breached:
+                    st["healthy"] = False
+                elif not was_healthy and not breached and all(
+                        b is not None and b <= slo.burn_threshold
+                        for b in burns.values()):
+                    st["healthy"] = True
+                st["burn"] = burns
+                now_healthy = st["healthy"]
+            if inst is not None:
+                for window_name, b in burns.items():
+                    if b is not None:
+                        inst.burn(slo.name, window_name).set(round(b, 6))
+                inst.healthy(slo.name).set(1.0 if now_healthy else 0.0)
+            if was_healthy and not now_healthy:
+                if inst is not None:
+                    inst.breaches(slo.name).inc()
+                flight.record(
+                    "slo_breach", slo=slo.name, slo_kind=slo.kind,
+                    burn_fast=burns.get("fast"),
+                    burn_slow=burns.get("slow"),
+                    burn_threshold=slo.burn_threshold)
+                log.warning("SLO %s breached (burn fast=%s slow=%s)",
+                            slo.name, burns.get("fast"),
+                            burns.get("slow"))
+            elif not was_healthy and now_healthy:
+                flight.record(
+                    "slo_recovered", slo=slo.name,
+                    burn_fast=burns.get("fast"),
+                    burn_slow=burns.get("slow"))
+                log.info("SLO %s recovered", slo.name)
+            results[slo.name] = {"healthy": now_healthy, "burn": burns}
+        return results
+
+    # -- reads ---------------------------------------------------------------
+    def healthz_section(self):
+        """The /healthz ``slo`` section: per-objective burn + health,
+        ``degraded`` truthy while any objective is breached (still
+        HTTP 200 — the burn informs operators, traffic keeps flowing).
+        None (section omitted) with nothing declared."""
+        with self._lock:
+            if not self._slos:
+                return None
+            objectives = {
+                name: {"healthy": st.get("healthy", True),
+                       "burn": st.get("burn", {}),
+                       **self._slos[name].describe()}
+                for name, st in self._status.items()
+                if name in self._slos}
+        return {"objectives": objectives,
+                "degraded": any(not o["healthy"]
+                                for o in objectives.values())}
+
+
+# -- histogram-direct burn (the rollout judge) --------------------------------
+
+def histogram_burn(hist, threshold, objective):
+    """Burn rate of a live PR-1 Histogram child against a latency SLO
+    (whole-history, no window — callers that need windows go through the
+    evaluator). 0.0 with no observations: an idle canary burns nothing."""
+    total = hist.count
+    if total == 0:
+        return 0.0
+    good = 0
+    for bound, c in zip(hist.buckets, hist.counts):
+        good += c
+        if bound >= float(threshold) * (1 - 1e-9):
+            break   # covering bound reached; everything past it is bad
+    bad_fraction = (total - good) / total
+    return bad_fraction / max(1.0 - objective, 1e-9)
+
+
+# -- module-level convenience (the gated entry points) ------------------------
+
+_state = {"evaluator": None}
+_lock = threading.Lock()
+
+
+def get_evaluator() -> SloEvaluator:
+    """The process-wide evaluator (created lazily). Raw handle —
+    callers outside telemetry/ use the gated helpers below (the
+    dl4jlint telemetry-gate contract)."""
+    ev = _state["evaluator"]
+    if ev is None:
+        with _lock:
+            ev = _state["evaluator"]
+            if ev is None:
+                ev = SloEvaluator()
+                _state["evaluator"] = ev
+    return ev
+
+
+def set_evaluator(evaluator):
+    """Swap the process evaluator (tests). Returns the previous one."""
+    prev = _state["evaluator"]
+    _state["evaluator"] = evaluator
+    return prev
+
+
+def declare(slo: Slo):
+    return get_evaluator().declare(slo)
+
+
+def remove(name):
+    ev = _state["evaluator"]
+    if ev is not None:
+        ev.remove(name)
+
+
+def evaluate():
+    """One evaluation pass now (None while telemetry is disabled)."""
+    return get_evaluator().evaluate()
+
+
+def healthz_section():
+    """The /healthz ``slo`` section (None with nothing declared) —
+    read-only; the fleet router's hand-rolled healthz calls this
+    directly since it does not use health.healthz()."""
+    ev = _state["evaluator"]
+    if ev is None:
+        return None
+    return ev.healthz_section()

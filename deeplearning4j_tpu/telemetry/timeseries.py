@@ -1,0 +1,382 @@
+"""Windowed metric time series: a bounded in-process ring of periodic
+registry snapshots (ISSUE 16 tentpole, piece 1).
+
+Every observability layer before this PR is an instantaneous view — a
+/metrics scrape says *how much so far*, never *how fast right now* or
+*what was p99 over the last minute*. This module adds the time
+dimension without any external TSDB:
+
+- a background sampler appends one bounded snapshot per interval for
+  the *selected* metric families (name-prefix allowlist — sampling the
+  whole registry would make the always-on cost proportional to
+  instrument count, not interest);
+- counters become **rates** (delta / monotonic-elapsed between the two
+  samples bracketing a window);
+- log-bucket histograms become **windowed quantiles** (cumulative
+  bucket-count deltas over the window, read exactly the way Prometheus
+  would read ``increase()`` + ``histogram_quantile``);
+- the ring is queryable at ``GET /debug/timeseries`` (ui/server.py and
+  the fleet router) and feeds the SLO burn-rate evaluator
+  (telemetry/slo.py).
+
+Disabled contract (the PR-1 rule): ``telemetry.disable()`` makes
+``sample_now()`` return before touching the registry, so a disabled
+process performs ZERO registry calls per tick — and the sampler is
+periodic, never per-request, so the request path performs zero
+time-series calls whether enabled or not (CountingStub-asserted in
+tests/test_fleet_slo.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.telemetry.registry import _sample_name
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+DEFAULT_INTERVAL = 5.0
+DEFAULT_CAPACITY = 720          # 1 h of history at the default interval
+# the families worth a time dimension out of the box: serving + fleet
+# request traffic, training step time, and the SLO layer's own gauges
+DEFAULT_PREFIXES = ("dl4j_serving_", "dl4j_fleet_", "dl4j_step_seconds",
+                    "dl4j_slo_")
+
+_state = {"sampler": None}
+_lock = threading.Lock()
+
+
+class TimeSeriesSampler:
+    """Bounded ring of periodic windowed snapshots. ``sample_now`` is
+    the only registry-touching entry point: one pass over the selected
+    families, one deque append — no I/O, no device work, and an early
+    return (zero registry calls) while telemetry is disabled."""
+
+    def __init__(self, interval=DEFAULT_INTERVAL,
+                 capacity=DEFAULT_CAPACITY, prefixes=DEFAULT_PREFIXES):
+        self.interval = float(interval)
+        self.capacity = int(capacity)
+        self.prefixes = tuple(prefixes)
+        self._samples: deque = deque(maxlen=self.capacity)
+        self._kinds: dict = {}      # sample key -> counter|gauge
+        self._bounds: dict = {}     # histogram key -> bucket bounds
+        self._callbacks: list = []  # post-sample hooks (SLO evaluator)
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- sampling ------------------------------------------------------------
+    def sample_now(self):
+        """Append one snapshot of the selected families; returns the
+        sample dict, or None while telemetry is disabled (zero registry
+        calls on the disabled path)."""
+        if not _registry.enabled():
+            return None
+        reg = _registry.get_registry()
+        values, hist = {}, {}
+        for fam in reg.collect():
+            if fam.local or not fam.name.startswith(self.prefixes):
+                continue
+            for labels, child in fam.children():
+                key = _sample_name(fam.name, labels)
+                if fam.kind == "histogram":
+                    # non-cumulative per-slot counts: deltas stay
+                    # per-slot and cumulate only at quantile time
+                    hist[key] = (tuple(child.counts), child.sum)
+                    self._bounds[key] = child.buckets
+                else:
+                    values[key] = float(child.value)
+                    self._kinds[key] = fam.kind
+        sample = {"ts": round(time.time(), 6),
+                  "mono": time.monotonic(),
+                  "values": values, "hist": hist}
+        with self._lock:
+            self._samples.append(sample)
+        for cb in list(self._callbacks):
+            try:
+                cb()
+            except Exception:
+                log.exception("timeseries post-sample callback failed")
+        return sample
+
+    def on_sample(self, callback):
+        """Run ``callback()`` after every appended sample (the SLO
+        evaluator's tick). Idempotent per callback object."""
+        if callback not in self._callbacks:
+            self._callbacks.append(callback)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start the background sampling thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dl4j-timeseries")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.sample_now()
+            except Exception:
+                # a sampler crash must never take serving down with it
+                log.exception("timeseries sample failed")
+
+    def clear(self):
+        with self._lock:
+            self._samples.clear()
+
+    def __len__(self):
+        return len(self._samples)
+
+    # -- windowed reads ------------------------------------------------------
+    def _window_pair(self, window=None):
+        """(oldest-in-window, newest) samples, or None with <2 samples.
+        ``window=None`` spans the whole ring."""
+        with self._lock:
+            samples = list(self._samples)
+        if len(samples) < 2:
+            return None
+        newest = samples[-1]
+        if window is None:
+            return samples[0], newest
+        horizon = newest["mono"] - float(window)
+        oldest = newest
+        for s in samples:
+            if s["mono"] >= horizon:
+                oldest = s
+                break
+        if oldest is newest:
+            oldest = samples[-2]   # degenerate window: last two ticks
+        return oldest, newest
+
+    def series(self, key, limit=None):
+        """[[wall_ts, value], ...] for one counter/gauge sample key."""
+        with self._lock:
+            samples = list(self._samples)
+        out = [[s["ts"], s["values"][key]] for s in samples
+               if key in s["values"]]
+        return out[-int(limit):] if limit else out
+
+    def rate(self, key, window=None):
+        """Per-second increase of a counter sample over the window
+        (None without two samples; clamped at 0 across a reset)."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return None
+        old, new = pair
+        if key not in old["values"] or key not in new["values"]:
+            return None
+        dt = new["mono"] - old["mono"]
+        if dt <= 0:
+            return None
+        return max(new["values"][key] - old["values"][key], 0.0) / dt
+
+    def _hist_delta(self, key, window=None):
+        """(per-slot count deltas, bounds, total) for one histogram
+        sample over the window, or None."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return None
+        old, new = pair
+        if key not in new["hist"]:
+            return None
+        new_counts = new["hist"][key][0]
+        old_entry = old["hist"].get(key)
+        old_counts = old_entry[0] if old_entry else (0,) * len(new_counts)
+        if len(old_counts) != len(new_counts):
+            old_counts = (0,) * len(new_counts)
+        delta = [max(n - o, 0) for n, o in zip(new_counts, old_counts)]
+        return delta, self._bounds.get(key, ()), sum(delta)
+
+    def quantile(self, key, q=0.99, window=None):
+        """Windowed quantile of a histogram sample: the smallest bucket
+        upper bound covering ``q`` of the window's observations (the
+        Prometheus ``histogram_quantile(increase(...))`` read). None
+        without data in the window."""
+        d = self._hist_delta(key, window)
+        if d is None or d[2] == 0:
+            return None
+        delta, bounds, total = d
+        target = q * total
+        acc = 0
+        for bound, c in zip(bounds, delta):
+            acc += c
+            if acc >= target:
+                return bound
+        return bounds[-1] if bounds else None
+
+    def bad_fraction(self, key, threshold, window=None):
+        """(observations above ``threshold``, total observations) for a
+        histogram sample over the window — the latency-SLO read.
+        ``threshold`` is quantized UP to the covering bucket bound
+        (observations at or under that bound count as good), so a
+        threshold between bounds errs toward healthy by at most one
+        bucket step. (None, 0) without data."""
+        d = self._hist_delta(key, window)
+        if d is None:
+            return None, 0
+        delta, bounds, total = d
+        if total == 0:
+            return None, 0
+        good = 0
+        for bound, c in zip(bounds, delta):
+            good += c
+            if bound >= float(threshold) * (1 - 1e-9):
+                break   # this bound covers the threshold; rest is bad
+        return total - good, total
+
+    def window_summary(self, window=None):
+        """Derived view of the newest window: counter rates, last gauge
+        values, histogram p50/p99 + observation rates."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return {"rates": {}, "gauges": {}, "quantiles": {}}
+        old, new = pair
+        dt = max(new["mono"] - old["mono"], 1e-9)
+        rates, gauges, quantiles = {}, {}, {}
+        for key, v in new["values"].items():
+            if self._kinds.get(key) == "counter":
+                r = max(v - old["values"].get(key, 0.0), 0.0) / dt
+                rates[key] = round(r, 6)
+            else:
+                gauges[key] = v
+        for key in new["hist"]:
+            d = self._hist_delta(key, window)
+            if d is None:
+                continue
+            total = d[2]
+            quantiles[key] = {
+                "p50": self.quantile(key, 0.5, window),
+                "p99": self.quantile(key, 0.99, window),
+                "count": total,
+                "rate": round(total / dt, 6),
+            }
+        return {"window_seconds": round(dt, 3), "rates": rates,
+                "gauges": gauges, "quantiles": quantiles}
+
+    def describe(self, window=None, name=None):
+        """The GET /debug/timeseries payload: sampler config, the
+        windowed derived view, and raw counter/gauge series (optionally
+        filtered by ``name`` prefix)."""
+        with self._lock:
+            samples = list(self._samples)
+        span = (samples[-1]["mono"] - samples[0]["mono"]
+                if len(samples) > 1 else 0.0)
+        series = {}
+        if samples:
+            for key in sorted(samples[-1]["values"]):
+                if name and not key.startswith(name):
+                    continue
+                series[key] = self.series(key)
+        summary = self.window_summary(window)
+        if name:
+            for section in ("rates", "gauges", "quantiles"):
+                summary[section] = {
+                    k: v for k, v in summary.get(section, {}).items()
+                    if k.startswith(name)}
+        return {
+            "config": {"interval": self.interval,
+                       "capacity": self.capacity,
+                       "prefixes": list(self.prefixes)},
+            "samples": len(samples),
+            "span_seconds": round(span, 3),
+            "window": summary,
+            "series": series,
+        }
+
+
+# -- module-level convenience (the gated entry points) ------------------------
+
+def get_sampler() -> TimeSeriesSampler:
+    """The process-wide sampler (created lazily). Raw handle — callers
+    outside telemetry/ go through the module helpers below, which gate
+    on the enabled flag (the dl4jlint telemetry-gate contract)."""
+    s = _state["sampler"]
+    if s is None:
+        with _lock:
+            s = _state["sampler"]
+            if s is None:
+                s = TimeSeriesSampler()
+                _state["sampler"] = s
+    return s
+
+
+def set_sampler(sampler):
+    """Swap the process sampler (tests). Returns the previous one."""
+    prev = _state["sampler"]
+    _state["sampler"] = sampler
+    return prev
+
+
+def configure(interval=None, capacity=None, prefixes=None):
+    """Reconfigure the process sampler in place (ring contents are
+    preserved on an interval change, dropped on a capacity change)."""
+    s = get_sampler()
+    if interval is not None:
+        s.interval = float(interval)
+    if capacity is not None:
+        s.capacity = int(capacity)
+        with s._lock:
+            s._samples = deque(s._samples, maxlen=s.capacity)
+    if prefixes is not None:
+        s.prefixes = tuple(prefixes)
+    return s
+
+
+def start():
+    return get_sampler().start()
+
+
+def stop(timeout=5.0):
+    s = _state["sampler"]
+    if s is not None:
+        s.stop(timeout)
+
+
+def sample_now():
+    """One snapshot now (deterministic tests; returns None while
+    telemetry is disabled — the zero-registry-calls gate lives in the
+    sampler itself)."""
+    return get_sampler().sample_now()
+
+
+def on_sample(callback):
+    get_sampler().on_sample(callback)
+
+
+def rate(key, window=None):
+    return get_sampler().rate(key, window)
+
+
+def quantile(key, q=0.99, window=None):
+    return get_sampler().quantile(key, q, window)
+
+
+def bad_fraction(key, threshold, window=None):
+    return get_sampler().bad_fraction(key, threshold, window)
+
+
+def describe(window=None, name=None):
+    """The GET /debug/timeseries payload — read-only, served whether or
+    not telemetry is currently enabled (incident reads outlive a
+    disable())."""
+    return get_sampler().describe(window=window, name=name)
+
+
+def clear():
+    s = _state["sampler"]
+    if s is not None:
+        s.clear()

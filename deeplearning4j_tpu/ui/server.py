@@ -29,6 +29,7 @@ import errno
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 log = logging.getLogger(__name__)
@@ -131,7 +132,12 @@ class _Handler(BaseHTTPRequestHandler):
             query = parse_qs(urlsplit(self.path).query)
             exemplars = (query.get("exemplars") or ["0"])[0] not in (
                 "0", "false", "")
-            body = prometheus.render(exemplars=exemplars).encode()
+            # /metrics?name=<prefix> keeps only matching families —
+            # selective scrapers (the fleet router's poll thread) stop
+            # rendering and parsing the full exposition every interval
+            name_prefix = (query.get("name") or [None])[0]
+            body = prometheus.render(exemplars=exemplars,
+                                     name_prefix=name_prefix).encode()
             ctype = prometheus.CONTENT_TYPE
         elif self.path == "/healthz":
             # liveness + readiness: divergence state, last-step age,
@@ -196,6 +202,29 @@ class _Handler(BaseHTTPRequestHandler):
             from deeplearning4j_tpu.telemetry import memledger
 
             self._respond(json.dumps(memledger.describe()).encode())
+            return
+        elif self.path.startswith("/debug/timeseries"):
+            # the windowed-snapshot ring (ISSUE 16): counter rates,
+            # gauge series, histogram p50/p99 over ?window= seconds,
+            # ?name= prefix-filters the keys. Read-only and served
+            # whether or not telemetry is currently enabled (incident
+            # reads outlive a disable())
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import timeseries
+
+            query = parse_qs(urlsplit(self.path).query)
+            window = (query.get("window") or [None])[0]
+            name = (query.get("name") or [None])[0]
+            try:
+                window = float(window) if window is not None else None
+            except ValueError:
+                self._respond(b'{"error": "window must be seconds"}',
+                              status=400)
+                return
+            body = json.dumps(
+                timeseries.describe(window=window, name=name)).encode()
+            self._respond(body)
             return
         elif self.path.startswith("/debug/traces"):
             # span-tree export (ISSUE 10): the whole ring as JSONL, or
@@ -273,12 +302,23 @@ class _Handler(BaseHTTPRequestHandler):
             model=name)
         headers = ({"traceparent": root.traceparent()}
                    if root is not None else {})
+        # hop decomposition (ISSUE 16): predict responses report the
+        # already-captured per-request phases in a Server-Timing header
+        # (dur in ms, per the spec) so the fleet router can attribute
+        # the serialize+network+parse remainder by subtraction
+        timing: dict = {}
+        t0 = time.perf_counter()
         try:
             with (root or tracing.NULL):
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    out = handler(self.server.ui._serving, name, body)
+                    if kind == "predict":
+                        out = handler(self.server.ui._serving, name,
+                                      body, timing=timing)
+                    else:
+                        out = handler(self.server.ui._serving, name,
+                                      body)
                 except shttp.HttpError as e:
                     # attribute BEFORE the span exits: finish() hands
                     # the attrs to the export ring
@@ -290,6 +330,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(shttp.error_body(e), status=e.status,
                           headers={**e.headers, **headers})
             return
+        if timing:
+            handler_ms = (time.perf_counter() - t0) * 1e3
+            parts = [f"{phase};dur={seconds * 1e3:.3f}"
+                     for phase, seconds in sorted(timing.items())]
+            parts.append(f"handler;dur={handler_ms:.3f}")
+            headers["Server-Timing"] = ", ".join(parts)
         self._respond(out, headers=headers)
 
     def log_message(self, *args):  # quiet

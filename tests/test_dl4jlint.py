@@ -498,6 +498,60 @@ class TestTelemetryGateRule:
         """
         assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
 
+    def test_flags_ungated_timeseries_handle(self, tmp_path):
+        # ISSUE 16: the time-series sampler's raw handle in a request
+        # helper with no gate — reading the ring is free, but the raw
+        # handle next to an emission idiom is exactly how per-request
+        # sampling would sneak back onto the disabled path
+        src = """
+            from deeplearning4j_tpu.telemetry import timeseries
+
+            def note_request_rate():
+                return timeseries.get_sampler().rate(
+                    "dl4j_serving_requests_total")
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_sample_gated_timeseries_handle(self, tmp_path):
+        # sample_now() gates internally (None + zero registry calls
+        # while disabled), so guarding on it IS the gate
+        clean = """
+            from deeplearning4j_tpu.telemetry import timeseries
+
+            def note_request_rate():
+                if timeseries.sample_now() is None:
+                    return None
+                return timeseries.get_sampler().rate(
+                    "dl4j_serving_requests_total")
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+    def test_flags_ungated_slo_evaluator_handle(self, tmp_path):
+        # ISSUE 16: a raw SLO-evaluator handle without a gate — note
+        # ``get_evaluator().evaluate()`` would be self-gating (evaluate
+        # gates internally, so its name IS in the gate set); the flagged
+        # shape is the raw handle used for anything else
+        src = """
+            from deeplearning4j_tpu.telemetry import slo
+
+            def judge_canary(objective):
+                return slo.get_evaluator().declare_all(objective)
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_bundle_gated_slo_evaluator_handle(self, tmp_path):
+        # slo_instruments() is the bundle factory (None when disabled)
+        # matching every other *_instruments — guarding on it gates
+        clean = """
+            from deeplearning4j_tpu.telemetry import slo
+
+            def judge_canary():
+                if slo.slo_instruments() is None:
+                    return None
+                return slo.get_evaluator().evaluate()
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
     def test_near_miss_sampler_gated_tracer(self, tmp_path):
         # the sampler IS a gate: current() returns None when disabled
         # or unsampled, so guarding on it keeps the disabled path at

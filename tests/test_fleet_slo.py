@@ -1,0 +1,809 @@
+"""ISSUE 16 tests: fleet-wide SLOs — the windowed time-series ring,
+multi-window burn-rate alerting, metrics federation under a ``worker``
+label, cross-process trace stitching, and hop-level latency
+decomposition.
+
+Fast tier: time-series rate/quantile math over a fresh registry, the
+full SLO breach -> flight event -> healthz-degraded (still 200) ->
+recovery cycle, the disabled contract (zero registry calls from
+sample_now/evaluate under a CountingStub), Server-Timing emission and
+the router's four-phase hop decomposition (phases sum to the measured
+hop), federation label-collision handling (``exported_worker``), the
+merged flight stream's cross-process ordering, the 503 Retry-After
+satellite, ``/metrics?name=`` filtering, ``/debug/timeseries``, and
+the rollout controller judging a canary by SLO burn.
+
+Slow tier: real subprocess workers — one ``/debug/fleet/traces``
+response returns the stitched cross-process span tree (the worker's
+``http.predict`` a true child of the router's ``fleet.predict``), one
+``/debug/fleet/metrics`` scrape federates every worker, and an
+injected worker latency regression (LinearServable's ``delay_ms``
+knob) breaches a spec-declared SLO in its fast burn window, lands in
+the federated flight stream, degrades the worker's /healthz without a
+503, and recovers once the regression is rolled away.
+"""
+
+import json
+import time
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.fleet import FleetRouter, WorkerHandle
+from deeplearning4j_tpu.fleet.router import (
+    HOP_PHASES, _http, _inject_worker_label, _merge_expositions,
+    _parse_server_timing, spawn_local_workers)
+from deeplearning4j_tpu.fleet.worker import WorkerAdmin
+from deeplearning4j_tpu.serving import InferenceSession
+from deeplearning4j_tpu.telemetry import flight, health, prometheus, tracing
+from deeplearning4j_tpu.telemetry import slo as slo_mod
+from deeplearning4j_tpu.telemetry import timeseries
+from deeplearning4j_tpu.telemetry.registry import (
+    MetricsRegistry, log_buckets)
+from deeplearning4j_tpu.telemetry.slo import Slo, SloEvaluator, histogram_burn
+from deeplearning4j_tpu.telemetry.timeseries import TimeSeriesSampler
+from deeplearning4j_tpu.ui.server import UIServer
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+BUCKETS = log_buckets(1e-3, 10.0)
+
+
+@pytest.fixture
+def fresh_telemetry():
+    """Clean registry + private sampler/evaluator swapped into the
+    process slots, restored (and the slo healthz provider retracted)
+    after."""
+    reg = MetricsRegistry()
+    prev_reg = telemetry.set_registry(reg)
+    sampler = TimeSeriesSampler(interval=999.0, capacity=64,
+                                prefixes=("dl4j_",))
+    prev_sampler = timeseries.set_sampler(sampler)
+    ev = SloEvaluator(sampler=sampler)
+    prev_ev = slo_mod.set_evaluator(ev)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    yield reg, sampler, ev
+    health.unregister_healthz_provider("slo")
+    slo_mod.set_evaluator(prev_ev)
+    timeseries.set_sampler(prev_sampler)
+    telemetry.set_registry(prev_reg)
+    (telemetry.enable if was_enabled else telemetry.disable)()
+
+
+class CountingStub:
+    """Registry stand-in: ANY attribute access is a contract breach."""
+
+    def __init__(self):
+        type(self).calls = 0
+
+    def __getattr__(self, name):
+        type(self).calls += 1
+        raise AssertionError(f"registry.{name} touched while disabled")
+
+
+# ---------------------------------------------------------------------------
+# the in-process fleet harness (mirrors tests/test_fleet.py)
+# ---------------------------------------------------------------------------
+
+def _spec(scale=2.0, bias=0.0, delay_ms=0.0, shape=(3,), name="m",
+          version=1):
+    return {"name": name, "version": version, "kind": "linear",
+            "scale": scale, "bias": bias, "delay_ms": delay_ms,
+            "example_shape": list(shape), "ladder": [1, 4, 8]}
+
+
+class _InprocWorker:
+    def __init__(self, name, specs=()):
+        self.session = InferenceSession(max_latency=0.0)
+        self.admin = WorkerAdmin(self.session)
+        for s in specs:
+            self.admin.register_spec(s["name"], s, s["version"])
+        self.server = (UIServer().serveModels(self.session)
+                       .serveFleetAdmin(self.admin).start(port=0))
+        self.url = f"http://127.0.0.1:{self.server.port}"
+        self.handle = WorkerHandle(name, self.url)
+
+    def stop(self):
+        self.server.stop()
+        self.session.close()
+
+
+class _Fleet:
+    def __init__(self, n=2, specs=None, **router_kw):
+        specs = [_spec()] if specs is None else specs
+        self.workers = [_InprocWorker(f"w{i}", specs) for i in range(n)]
+        router_kw.setdefault("poll_interval", 0.05)
+        self.router = FleetRouter([w.handle for w in self.workers],
+                                  **router_kw)
+        self.router.start(port=0)
+        self.url = f"http://127.0.0.1:{self.router.port}"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(w.handle.models for w in self.workers):
+                break
+            time.sleep(0.02)
+
+    def predict(self, instances, model="m", headers=None):
+        body = json.dumps({"instances": instances}).encode()
+        return _http(f"{self.url}/serving/v1/models/{model}:predict",
+                     body=body, headers=headers, timeout=30.0)
+
+    def close(self):
+        self.router.close()
+        for w in self.workers:
+            w.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# time series: the windowed ring
+# ---------------------------------------------------------------------------
+
+class TestTimeSeries:
+    def test_counter_rate_is_delta_over_elapsed(self, fresh_telemetry):
+        reg, sampler, _ = fresh_telemetry
+        c = reg.counter("dl4j_test_things_total", "h")
+        c.inc(3)
+        sampler.sample_now()
+        c.inc(5)
+        sampler.sample_now()
+        samples = list(sampler._samples)
+        dt = samples[-1]["mono"] - samples[0]["mono"]
+        assert sampler.rate("dl4j_test_things_total") == \
+            pytest.approx(5.0 / dt)
+        # a counter reset never reports a negative rate
+        c.value = 0.0
+        sampler.sample_now()
+        assert timeseries.rate("dl4j_test_things_total",
+                               window=1e-9) == 0.0
+
+    def test_histogram_windowed_quantiles_and_bad_fraction(
+            self, fresh_telemetry):
+        reg, sampler, _ = fresh_telemetry
+        h = reg.histogram("dl4j_test_lat_seconds", "h", buckets=BUCKETS)
+        h.observe(5.0)            # pre-window traffic must not leak in
+        sampler.sample_now()
+        for _ in range(10):
+            h.observe(0.002)
+        h.observe(0.5)
+        h.observe(0.5)
+        sampler.sample_now()
+        p50 = sampler.quantile("dl4j_test_lat_seconds", 0.5)
+        p99 = sampler.quantile("dl4j_test_lat_seconds", 0.99)
+        assert p50 is not None and p50 < 0.01
+        assert p99 is not None and 0.5 <= p99 < 5.0
+        bad, total = sampler.bad_fraction("dl4j_test_lat_seconds", 0.01)
+        assert (bad, total) == (2, 12)
+        # threshold quantizes UP to the covering bucket bound
+        bad_at_bound, _ = sampler.bad_fraction(
+            "dl4j_test_lat_seconds", 0.5)
+        assert bad_at_bound == 0
+
+    def test_no_data_reads_are_none(self, fresh_telemetry):
+        _, sampler, _ = fresh_telemetry
+        assert sampler.rate("dl4j_test_things_total") is None
+        assert sampler.quantile("dl4j_test_lat_seconds") is None
+        assert sampler.bad_fraction("dl4j_x", 0.1) == (None, 0)
+
+    def test_prefix_allowlist(self, fresh_telemetry):
+        reg, sampler, _ = fresh_telemetry
+        sampler.prefixes = ("dl4j_serving_",)
+        reg.counter("dl4j_serving_in_total", "h").inc()
+        reg.counter("dl4j_other_total", "h").inc()
+        s = sampler.sample_now()
+        assert "dl4j_serving_in_total" in s["values"]
+        assert "dl4j_other_total" not in s["values"]
+
+    def test_configure_capacity_bounds_the_ring(self, fresh_telemetry):
+        reg, sampler, _ = fresh_telemetry
+        reg.counter("dl4j_test_things_total", "h").inc()
+        timeseries.configure(capacity=2)
+        for _ in range(5):
+            timeseries.sample_now()
+        assert len(sampler) == 2
+
+    def test_describe_payload_and_name_filter(self, fresh_telemetry):
+        reg, sampler, _ = fresh_telemetry
+        reg.counter("dl4j_test_things_total", "h").inc(2)
+        reg.gauge("dl4j_other_depth", "h").set(7)
+        sampler.sample_now()
+        sampler.sample_now()
+        d = timeseries.describe(name="dl4j_test_")
+        assert d["config"]["capacity"] == 64
+        assert d["samples"] == 2
+        assert list(d["series"]) == ["dl4j_test_things_total"]
+        assert "dl4j_other_depth" not in d["window"]["gauges"]
+        full = timeseries.describe()
+        assert full["window"]["gauges"]["dl4j_other_depth"] == 7.0
+
+    def test_on_sample_callback_ticks(self, fresh_telemetry):
+        _, sampler, _ = fresh_telemetry
+        hits = []
+        sampler.on_sample(lambda: hits.append(1))
+        sampler.on_sample(lambda: hits.append(1))   # not idempotent: 2 cbs
+        sampler.sample_now()
+        assert len(hits) == 2
+
+    def test_disabled_sample_now_zero_registry_calls(self):
+        stub = CountingStub()
+        prev = telemetry.set_registry(stub)
+        telemetry.disable()
+        try:
+            sampler = TimeSeriesSampler()
+            assert sampler.sample_now() is None
+            assert CountingStub.calls == 0
+        finally:
+            telemetry.set_registry(prev)
+            telemetry.enable()
+
+
+# ---------------------------------------------------------------------------
+# SLOs: multi-window burn rate
+# ---------------------------------------------------------------------------
+
+def _latency_slo(**kw):
+    # tiny windows: _window_pair falls back to the last two samples, so
+    # each evaluation judges exactly the traffic between two explicit
+    # sample_now() calls — fully deterministic
+    kw.setdefault("fast_window", 1e-6)
+    kw.setdefault("slow_window", 1e-6)
+    return Slo(kw.pop("name", "predict_latency"), kind="latency",
+               metric=kw.pop("metric", "dl4j_test_lat_seconds"),
+               threshold=kw.pop("threshold", 0.01),
+               objective=kw.pop("objective", 0.9), **kw)
+
+
+class TestSloBurnRate:
+    def test_breach_flight_healthz_degraded_then_recovery(
+            self, fresh_telemetry):
+        reg, sampler, ev = fresh_telemetry
+        flight.get_recorder().clear()
+        h = reg.histogram("dl4j_test_lat_seconds", "h", buckets=BUCKETS)
+        ev.declare(_latency_slo())
+        h.observe(0.002)
+        sampler.sample_now()          # ticks ev.evaluate via on_sample
+        snap = reg.snapshot()
+        assert snap['dl4j_slo_healthy{slo="predict_latency"}'] == 1.0
+        # injected regression: every observation above threshold
+        for _ in range(20):
+            h.observe(0.2)
+        sampler.sample_now()
+        snap = reg.snapshot()
+        assert snap['dl4j_slo_healthy{slo="predict_latency"}'] == 0.0
+        assert snap['dl4j_slo_breaches_total{slo="predict_latency"}'] \
+            == 1.0
+        burn_fast = snap[
+            'dl4j_slo_burn_rate{slo="predict_latency",window="fast"}']
+        assert burn_fast > 1.0
+        breach = flight.get_recorder().events("slo_breach")
+        assert breach and breach[0]["slo"] == "predict_latency"
+        assert breach[0]["burn_fast"] > 1.0
+        # degraded, never 503: traffic keeps flowing on a burning budget
+        payload, status = health.healthz()
+        assert status == 200
+        assert payload["status"] == "degraded"
+        assert payload["slo"]["degraded"] is True
+        obj = payload["slo"]["objectives"]["predict_latency"]
+        assert obj["healthy"] is False and obj["threshold"] == 0.01
+        # recovery: a clean window on both burn windows clears it
+        for _ in range(20):
+            h.observe(0.002)
+        sampler.sample_now()
+        snap = reg.snapshot()
+        assert snap['dl4j_slo_healthy{slo="predict_latency"}'] == 1.0
+        assert snap['dl4j_slo_breaches_total{slo="predict_latency"}'] \
+            == 1.0                     # transitions, not ticks
+        assert flight.get_recorder().events("slo_recovered")
+        payload, status = health.healthz()
+        assert status == 200 and payload["status"] == "ok"
+
+    def test_fast_spike_alone_does_not_breach(self, fresh_telemetry):
+        reg, sampler, ev = fresh_telemetry
+        h = reg.histogram("dl4j_test_lat_seconds", "h", buckets=BUCKETS)
+        # slow window spans the whole ring (full history), fast window
+        # the last tick: a spike after a long good history is fast-hot
+        # but slow-cold -> no page
+        ev.declare(_latency_slo(slow_window=3600.0, objective=0.5))
+        for _ in range(100):
+            h.observe(0.002)
+        sampler.sample_now()
+        h.observe(0.2)
+        h.observe(0.2)
+        sampler.sample_now()
+        for _ in range(100):
+            h.observe(0.002)
+        sampler.sample_now()
+        res = ev.evaluate()
+        st = res["predict_latency"]
+        assert st["healthy"] is True
+
+    def test_no_traffic_holds_state(self, fresh_telemetry):
+        reg, sampler, ev = fresh_telemetry
+        h = reg.histogram("dl4j_test_lat_seconds", "h", buckets=BUCKETS)
+        ev.declare(_latency_slo())
+        sampler.sample_now()
+        for _ in range(5):
+            h.observe(0.2)
+        sampler.sample_now()          # breach
+        assert ev.evaluate()["predict_latency"]["healthy"] is False
+        sampler.sample_now()          # idle tick: burns are None
+        res = ev.evaluate()["predict_latency"]
+        assert res["burn"]["fast"] is None
+        assert res["healthy"] is False   # held, not silently recovered
+
+    def test_error_rate_slo(self, fresh_telemetry):
+        reg, sampler, ev = fresh_telemetry
+        c = reg.counter("dl4j_test_req_total", "h", ("outcome",))
+        ev.declare(Slo("errors", kind="error_rate",
+                       bad=('outcome="transport"',),
+                       total="dl4j_test_req_total",
+                       objective=0.95, fast_window=1e-6,
+                       slow_window=1e-6))
+        c.labels(outcome="ok").inc()
+        sampler.sample_now()
+        c.labels(outcome="ok").inc(9)
+        c.labels(outcome="transport").inc(1)
+        sampler.sample_now()
+        res = ev.evaluate()["errors"]
+        # 10% bad over a 5% budget = burn 2.0 on both windows
+        assert res["burn"]["fast"] == pytest.approx(2.0)
+        assert res["healthy"] is False
+
+    def test_disabled_evaluate_zero_calls_zero_flight(self):
+        ev = SloEvaluator(sampler=TimeSeriesSampler())
+        ev._slos["x"] = _latency_slo(name="x")
+        ev._status["x"] = {"healthy": True, "burn": {}}
+        flight.get_recorder().clear()
+        stub = CountingStub()
+        prev = telemetry.set_registry(stub)
+        telemetry.disable()
+        try:
+            assert ev.evaluate() is None
+            assert CountingStub.calls == 0
+            assert slo_mod.slo_instruments() is None
+            assert timeseries.sample_now() is None
+        finally:
+            telemetry.set_registry(prev)
+            telemetry.enable()
+        assert flight.get_recorder().events("slo_breach") == []
+
+    def test_histogram_burn_math(self, fresh_telemetry):
+        reg, _, _ = fresh_telemetry
+        h = reg.histogram("dl4j_test_burn_seconds", "h", buckets=BUCKETS)
+        assert histogram_burn(h, 0.01, 0.9) == 0.0   # idle burns nothing
+        for _ in range(9):
+            h.observe(0.002)
+        h.observe(0.2)
+        # bad fraction 0.1 over a 0.1 budget: burning exactly the budget
+        assert histogram_burn(h, 0.01, 0.9) == pytest.approx(1.0)
+        assert histogram_burn(h, 0.01, 0.99) == pytest.approx(10.0)
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            Slo("x", kind="latency")               # needs metric+threshold
+        with pytest.raises(ValueError):
+            Slo("x", kind="error_rate")            # needs bad+total
+        with pytest.raises(ValueError):
+            Slo("x", kind="availability")
+        with pytest.raises(ValueError):
+            _latency_slo(objective=1.0)
+
+
+# ---------------------------------------------------------------------------
+# hop decomposition
+# ---------------------------------------------------------------------------
+
+class TestHopDecomposition:
+    def test_worker_emits_server_timing(self, fresh_telemetry):
+        w = _InprocWorker("w0", [_spec()])
+        try:
+            status, headers, _ = _http(
+                w.url + "/serving/v1/models/m:predict",
+                body=json.dumps({"instances": [[1.0, 2.0, 3.0]]}).encode(),
+                timeout=30.0)
+            assert status == 200
+            st = next(v for k, v in headers.items()
+                      if k.lower() == "server-timing")
+            phases = _parse_server_timing(st)
+            assert {"queue", "execute", "handler"} <= set(phases)
+            # handler wraps queue+execute (all in seconds after parse)
+            assert phases["handler"] >= phases["execute"]
+            assert all(v < 30.0 for v in phases.values())
+        finally:
+            w.stop()
+
+    def test_parse_server_timing_units_and_garbage(self):
+        assert _parse_server_timing(
+            "queue;dur=1.5, execute;dur=250") == \
+            {"queue": 0.0015, "execute": 0.25}
+        assert _parse_server_timing("cache;desc=hit, bad;dur=x") == {}
+
+    def test_router_decomposes_hop_phases_sum_to_hop(
+            self, fresh_telemetry):
+        reg, _, _ = fresh_telemetry
+        # the tracer ring is process-global and survives across test
+        # files — this id must be unique suite-wide (test_fleet.py owns
+        # "ab"*16), and the newest matching span is ours
+        trace_id = "d6" * 16
+        with _Fleet(n=1) as f:
+            status, _, _ = f.predict(
+                [[1.0, 2.0, 3.0]],
+                headers={"traceparent": f"00-{trace_id}-{'cd' * 8}-01"})
+            assert status == 200
+            snap = reg.snapshot()
+            phase_sums = {}
+            for p in HOP_PHASES:
+                key = f'dl4j_fleet_hop_seconds_count{{phase="{p}"}}'
+                assert snap[key] == 1.0
+                phase_sums[p] = snap[
+                    f'dl4j_fleet_hop_seconds_sum{{phase="{p}"}}']
+            hop_sum = snap['dl4j_fleet_request_seconds_sum{worker="w0"}']
+            # the four phases partition the measured hop exactly
+            assert sum(phase_sums.values()) == pytest.approx(
+                hop_sum, rel=1e-6)
+            # and >=90% of the hop is attributed beyond pure transit
+            # bookkeeping (the ISSUE acceptance read: decomposition
+            # covers the hop, not a sliver of it)
+            assert sum(phase_sums.values()) >= 0.9 * hop_sum
+            span = [
+                s for s in tracing.get_tracer().spans(trace_id)
+                if s["name"] == "fleet.predict"][-1]
+            for p in HOP_PHASES:
+                assert f"hop_{p}_s" in span["attrs"]
+            assert span["attrs"]["hop_transit_s"] == pytest.approx(
+                phase_sums["transit"], abs=1e-5)
+
+    def test_disabled_request_path_zero_registry_calls(self):
+        # the harness is built enabled (instrument creation is
+        # registration-time), then the stub is swapped in: the routed
+        # request path itself — hop decomposition included — must not
+        # touch the registry while disabled. poll_interval is long so
+        # no scrape poll lands inside the stubbed window.
+        with _Fleet(n=1, poll_interval=60.0) as f:
+            assert f.predict([[1.0, 2.0, 3.0]])[0] == 200
+            stub = CountingStub()
+            prev = telemetry.set_registry(stub)
+            telemetry.disable()
+            try:
+                status, _, body = f.predict([[1.0, 2.0, 3.0]])
+                assert status == 200
+                assert json.loads(body)["predictions"] == \
+                    [[2.0, 4.0, 6.0]]
+                assert CountingStub.calls == 0
+            finally:
+                telemetry.set_registry(prev)
+                telemetry.enable()
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+class TestFederation:
+    def test_inject_worker_label_shapes(self):
+        assert _inject_worker_label(
+            'dl4j_x_total{model="m"} 3', "w0") == \
+            'dl4j_x_total{worker="w0",model="m"} 3'
+        assert _inject_worker_label("dl4j_x_total 3", "w0") == \
+            'dl4j_x_total{worker="w0"} 3'
+
+    def test_preexisting_worker_label_renamed_not_collided(self):
+        # two processes exporting the SAME family with a worker label
+        # (the router's own dl4j_fleet_* set does): the source's label
+        # must move aside, Prometheus-federation style
+        line = 'dl4j_fleet_requests_total{worker="w1",outcome="ok"} 2'
+        out = _inject_worker_label(line, "router")
+        assert out == ('dl4j_fleet_requests_total{worker="router",'
+                       'exported_worker="w1",outcome="ok"} 2')
+
+    def test_merge_expositions_two_workers_same_series(self):
+        exp = ("# HELP dl4j_serving_requests_total h\n"
+               "# TYPE dl4j_serving_requests_total counter\n"
+               'dl4j_serving_requests_total{model="m",outcome="ok"} %d\n')
+        merged = _merge_expositions(
+            [("w0", exp % 3), ("w1", exp % 5)])
+        assert merged.count("# TYPE dl4j_serving_requests_total") == 1
+        assert 'worker="w0",model="m"' in merged
+        assert 'worker="w1",model="m"' in merged
+        # identical family+labels from two workers stay distinct, and
+        # the merged exposition round-trips through the parser
+        parsed = prometheus.parse(merged)
+        assert parsed['dl4j_serving_requests_total'
+                      '{worker="w0",model="m",outcome="ok"}'] == 3.0
+        assert parsed['dl4j_serving_requests_total'
+                      '{worker="w1",model="m",outcome="ok"}'] == 5.0
+
+    def test_one_scrape_federates_router_and_workers(self):
+        with _Fleet(n=2) as f:
+            assert f.predict([[1.0, 2.0, 3.0]])[0] == 200
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                status, headers, body = _http(
+                    f.url + "/debug/fleet/metrics", timeout=10.0)
+                text = body.decode()
+                if ('worker="w0"' in text and 'worker="w1"' in text
+                        and 'worker="router"' in text):
+                    break
+                time.sleep(0.05)
+            assert status == 200
+            assert "text/plain" in headers.get("Content-Type",
+                                               headers.get("content-type", ""))
+            assert 'worker="w0"' in text and 'worker="w1"' in text
+            assert 'worker="router"' in text
+            # parseable as one well-formed exposition
+            parsed = prometheus.parse(text)
+            assert any(k.startswith("dl4j_serving_requests_total")
+                       for k in parsed)
+            # name filter narrows the merged exposition too
+            _, _, filtered = _http(
+                f.url + "/debug/fleet/metrics?name=dl4j_serving_",
+                timeout=10.0)
+            assert all(k.startswith("dl4j_serving_")
+                       for k in prometheus.parse(filtered.decode()))
+
+    def test_fleet_flight_merged_and_time_ordered(self):
+        flight.get_recorder().clear()
+        with _Fleet(n=1) as f:
+            assert f.predict([[1.0, 2.0, 3.0]])[0] == 200
+            flight.record("router_marker", x=1)
+            _, _, body = _http(f.url + "/debug/fleet/flight",
+                               timeout=10.0)
+            events = [json.loads(line) for line in
+                      body.decode().splitlines() if line]
+            assert events
+            assert {e["worker"] for e in events} >= {"router"}
+            ts = [e["ts"] for e in events]
+            assert ts == sorted(ts)
+            marker = next(e for e in events
+                          if e["kind"] == "router_marker")
+            assert marker["worker"] == "router"
+            # ISSUE 16 satellite: events carry BOTH clocks — wall for
+            # cross-process merge order, monotonic for local deltas
+            assert "mono" in marker and "ts" in marker
+
+    def test_flight_events_carry_wall_and_mono(self):
+        flight.get_recorder().clear()
+        before_ts, before_mono = time.time(), time.monotonic()
+        flight.record("clock_check")
+        e = flight.get_recorder().events("clock_check")[0]
+        assert before_ts - 1.0 <= e["ts"] <= time.time() + 1.0
+        assert before_mono - 1.0 <= e["mono"] <= time.monotonic()
+
+    def test_503_no_worker_carries_retry_after(self):
+        # deterministic 503 (every worker already ejected): the client
+        # is told exactly when routing capacity can next change — one
+        # poll round from now
+        router = FleetRouter(
+            [WorkerHandle("dead", "http://127.0.0.1:9")],
+            poll_interval=0.1, retry_budget=0)
+        router.workers[0].up = False
+        router.start(port=0)
+        try:
+            status, headers, _ = _http(
+                f"http://127.0.0.1:{router.port}"
+                "/serving/v1/models/m:predict",
+                body=json.dumps({"instances": [[1.0]]}).encode(),
+                timeout=10.0)
+            assert status == 503
+            ra = next(v for k, v in headers.items()
+                      if k.lower() == "retry-after")
+            assert float(ra) == pytest.approx(0.1)
+        finally:
+            router.close()
+
+    def test_metrics_name_prefix_filter(self, fresh_telemetry):
+        reg, _, _ = fresh_telemetry
+        reg.counter("dl4j_serving_x_total", "h").inc()
+        reg.counter("dl4j_fleet_y_total", "h").inc()
+        w = _InprocWorker("w0")
+        try:
+            _, _, body = _http(w.url + "/metrics?name=dl4j_serving_",
+                               timeout=10.0)
+            text = body.decode()
+            assert "dl4j_serving_x_total" in text
+            assert "dl4j_fleet_y_total" not in text
+            _, _, full = _http(w.url + "/metrics", timeout=10.0)
+            assert "dl4j_fleet_y_total" in full.decode()
+        finally:
+            w.stop()
+
+    def test_debug_timeseries_route(self, fresh_telemetry):
+        reg, _, _ = fresh_telemetry
+        reg.counter("dl4j_serving_x_total", "h").inc(4)
+        timeseries.sample_now()
+        timeseries.sample_now()
+        w = _InprocWorker("w0")
+        try:
+            status, _, body = _http(
+                w.url + "/debug/timeseries?window=60&name=dl4j_serving_",
+                timeout=10.0)
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["samples"] == 2
+            assert "dl4j_serving_x_total" in payload["series"]
+            status, _, _ = _http(w.url + "/debug/timeseries?window=bogus",
+                                 timeout=10.0)
+            assert status == 400
+        finally:
+            w.stop()
+
+
+# ---------------------------------------------------------------------------
+# rollout: SLO-burn canary judgment
+# ---------------------------------------------------------------------------
+
+class TestRolloutSloJudge:
+    def test_canary_exceeding_incumbent_burn_rolls_back(self):
+        # correctness metrics are blinded (agreement off, p99 ratio
+        # effectively off): only the declared SLO can fail this canary
+        slo = Slo("hop", kind="latency",
+                  metric="dl4j_fleet_request_seconds",
+                  threshold=0.02, objective=0.9)
+        with _Fleet(n=2) as f:
+            ctl = f.router.start_rollout(
+                "m", _spec(delay_ms=80.0, version=2), version=2,
+                fraction=1.0, min_samples=8, p99_ratio=1000.0,
+                min_agreement=0.0, slo=slo, slo_burn_ratio=2.0)
+            deadline = time.monotonic() + 30.0
+            while not ctl.terminal() and time.monotonic() < deadline:
+                f.predict([[1.0, 2.0, 3.0]])
+                time.sleep(0.005)
+            assert ctl.terminal()
+            assert ctl.state == "rolled_back"
+            d = ctl.describe()
+            assert d["decision"]["verdict"] == "rollback"
+            assert "slo burn" in d["decision"]["reason"]
+            assert d["decision"]["slo_burn_canary"] > \
+                2.0 * max(d["decision"]["slo_burn_incumbent"], 1.0)
+
+    def test_rollout_slo_must_be_latency_kind(self):
+        from deeplearning4j_tpu.fleet.rollout import RolloutController
+
+        bad = Slo("e", kind="error_rate", bad=("x",), total="dl4j_t")
+        with pytest.raises(ValueError):
+            RolloutController(None, "m", {}, 2, slo=bad)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real worker processes
+# ---------------------------------------------------------------------------
+
+def _poll(fn, timeout=20.0, every=0.05):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(every)
+    return last
+
+
+@pytest.mark.slow
+class TestFleetSloProcesses:
+    def test_stitched_cross_process_trace_and_federation(self):
+        """ISSUE 16 acceptance: one /debug/fleet/traces response holds
+        the stitched tree — the subprocess worker's http.predict span a
+        true CHILD of the router's fleet.predict span — and one
+        /debug/fleet/metrics scrape federates every live worker."""
+        spec = {"models": [_spec()]}
+        workers = spawn_local_workers(2, spec, extra_env=CPU_ENV)
+        router = FleetRouter(workers, owns_workers=True,
+                             poll_interval=0.1).start(port=0)
+        url = f"http://127.0.0.1:{router.port}"
+        try:
+            trace_id = "7c" * 16
+            body = json.dumps({"instances": [[1.0, 2.0, 3.0]]}).encode()
+            status, _, _ = _http(
+                url + "/serving/v1/models/m:predict", body=body,
+                headers={"traceparent": f"00-{trace_id}-{'2d' * 8}-01"},
+                timeout=30.0)
+            assert status == 200
+
+            def stitched():
+                _, _, b = _http(
+                    url + f"/debug/fleet/traces?trace_id={trace_id}",
+                    timeout=10.0)
+                spans = [json.loads(line) for line in
+                         b.decode().splitlines() if line]
+                by_name = {s["name"]: s for s in spans}
+                if {"fleet.predict", "http.predict"} <= set(by_name):
+                    return by_name
+                return None
+
+            by_name = _poll(stitched)
+            assert by_name, "stitched trace never federated"
+            fleet_span = by_name["fleet.predict"]
+            http_span = by_name["http.predict"]
+            assert fleet_span["trace_id"] == trace_id
+            assert http_span["trace_id"] == trace_id
+            # the cross-process parent edge IS the stitch
+            assert http_span["parent_id"] == fleet_span["span_id"]
+            assert fleet_span["worker"] == "router"
+            assert http_span["worker"].startswith("w")
+
+            def federated():
+                _, _, b = _http(url + "/debug/fleet/metrics",
+                                timeout=10.0)
+                t = b.decode()
+                ok = all(f'worker="{w}"' in t
+                         for w in ("router", "w0", "w1"))
+                return t if ok else None
+
+            text = _poll(federated)
+            assert text, "scrape never federated all live workers"
+            assert list(prometheus.parse(text))
+        finally:
+            router.close()
+
+    def test_injected_latency_regression_breaches_then_recovers(self):
+        """ISSUE 16 acceptance: a worker latency regression (the
+        LinearServable delay knob) breaches the spec-declared SLO in
+        its fast burn window — worker /healthz degrades but stays 200,
+        the breach lands in the federated flight stream — and rolling
+        the regression away recovers it."""
+        spec = {
+            "models": [_spec(delay_ms=30.0)],
+            "timeseries": {"interval": 0.2},
+            "slos": [{"name": "predict_latency", "kind": "latency",
+                      "metric": 'dl4j_serving_execute_seconds{model="m"}',
+                      "threshold": 0.005, "objective": 0.9,
+                      "fast_window": 1e-6, "slow_window": 1e-6}],
+        }
+        workers = spawn_local_workers(1, spec, extra_env=CPU_ENV)
+        router = FleetRouter(workers, owns_workers=True,
+                             poll_interval=0.1).start(port=0)
+        url = f"http://127.0.0.1:{router.port}"
+        w_url = workers[0].url
+        body = json.dumps({"instances": [[1.0, 2.0, 3.0]]}).encode()
+        try:
+            def drive_until_slo(healthy):
+                def step():
+                    _http(url + "/serving/v1/models/m:predict",
+                          body=body, timeout=30.0)
+                    _, _, hb = _http(w_url + "/healthz", timeout=10.0)
+                    payload = json.loads(hb)
+                    section = payload.get("slo")
+                    if section is None:
+                        return None
+                    if section["degraded"] is (not healthy):
+                        return payload
+                    return None
+                return _poll(step, timeout=30.0)
+
+            degraded = drive_until_slo(healthy=False)
+            assert degraded, "declared SLO never breached under delay"
+            # degraded-not-503: the worker still answers 200 ready
+            status, _, hb = _http(w_url + "/healthz", timeout=10.0)
+            assert status == 200
+            assert json.loads(hb)["status"] == "degraded"
+            # the breach is visible fleet-wide in ONE federated stream
+            _, _, fb = _http(url + "/debug/fleet/flight", timeout=10.0)
+            breaches = [json.loads(line) for line in
+                        fb.decode().splitlines()
+                        if line and '"slo_breach"' in line]
+            assert any(e["worker"] == "w0"
+                       and e["slo"] == "predict_latency"
+                       for e in breaches)
+            # roll the regression away: v2 without the delay wins the
+            # newest-version default, and the SLO recovers
+            status, _, _ = _http(
+                w_url + "/serving/v1/models/m:register",
+                body=json.dumps(
+                    {"spec": _spec(delay_ms=0.0, version=2),
+                     "version": 2}).encode(),
+                timeout=30.0)
+            assert status in (200, 201)
+            recovered = drive_until_slo(healthy=True)
+            assert recovered, "SLO never recovered after the fix"
+            assert recovered["status"] in ("ok", "degraded")
+            _, _, fb = _http(url + "/debug/fleet/flight", timeout=10.0)
+            assert '"slo_recovered"' in fb.decode()
+        finally:
+            router.close()

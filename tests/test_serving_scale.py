@@ -498,6 +498,17 @@ class TestContinuousBatchingDecode:
         m = TransformerDecodeModel.init(
             vocab=16, hidden=16, n_layers=1, n_heads=2, max_len=32,
             max_slots=1, page=4, max_pages_per_slot=8, seed=1)
+        # the tiny model decodes a whole request between two 5 ms polls
+        # of active_slots, so the slot-held window must be stretched to
+        # make the observation deterministic: ~10 ms per boundary holds
+        # the only slot for ~240 ms while `first` generates
+        real_step = m.step
+
+        def _slow_step(*a, **kw):
+            time.sleep(0.01)
+            return real_step(*a, **kw)
+
+        m.step = _slow_step
         eng = DecodeEngine(m, name="bp-test", pending_size=2).warmup()
         first = eng.submit([1], 24)
         deadline = time.perf_counter() + 10.0
