@@ -10,6 +10,13 @@ constants compared against ``self.path`` or passed to a
 ``path.startswith(...)`` check) and requires each ``/debug/...`` /
 ``/serving/...`` route to appear in docs/OBSERVABILITY.md or
 docs/SERVING.md (cross-link: docs/OBSERVABILITY.md "Route drift").
+
+ISSUE 18 extends the surface in two ways: the bare ``/debug`` index
+route counts as a route (operators' route discovery endpoint — it
+must be documented like everything it lists), and in a module that
+defines a ``*DEBUG_ROUTES`` index table, every dispatched ``/debug``
+route must appear in that table — a handler added without an index
+entry is invisible to the one endpoint built to make routes findable.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ import re
 from deeplearning4j_tpu.analysis.core import Rule, Severity, register
 from deeplearning4j_tpu.analysis.model import call_chain
 
-_ROUTE_RE = re.compile(r"^/(debug|serving)/")
+_ROUTE_RE = re.compile(r"^/(debug|serving)(/|$|\?)")
 
 
 def _mentions_path(node) -> bool:
@@ -62,6 +69,27 @@ def dispatched_routes(mod):
     return out
 
 
+def index_routes(mod):
+    """Route strings listed in the module's ``*DEBUG_ROUTES`` index
+    table(s) (the GET /debug payload), or None when the module defines
+    no index table."""
+    found = None
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets
+                   if isinstance(t, ast.Name)]
+        if not any(t.endswith("DEBUG_ROUTES") for t in targets):
+            continue
+        found = set() if found is None else found
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str) and \
+                    sub.value.startswith("/"):
+                found.add(sub.value)
+    return found
+
+
 @register
 class RouteDriftRule(Rule):
     name = "route-drift"
@@ -73,12 +101,31 @@ class RouteDriftRule(Rule):
     def check_module(self, mod, project):
         docs = (project.config.get("docs_text", "") + "\n"
                 + project.config.get("serving_docs_text", ""))
+        index = index_routes(mod)
         for route, node in dispatched_routes(mod):
+            base = route.rstrip("?").rstrip("/") or route
             # substring match: "/debug/hlo/" is documented as
             # "/debug/hlo/<key>", query-string variants as their base
-            if route in docs:
+            if route not in docs and base not in docs:
+                yield self.finding(
+                    mod, node,
+                    f"route {route!r} is dispatched here but "
+                    f"documented in neither docs/OBSERVABILITY.md nor "
+                    f"docs/SERVING.md")
+            # index coverage (ISSUE 18): a module with a /debug index
+            # table must list every /debug route it dispatches
+            if index is None or not base.startswith("/debug"):
                 continue
-            yield self.finding(
-                mod, node,
-                f"route {route!r} is dispatched here but documented in "
-                f"neither docs/OBSERVABILITY.md nor docs/SERVING.md")
+            # an entry covers a dispatch literal when they normalize
+            # to the same route: "<key>"-style placeholders and
+            # trailing slashes stripped ("/debug/hlo/<key>" covers the
+            # "/debug/hlo/" startswith dispatch) — deliberately exact
+            # beyond that, so the bare "/debug" index entry cannot
+            # blanket-cover every /debug/* route
+            if not any(entry.split("<")[0].rstrip("/") == base
+                       for entry in index):
+                yield self.finding(
+                    mod, node,
+                    f"route {route!r} is dispatched here but missing "
+                    f"from this module's DEBUG_ROUTES index table "
+                    f"(GET /debug would not list it)")

@@ -15,8 +15,11 @@ guards on.
 
 This rule flags a raw ``get_registry()``, ``get_tracer()``,
 ``get_memledger()`` (ISSUE 14: the HBM ownership ledger's raw handle),
-``get_sampler()``, or ``get_evaluator()`` (ISSUE 16: the time-series
-sampler's and SLO evaluator's raw handles) call in a function (outside
+``get_sampler()``, ``get_evaluator()`` (ISSUE 16: the time-series
+sampler's and SLO evaluator's raw handles), or ``get_profiler()``
+(ISSUE 18: the continuous wall-clock profiler's raw handle — the
+sampler thread must not exist while disabled) call in a function
+(outside
 ``telemetry/`` itself and the analyzer) that contains no
 ``enabled()``/sampler-gate check — the class of drift that silently
 re-introduces per-step observability overhead on the disabled path.
@@ -66,11 +69,18 @@ _TIMESERIES_GATES = {"enabled", "enable", "sample_now", "configure",
 # disabled) matching every other *_instruments
 _SLO_GATES = {"enabled", "enable", "evaluate", "declare", "remove",
               "slo_instruments"}
+# continuous-profiler gates (ISSUE 18): `sample_now()` gates
+# internally (None + zero registry calls + zero frame walks when
+# disabled), `start()` refuses to spawn the sampler thread while
+# disabled, `configure`/`register_thread` are setup-time
+_PROFILER_GATES = {"enabled", "enable", "configure", "start",
+                   "sample_now", "register_thread"}
 _EMITTER_GATES = {"get_registry": _REGISTRY_GATES,
                   "get_tracer": _TRACER_GATES,
                   "get_memledger": _MEMLEDGER_GATES,
                   "get_sampler": _TIMESERIES_GATES,
-                  "get_evaluator": _SLO_GATES}
+                  "get_evaluator": _SLO_GATES,
+                  "get_profiler": _PROFILER_GATES}
 _EXEMPT_PREFIXES = ("telemetry/", "analysis/")
 
 
@@ -79,10 +89,10 @@ class TelemetryGateRule(Rule):
     name = "telemetry-gate"
     severity = Severity.ERROR
     description = ("get_registry()/get_tracer()/get_memledger()/"
-                   "get_sampler()/get_evaluator() in a function with "
-                   "no enabled()/sampler gate — breaks the "
-                   "zero-observability-calls-when-disabled contract "
-                   "(PR 1, PR 10, PR 14, PR 16)")
+                   "get_sampler()/get_evaluator()/get_profiler() in a "
+                   "function with no enabled()/sampler gate — breaks "
+                   "the zero-observability-calls-when-disabled "
+                   "contract (PR 1, PR 10, PR 14, PR 16, PR 17)")
 
     def check_module(self, mod, project):
         rel = mod.rel

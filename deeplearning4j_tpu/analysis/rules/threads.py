@@ -1,14 +1,19 @@
-"""thread-hygiene: explicit daemon=, and stored threads get joined.
+"""thread-hygiene: explicit daemon=, stored threads get joined, and
+every package thread is named.
 
 Incidents: the PR-5/6 review-fix lists are a catalog of thread
 lifecycle bugs (the batcher re-arming its own shutdown sentinel after
 a timed-out join, the prefetcher producer leaking into the next fit,
-supervisor watchdog shutdown races). Two cheap invariants prevent the
-recurring half: (a) every ``threading.Thread`` states ``daemon=``
+supervisor watchdog shutdown races). Three cheap invariants prevent
+the recurring half: (a) every ``threading.Thread`` states ``daemon=``
 explicitly — an implicit non-daemon worker turns a crashed test into a
 hung process; (b) a thread stored on ``self`` is joined somewhere in
 its class (``close``/``stop``/``shutdown``/``retire``/``join`` path) —
-otherwise shutdown is fire-and-forget and errors are never surfaced.
+otherwise shutdown is fire-and-forget and errors are never surfaced;
+(c) every thread states ``name=`` (ISSUE 18: the
+``dl4j:<subsystem>:<role>`` convention) — an unnamed ``Thread-N``
+cannot be attributed by the continuous wall-clock profiler's
+thread-name parse or by a native thread dump.
 """
 
 from __future__ import annotations
@@ -23,9 +28,10 @@ from deeplearning4j_tpu.analysis.model import call_chain, keyword
 class ThreadHygieneRule(Rule):
     name = "thread-hygiene"
     severity = Severity.WARN
-    description = ("threading.Thread without explicit daemon=, or a "
+    description = ("threading.Thread without explicit daemon=, a "
                    "self-stored thread never joined anywhere in its "
-                   "class")
+                   "class, or an unnamed package thread (profiler/"
+                   "thread-dump attribution needs name=)")
 
     def check_module(self, mod, project):
         # class name -> set of attr names .join()ed anywhere in it;
@@ -33,6 +39,7 @@ class ThreadHygieneRule(Rule):
         # _thread (the prefetcher's drain-then-join idiom)
         joined: dict = {}
         daemon_attr_set: dict = {}
+        name_attr_set: dict = {}
         for info in mod.functions.values():
             cls = info.class_name
             if cls is None:
@@ -44,15 +51,19 @@ class ThreadHygieneRule(Rule):
                     joined.setdefault(cls, set()).add(name)
                     for attr in aliases.get(name, ()):
                         joined[cls].add(attr)
-        # `t.daemon = True` after construction also satisfies (a)
+        # `t.daemon = True` / `t.name = "..."` after construction also
+        # satisfy (a) / (c)
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     if isinstance(t, ast.Attribute) and \
-                            t.attr == "daemon":
+                            t.attr in ("daemon", "name"):
                         base = call_chain(t.value)
                         if base:
-                            daemon_attr_set.setdefault(
+                            dest = (daemon_attr_set
+                                    if t.attr == "daemon"
+                                    else name_attr_set)
+                            dest.setdefault(
                                 mod.scope_name(node), set()).add(
                                     base[-1])
 
@@ -63,9 +74,11 @@ class ThreadHygieneRule(Rule):
                 if len(chain) == 2 and chain[0] not in ("threading",):
                     continue  # SomeClass.Thread / other libs
                 yield from self._check_thread(mod, info, call, joined,
-                                              daemon_attr_set)
+                                              daemon_attr_set,
+                                              name_attr_set)
 
-    def _check_thread(self, mod, info, call, joined, daemon_attr_set):
+    def _check_thread(self, mod, info, call, joined, daemon_attr_set,
+                      name_attr_set):
         stmt = self._enclosing_stmt(mod, call)
         target_names = self._assign_names(stmt)
         if keyword(call, "daemon") is None:
@@ -76,6 +89,18 @@ class ThreadHygieneRule(Rule):
                     "threading.Thread without explicit daemon= — an "
                     "implicit non-daemon worker hangs process exit on "
                     "a crash; state the lifecycle intent",
+                    scope=info.qualname)
+        # (c) unnamed package thread (ISSUE 18): samples and native
+        # thread dumps see an anonymous Thread-N
+        if keyword(call, "name") is None:
+            named_later = name_attr_set.get(info.qualname, set())
+            if not (target_names & named_later):
+                yield self.finding(
+                    mod, call,
+                    "unnamed package thread — the continuous profiler "
+                    "and native thread dumps cannot attribute an "
+                    "anonymous Thread-N; pass "
+                    "name='dl4j:<subsystem>:<role>'",
                     scope=info.qualname)
         # (b) stored on self and never joined in the class
         self_attrs = self._self_attrs(stmt)

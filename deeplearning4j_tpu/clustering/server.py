@@ -87,7 +87,8 @@ class NearestNeighborsServer:
         self._httpd.nn = self
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="dl4j:serving:clustering")
         self._thread.start()
         return self
 

@@ -128,7 +128,7 @@ def _prewarm_epoch():
     truth (idempotent; the GIL makes the global publish safe)."""
     if _code_epoch is None:
         threading.Thread(target=code_epoch, daemon=True,
-                         name="dl4j-store-epoch").start()
+                         name="dl4j:train:store-epoch").start()
 
 
 def enabled() -> bool:

@@ -176,7 +176,8 @@ class AsyncDataSetIterator(DataSetIterator):
             finally:
                 self._queue.put(self._END)
 
-        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread = threading.Thread(target=produce, daemon=True,
+                                        name="dl4j:etl:result-drain")
         self._thread.start()
 
     def reset(self):

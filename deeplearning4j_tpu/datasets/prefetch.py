@@ -240,7 +240,7 @@ class DevicePrefetcher(DataSetIterator):
         self._thread = threading.Thread(
             target=self._produce,
             args=(self._gen, self._queue, tracing.current()),
-            daemon=True, name=f"dl4j-prefetch-{self._loop}")
+            daemon=True, name=f"dl4j:prefetch:{self._loop}")
         self._thread.start()
 
     def _stop(self):

@@ -124,7 +124,7 @@ class RolloutController:
         self._queue = queue.Queue(maxsize=64)
         self._thread = threading.Thread(
             target=self._mirror_loop, daemon=True,
-            name=f"dl4j-fleet-mirror-{name}")
+            name=f"dl4j:fleet:mirror-{name}")
 
     # -- state ---------------------------------------------------------------
     def terminal(self) -> bool:

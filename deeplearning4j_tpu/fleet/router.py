@@ -379,11 +379,11 @@ class FleetRouter:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
-            name="dl4j-fleet-router")
+            name="dl4j:fleet:serve")
         self._thread.start()
         self._poll_thread = threading.Thread(
             target=self._poll_loop, daemon=True,
-            name="dl4j-fleet-poll")
+            name="dl4j:fleet:poll")
         self._poll_thread.start()
         inst = self._inst()
         if inst is not None:
@@ -394,6 +394,12 @@ class FleetRouter:
         # close() deliberately leaves it running for other routers
         from deeplearning4j_tpu.telemetry import timeseries
         timeseries.start()
+        # the continuous profiler (ISSUE 18): the router samples its
+        # own serve/poll/mirror/handler threads so /debug/fleet/profile
+        # covers the hop's router side, not just the workers; no-op
+        # (zero sampler thread) while telemetry is disabled
+        from deeplearning4j_tpu.telemetry import profiler
+        profiler.start()
         flight.record("fleet_start", port=self.port,
                       workers=[w.name for w in self.workers])
         log.info("fleet router on http://127.0.0.1:%d (%d workers)",
@@ -835,6 +841,28 @@ class FleetRouter:
             return "\n"
         return "\n".join(json.dumps(r) for r in records) + "\n"
 
+    def fleet_profile(self, window=None) -> str:
+        """GET /debug/fleet/profile[?window=]: the fleet's collapsed
+        wall-clock stacks merged under an injected worker root frame —
+        the router's own sampler ring (poll/mirror/handler threads
+        included) plus every live worker's /debug/profile/cpu. One
+        request → one whole-fleet flamegraph."""
+        from deeplearning4j_tpu.telemetry import profiler
+
+        merged = {}
+        for stack, count in profiler.collapsed(window).items():
+            key = f"router;{stack}"
+            merged[key] = merged.get(key, 0) + count
+        path = "/debug/profile/cpu" + (
+            f"?window={float(window)}" if window is not None else "")
+        for w, body in self._fan_out(path):
+            worker_stacks = profiler.parse_collapsed(
+                body.decode(errors="replace"))
+            for stack, count in worker_stacks.items():
+                key = f"{w.name};{stack}"
+                merged[key] = merged.get(key, 0) + count
+        return profiler.render_collapsed(merged)
+
 
 def _outcome(status) -> str:
     if status == 200:
@@ -846,6 +874,30 @@ def _outcome(status) -> str:
     if 400 <= status < 500:
         return "client_error"
     return "upstream_error"
+
+
+# the router's /debug index (ISSUE 18 satellite) — its own debug
+# surface plus the fleet-federated routes; served at GET /debug via
+# ui.server.debug_index
+ROUTER_DEBUG_ROUTES = (
+    ("GET", "/debug", "this index: every debug route + description"),
+    ("GET", "/debug/fleet",
+     "router state: workers, health, breaker, rollout, capture"),
+    ("GET", "/debug/fleet/metrics",
+     "every live worker's /metrics + the router's, merged under a "
+     "worker label (?name=)"),
+    ("GET", "/debug/fleet/flight",
+     "fleet-merged flight events as JSONL, ordered by wall clock"),
+    ("GET", "/debug/fleet/profile",
+     "whole-fleet flamegraph: router + worker collapsed stacks, "
+     "worker injected as root frame (?window=)"),
+    ("GET", "/debug/fleet/traces",
+     "stitched cross-process span trees as JSONL (?trace_id=)"),
+    ("GET", "/debug/profile/cpu",
+     "the router's own collapsed wall-clock stacks (?window=)"),
+    ("GET", "/debug/timeseries",
+     "the router's windowed metric ring (?window=, ?name=)"),
+)
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -895,6 +947,39 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif self.path.startswith("/debug/fleet/flight"):
             self._respond(router.fleet_flight().encode(),
                           ctype="application/x-ndjson")
+        elif self.path.startswith("/debug/fleet/profile"):
+            # the whole-fleet flamegraph (ISSUE 18): router + every
+            # live worker's collapsed stacks, worker name injected as
+            # the root frame
+            from urllib.parse import parse_qs, urlsplit
+
+            query = parse_qs(urlsplit(self.path).query)
+            window = (query.get("window") or [None])[0]
+            try:
+                window = float(window) if window is not None else None
+            except ValueError:
+                self._respond(b'{"error": "window must be seconds"}',
+                              status=400)
+                return
+            self._respond(router.fleet_profile(window).encode(),
+                          ctype="text/plain; charset=utf-8")
+        elif self.path.startswith("/debug/profile/cpu"):
+            # the router's OWN sampler ring (same surface as the
+            # workers': ui/server.py)
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import profiler
+
+            query = parse_qs(urlsplit(self.path).query)
+            window = (query.get("window") or [None])[0]
+            try:
+                window = float(window) if window is not None else None
+            except ValueError:
+                self._respond(b'{"error": "window must be seconds"}',
+                              status=400)
+                return
+            self._respond(profiler.render(window).encode(),
+                          ctype="text/plain; charset=utf-8")
         elif self.path.startswith("/debug/fleet/traces"):
             from urllib.parse import parse_qs, urlsplit
 
@@ -922,6 +1007,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 window=window, name=name)).encode())
         elif self.path.startswith("/debug/fleet"):
             self._respond(json.dumps(router.describe()).encode())
+        elif self.path.rstrip("/") == "/debug" or \
+                self.path.startswith("/debug?"):
+            # the route index (ISSUE 18 satellite)
+            from deeplearning4j_tpu.ui.server import debug_index
+
+            self._respond(json.dumps(
+                debug_index(ROUTER_DEBUG_ROUTES)).encode())
         else:
             self._respond(b'{"error": "not found"}', status=404)
 

@@ -179,6 +179,17 @@ def serve(spec, port=0, port_file=None, max_latency=0.0,
     for s in spec.get("slos", ()):
         slo_mod.declare(slo_mod.Slo(**s))
     timeseries.start()
+    # continuous profiler (ISSUE 18): every worker samples its own
+    # threads so the router's /debug/fleet/profile merge has per-worker
+    # collapsed stacks to federate; spec-tunable, no-op (zero sampler
+    # thread) while telemetry is disabled
+    from deeplearning4j_tpu.telemetry import profiler
+
+    prof_spec = spec.get("profiler") or {}
+    profiler.configure(hz=prof_spec.get("hz"),
+                       bucket_seconds=prof_spec.get("bucket_seconds"),
+                       capacity=prof_spec.get("capacity"))
+    profiler.start()
     # a fresh UIServer instance per worker process — the getInstance()
     # singleton is a same-process convenience the fleet must not share
     server = UIServer()
@@ -189,6 +200,7 @@ def serve(spec, port=0, port_file=None, max_latency=0.0,
              server.port)
     if stop_event is not None:
         stop_event.wait()
+        profiler.stop()
         timeseries.stop()
         server.stop()
         session.close()

@@ -406,7 +406,7 @@ class AsyncCheckpointer:
         self._cond = threading.Condition()
         self._thread = threading.Thread(
             target=self._writer_loop, daemon=True,
-            name=f"dl4j-async-ckpt-{os.path.basename(self.dir)}")
+            name=f"dl4j:ckpt:writer-{os.path.basename(self.dir)}")
         self._thread.start()
         os.makedirs(self.dir, exist_ok=True)
         _ensure_provider()

@@ -138,7 +138,7 @@ class Watchdog:
     def start(self):
         self._last_progress = time.monotonic()
         self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="dl4j-watchdog")
+                                        name="dl4j:ckpt:watchdog")
         self._thread.start()
         return self
 

@@ -105,7 +105,8 @@ class A3CDiscreteDense(A2CDiscreteDense):
                 if done_all:
                     stop.set()
 
-        threads = [threading.Thread(target=actor, args=(i,), daemon=True)
+        threads = [threading.Thread(target=actor, args=(i,), daemon=True,
+                                    name=f"dl4j:train:a3c-actor-{i}")
                    for i in range(conf.nThreads)]
         for t in threads:
             t.start()

@@ -338,7 +338,7 @@ class DynamicBatcher:
         # would never be completed nor failed
         self._submit_lock = threading.Lock()
         self._worker = threading.Thread(
-            target=self._run, name=f"dl4j-batcher-{entry.name}",
+            target=self._run, name=f"dl4j:batcher:coalescer-{entry.name}",
             daemon=True)
         self._worker.start()
 

@@ -772,7 +772,7 @@ class DecodeEngine:
         self._submit_lock = threading.Lock()
         self._wake = threading.Event()
         self._thread = threading.Thread(
-            target=self._loop, name=f"dl4j-decode-{name}", daemon=True)
+            target=self._loop, name=f"dl4j:decode:engine-{name}", daemon=True)
         self._thread.start()
 
     # -- client side ---------------------------------------------------------

@@ -73,7 +73,7 @@ class Replica:
         self.consec_errors = 0   # circuit breaker input
         self._thread = threading.Thread(
             target=rset._worker_loop, args=(self,),
-            name=f"dl4j-replica-{rset.entry.name}-{index}", daemon=True)
+            name=f"dl4j:replica:{rset.entry.name}-{index}", daemon=True)
 
     def load(self) -> int:
         return len(self.queue) + self.inflight

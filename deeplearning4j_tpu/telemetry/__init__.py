@@ -52,6 +52,16 @@ over the ring: dl4j_slo_* metrics, slo_breach/slo_recovered flight
 events, a degraded-not-503 /healthz `slo` section, and a
 histogram-direct burn judge the rollout controller uses on canaries).
 
+ISSUE 18 adds stack-level attribution: `telemetry.profiler` — an
+always-on ~19Hz wall-clock sampler over sys._current_frames() folding
+every thread's stack into a bounded ring of collapsed stacks
+(flamegraph-ready at GET /debug/profile/cpu, subsystem-attributed via
+the dl4j:<subsystem>:<role> thread-name convention + module-path
+heuristics, scrape-only dl4j_profile_self_seconds_total), single-flight
+deep captures (POST /debug/profile/capture: high-rate sample +
+jax.profiler.trace artifacts, content-addressed, atomic_save-committed)
+and fleet-merged flamegraphs at GET /debug/fleet/profile.
+
 Disabling (`telemetry.disable()`) removes every per-step registry call
 from the training loops — they check the flag once per fit() — and
 compiles the health stats OUT of the jitted step (pre-health output
@@ -61,7 +71,7 @@ step."""
 
 from deeplearning4j_tpu.telemetry import (
     aggregate, compile_ledger, costmodel, flight, health, hlo_audit,
-    memledger, prometheus, slo, timeseries, tracing)
+    memledger, profiler, prometheus, slo, timeseries, tracing)
 from deeplearning4j_tpu.telemetry.memledger import (
     CapacityError, DeviceOomError)
 from deeplearning4j_tpu.telemetry.aggregate import aggregate_snapshot
@@ -89,6 +99,6 @@ __all__ = [
     "enable", "enabled", "etl_instruments", "fleet_instruments",
     "flight", "get_registry",
     "health", "hlo_audit", "log_buckets", "loop_instruments",
-    "memledger", "prometheus", "serving_instruments", "set_registry",
-    "slo", "span", "timeseries", "tracing",
+    "memledger", "profiler", "prometheus", "serving_instruments",
+    "set_registry", "slo", "span", "timeseries", "tracing",
 ]

@@ -1,0 +1,632 @@
+"""Continuous profiling (ISSUE 18 tentpole): stack-level attribution
+for the host side of the stack.
+
+Every observability layer before this PR says *how much* (metrics),
+*when* (traces, time series), or *what memory* (the HBM ledger) — none
+says **which code** is burning wall-clock time. Three pieces close
+that gap:
+
+1. **Always-on continuous sampler** (`ContinuousProfiler`): a low-rate
+   (~19 Hz default — a prime, so it cannot alias against second- or
+   10ms-periodic work) wall-clock sampler over ``sys._current_frames()``
+   that folds every thread's stack into a bounded ring of collapsed
+   stacks (``frame;frame;frame count`` — flamegraph.pl-ready), served
+   at ``GET /debug/profile/cpu?window=``. Each sample is attributed to
+   a *subsystem* (serving / batcher / replica / decode / etl / prefetch
+   / fleet / ckpt / train / ui / telemetry / other) via a thread-role
+   registry, the ``dl4j:<subsystem>:<role>`` thread-name convention,
+   and module-path heuristics — the collapsed stack's root frame IS the
+   subsystem, so flamegraphs group by it and
+   ``dl4j_profile_self_seconds_total{subsystem}`` (scrape-only: per-host
+   thread populations differ) integrates the same attribution.
+
+2. **On-demand deep capture** (``capture()``): a single-flight
+   (`CaptureBusyError` → HTTP 409) high-rate (~199 Hz) sample plus a
+   ``jax.profiler.trace()`` device capture, committed into a
+   content-addressed artifact directory via the shared ``atomic_save``
+   seam — listable and downloadable at ``/debug/profile/captures``.
+
+3. **Fleet federation** lives in fleet/router.py
+   (``GET /debug/fleet/profile``): the router fans this module's
+   collapsed output from every live worker and prefixes a worker
+   frame, one request → one whole-fleet flamegraph.
+
+Disabled contract (the PR-1 rule): under ``telemetry.disable()`` there
+is ZERO sampler thread (``start()`` refuses to spawn; a running loop
+exits on the next tick) and ``sample_now()`` returns before touching
+``sys._current_frames()`` or the registry — CountingStub-asserted in
+tests/test_profiler.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import sys
+import threading
+import time
+from collections import deque
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.utils.checkpoint import atomic_save
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+DEFAULT_HZ = 19.0            # prime: never aliases periodic work
+DEFAULT_BUCKET_SECONDS = 5.0
+DEFAULT_CAPACITY = 720       # 1 h of history at the default bucket
+DEFAULT_MAX_STACKS = 512     # unique stacks per bucket before folding
+DEFAULT_MAX_DEPTH = 48
+CAPTURE_HZ = 199.0           # deep-capture rate (also prime)
+CAPTURE_MAX_SECONDS = 60.0
+
+SELF_SECONDS_HELP = ("Estimated wall-clock seconds observed per "
+                     "subsystem by the continuous profiler "
+                     "(samples x sampling period; scrape-only)")
+
+#: the canonical subsystem taxonomy (docs/OBSERVABILITY.md table).
+SUBSYSTEMS = ("serving", "batcher", "replica", "decode", "etl",
+              "prefetch", "fleet", "ckpt", "train", "ui", "telemetry",
+              "other")
+
+# module-path heuristics: first fragment match on the in-package
+# relative path, scanned leaf-most frame first (the most specific
+# frame wins — a batcher thread parked in queue.get still shows
+# serving/batcher.py deeper in its stack)
+_MODULE_MAP = (
+    ("serving/batcher", "batcher"),
+    ("serving/replica", "replica"),
+    ("serving/decode", "decode"),
+    ("serving/prefill", "decode"),
+    ("serving/speculative", "decode"),
+    ("serving/prefix_cache", "decode"),
+    ("serving/kv_cache", "decode"),
+    ("serving/", "serving"),
+    ("clustering/", "serving"),
+    ("fleet/", "fleet"),
+    ("datasets/prefetch", "prefetch"),
+    ("datasets/", "etl"),
+    ("resilience/", "ckpt"),
+    ("telemetry/", "telemetry"),
+    ("analysis/", "telemetry"),
+    ("ui/", "ui"),
+    ("nn/", "train"),
+    ("graph/", "train"),
+    ("optimize/", "train"),
+    ("parallel/", "train"),
+    ("autodiff/", "train"),
+    ("rl/", "train"),
+    ("compilestore", "train"),
+)
+
+_PKG_MARKER = "deeplearning4j_tpu" + os.sep
+
+_state = {"profiler": None}
+_lock = threading.Lock()
+
+
+class CaptureBusyError(RuntimeError):
+    """A deep capture is already in flight (single-flight contract —
+    the HTTP layer maps this to 409)."""
+
+
+def thread_name(subsystem: str, role: str) -> str:
+    """The ``dl4j:<subsystem>:<role>`` naming convention every
+    long-lived package thread follows, so wall-clock samples and
+    native thread dumps attribute without a registry entry."""
+    return f"dl4j:{subsystem}:{role}"
+
+
+def _rel_path(filename: str) -> str | None:
+    """In-package relative path ('serving/batcher.py') or None."""
+    idx = filename.rfind(_PKG_MARKER)
+    if idx < 0:
+        return None
+    return filename[idx + len(_PKG_MARKER):].replace(os.sep, "/")
+
+
+def _frame_label(frame) -> str:
+    """'serving.batcher:_coalesce' for package frames,
+    'threading:wait' for everything else."""
+    code = frame.f_code
+    rel = _rel_path(code.co_filename)
+    if rel is not None:
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        mod = mod.replace("/", ".")
+    else:
+        base = os.path.basename(code.co_filename)
+        mod = base[:-3] if base.endswith(".py") else base
+    name = code.co_name
+    return f"{mod}:{name}".replace(";", "_")
+
+
+def collapse_frame(frame, max_depth=DEFAULT_MAX_DEPTH) -> str:
+    """Fold one thread's stack root-first into the collapsed format
+    ('root;...;leaf'). Depth beyond ``max_depth`` folds into a single
+    '(deep)' frame at the root so leaf frames survive."""
+    labels = []
+    while frame is not None:
+        labels.append(_frame_label(frame))
+        frame = frame.f_back
+    labels.reverse()                       # root first
+    if len(labels) > max_depth:
+        labels = ["(deep)"] + labels[-(max_depth - 1):]
+    return ";".join(labels)
+
+
+def _heuristic_subsystem(frame) -> str | None:
+    """Leaf-to-root scan for the first in-package frame's subsystem."""
+    while frame is not None:
+        rel = _rel_path(frame.f_code.co_filename)
+        if rel is not None:
+            for fragment, subsystem in _MODULE_MAP:
+                if rel.startswith(fragment):
+                    return subsystem
+        frame = frame.f_back
+    return None
+
+
+def parse_collapsed(text: str) -> dict:
+    """Round-trip reader for the collapsed format: 'stack count' lines
+    back into a {collapsed: count} dict (merging duplicates)."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack:
+            continue
+        out[stack] = out.get(stack, 0) + int(count)
+    return out
+
+
+def render_collapsed(stacks: dict) -> str:
+    """{collapsed: count} → 'stack count\\n' lines, largest first."""
+    lines = [f"{stack} {int(count)}" for stack, count in
+             sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0]))]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def attribution(stacks: dict) -> dict:
+    """Per-subsystem sample counts from a collapsed dict (the root
+    frame is the subsystem by construction)."""
+    out: dict = {}
+    for stack, count in stacks.items():
+        subsystem = stack.split(";", 1)[0]
+        out[subsystem] = out.get(subsystem, 0) + int(count)
+    return out
+
+
+class ContinuousProfiler:
+    """The always-on wall-clock sampler: one ``sys._current_frames()``
+    pass per tick, folded into a bounded ring of per-bucket collapsed
+    stacks. ``sample_now`` is the only hot entry point and returns
+    before touching anything while telemetry is disabled."""
+
+    def __init__(self, hz=DEFAULT_HZ, bucket_seconds=DEFAULT_BUCKET_SECONDS,
+                 capacity=DEFAULT_CAPACITY, max_stacks=DEFAULT_MAX_STACKS,
+                 max_depth=DEFAULT_MAX_DEPTH):
+        self.hz = float(hz)
+        self.bucket_seconds = float(bucket_seconds)
+        self.capacity = int(capacity)
+        self.max_stacks = int(max_stacks)
+        self.max_depth = int(max_depth)
+        self._buckets: deque = deque(maxlen=self.capacity)
+        self._roles: dict = {}       # thread ident -> subsystem
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._samples = 0
+        self._counter = None         # lazy scrape-only family
+
+    # -- attribution ---------------------------------------------------------
+    def register_thread(self, subsystem: str, role: str = "",
+                        ident: int | None = None):
+        """Explicitly attribute a thread (defaults to the caller) to a
+        subsystem — the registry outranks name parsing and heuristics.
+        Threads that cannot be renamed (pool workers) use this."""
+        if ident is None:
+            ident = threading.get_ident()
+        with self._lock:
+            self._roles[int(ident)] = str(subsystem)
+        return ident
+
+    def unregister_thread(self, ident: int | None = None):
+        if ident is None:
+            ident = threading.get_ident()
+        with self._lock:
+            self._roles.pop(int(ident), None)
+
+    def subsystem_of(self, ident, name, frame) -> str:
+        """Registry > dl4j:<subsystem>:<role> name > module-path
+        heuristics > 'other'."""
+        role = self._roles.get(ident)
+        if role is not None:
+            return role
+        if name and name.startswith("dl4j:"):
+            parts = name.split(":")
+            if len(parts) >= 2 and parts[1]:
+                return parts[1]
+        found = _heuristic_subsystem(frame)
+        return found if found is not None else "other"
+
+    # -- sampling ------------------------------------------------------------
+    def sample_now(self):
+        """Fold one sample of every live thread's stack into the ring;
+        returns the number of threads sampled, or None while telemetry
+        is disabled (zero registry calls, zero frame walks)."""
+        if not _registry.enabled():
+            return None
+        period = 1.0 / self.hz
+        names = {t.ident: t.name for t in threading.enumerate()
+                 if t.ident is not None}
+        me = threading.get_ident()
+        seconds_by_subsystem: dict = {}
+        folded = []
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue                    # never profile the profiler
+            subsystem = self.subsystem_of(ident, names.get(ident), frame)
+            stack = subsystem + ";" + collapse_frame(frame, self.max_depth)
+            folded.append((subsystem, stack))
+            seconds_by_subsystem[subsystem] = \
+                seconds_by_subsystem.get(subsystem, 0.0) + period
+        now = time.monotonic()
+        with self._lock:
+            bucket = self._buckets[-1] if self._buckets else None
+            if (bucket is None or
+                    now - bucket["mono"] >= self.bucket_seconds):
+                bucket = {"mono": now, "ts": time.time(), "stacks": {}}
+                self._buckets.append(bucket)
+            stacks = bucket["stacks"]
+            for subsystem, stack in folded:
+                if stack not in stacks and len(stacks) >= self.max_stacks:
+                    stack = subsystem + ";(truncated)"
+                stacks[stack] = stacks.get(stack, 0) + 1
+            self._samples += 1
+        counter = self._counter
+        if counter is None:
+            counter = _registry.get_registry().counter(
+                "dl4j_profile_self_seconds_total", SELF_SECONDS_HELP,
+                ("subsystem",))
+            counter.local = True    # per-host thread population
+            self._counter = counter
+        for subsystem, secs in seconds_by_subsystem.items():
+            counter.labels(subsystem=subsystem).inc(secs)
+        return len(folded)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start the sampler thread — a no-op while telemetry is
+        disabled (the disabled contract is *zero sampler thread*, not
+        a parked one). Idempotent."""
+        if not _registry.enabled():
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=thread_name("telemetry", "profiler"))
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(1.0 / self.hz):
+            if not _registry.enabled():
+                break               # disable() drains the sampler thread
+            try:
+                self.sample_now()
+            except Exception:
+                # a profiler crash must never take the process with it
+                log.exception("profile sample failed")
+        self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def clear(self):
+        with self._lock:
+            self._buckets.clear()
+            self._samples = 0
+
+    # -- reads ---------------------------------------------------------------
+    def collapsed(self, window=None) -> dict:
+        """Merged {collapsed: count} over the trailing ``window``
+        seconds (whole ring when None)."""
+        horizon = (time.monotonic() - float(window)
+                   if window is not None else None)
+        out: dict = {}
+        with self._lock:
+            for bucket in self._buckets:
+                if horizon is not None and bucket["mono"] < horizon:
+                    continue
+                for stack, count in bucket["stacks"].items():
+                    out[stack] = out.get(stack, 0) + count
+        return out
+
+    def render(self, window=None) -> str:
+        """The GET /debug/profile/cpu payload (collapsed text)."""
+        return render_collapsed(self.collapsed(window))
+
+    def describe(self, window=None) -> dict:
+        """Sampler config + per-subsystem attribution (JSON reads)."""
+        stacks = self.collapsed(window)
+        with self._lock:
+            buckets = len(self._buckets)
+            samples = self._samples
+        return {
+            "config": {"hz": self.hz,
+                       "bucket_seconds": self.bucket_seconds,
+                       "capacity": self.capacity,
+                       "max_stacks": self.max_stacks,
+                       "max_depth": self.max_depth},
+            "running": self.running,
+            "samples": samples,
+            "buckets": buckets,
+            "attribution": attribution(stacks),
+            "unique_stacks": len(stacks),
+        }
+
+    # -- deep capture --------------------------------------------------------
+    _capture_lock = threading.Lock()
+
+    def capture(self, seconds=2.0, hz=CAPTURE_HZ, out_dir=None,
+                device_trace=True):
+        """Single-flight deep capture: ``seconds`` of high-rate
+        wall-clock sampling plus (best-effort) a ``jax.profiler.trace``
+        device capture, committed as a content-addressed artifact
+        directory. Raises CaptureBusyError when one is in flight."""
+        if not self._capture_lock.acquire(blocking=False):
+            raise CaptureBusyError("a deep capture is already running")
+        try:
+            return self._capture_locked(
+                min(float(seconds), CAPTURE_MAX_SECONDS), float(hz),
+                out_dir or capture_dir(), device_trace)
+        finally:
+            self._capture_lock.release()
+
+    def _capture_locked(self, seconds, hz, root, device_trace):
+        os.makedirs(root, exist_ok=True)
+        stage = os.path.join(root, f".stage-{os.getpid()}-{id(self):x}")
+        shutil.rmtree(stage, ignore_errors=True)
+        os.makedirs(stage)
+        device_dir = os.path.join(stage, "device")
+        trace_error = None
+        stacks: dict = {}
+        samples = 0
+
+        def _sample_loop():
+            nonlocal samples
+            period = 1.0 / hz
+            me = threading.get_ident()
+            # the window starts when sampling starts — not at capture
+            # entry, where jax.profiler.trace startup (seconds on a
+            # cold backend) would eat it
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < seconds:
+                names = {t.ident: t.name for t in threading.enumerate()
+                         if t.ident is not None}
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue
+                    subsystem = self.subsystem_of(
+                        ident, names.get(ident), frame)
+                    stack = (subsystem + ";" +
+                             collapse_frame(frame, self.max_depth))
+                    stacks[stack] = stacks.get(stack, 0) + 1
+                samples += 1
+                time.sleep(period)
+
+        def _run_sampling():
+            # sample from a helper thread so the CALLER's stack is in
+            # the capture — for an HTTP-triggered capture that is the
+            # handler thread, and it guarantees a non-empty corpus
+            # even in an otherwise idle process
+            sampler = threading.Thread(
+                target=_sample_loop, daemon=True,
+                name=thread_name("telemetry", "capture"))
+            sampler.start()
+            sampler.join()
+
+        if device_trace:
+            try:
+                import jax
+                with jax.profiler.trace(device_dir):
+                    _run_sampling()
+            except Exception as exc:      # no device / profiler backend
+                trace_error = f"{type(exc).__name__}: {exc}"
+                if samples == 0:          # trace died before sampling ran
+                    _run_sampling()
+        else:
+            _run_sampling()
+
+        collapsed_text = render_collapsed(stacks)
+        atomic_save(os.path.join(stage, "cpu.collapsed"),
+                    lambda tmp: _write_text(tmp, collapsed_text))
+        cap_id = "cap_" + hashlib.sha256(
+            collapsed_text.encode()).hexdigest()[:12]
+        meta = {
+            "id": cap_id,
+            "created": round(time.time(), 3),
+            "seconds": seconds,
+            "hz": hz,
+            "samples": samples,
+            "unique_stacks": len(stacks),
+            "attribution": attribution(stacks),
+            "device_trace": device_trace and trace_error is None,
+            "device_trace_error": trace_error,
+        }
+        atomic_save(os.path.join(stage, "meta.json"),
+                    lambda tmp: _write_text(tmp, json.dumps(
+                        meta, indent=2, sort_keys=True)))
+        final = os.path.join(root, cap_id)
+        shutil.rmtree(final, ignore_errors=True)   # re-capture idempotent
+        os.replace(stage, final)
+        from deeplearning4j_tpu.telemetry import flight
+        flight.record("profile_capture", id=cap_id, seconds=seconds,
+                      samples=samples, device_trace=meta["device_trace"])
+        return meta
+
+
+def _write_text(path, text):
+    with open(path, "w") as fh:
+        fh.write(text)
+
+
+# -- capture artifact store ---------------------------------------------------
+
+def capture_dir() -> str:
+    """Where deep-capture artifacts land: ``DL4J_PROFILE_DIR`` or a
+    per-user tmp directory."""
+    env = os.environ.get("DL4J_PROFILE_DIR")
+    if env:
+        return env
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"dl4j-captures-{os.getuid()}")
+
+
+def list_captures(root=None) -> list:
+    """Committed captures, newest first (the staged ``.stage-*`` dirs
+    are invisible by construction)."""
+    root = root or capture_dir()
+    out = []
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for entry in entries:
+        if not entry.startswith("cap_"):
+            continue
+        meta_path = os.path.join(root, entry, "meta.json")
+        try:
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        meta["files"] = sorted(
+            f for f in os.listdir(os.path.join(root, entry))
+            if os.path.isfile(os.path.join(root, entry, f)))
+        out.append(meta)
+    out.sort(key=lambda m: m.get("created", 0), reverse=True)
+    return out
+
+
+def read_capture(cap_id, filename, root=None) -> bytes:
+    """One artifact file's bytes; raises FileNotFoundError on unknown
+    ids and refuses path escapes."""
+    root = root or capture_dir()
+    if (os.sep in cap_id or "/" in cap_id or ".." in cap_id or
+            os.sep in filename or "/" in filename or ".." in filename):
+        raise FileNotFoundError(f"{cap_id}/{filename}")
+    path = os.path.join(root, cap_id, filename)
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+# -- module-level convenience (the gated entry points) ------------------------
+
+def get_profiler() -> ContinuousProfiler:
+    """The process-wide profiler (created lazily). Raw handle — callers
+    outside telemetry/ go through the module helpers below, which gate
+    on the enabled flag (the dl4jlint telemetry-gate contract)."""
+    p = _state["profiler"]
+    if p is None:
+        with _lock:
+            p = _state["profiler"]
+            if p is None:
+                p = ContinuousProfiler()
+                _state["profiler"] = p
+    return p
+
+
+def set_profiler(profiler):
+    """Swap the process profiler (tests). Returns the previous one."""
+    prev = _state["profiler"]
+    _state["profiler"] = profiler
+    return prev
+
+
+def configure(hz=None, bucket_seconds=None, capacity=None,
+              max_stacks=None, max_depth=None):
+    """Reconfigure the process profiler in place (ring contents are
+    preserved on a rate change, dropped on a capacity change)."""
+    p = get_profiler()
+    if hz is not None:
+        p.hz = float(hz)
+    if bucket_seconds is not None:
+        p.bucket_seconds = float(bucket_seconds)
+    if capacity is not None:
+        p.capacity = int(capacity)
+        with p._lock:
+            p._buckets = deque(p._buckets, maxlen=p.capacity)
+    if max_stacks is not None:
+        p.max_stacks = int(max_stacks)
+    if max_depth is not None:
+        p.max_depth = int(max_depth)
+    return p
+
+
+def start():
+    """Start the continuous sampler (no-op while telemetry is
+    disabled — zero sampler thread is the disabled contract)."""
+    return get_profiler().start()
+
+
+def stop(timeout=5.0):
+    p = _state["profiler"]
+    if p is not None:
+        p.stop(timeout)
+
+
+def sample_now():
+    """One sample now (deterministic tests; returns None while
+    telemetry is disabled — the gate lives in the profiler itself)."""
+    return get_profiler().sample_now()
+
+
+def register_thread(subsystem, role="", ident=None):
+    """Attribute the calling (or given) thread to a subsystem."""
+    return get_profiler().register_thread(subsystem, role, ident)
+
+
+def render(window=None):
+    """The GET /debug/profile/cpu payload — read-only, served whether
+    or not telemetry is currently enabled (incident reads outlive a
+    disable())."""
+    return get_profiler().render(window)
+
+
+def collapsed(window=None):
+    """Merged {collapsed: count} over the window (read-only — the
+    fleet router's merge input)."""
+    return get_profiler().collapsed(window)
+
+
+def describe(window=None):
+    return get_profiler().describe(window)
+
+
+def capture(seconds=2.0, hz=CAPTURE_HZ, out_dir=None, device_trace=True):
+    """Run one single-flight deep capture (raises CaptureBusyError
+    when one is already in flight)."""
+    return get_profiler().capture(seconds, hz, out_dir, device_trace)
+
+
+def clear():
+    p = _state["profiler"]
+    if p is not None:
+        p.clear()

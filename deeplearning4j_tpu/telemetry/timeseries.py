@@ -116,7 +116,7 @@ class TimeSeriesSampler:
             return self
         self._stop.clear()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="dl4j-timeseries")
+            target=self._run, daemon=True, name="dl4j:telemetry:timeseries")
         self._thread.start()
         return self
 

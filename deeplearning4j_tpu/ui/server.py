@@ -80,6 +80,45 @@ setInterval(draw, 2000);
 """
 
 
+# the /debug index (ISSUE 18 satellite): every debug route this server
+# dispatches, with a one-line description — operators discover routes
+# here instead of reading docs mid-incident. The route-drift rule
+# checks the dispatched literals against this table AND the docs, so
+# the index cannot rot.
+DEBUG_ROUTES = (
+    ("GET", "/debug", "this index: every debug route + description"),
+    ("GET", "/debug/compiles",
+     "compile ledger: every compile with cause/seconds/fingerprint "
+     "(?site=) + executable-store stats"),
+    ("GET", "/debug/flightrecorder",
+     "the bounded flight-event ring as JSONL"),
+    ("GET", "/debug/hlo/<key>",
+     "per-executable HLO fusion/collective/remat/buffer audit"),
+    ("GET", "/debug/memory",
+     "HBM ownership ledger: claims, reconciliation, planner headroom"),
+    ("GET", "/debug/profile/cpu",
+     "continuous profiler: collapsed wall-clock stacks, "
+     "flamegraph-ready (?window= seconds)"),
+    ("POST", "/debug/profile/capture",
+     "single-flight deep capture (?seconds=): high-rate sample + "
+     "device trace, 409 while one runs"),
+    ("GET", "/debug/profile/captures",
+     "committed capture artifacts: list, or /<id>/<file> to download"),
+    ("GET", "/debug/timeseries",
+     "windowed metric ring: counter rates, gauge series, histogram "
+     "p50/p99 (?window=, ?name=)"),
+    ("GET", "/debug/traces", "sampled span trees as JSONL (?trace_id=)"),
+)
+
+
+def debug_index(routes=DEBUG_ROUTES) -> dict:
+    """The GET /debug payload (shared with the fleet router, which
+    passes its own table)."""
+    return {"routes": [
+        {"method": method, "route": route, "description": description}
+        for method, route, description in routes]}
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpuUI/1.0"
 
@@ -226,6 +265,60 @@ class _Handler(BaseHTTPRequestHandler):
                 timeseries.describe(window=window, name=name)).encode()
             self._respond(body)
             return
+        elif self.path.startswith("/debug/profile/cpu"):
+            # the continuous profiler (ISSUE 18): collapsed wall-clock
+            # stacks over ?window= trailing seconds (whole ring when
+            # absent), subsystem as the root frame — pipe straight
+            # into flamegraph.pl. Read-only and served whether or not
+            # telemetry is currently enabled (the ring outlives a
+            # disable())
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import profiler
+
+            query = parse_qs(urlsplit(self.path).query)
+            window = (query.get("window") or [None])[0]
+            try:
+                window = float(window) if window is not None else None
+            except ValueError:
+                self._respond(b'{"error": "window must be seconds"}',
+                              status=400)
+                return
+            self._respond(profiler.render(window).encode(),
+                          ctype="text/plain; charset=utf-8")
+            return
+        elif self.path.startswith("/debug/profile/captures"):
+            # deep-capture artifacts (ISSUE 18): bare path lists the
+            # committed captures (meta + files), /<id>/<file> downloads
+            # one artifact (cpu.collapsed, meta.json, device trace)
+            from urllib.parse import unquote, urlsplit
+
+            from deeplearning4j_tpu.telemetry import profiler
+
+            rest = unquote(urlsplit(self.path).path[
+                len("/debug/profile/captures"):]).strip("/")
+            if not rest:
+                self._respond(json.dumps(
+                    {"captures": profiler.list_captures()}).encode())
+                return
+            parts = rest.split("/", 1)
+            cap_id = parts[0]
+            filename = parts[1] if len(parts) > 1 else "meta.json"
+            try:
+                data = profiler.read_capture(cap_id, filename)
+            except (FileNotFoundError, IsADirectoryError):
+                self._respond(b'{"error": "unknown capture"}',
+                              status=404)
+                return
+            ctype = ("application/json" if filename.endswith(".json")
+                     else "application/octet-stream")
+            self._respond(data, ctype=ctype)
+            return
+        elif self.path.rstrip("/") == "/debug" or \
+                self.path.startswith("/debug?"):
+            # the route index (ISSUE 18 satellite)
+            self._respond(json.dumps(debug_index()).encode())
+            return
         elif self.path.startswith("/debug/traces"):
             # span-tree export (ISSUE 10): the whole ring as JSONL, or
             # one trace via /debug/traces?trace_id=<32hex>
@@ -262,6 +355,31 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         from deeplearning4j_tpu.serving import http as shttp
         from deeplearning4j_tpu.telemetry import tracing
+
+        if self.path.startswith("/debug/profile/capture"):
+            # on-demand deep capture (ISSUE 18): ?seconds= of high-rate
+            # sampling + a jax.profiler.trace device capture, committed
+            # content-addressed; single-flight — 409 while one runs
+            from urllib.parse import parse_qs, urlsplit
+
+            from deeplearning4j_tpu.telemetry import profiler
+
+            query = parse_qs(urlsplit(self.path).query)
+            try:
+                seconds = float((query.get("seconds") or ["2"])[0])
+            except ValueError:
+                self._respond(b'{"error": "seconds must be a number"}',
+                              status=400)
+                return
+            try:
+                meta = profiler.capture(seconds=seconds)
+            except profiler.CaptureBusyError:
+                self._respond(
+                    b'{"error": "a deep capture is already running"}',
+                    status=409)
+                return
+            self._respond(json.dumps(meta).encode())
+            return
 
         # fleet-admin control plane (ISSUE 15): rollouts push/retract
         # spec-built model versions through the versioned registry —
@@ -432,7 +550,8 @@ class UIServer:
                         port, self.port)
         log.info("UI server listening on http://127.0.0.1:%d", self.port)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="dl4j:ui:serve")
         self._thread.start()
         return self
 

@@ -745,7 +745,8 @@ class TestThreadHygieneRule:
         msgs = "\n".join(h.message for h in hits)
         assert "daemon" in msgs
         assert "never .join()ed" in msgs
-        assert len(hits) == 2
+        assert "unnamed package thread" in msgs
+        assert len(hits) == 3
 
     def test_near_miss_daemon_and_alias_join(self, tmp_path):
         clean = """
@@ -754,7 +755,8 @@ class TestThreadHygieneRule:
             class W:
                 def start(self):
                     self._t = threading.Thread(target=self._run,
-                                               daemon=True)
+                                               daemon=True,
+                                               name="dl4j:etl:w")
                     self._t.start()
 
                 def _run(self):
@@ -793,7 +795,8 @@ class TestFleetRouterFixtures:
         hits = rules_of(lint(tmp_path, src), "thread-hygiene")
         msgs = "\n".join(h.message for h in hits)
         assert "daemon" in msgs and "never .join()ed" in msgs
-        assert len(hits) == 2
+        assert "unnamed package thread" in msgs  # ISSUE 18 check (c)
+        assert len(hits) == 3
 
     def test_near_miss_router_poll_idiom_clean(self, tmp_path):
         # the shape fleet/router.py actually uses: explicit daemon=,
@@ -852,6 +855,120 @@ class TestFleetRouterFixtures:
                     ("worker",)).labels(worker=worker).set(1.0)
         """
         assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+
+class TestProfilerFixtures:
+    """ISSUE 18 satellites: TP/near-miss pairs for the unnamed-thread
+    half of thread-hygiene, the ``get_profiler`` telemetry-gate
+    emitter, and the /debug index-coverage half of route-drift."""
+
+    def test_flags_unnamed_thread_only(self, tmp_path):
+        # daemon= stated and joined — the ONLY defect is the missing
+        # name=, so an anonymous Thread-N shows up in the continuous
+        # profiler's flamegraph with no subsystem to attribute it to
+        src = """
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """
+        hits = rules_of(lint(tmp_path, src), "thread-hygiene")
+        assert len(hits) == 1
+        assert "unnamed package thread" in hits[0].message
+        assert "dl4j:<subsystem>:<role>" in hits[0].message
+
+    def test_near_miss_thread_named_after_construction(self, tmp_path):
+        # ``t.name = ...`` after construction satisfies (c) the same
+        # way ``t.daemon = True`` satisfies (a)
+        clean = """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.name = "dl4j:etl:pump"
+                t.start()
+                return t
+        """
+        assert rules_of(lint(tmp_path, clean), "thread-hygiene") == []
+
+    def test_flags_ungated_profiler_handle(self, tmp_path):
+        # a raw profiler handle outside telemetry/ with no gate — the
+        # shape that would put sampling work back on the disabled path
+        src = """
+            from deeplearning4j_tpu.telemetry import profiler
+
+            def snapshot_stacks(window):
+                return profiler.get_profiler().render(window=window)
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_enabled_gated_profiler_handle(self, tmp_path):
+        # enabled()/start()/sample_now()/... are the profiler's gate
+        # set: each no-ops (or returns None) while telemetry is
+        # disabled, so guarding on one keeps disabled at zero calls
+        clean = """
+            from deeplearning4j_tpu.telemetry import profiler
+
+            def snapshot_stacks(window):
+                if profiler.sample_now() is None:
+                    return ""
+                return profiler.get_profiler().render(window=window)
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+    def test_flags_route_missing_from_debug_index(self, tmp_path):
+        # ISSUE 18 route-drift extension: a module that serves the
+        # GET /debug index must list every /debug route it dispatches
+        # — both routes below are documented, but only one is indexed
+        src = """
+            DEBUG_ROUTES = (
+                ("GET", "/debug", "route index"),
+                ("GET", "/debug/memory", "HBM ledger"),
+            )
+
+            def do_GET(self):
+                if self.path == "/debug/memory":
+                    return self.send(200)
+                if self.path == "/debug/timeseries":
+                    return self.send(200)
+        """
+        docs = "/debug /debug/memory /debug/timeseries"
+        hits = rules_of(lint(tmp_path, src, docs_text=docs),
+                        "route-drift")
+        assert len(hits) == 1
+        assert "/debug/timeseries" in hits[0].message
+        assert "DEBUG_ROUTES index" in hits[0].message
+
+    def test_near_miss_indexed_and_bare_index_not_blanket(
+            self, tmp_path):
+        # the same module with the route indexed is clean — and the
+        # bare "/debug" entry alone must NOT blanket-cover it (the
+        # fixture above would pass otherwise)
+        clean = """
+            DEBUG_ROUTES = (
+                ("GET", "/debug", "route index"),
+                ("GET", "/debug/memory", "HBM ledger"),
+                ("GET", "/debug/timeseries", "windowed ring"),
+            )
+
+            def do_GET(self):
+                if self.path == "/debug/memory":
+                    return self.send(200)
+                if self.path == "/debug/timeseries":
+                    return self.send(200)
+        """
+        docs = "/debug /debug/memory /debug/timeseries"
+        assert rules_of(lint(tmp_path, clean, docs_text=docs),
+                        "route-drift") == []
 
 
 class TestMetricDriftRule:
