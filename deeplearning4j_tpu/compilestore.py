@@ -47,6 +47,12 @@ or ``DL4J_EXECUTABLE_STORE=/path`` — so default-configured processes
 (and the existing test matrix) see byte-identical behavior. Multi-host
 processes keep it off: serialized SPMD executables bake in a device
 assignment this module does not yet reconcile across process sets.
+Mesh-sharded servables (ISSUE 19) are scoped out for the same reason
+even single-process: ``ShardedServable.compile_shape`` never consults
+the store and ledgers ``store="reject"`` with an explicit cause
+(``serving.sharded.STORE_REJECT_SHARDED``) plus a
+``compile_store_reject`` flight event — visible refusal, not silent
+bypass.
 
 Telemetry: each resolve observes ``dl4j_compile_seconds{mode}`` and the
 ledger grows matching ``cache_hit`` / ``cache_reject`` causes;

@@ -12,7 +12,11 @@ prior PR built, plus two fleet seams:
   ``kind: "linear"`` is the deterministic host-side stand-in the fleet
   tests and the router-overhead bench lean on (y = scale·x + bias,
   optional injected service delay — the knob a deliberately-regressed
-  canary uses);
+  canary uses); ``kind: "sharded"`` (ISSUE 19) builds a GSPMD
+  mesh-partitioned servable over ``model_parallel`` of the worker's
+  devices (spec key ``host_devices`` forces N virtual CPU devices at
+  process start), serving models bigger than one device behind the
+  same router, health polling, and canary machinery;
 - **the admin surface**: :class:`WorkerAdmin` exposes the versioned
   re-register seam (``POST /serving/v1/models/<name>:register`` /
   ``:unregister`` on the worker's UIServer, serving/http.py) that
@@ -98,7 +102,38 @@ def _build_mlp(spec):
     return as_servable(net, (n_in,), None)
 
 
-SPEC_BUILDERS = {"linear": _build_linear, "mlp": _build_mlp}
+def _build_sharded(spec):
+    """A GSPMD mesh-sharded servable (ISSUE 19): a column-parallel MLP
+    partitioned over ``model_parallel`` devices. The worker process
+    builds its own mesh from its own visible devices — on CPU the spec
+    sets ``host_devices`` and main() forces the virtual device count
+    BEFORE the first backend touch. Bit-identical to the ``mlp``-style
+    single-device reference by construction (serving/sharded.py), so
+    canary agreement checks work across sharded and unsharded groups."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import MeshConfig
+    from deeplearning4j_tpu.serving.sharded import sharded_mlp_servable
+
+    tp = int(spec.get("model_parallel", 2))
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"sharded spec wants model_parallel={tp} but the worker "
+            f"sees only {len(devices)} device(s); set host_devices in "
+            f"the spec (CPU) or run on a bigger slice")
+    mesh = MeshConfig(data=1, model=tp, devices=devices[:tp]).build()
+    sizes = tuple(int(s) for s in spec.get(
+        "sizes", (int(spec.get("n_in", 8)), int(spec.get("width", 32)),
+                  int(spec.get("n_out", 4)))))
+    return sharded_mlp_servable(
+        mesh, sizes, example_shape=(sizes[0],),
+        seed=int(spec.get("seed", 7)),
+        batch_axis=spec.get("batch_axis"))
+
+
+SPEC_BUILDERS = {"linear": _build_linear, "mlp": _build_mlp,
+                 "sharded": _build_sharded}
 
 
 def build_servable(spec) -> Servable:
@@ -226,6 +261,17 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     with open(args.spec) as f:
         spec = json.load(f)
+    # sharded workers on CPU (ISSUE 19): the spec can force N virtual
+    # host devices for the mesh. XLA reads XLA_FLAGS lazily at first
+    # backend init, and nothing above this line touches a device — so
+    # setting it here (before serve() builds any servable) is in time.
+    # A pre-set force (test harness, operator) wins over the spec.
+    n_dev = spec.get("host_devices")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_dev and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{int(n_dev)}").strip()
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())

@@ -28,7 +28,12 @@ gives the INFERENCE side the same contract under concurrent traffic:
   PR-1 telemetry registry (`dl4j_serving_*`);
 - HTTP: `UIServer.serveModels(session)` exposes
   `POST /serving/v1/models/<name>:predict` and
-  `GET /serving/v1/models` beside `/metrics`.
+  `GET /serving/v1/models` beside `/metrics`;
+- `ShardedServable` / `ShardedTransformerDecodeModel` (ISSUE 19):
+  GSPMD mesh-partitioned serving — params sharded per NamedSharding
+  over a `parallel.mesh` device mesh, the paged KV pool sharded
+  page-wise, capacity PLACED per device instead of admitted in total,
+  all through the same ladder/registry/warmup/ledger path.
 
 See docs/SERVING.md.
 """
@@ -52,6 +57,9 @@ from deeplearning4j_tpu.serving.servable import (
     FnServable, GraphServable, NetworkServable, SameDiffServable, Servable,
     as_servable)
 from deeplearning4j_tpu.serving.session import InferenceSession
+from deeplearning4j_tpu.serving.sharded import (
+    ShardedServable, ShardedTransformerDecodeModel, column_parallel_mlp,
+    sharded_mlp_servable)
 from deeplearning4j_tpu.serving.speculative import (
     SpeculativeConfig, SpeculativeDecoder)
 
@@ -63,8 +71,11 @@ __all__ = [
     "NetworkServable", "PagedKVCache", "PrefixCache", "QueueFullError",
     "Replica",
     "ReplicaDeath", "ReplicaSet", "RnnDecodeModel", "SameDiffServable",
-    "Servable", "ServingShutdown", "ServingTimeout", "ShedError",
+    "Servable", "ServingShutdown", "ServingTimeout", "ShardedServable",
+    "ShardedTransformerDecodeModel", "ShedError",
     "SpeculativeConfig", "SpeculativeDecoder",
-    "TransformerDecodeModel", "as_servable", "execute_plan",
-    "pad_batch", "pad_rows", "pad_time", "run_batch", "unpad",
+    "TransformerDecodeModel", "as_servable", "column_parallel_mlp",
+    "execute_plan",
+    "pad_batch", "pad_rows", "pad_time", "run_batch",
+    "sharded_mlp_servable", "unpad",
 ]

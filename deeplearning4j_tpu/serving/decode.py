@@ -637,7 +637,25 @@ class DecodeEngine:
         from deeplearning4j_tpu.telemetry import memledger
 
         self._plan_device = memledger.device_label()
+        # a mesh-sharded model (serving/sharded.py) is planned as a
+        # PLACEMENT: each mesh device's pool share against that
+        # device's own headroom — the whole point of sharding the pool
+        # is that the total never has to fit one device
+        self._sharded_mesh = getattr(model, "mesh", None)
         if getattr(model, "uses_pages", False) and \
+                self._sharded_mesh is not None and \
+                memledger.capacity_known():
+            pool_est = _pool_bytes_estimate(model)
+            if pool_est is not None:
+                memledger.plan_capacity(
+                    f"decode:{name}:kv", pool_est,
+                    detail={"lane": "target", "pages": model.n_pages,
+                            "page": model.page,
+                            "slots": model.max_slots,
+                            "pool_shards": getattr(
+                                model, "pool_shards", None)},
+                    per_device=model.pool_device_bytes())
+        elif getattr(model, "uses_pages", False) and \
                 memledger.capacity_known(device=self._plan_device):
             pool_est = _pool_bytes_estimate(model)
             if pool_est is not None:
@@ -756,11 +774,26 @@ class DecodeEngine:
         self._ids = 0
         # HBM ledger claims registered LAST (ISSUE 14): any validation
         # raise above must not leak a claim for an engine that never
-        # existed — the pools are only pinned once this line is reached
-        self._mem_claim = memledger.claim(
-            "kv_cache", f"{name}:target", nbytes=self._pool_bytes,
-            slots=model.max_slots,
-            pages=getattr(model, "n_pages", None))
+        # existed — the pools are only pinned once this line is reached.
+        # A mesh-sharded pool (ISSUE 19) splits its claim per device —
+        # one `name:target@<device>` row per mesh device so
+        # /debug/memory attributes each device's actual share, instead
+        # of one total that no single device holds
+        self._shard_mem_claims = []
+        if self._sharded_mesh is not None and \
+                callable(getattr(model, "pool_device_bytes", None)):
+            for label, share in sorted(
+                    model.pool_device_bytes().items()):
+                self._shard_mem_claims.append(memledger.claim(
+                    "kv_cache", f"{name}:target@{label}",
+                    nbytes=share, device=label, sharded=True,
+                    slots=model.max_slots,
+                    pages=getattr(model, "n_pages", None)))
+        else:
+            self._mem_claim = memledger.claim(
+                "kv_cache", f"{name}:target", nbytes=self._pool_bytes,
+                slots=model.max_slots,
+                pages=getattr(model, "n_pages", None))
         if self._spec is not None:
             self._draft_mem_claim = memledger.claim(
                 "kv_cache", f"{name}:draft",
@@ -937,6 +970,14 @@ class DecodeEngine:
                                "pool_bytes": self._pool_bytes,
                                "used_bytes": per_page
                                * self._kv.used_pages}
+            if self._sharded_mesh is not None and \
+                    callable(getattr(self.model,
+                                     "pool_device_bytes", None)):
+                out["kv_pages"]["per_device_bytes"] = \
+                    self.model.pool_device_bytes()
+        if self._sharded_mesh is not None and \
+                callable(getattr(self.model, "sharded_health", None)):
+            out["sharded"] = self.model.sharded_health()
         if self._pcache is not None:
             out["prefix_cache"] = self._pcache.stats()
         if self._spec is not None:
@@ -950,6 +991,9 @@ class DecodeEngine:
         # the pools die with the engine: release their HBM claims
         if self._mem_claim is not None:
             self._mem_claim.release()
+        for c in self._shard_mem_claims:
+            c.release()
+        self._shard_mem_claims = []
         if self._draft_mem_claim is not None:
             self._draft_mem_claim.release()
         # fail everything still pending or active

@@ -43,16 +43,33 @@ class _Entry:
         # has one (a busy neighbor device must not veto this one), and
         # skipped outright — estimate included — when no device
         # capacity is knowable (unconfigured deployments pay nothing)
+        # Sharded registrations (ISSUE 19) upgrade the judgement from
+        # admitting to PLACING: the headroom check scopes to the SET
+        # of mesh devices — each device's share of the sharded
+        # footprint against that device's own headroom, with the shard
+        # layout recorded as the capacity_plan flight event and a
+        # per-device breakdown in CapacityError.detail on rejection.
         from deeplearning4j_tpu.telemetry import memledger
 
+        mesh = getattr(self.servable, "mesh", None)
         dev = (None if self.servable.device is None
                else memledger.device_label(self.servable.device))
-        if memledger.capacity_known(device=dev):
+        if memledger.capacity_known(device=None if mesh is not None
+                                    else dev):
             from deeplearning4j_tpu.serving.servable import (
                 estimate_warmup_bytes)
 
             est = estimate_warmup_bytes(self.servable, self.ladder)
-            if est is not None:
+            if est is not None and mesh is not None:
+                from deeplearning4j_tpu.serving.sharded import (
+                    mesh_shape)
+
+                memledger.plan_capacity(
+                    f"serving:{self.name}:v{self.version}",
+                    est["total"],
+                    detail={**est, "mesh": mesh_shape(mesh)},
+                    per_device=self.servable.placement_bytes(est))
+            elif est is not None:
                 memledger.plan_capacity(
                     f"serving:{self.name}:v{self.version}",
                     est["total"], detail=est, device=dev)
@@ -189,6 +206,13 @@ class ModelRegistry:
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._models)
+
+    def entries(self) -> list[_Entry]:
+        """Every live (name, version) entry — the health scrape's way
+        to reach the servable objects (sharded /healthz section)."""
+        with self._lock:
+            return [e for vs in self._models.values()
+                    for e in vs.values()]
 
     def warmup(self, name=None, version=None):
         """AOT-compile the ladder for one model (or EVERY version of

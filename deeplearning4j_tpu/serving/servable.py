@@ -177,6 +177,13 @@ class Servable:
     def _ledger_site(self) -> str:
         return self.cost_label or f"servable:{type(self).__name__}"
 
+    def _sharding_desc(self, shape=None) -> str:
+        """The sharding string the compile ledger's abstract signature
+        carries: the pinned device here; a mesh description for
+        ShardedServable (serving/sharded.py) — which is what makes a
+        forced mesh-shape change classify as ``sharding_change``."""
+        return "" if self.device is None else str(self.device)
+
     def _program_digest(self):
         """Digest of everything beyond the input signature that
         determines the traced program, when the adapter can state it
@@ -206,7 +213,7 @@ class Servable:
         compile_ledger.record_executable(
             self._ledger_site(), exe, ((shape, str(self.dtype)),),
             seconds=seconds, bucketed=True,
-            sharding="" if self.device is None else str(self.device),
+            sharding=self._sharding_desc(shape),
             store=info.get("store"), mode=info.get("mode", "compile"),
             fingerprint=info.get("hlo_fingerprint"))
         # HBM ledger (ISSUE 14): claim this bucket executable's
@@ -251,7 +258,7 @@ class Servable:
         return compile_ledger.Signature(
             args=((tuple(shape), str(self.dtype)),), donation=(),
             policy="",
-            sharding="" if self.device is None else str(self.device))
+            sharding=self._sharding_desc(shape))
 
     def compile_shape(self, shape: tuple):
         """Acquire the inference executable for one concrete input

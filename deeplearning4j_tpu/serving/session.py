@@ -389,6 +389,28 @@ class InferenceSession:
                 continue
         if decs:
             out["decoders"] = decs
+        # mesh-sharded servables and decoders (ISSUE 19): one entry per
+        # sharded unit — mesh shape, device set, per-device bytes —
+        # so an operator sees WHERE a big model landed, not just that
+        # it is up
+        sharded = {}
+        for e in self.registry.entries():
+            sh = getattr(e.servable, "sharded_health", None)
+            if callable(sh):
+                try:
+                    sharded[f"{e.name}:v{e.version}"] = sh()
+                except Exception:
+                    continue
+        for name, engine in decoders.items():
+            sh = getattr(getattr(engine, "model", None),
+                         "sharded_health", None)
+            if callable(sh):
+                try:
+                    sharded[f"decode:{name}"] = sh()
+                except Exception:
+                    continue
+        if sharded:
+            out["sharded"] = sharded
         return out
 
     def stats(self) -> dict:

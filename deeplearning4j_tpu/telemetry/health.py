@@ -566,7 +566,10 @@ def healthz(serving=None):
         except Exception:
             log.exception("serving health_details failed")
             details = {}
-        for section in ("replica_sets", "decoders"):
+        # "sharded" (ISSUE 19) is placement info, not liveness — its
+        # rows carry no "degraded" key, so the any() below is a no-op
+        # for it by construction
+        for section in ("replica_sets", "decoders", "sharded"):
             rows = details.get(section)
             if rows:
                 serving_info[section] = rows

@@ -782,12 +782,24 @@ def raise_if_oom(exc, site, **context):
 # admission-time capacity planning
 # ---------------------------------------------------------------------------
 
-def plan_capacity(site, need_bytes, detail=None, device=None):
+def plan_capacity(site, need_bytes, detail=None, device=None,
+                  per_device=None):
     """Admit or reject a prospective allocation of ``need_bytes`` at
     ``site`` against live headroom. Raises :class:`CapacityError`
     (structured — BEFORE any compile or pool allocation) when headroom
     is known and exceeded; returns the plan dict otherwise. Unknown
-    headroom admits: the planner refuses to guess."""
+    headroom admits: the planner refuses to guess.
+
+    ``per_device`` upgrades the judgement from admitting to PLACING
+    (ISSUE 19): a ``{device_label: share_bytes}`` shard layout is
+    checked device by device — each device's share against that
+    device's own headroom, never the sharded total against any single
+    device — and the layout rides the ``capacity_plan`` flight event
+    as the placement decision. Rejection carries the full per-device
+    breakdown in ``CapacityError.detail["per_device"]``."""
+    if per_device:
+        return _plan_placement(site, need_bytes, per_device,
+                               detail=detail)
     need = int(need_bytes)
     hr = headroom(device=device)
     plan = {"site": site, "need_bytes": need, "headroom_bytes": hr,
@@ -807,6 +819,49 @@ def plan_capacity(site, need_bytes, detail=None, device=None):
             f"(breakdown: {detail or {}})",
             site=site, need_bytes=need, headroom_bytes=hr,
             detail=detail)
+    return plan
+
+
+def _plan_placement(site, need_bytes, per_device, detail=None):
+    """The sharded half of :func:`plan_capacity`: judge a shard layout
+    (``{device_label: share_bytes}``) against the headroom of exactly
+    the mesh's device set. A device with unknown headroom admits its
+    share (same refuse-to-guess rule as the scalar path)."""
+    need = int(need_bytes)
+    layout = {}
+    worst = None          # tightest violated device, for the message
+    for label, share in sorted(per_device.items()):
+        share = int(share)
+        hr = headroom(device=label)
+        fits = hr is None or share <= hr
+        layout[label] = {"share_bytes": share, "headroom_bytes": hr,
+                         "fits": fits}
+        if not fits and (worst is None
+                         or hr - share < worst[2] - worst[1]):
+            worst = (label, share, hr)
+    plan = {"site": site, "need_bytes": need,
+            "sharded": True, "devices": len(layout),
+            "fits": worst is None, "per_device": layout,
+            **({"detail": dict(detail)} if detail else {})}
+    try:
+        from deeplearning4j_tpu.telemetry import flight
+
+        flight.record("capacity_plan",
+                      **{k: v for k, v in plan.items()
+                         if k != "detail"})
+    except Exception:
+        pass
+    if worst is not None:
+        label, share, hr = worst
+        full = dict(detail or {})
+        full["per_device"] = layout
+        raise CapacityError(
+            f"capacity planner rejected {site}: sharded placement over "
+            f"{len(layout)} devices does not fit — {label} needs "
+            f"{share} bytes against {hr} bytes of headroom "
+            f"(per-device breakdown in detail)",
+            site=site, need_bytes=need, headroom_bytes=hr,
+            detail=full)
     return plan
 
 

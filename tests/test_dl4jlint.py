@@ -971,6 +971,114 @@ class TestProfilerFixtures:
                         "route-drift") == []
 
 
+class TestShardedServingFixtures:
+    """ISSUE 19 satellite: TP/near-miss pairs for the sharded serving
+    path — a router/poll thread that reaches a collective
+    (collective-thread), the clean shard-dispatch idiom serving/
+    sharded.py actually uses (precompiled executable + device_put from
+    the poll thread issues NO collectives), and the per-device claim
+    emitters (telemetry-gate)."""
+
+    def test_flags_poll_thread_reaching_collective(self, tmp_path):
+        # the incident shape the fixture encodes: a health poller that
+        # "just checks shard liveness" by all-gathering shard stats —
+        # a collective issued from a router/poll thread deadlocks the
+        # mesh the moment the main thread is mid-dispatch
+        src = """
+            import threading
+            import jax
+
+            def gather_shard_stats(x):
+                return jax.lax.all_gather(x, "model")
+
+            class ShardedGroup:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._poll_loop, daemon=True,
+                        name="dl4j:fleet:shard-poll")
+                    self._t.start()
+
+                def _poll_loop(self):
+                    self._refresh_layout()
+
+                def _refresh_layout(self):
+                    return gather_shard_stats(1)
+
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """
+        hits = rules_of(lint(tmp_path, src), "collective-thread")
+        assert len(hits) == 1
+        assert "_poll_loop" in hits[0].message
+        assert "all_gather" in hits[0].message or \
+            "gather_shard_stats" in hits[0].message
+
+    def test_near_miss_shard_dispatch_idiom_clean(self, tmp_path):
+        # the shape ShardedServable actually has: warmup lowers the
+        # mesh-sharded executable on the MAIN thread; the poll thread
+        # only invokes the stored AOT executable and device_puts host
+        # args — GSPMD collectives live INSIDE the executable, so no
+        # Python-level collective is reachable from the thread
+        clean = """
+            import threading
+            import jax
+
+            def lower_sharded(fn, sharding, x):
+                return jax.jit(fn).lower(x).compile()   # main thread
+
+            class ShardedGroup:
+                def __init__(self, fn, sharding, x):
+                    self._exe = lower_sharded(fn, sharding, x)
+                    self._sharding = sharding
+                    self._t = threading.Thread(
+                        target=self._poll_loop, daemon=True,
+                        name="dl4j:fleet:shard-poll")
+                    self._t.start()
+
+                def _poll_loop(self):
+                    probe = jax.device_put([0.0], self._sharding)
+                    self._exe(probe)
+
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """
+        assert rules_of(lint(tmp_path, clean), "collective-thread") == []
+
+    def test_flags_ungated_per_device_claim_emission(self, tmp_path):
+        # a raw per-device shard-bytes gauge on the placement path with
+        # no gate — one emission per mesh device makes the
+        # zero-calls-when-disabled breach N× worse than usual
+        src = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_placed(layout):
+                for label, share in layout.items():
+                    telemetry.get_registry().gauge(
+                        "dl4j_serving_shard_bytes", "h",
+                        ("device",)).labels(
+                        device=label).set(share["share_bytes"])
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_enabled_gate_covers_per_device_loop(
+            self, tmp_path):
+        # the idiom memledger's placement path uses: one enabled()
+        # check before the per-device loop gates every emission in it
+        clean = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_placed(layout):
+                if not telemetry.enabled():
+                    return
+                for label, share in layout.items():
+                    telemetry.get_registry().gauge(
+                        "dl4j_serving_shard_bytes", "h",
+                        ("device",)).labels(
+                        device=label).set(share["share_bytes"])
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+
 class TestMetricDriftRule:
     def test_flags_prefix_and_undocumented(self, tmp_path):
         src = """
