@@ -2042,6 +2042,190 @@ def bench_fleet(duration=1.2, deadline_ms=100.0, rows_per_request=1):
     }
 
 
+def bench_fleet_loop(fill=40, n_baseline=80):
+    """ISSUE 20: the closed loop, measured. Four numbers against a
+    live 2-worker fleet on this box:
+
+    - capture -> fine-tune -> publish -> promote wall clock
+      (`loop_wall_s`): live traffic into the capture ring, a fresh
+      model distilled from it at the `train` admission priority, the
+      checkpoint pushed back through a `from_checkpoint` canary
+      rollout, and the canary promoted fleet-wide;
+    - serving p99 with vs without the concurrent fine-tune: the train
+      class is capped and shed first (arbitration, not isolation —
+      the fit still competes for the same cores, so the read is
+      "bounded", not "free");
+    - respawn MTTR: SIGKILL a spawned worker under traffic and time
+      kill -> the respawned process routable again;
+    - client-visible errors across the kill window (the router's
+      retry budget + the respawner should hold this at 0).
+    """
+    import os
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from deeplearning4j_tpu.fleet import (
+        Autopilot, FleetFineTuner, Respawner, TrafficCapture)
+    from deeplearning4j_tpu.fleet.router import (
+        FleetRouter, TransportFailure, _http, spawn_local_workers)
+    from deeplearning4j_tpu.serving.admission import AdmissionController
+    from deeplearning4j_tpu.telemetry import flight
+
+    def _tiny():
+        from deeplearning4j_tpu.nn import (
+            DenseLayer, InputType, MultiLayerNetwork,
+            NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        conf = (NeuralNetConfiguration.Builder().seed(7)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer.Builder().nOut(8)
+                       .activation("tanh").build())
+                .layer(OutputLayer.Builder().nOut(2)
+                       .activation("softmax").build())
+                .setInputType(InputType.feedForward(3)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    tmp = tempfile.mkdtemp(prefix="dl4j_fleet_loop_")
+    mlp = {"name": "m", "version": 1, "kind": "mlp", "n_in": 3,
+           "n_out": 2, "width": 8, "seed": 7, "example_shape": [3],
+           "ladder": [1, 4]}
+    spec = {"models": [mlp]}
+    handles = spawn_local_workers(
+        2, spec, base_dir=os.path.join(tmp, "fleet"), timeout=120.0,
+        extra_env={"JAX_PLATFORMS": "cpu"})
+    cap = TrafficCapture(sample_interval=1, max_records=512)
+    router = FleetRouter(handles, poll_interval=0.1, capture=cap,
+                         owns_workers=True,
+                         retry_budget=4).start(port=0)
+    url = f"http://127.0.0.1:{router.port}"
+    rng = np.random.default_rng(5)
+    stats = {"sent": 0, "ok": 0}
+
+    def predict_once(lats=None):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        t0 = time.perf_counter()
+        try:
+            status, _, _ = _http(
+                f"{url}/serving/v1/models/m:predict",
+                body=json.dumps({"instances": x.tolist()}).encode(),
+                timeout=30.0)
+        except TransportFailure:
+            stats["sent"] += 1
+            return 0
+        stats["sent"] += 1
+        stats["ok"] += status == 200
+        if status == 200 and lats is not None:
+            lats.append(time.perf_counter() - t0)
+        return status
+
+    def p99_ms(lats):
+        return round(float(np.quantile(lats, 0.99)) * 1e3, 2) \
+            if lats else 0.0
+
+    results = {}
+    try:
+        # capture + unloaded baseline
+        for _ in range(fill):
+            predict_once()
+        base_lat = []
+        for _ in range(n_baseline):
+            predict_once(base_lat)
+        t_loop = time.perf_counter()
+        path = cap.save(os.path.join(tmp, "traffic.jsonl"),
+                        append=True)
+
+        # fine-tune at train priority while serving continues
+        adm = AdmissionController(default_budget=8)
+        ft = FleetFineTuner(
+            router, "m", path, _tiny, os.path.join(tmp, "ckpt"),
+            admission=adm, epochs=2, batch_size=8,
+            spec_extra={"example_shape": [3]},
+            rollout_kw={"fraction": 1.0, "min_samples": 5,
+                        "p99_ratio": 100.0, "push_timeout": 120.0},
+            everyNIterations=1).start()
+        during = []
+        while ft._thread.is_alive():
+            predict_once(during)
+            time.sleep(0.002)
+        ft.join(60.0)
+        t_trained = time.perf_counter()
+
+        # drive the published canary to its verdict
+        ctl = router.rollout
+        deadline = time.monotonic() + 120.0
+        while ctl is not None and not ctl.terminal() and \
+                time.monotonic() < deadline:
+            predict_once()
+            time.sleep(0.002)
+        loop_wall = time.perf_counter() - t_loop
+        results.update({
+            "finetune_state": ft.state,
+            "published_version": ft.published_version,
+            "rollout_state": None if ctl is None else ctl.state,
+            "finetune_s": round(t_trained - t_loop, 2),
+            "serving_p99_ms_baseline": p99_ms(base_lat),
+            "serving_p99_ms_during_finetune": p99_ms(during),
+            "train_sheds": next(
+                (e.get("train_sheds") for e in
+                 flight.get_recorder().events("finetune_complete")),
+                None),
+        })
+
+        # respawn MTTR: kill a worker under traffic, time the revival
+        rs = Respawner(router, max_respawns=3, spawn_timeout=120.0)
+        ap = Autopilot(router, respawner=rs, interval=0.05).start()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            _, _, hb = _http(url + "/healthz", timeout=10.0)
+            if json.loads(hb)["fleet"]["routable"] == 2:
+                break
+            time.sleep(0.05)
+        victim = router.workers[0]
+        sent0, ok0 = stats["sent"], stats["ok"]
+        t_kill = time.perf_counter()
+        os.kill(victim.proc.pid, _signal.SIGKILL)
+        mttr = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            predict_once()
+            if victim.up and any(
+                    e["outcome"] == "ok" for e in
+                    flight.get_recorder().events("worker_respawn")):
+                mttr = time.perf_counter() - t_kill
+                break
+            time.sleep(0.01)
+        ap.close()
+        results.update({
+            "respawn_mttr_s": None if mttr is None else round(mttr, 2),
+            "kill_window_errors": (stats["sent"] - sent0)
+            - (stats["ok"] - ok0),
+        })
+    finally:
+        router.close()
+    return {
+        "metric": "fleet_loop_capture_to_promoted_s",
+        "value": round(loop_wall, 2),
+        "unit": "s",
+        "vs_baseline": None,
+        "host_bound": _host_bound(),
+        **results,
+        "note": ("2 spawned CPU workers behind the router; loop wall "
+                 "covers capture save -> distillation fine-tune at "
+                 "train priority (admission-capped, shed first) -> "
+                 "from_checkpoint canary -> fleet-wide promote, with "
+                 "client traffic flowing throughout; p99 pair is the "
+                 "concurrent-training tax on serving (same cores — "
+                 "bounded, not free); respawn MTTR is SIGKILL -> "
+                 "autopilot-respawned worker routable, with the "
+                 "client-visible error count over that window "
+                 "(`python bench.py --only fleet_loop`)"),
+    }
+
+
 def bench_sharded_serving(prompt_len=128, max_new=32, n_requests=6):
     """ISSUE 19: GSPMD-sharded serving vs the single-device reference.
     Two arms on one 4-way model-parallel mesh: (a) predict hop — the
@@ -2203,6 +2387,7 @@ ALL_BENCHES = [("bert", bench_bert), ("lenet", bench_lenet),
                ("memory", bench_memory),
                ("coldstart", bench_coldstart),
                ("fleet", bench_fleet),
+               ("fleet_loop", bench_fleet_loop),
                ("sharded_serving", bench_sharded_serving)]
 
 

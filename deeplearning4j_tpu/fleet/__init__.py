@@ -23,11 +23,20 @@ UIServer + InferenceSession:
 - :mod:`fleet.capture` — :class:`TrafficCapture`: head-sampled live
   requests into a replayable on-disk dataset
   (:class:`CaptureReplayIterator` is a DataSetIterator), the first hop
-  of the train-from-traffic loop.
+  of the train-from-traffic loop;
+- :mod:`fleet.autopilot` — the closed loop (ISSUE 20):
+  :class:`FleetFineTuner` trains from a saved capture at ``train``
+  admission priority and publishes the checkpoint back through a
+  canary rollout; :class:`Respawner` restarts dead spawned workers
+  with bounded backoff; :class:`Autoscaler` sizes the fleet from
+  sustained load, gated by the capacity planner; :class:`Autopilot`
+  is the control thread that ties them together.
 
 See docs/FLEET.md for the architecture and the rollout state machine.
 """
 
+from deeplearning4j_tpu.fleet.autopilot import (
+    Autopilot, Autoscaler, FleetFineTuner, Respawner)
 from deeplearning4j_tpu.fleet.capture import (
     CaptureReplayIterator, TrafficCapture)
 from deeplearning4j_tpu.fleet.rollout import (
@@ -50,8 +59,9 @@ def __getattr__(name):
         f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
-    "CaptureReplayIterator", "FleetRouter", "LinearServable",
-    "ROLLOUT_STATES", "RolloutController", "TrafficCapture",
-    "WorkerAdmin", "WorkerHandle", "build_servable",
+    "Autopilot", "Autoscaler", "CaptureReplayIterator",
+    "FleetFineTuner", "FleetRouter", "LinearServable",
+    "ROLLOUT_STATES", "Respawner", "RolloutController",
+    "TrafficCapture", "WorkerAdmin", "WorkerHandle", "build_servable",
     "spawn_local_workers",
 ]

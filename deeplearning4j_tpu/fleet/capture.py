@@ -17,6 +17,15 @@ standard DataSetIterator whose arrays are bit-identical run to run —
 JSON doubles round-trip exactly, and the float32 cast is the same cast
 the serving path applied. Determinism is asserted in
 tests/test_fleet.py (capture → save → replay → re-save byte-identical).
+
+ISSUE 20: ``save(path, append=True)`` commits only records newer than
+the last append (per-record ``seq`` high-water mark), so a
+long-running loop can persist the ring continuously; ``max_bytes``
+bounds the base file with a logrotate-style sweep
+(``capture.jsonl.1`` newest rotated segment, higher suffixes older,
+every move the same tmp/os.replace commit). :func:`load_capture` and
+the replay iterator read a rotated set oldest-first, so replay of a
+rotated capture stays bit-identical to an unrotated one.
 """
 
 from __future__ import annotations
@@ -46,6 +55,7 @@ class TrafficCapture:
         self._counter = itertools.count()
         self._seq = itertools.count(1)
         self._sampled = 0
+        self._saved_seq = 0   # append high-water mark (one target file)
         self._lock = threading.Lock()
 
     def maybe_record(self, model, body, response_body, inst=None):
@@ -82,29 +92,86 @@ class TrafficCapture:
                     "sampled": self._sampled,
                     "buffered": len(self._records)}
 
-    def save(self, path) -> str:
+    def save(self, path, append=False, max_bytes=None) -> str:
         """Commit the ring as canonical JSONL (sorted keys, fixed
         separators — the same ring always serializes to the same
         bytes) via tmp + os.replace, so a reader never sees a torn
-        file."""
+        file.
+
+        ``append=True`` commits only records newer than the previous
+        append (the per-record ``seq`` is the high-water mark — a
+        record evicted from the ring before a save is simply gone,
+        the ring bound is the backpressure). ``max_bytes`` (append
+        mode) rotates the base file logrotate-style before it would
+        grow past the bound: ``path.1`` is the newest rotated
+        segment, higher suffixes older. Every file movement is the
+        same tmp + os.replace commit."""
         recs = self.records()
+        if append:
+            with self._lock:
+                saved = self._saved_seq
+            recs = [r for r in recs if r["seq"] > saved]
+        lines = "".join(
+            json.dumps(rec, sort_keys=True, separators=(",", ":"))
+            + "\n" for rec in recs)
+        existing = ""
+        if append:
+            try:
+                with open(path) as f:
+                    existing = f.read()
+            except FileNotFoundError:
+                existing = ""
+            if max_bytes is not None and existing and \
+                    len(existing) + len(lines) > int(max_bytes):
+                _rotate(path)
+                existing = ""
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            for rec in recs:
-                f.write(json.dumps(rec, sort_keys=True,
-                                   separators=(",", ":")) + "\n")
+            f.write(existing)
+            f.write(lines)
         os.replace(tmp, path)
+        if append and recs:
+            with self._lock:
+                self._saved_seq = max(self._saved_seq,
+                                      recs[-1]["seq"])
         return path
 
 
+def _rotate(path):
+    """Sweep ``path`` into the numbered set: existing ``path.N`` move
+    to ``path.N+1`` (highest first, so nothing is clobbered), then the
+    base file becomes ``path.1``."""
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    for i in range(n - 1, 0, -1):
+        os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+    os.replace(path, f"{path}.1")
+
+
+def capture_files(path) -> list:
+    """The capture's file set in record order (oldest first): rotated
+    segments ``path.N`` highest-N first, then the base file."""
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    files = [f"{path}.{i}" for i in range(n - 1, 0, -1)]
+    if os.path.exists(path):
+        files.append(path)
+    return files
+
+
 def load_capture(path) -> list:
-    """The saved records, in capture order."""
+    """The saved records, in capture order — a rotated set reads
+    oldest segment first, so replay order (and therefore the replayed
+    arrays) is identical to an unrotated save."""
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+    for fp in (capture_files(path) or [path]):
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
     return out
 
 

@@ -32,6 +32,22 @@ is that decision, as a small state machine:
   it; the registry falls back to vN (newest remaining). Every
   transition and the final decision are flight events, and
   ``dl4j_fleet_rollout_state`` tracks the machine numerically.
+
+Decode-path rollouts (ISSUE 20): a decoder spec (``kind`` in
+``fleet.worker.DECODER_SPEC_BUILDERS``) is judged by the SAME machine
+with three decode-specific bindings. Decoders are unversioned in the
+session, so the canary engine registers under an ALIAS
+(``name@v<version>``) on the canary worker while client ``:decode``
+traffic keeps hitting the bare name — the alias is the pin, no body
+rewriting needed (``pins()`` is always False for decode). Mirrored
+requests replay the primary's prompt against the alias: agreement is
+EXACT token-stream equality (greedy decode is argmax — identical
+weights must produce identical streams), and latency is judged on
+TTFT (the worker's ``Server-Timing: ttft`` phase; wall time when the
+header is absent). Promotion registers the spec under the bare name on
+every worker (replacing each engine at its next registration boundary)
+then retracts the alias; rollback retracts only the alias — the
+incumbent engines were never touched.
 """
 
 from __future__ import annotations
@@ -58,6 +74,17 @@ _TERMINAL = ("complete", "rolled_back")
 # coarse ladder would alias a healthy canary into a "regression" one
 # bucket up
 _LATENCY_BUCKETS = log_buckets(1e-4, 10.0, per_decade=12)
+
+
+def _spec_kind(spec) -> str:
+    """``"decode"`` for decoder specs (judged on token streams + TTFT
+    under an alias), ``"predict"`` otherwise."""
+    from deeplearning4j_tpu.fleet.worker import DECODER_SPEC_BUILDERS
+
+    if isinstance(spec, dict) and \
+            spec.get("kind") in DECODER_SPEC_BUILDERS:
+        return "decode"
+    return "predict"
 
 
 def histogram_quantile(hist, q=0.99):
@@ -104,6 +131,11 @@ class RolloutController:
             raise ValueError("rollout SLO judgment needs a latency SLO")
         self.slo = slo
         self.slo_burn_ratio = float(slo_burn_ratio)
+        self.kind = _spec_kind(spec)
+        # decode canaries live under an alias name (decoders are
+        # unversioned in the session — the alias IS the version pin)
+        self.mirror_name = (f"{name}@v{int(version)}"
+                            if self.kind == "decode" else name)
         self.state = "idle"
         self.history = ["idle"]
         self.incumbent_version = None
@@ -142,8 +174,10 @@ class RolloutController:
 
     def pins(self, name) -> bool:
         """While canarying/promoting, regular traffic for the rollout
-        model stays pinned to the incumbent version."""
-        return (name == self.name
+        model stays pinned to the incumbent version. Decode rollouts
+        never pin — the canary lives under its alias, so bare-name
+        traffic cannot reach it."""
+        return (self.kind == "predict" and name == self.name
                 and self.state in ("canary", "promoting")
                 and self.incumbent_version is not None)
 
@@ -161,26 +195,27 @@ class RolloutController:
         return json.dumps(payload).encode()
 
     # -- admin pushes --------------------------------------------------------
-    def _push(self, w):
+    def _push(self, w, name=None):
         from deeplearning4j_tpu.fleet.router import _http
 
         body = json.dumps({"spec": self.spec, "version": self.version,
                            "warmup": True}).encode()
         status, _, rb = _http(
-            f"{w.url}/serving/v1/models/{self.name}:register",
-            body=body, timeout=self.push_timeout)
+            f"{w.url}/serving/v1/models/{name or self.mirror_name}"
+            f":register", body=body, timeout=self.push_timeout)
         if status != 200:
             raise RuntimeError(
                 f"push to {w.name} failed: HTTP {status} "
                 f"{rb[:200]!r}")
 
-    def _retract(self, w):
+    def _retract(self, w, name=None):
         from deeplearning4j_tpu.fleet.router import (
             TransportFailure, _http)
 
         body = json.dumps({"version": self.version}).encode()
         try:
-            _http(f"{w.url}/serving/v1/models/{self.name}:unregister",
+            _http(f"{w.url}/serving/v1/models/"
+                  f"{name or self.mirror_name}:unregister",
                   body=body, timeout=self.push_timeout)
         except TransportFailure:
             pass   # a dead worker has nothing serving to retract
@@ -196,11 +231,16 @@ class RolloutController:
                  if m.get("name") == self.name), default=0)
         if not live:
             raise RuntimeError("no live worker to canary on")
-        if incumbent < 1:
+        if self.kind == "decode":
+            # decoders are unversioned and absent from the polled model
+            # lists — the version is bookkeeping (it names the alias),
+            # the incumbent is whatever engine serves the bare name
+            incumbent = max(self.version - 1, 0)
+        elif incumbent < 1:
             raise RuntimeError(
                 f"model {self.name!r} is not served by any live "
                 f"worker — nothing to roll out against")
-        if self.version <= incumbent:
+        elif self.version <= incumbent:
             raise ValueError(
                 f"rollout version {self.version} must exceed the "
                 f"incumbent v{incumbent}")
@@ -232,14 +272,21 @@ class RolloutController:
             self._thread.join(timeout=self.push_timeout)
 
     # -- mirroring -----------------------------------------------------------
-    def on_primary(self, name, body, response_body, latency):
-        """Router hot-path hook after a successful :predict: enqueue
-        every Nth request for mirroring. Never blocks — a full mirror
-        queue drops the sample (bounded, like the trace ring)."""
-        if name != self.name or self.state != "canary":
+    def on_primary(self, name, body, response_body, latency,
+                   kind="predict", ttft=None):
+        """Router hot-path hook after a successful :predict/:decode:
+        enqueue every Nth request for mirroring. Never blocks — a full
+        mirror queue drops the sample (bounded, like the trace ring).
+        For decode traffic ``ttft`` (the worker's Server-Timing phase)
+        is the judged latency; the whole-hop ``latency`` is the
+        fallback when the worker reported none."""
+        if name != self.name or self.state != "canary" \
+                or kind != self.kind:
             return
         if next(self._counter) % self._interval:
             return
+        if kind == "decode" and ttft is not None:
+            latency = ttft
         try:
             self._queue.put_nowait((body, response_body, latency))
         except queue.Full:
@@ -272,24 +319,33 @@ class RolloutController:
 
     def _mirror_one(self, body, primary_body, primary_latency):
         from deeplearning4j_tpu.fleet.router import (
-            TransportFailure, _http)
+            TransportFailure, _http, _parse_server_timing)
 
         inst = self.router._inst()
         try:
             payload = json.loads(body)
-            payload["version"] = self.version
+            if self.kind == "predict":
+                payload["version"] = self.version
             mirror_body = json.dumps(payload).encode()
         except (ValueError, UnicodeDecodeError, TypeError):
             return   # unparsable primary: not a comparison sample
         t0 = time.perf_counter()
+        rh = {}
         try:
-            status, _, rb = _http(
+            status, rh, rb = _http(
                 f"{self.canary.url}/serving/v1/models/"
-                f"{self.name}:predict", body=mirror_body,
+                f"{self.mirror_name}:{self.kind}", body=mirror_body,
                 timeout=self.router.request_timeout)
         except TransportFailure as e:
             status, rb = None, str(e).encode()
         dt = time.perf_counter() - t0
+        if self.kind == "decode":
+            # judged on TTFT, same as the primary (whole-hop wall time
+            # would charge the canary for every generated token)
+            st = next((v for k, v in rh.items()
+                       if k.lower() == "server-timing"), None)
+            dt = _parse_server_timing(st).get("ttft", dt)
+        agree_key = "tokens" if self.kind == "decode" else "predictions"
         with self._lock:
             self._mirrors += 1
             if status != 200:
@@ -299,8 +355,8 @@ class RolloutController:
                 self._hist_incumbent.observe(primary_latency)
                 self._hist_canary.observe(dt)
                 try:
-                    agree = (json.loads(rb)["predictions"]
-                             == json.loads(primary_body)["predictions"])
+                    agree = (json.loads(rb)[agree_key]
+                             == json.loads(primary_body)[agree_key])
                 except (ValueError, KeyError, TypeError):
                     agree = False
                 if agree:
@@ -381,19 +437,42 @@ class RolloutController:
         # serving vN when it is readmitted — permanent version skew
         # with no reconciler. A fleet that cannot take the push
         # everywhere rolls back instead; retry when it is whole.
-        with self.router._lock:
-            rest = [w for w in self.router.workers
-                    if w.name not in self.pushed]
+        if self.kind == "decode":
+            # decode promotion replaces the BARE name everywhere — the
+            # canary included: its alias engine is what was judged, the
+            # bare-name engine is still the incumbent. Bare-name pushes
+            # that already landed are final (the build passed judgement
+            # before promotion began); a failed push only cleans up the
+            # canary alias via the ordinary rollback path.
+            with self.router._lock:
+                rest = list(self.router.workers)
+        else:
+            with self.router._lock:
+                rest = [w for w in self.router.workers
+                        if w.name not in self.pushed]
         for w in rest:
             flight.record("rollout_promote", model=self.name,
                           version=self.version, worker=w.name)
             try:
-                self._push(w)
+                if self.kind == "decode":
+                    self._push(w, name=self.name)
+                else:
+                    self._push(w)
             except (TransportFailure, RuntimeError) as e:
                 self._rollback(f"promotion push to {w.name} "
                                f"failed: {e}", stats)
                 return
-            self.pushed.append(w.name)
+            if self.kind != "decode":
+                self.pushed.append(w.name)
+        if self.kind == "decode":
+            # drop the canary's judging alias; best-effort — a stale
+            # alias is shadowed bookkeeping, not version skew
+            try:
+                self._retract(self.canary)
+            except (TransportFailure, RuntimeError):
+                log.warning("could not retract decode alias %s from %s",
+                            self.mirror_name, self.canary.name)
+            self.pushed = [w.name for w in rest]
         self.decision = {"verdict": "promote", **stats}
         self._set_state("complete")
         flight.record("rollout_complete", model=self.name,
@@ -416,7 +495,9 @@ class RolloutController:
                       restored=self.incumbent_version, **stats)
 
     def describe(self):
-        return {"model": self.name, "version": self.version,
+        return {"model": self.name, "kind": self.kind,
+                "mirror_name": self.mirror_name,
+                "version": self.version,
                 "incumbent": self.incumbent_version,
                 "state": self.state, "history": list(self.history),
                 "canary": None if self.canary is None

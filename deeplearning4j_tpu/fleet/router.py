@@ -103,9 +103,9 @@ class WorkerHandle:
 
     __slots__ = ("name", "url", "proc", "up", "ready", "consec_failures",
                  "inflight", "polled_load", "models", "last_health",
-                 "ejected_at", "last_error")
+                 "ejected_at", "last_error", "spawn")
 
-    def __init__(self, name, url, proc=None):
+    def __init__(self, name, url, proc=None, spawn=None):
         self.name = name
         self.url = url.rstrip("/")
         self.proc = proc
@@ -118,6 +118,10 @@ class WorkerHandle:
         self.last_health = None
         self.ejected_at = None
         self.last_error = None
+        # how to start this worker again: {"cmd": [...], "env": {...},
+        # "port_file": path} recorded by spawn_local_workers — the
+        # autopilot's Respawner relaunches a dead process from it
+        self.spawn = spawn
 
     def describe(self):
         return {
@@ -245,7 +249,8 @@ def _merge_expositions(sections) -> str:
 
 def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
                         extra_env=None, admission_budget=None,
-                        max_latency=0.0):
+                        max_latency=0.0, name_prefix="w",
+                        start_index=0):
     """Spawn N worker processes serving ``spec`` (a JSON-able dict,
     see fleet/worker.py), wait until every one reports a bound port
     AND a ready /healthz, and return their :class:`WorkerHandle` list.
@@ -266,8 +271,10 @@ def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
     env.update(extra_env or {})
     handles, procs = [], []
     try:
-        for i in range(int(n)):
-            port_file = os.path.join(base_dir, f"w{i}.port")
+        for j in range(int(n)):
+            i = int(start_index) + j
+            wname = f"{name_prefix}{i}"
+            port_file = os.path.join(base_dir, f"{wname}.port")
             try:
                 os.remove(port_file)
             except OSError:
@@ -279,15 +286,16 @@ def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
                    "--max-latency", str(max_latency)]
             if admission_budget is not None:
                 cmd += ["--admission-budget", str(admission_budget)]
-            procs.append((i, port_file, subprocess.Popen(cmd, env=env)))
+            procs.append((wname, port_file, cmd,
+                          subprocess.Popen(cmd, env=env)))
         deadline = time.monotonic() + timeout
-        for i, port_file, proc in procs:
+        for wname, port_file, cmd, proc in procs:
             port = None
             while time.monotonic() < deadline:
                 if proc.poll() is not None:
                     raise RuntimeError(
-                        f"fleet worker w{i} exited rc={proc.returncode} "
-                        f"before binding a port")
+                        f"fleet worker {wname} exited "
+                        f"rc={proc.returncode} before binding a port")
                 try:
                     with open(port_file) as f:
                         port = int(f.read().strip())
@@ -295,11 +303,12 @@ def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
                 except (OSError, ValueError):
                     time.sleep(0.05)
             if port is None:
-                raise TimeoutError(f"fleet worker w{i} never bound "
+                raise TimeoutError(f"fleet worker {wname} never bound "
                                    f"a port within {timeout}s")
-            handles.append(WorkerHandle(f"w{i}",
-                                        f"http://127.0.0.1:{port}",
-                                        proc=proc))
+            handles.append(WorkerHandle(
+                wname, f"http://127.0.0.1:{port}", proc=proc,
+                spawn={"cmd": list(cmd), "env": dict(env),
+                       "port_file": port_file}))
         for w in handles:   # block until warmed: no cold compile in
             while True:     # any first request's latency path
                 try:
@@ -316,7 +325,7 @@ def spawn_local_workers(n, spec, base_dir=None, timeout=60.0,
                         f"fleet worker {w.name} never became ready")
                 time.sleep(0.05)
     except Exception:
-        for _, _, proc in procs:
+        for _, _, _, proc in procs:
             proc.kill()
         raise
     return handles
@@ -351,6 +360,7 @@ class FleetRouter:
         self.owns_workers = owns_workers
         self.port = None
         self._rollout = None
+        self.autopilot = None     # attached by fleet/autopilot.py
         self._lock = threading.Lock()
         self._httpd = None
         self._thread = None
@@ -408,6 +418,15 @@ class FleetRouter:
 
     def close(self, timeout=5.0):
         self._stop.set()
+        # stop an attached autopilot FIRST: a respawner still ticking
+        # would resurrect the very worker processes terminated below
+        # (the orphan then outlives the fleet)
+        ap, self.autopilot = self.autopilot, None
+        if ap is not None:
+            try:
+                ap.close()
+            except Exception:
+                log.exception("autopilot close failed")
         if self._rollout is not None:
             self._rollout.close()
         if self._httpd is not None:
@@ -459,6 +478,44 @@ class FleetRouter:
     def _done(self, w):
         with self._lock:
             w.inflight -= 1
+
+    def add_worker(self, w):
+        """Adopt one more :class:`WorkerHandle` into routing (the
+        autoscaler's scale-up seam). The poll loop picks it up on its
+        next round; routing can use it immediately."""
+        with self._lock:
+            if any(x.name == w.name for x in self.workers):
+                raise ValueError(f"worker {w.name!r} already in fleet")
+            self.workers.append(w)
+        inst = self._inst()
+        if inst is not None:
+            inst.worker_up(w.name).set(1.0 if w.up else 0.0)
+        flight.record("worker_added", worker=w.name, url=w.url)
+        log.info("fleet worker %s added (%s)", w.name, w.url)
+
+    def retire_worker(self, name, timeout=5.0):
+        """Remove a worker from routing and (when the router owns its
+        process) terminate it — the autoscaler's scale-down seam.
+        In-flight requests already routed to it finish on their own
+        socket; new picks never see it."""
+        with self._lock:
+            w = next((x for x in self.workers if x.name == name), None)
+            if w is None:
+                raise ValueError(f"no such worker: {name!r}")
+            self.workers.remove(w)
+        inst = self._inst()
+        if inst is not None:
+            inst.worker_up(w.name).set(0.0)
+        if self.owns_workers and w.proc is not None \
+                and w.proc.poll() is None:
+            w.proc.terminate()
+            try:
+                w.proc.wait(timeout)
+            except Exception:
+                w.proc.kill()
+        flight.record("worker_retired", worker=w.name)
+        log.info("fleet worker %s retired", w.name)
+        return w
 
     def _note_transport_failure(self, w, err):
         """Breaker input: under the lock, bump the consecutive count
@@ -636,8 +693,10 @@ class FleetRouter:
             # four phases sum to dt by construction.
             st = next((v for k, v in rh.items()
                        if k.lower() == "server-timing"), None)
+            ttft = None
             if st:
                 phases = _parse_server_timing(st)
+                ttft = phases.get("ttft")
                 handler_s = min(phases.get("handler", dt), dt)
                 queue_s = phases.get("queue", 0.0)
                 execute_s = phases.get("execute", 0.0)
@@ -658,7 +717,14 @@ class FleetRouter:
                 if self.capture is not None:
                     self.capture.maybe_record(name, body, rb, inst=inst)
                 if rollout is not None:
-                    rollout.on_primary(name, body, rb, dt)
+                    rollout.on_primary(name, body, rb, dt,
+                                       kind="predict")
+            elif status == 200 and kind == "decode" \
+                    and rollout is not None:
+                # decode canaries are judged on TTFT (the worker's
+                # Server-Timing phase); whole-hop dt is the fallback
+                rollout.on_primary(name, body, rb, dt, kind="decode",
+                                   ttft=ttft)
             out = {k: v for k, v in rh.items()
                    if k.lower() in _PASS_HEADERS}
             return status, out, rb
@@ -749,6 +815,8 @@ class FleetRouter:
             out["rollout"] = self._rollout.describe()
         if self.capture is not None:
             out["capture"] = self.capture.describe()
+        if self.autopilot is not None:
+            out["autopilot"] = self.autopilot.describe()
         return out
 
     # -- federation (ISSUE 16): the fleet as ONE observability surface ------

@@ -132,8 +132,64 @@ def _build_sharded(spec):
         batch_axis=spec.get("batch_axis"))
 
 
+def _build_from_checkpoint(spec):
+    """ISSUE 20: serve a trained checkpoint — the fleet fine-tuner's
+    publish seam. ``checkpoint`` names a ModelSerializer zip (or a
+    sharded checkpoint directory); ``checkpoint_dir`` picks the newest
+    COMPLETE checkpoint in an ElasticTrainer directory instead. The
+    restored net warms through the PR-13 compile store exactly like an
+    ``mlp`` spec (NetworkServable's program digest is the net's own
+    conf), so a fine-tuned canary costs zero XLA compiles on a warm
+    host."""
+    from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+    from deeplearning4j_tpu.utils.serializer import ModelSerializer
+
+    path = spec.get("checkpoint")
+    if path is None:
+        cdir = spec.get("checkpoint_dir")
+        if not cdir:
+            raise ValueError('from_checkpoint spec needs "checkpoint" '
+                             '(a zip / sharded dir) or "checkpoint_dir"'
+                             ' (an ElasticTrainer directory)')
+        path = ElasticTrainer.latest_agreed(cdir)
+        if path is None:
+            raise ValueError(f"no complete checkpoint under {cdir!r}")
+    if not os.path.exists(path):
+        raise ValueError(f"checkpoint {path!r} does not exist")
+    # the updater is training state — a servable only needs params
+    net = ModelSerializer.restoreMultiLayerNetwork(
+        path, loadUpdater=False, sharded=os.path.isdir(path))
+    shape = tuple(int(s) for s in spec.get("example_shape", ())) or None
+    return as_servable(net, shape, None)
+
+
+def _build_decoder(spec):
+    """A seeded paged-KV transformer decode model (ISSUE 20 decode
+    mirroring): identical seeds build bit-identical params in every
+    worker process, and greedy decode is argmax — so a canary's token
+    streams match the incumbent's EXACTLY unless the weights differ,
+    which is the agreement oracle decode rollouts judge on."""
+    from deeplearning4j_tpu.serving.decode import TransformerDecodeModel
+
+    return TransformerDecodeModel.init(
+        vocab=int(spec.get("vocab", 32)),
+        hidden=int(spec.get("hidden", 16)),
+        n_layers=int(spec.get("n_layers", 1)),
+        n_heads=int(spec.get("n_heads", 2)),
+        max_len=int(spec.get("max_len", 64)),
+        seed=int(spec.get("seed", 0)),
+        max_slots=int(spec.get("max_slots", 4)),
+        page=int(spec.get("page", 8)),
+        max_pages_per_slot=int(spec.get("max_pages_per_slot", 8)))
+
+
 SPEC_BUILDERS = {"linear": _build_linear, "mlp": _build_mlp,
-                 "sharded": _build_sharded}
+                 "sharded": _build_sharded,
+                 "from_checkpoint": _build_from_checkpoint}
+
+# decoder specs register through session.register_decoder (continuous
+# batching engine) instead of the versioned predict registry
+DECODER_SPEC_BUILDERS = {"decoder": _build_decoder}
 
 
 def build_servable(spec) -> Servable:
@@ -146,8 +202,8 @@ def build_servable(spec) -> Servable:
     builder = SPEC_BUILDERS.get(kind)
     if builder is None:
         raise ValueError(
-            f"unknown model-spec kind {kind!r}; "
-            f"choose from {sorted(SPEC_BUILDERS)}")
+            f"unknown model-spec kind {kind!r}; choose from "
+            f"{sorted(SPEC_BUILDERS) + sorted(DECODER_SPEC_BUILDERS)}")
     return builder(spec)
 
 
@@ -162,6 +218,10 @@ class WorkerAdmin:
         self.session = session
 
     def register_spec(self, name, spec, version, warmup=True):
+        if isinstance(spec, dict) and \
+                spec.get("kind") in DECODER_SPEC_BUILDERS:
+            return self._register_decoder(name, spec, version,
+                                          warmup=warmup)
         sv = build_servable(spec)
         kw = {}
         ladder = spec.get("ladder")
@@ -170,7 +230,28 @@ class WorkerAdmin:
         return self.session.register(name, sv, version=int(version),
                                      warmup=bool(warmup), **kw)
 
+    def _register_decoder(self, name, spec, version, warmup=True):
+        """Decoder specs (ISSUE 20 decode mirroring) attach a
+        continuous-batching DecodeEngine under ``name`` — decoders are
+        UNVERSIONED in the session, so rollouts canary them under an
+        alias name (``m@v2``) and promotion re-registers the bare name
+        (see fleet/rollout.py). Returns a registry-entry-shaped result
+        for the :register route's response."""
+        import types
+
+        model = DECODER_SPEC_BUILDERS[spec["kind"]](spec)
+        kw = {}
+        if spec.get("chunk"):
+            kw["chunk"] = int(spec["chunk"])
+        engine = self.session.register_decoder(
+            name, model, warmup=bool(warmup), **kw)
+        return types.SimpleNamespace(version=int(version),
+                                     warmed=engine._warmed)
+
     def unregister(self, name, version=None):
+        if name in self.session._decoders:
+            self.session.unregister_decoder(name)
+            return
         self.session.registry.unregister(
             name, None if version is None else int(version))
 

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 
 import jax
@@ -264,8 +265,15 @@ class ElasticTrainer:
         def on_signal(signum, frame):
             preempted["flag"] = True
 
-        old_term = signal.signal(signal.SIGTERM, on_signal)
-        old_int = signal.signal(signal.SIGINT, on_signal)
+        # signal handlers can only be installed from the main thread;
+        # a fit running on a worker thread (the fleet fine-tuner) skips
+        # signal-based preemption and keeps the periodic checkpoints
+        on_main = (threading.current_thread()
+                   is threading.main_thread())
+        old_term = old_int = None
+        if on_main:
+            old_term = signal.signal(signal.SIGTERM, on_signal)
+            old_int = signal.signal(signal.SIGINT, on_signal)
         last_cp = [self.net._iteration]
 
         class _Every:
@@ -332,6 +340,7 @@ class ElasticTrainer:
             tspan.__exit__(*_sys.exc_info())
             mark_idle()
             self.net.setListeners(*prior)
-            signal.signal(signal.SIGTERM, old_term)
-            signal.signal(signal.SIGINT, old_int)
+            if on_main:
+                signal.signal(signal.SIGTERM, old_term)
+                signal.signal(signal.SIGINT, old_int)
         return self.net

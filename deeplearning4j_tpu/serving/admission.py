@@ -6,15 +6,18 @@ whose QueueFullError rejected WHOEVER arrived next — under 2x overload
 every caller's p99 degrades together, which is the opposite of what a
 production tier wants. Admission control makes overload a POLICY:
 
-- three priority classes — ``high`` (interactive / SLO-bound),
-  ``normal`` (default), ``batch`` (best-effort backfill);
+- four priority classes — ``high`` (interactive / SLO-bound),
+  ``normal`` (default), ``batch`` (best-effort backfill), and
+  ``train`` (ISSUE 20: the fleet fine-tuner's steps, arbitrated
+  against serving on the same host);
 - a per-model concurrency budget (requests admitted and not yet
   terminal). Lower classes are capped at a FRACTION of the budget, so
-  headroom is reserved: ``batch`` traffic is shed first, ``normal``
-  next, and ``high`` keeps the full budget. Under 2x overload the
-  best-effort tail absorbs the shedding and high-priority p99 stays
-  near its unloaded value (the bench.py `serving_load` row measures
-  exactly this);
+  headroom is reserved: ``train`` traffic is shed first, ``batch``
+  next, then ``normal``, and ``high`` keeps the full budget. Under 2x
+  overload the best-effort tail absorbs the shedding and high-priority
+  p99 stays near its unloaded value (the bench.py `serving_load` row
+  measures exactly this; `fleet_loop` measures the train-vs-serve
+  arbitration);
 - shed responses carry a computed ``retry_after`` (seconds), derived
   from the recent per-request service rate and the current standing
   load — an honest backoff hint for HTTP 429 Retry-After instead of a
@@ -30,12 +33,15 @@ from __future__ import annotations
 import threading
 import time
 
-PRIORITIES = ("high", "normal", "batch")
+PRIORITIES = ("high", "normal", "batch", "train")
 
 # fraction of a model's budget each class may fill (cumulative with
-# everything above it): batch is shed beyond 50% standing load, normal
-# beyond 85%, high rides to the full budget
-DEFAULT_CLASS_FRACTION = {"high": 1.0, "normal": 0.85, "batch": 0.5}
+# everything above it): train is shed beyond 25% standing load, batch
+# beyond 50%, normal beyond 85%, high rides to the full budget — so a
+# co-hosted fine-tune loop can never occupy more than a quarter of a
+# serving model's budget, and is the first thing shed under load
+DEFAULT_CLASS_FRACTION = {"high": 1.0, "normal": 0.85, "batch": 0.5,
+                          "train": 0.25}
 
 
 class ShedError(RuntimeError):

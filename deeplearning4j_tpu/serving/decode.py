@@ -212,6 +212,25 @@ def _pool_bytes_estimate(model):
 # decode models
 # ---------------------------------------------------------------------------
 
+def _maybe_store(jitted, site, model, lane):
+    """Route a decode-model jit through the PR-13 persistent executable
+    store (ISSUE 20 satellite: the remaining cold-start gap). Warm
+    engine construction then deserializes every step/prefill/verify
+    executable instead of compiling — ledger-asserted zero XLA
+    compiles. The sharded lane stays scoped out (ISSUE 19: serialized
+    SPMD executables bake in a device assignment), and the wrapper is
+    the identity when the store is off."""
+    from deeplearning4j_tpu import compilestore
+
+    if getattr(model, "mesh", None) is not None:
+        return jitted
+    if not compilestore.enabled():
+        return jitted
+    return compilestore.StoredJit(
+        jitted, site, program=f"{model._store_program()}:{lane}",
+        donation=())
+
+
 class RnnDecodeModel:
     """Token-step decode over a MultiLayerNetwork with recurrent
     layers (the graves_lstm char-RNN workload as a token stream).
@@ -239,12 +258,23 @@ class RnnDecodeModel:
         self.n_in = net.layers[0].nIn
         self.vocab = int(vocab) if vocab is not None else int(self.n_in)
         self._dtype = net.conf.dtype
-        self._jit_step = jax.jit(self._fn)
-        self._jit_masked = jax.jit(self.masked_fn)
+        self._jit_step = _maybe_store(jax.jit(self._fn),
+                                      "decode:step", self, "step")
+        self._jit_masked = _maybe_store(jax.jit(self.masked_fn),
+                                        "decode:step", self, "masked")
         # slot is a TRACED scalar: one reset executable serves every
         # slot (a static slot arg would compile per slot index and
         # break the zero-steady-state-recompiles contract)
-        self._jit_reset = jax.jit(self._reset_fn)
+        self._jit_reset = _maybe_store(jax.jit(self._reset_fn),
+                                       "decode:reset", self, "reset")
+
+    def _store_program(self):
+        """Store program digest (the servable.py idiom): the math is a
+        pure function of the net's conf plus the engine geometry, so
+        identical digests guarantee identical lowered programs and a
+        warm process never pays a fingerprint re-trace."""
+        return (f"decode:RnnDecodeModel:{self.net.conf.to_json()}"
+                f":slots={self.max_slots}:vocab={self.vocab}")
 
     # state: the full per-layer states list with recurrent carries
     # seeded to [max_slots] rows
@@ -350,8 +380,21 @@ class TransformerDecodeModel:
                         else max_slots * max_pages_per_slot)
         self.eps = eps
         self.n_layers = len(params["layers"])
-        self._jit_step = jax.jit(self._fn)
-        self._jit_masked = jax.jit(self.masked_fn)
+        self._jit_step = _maybe_store(jax.jit(self._fn),
+                                      "decode:step", self, "step")
+        self._jit_masked = _maybe_store(jax.jit(self.masked_fn),
+                                        "decode:step", self, "masked")
+
+    def _store_program(self):
+        """Store program digest: the transformer step is determined by
+        the structural geometry below (param SHAPES ride in the
+        per-signature key, and the values never shape the program)."""
+        return (f"decode:TransformerDecodeModel:L={self.n_layers}"
+                f":heads={self.n_heads}:hidden={self.hidden}"
+                f":vocab={self.vocab}:max_len={self.max_len}"
+                f":slots={self.max_slots}:page={self.page}"
+                f":pages={self.n_pages}"
+                f":pps={self.max_pages_per_slot}:eps={self.eps}")
 
     @classmethod
     def from_bert(cls, params, cfg, **kw):

@@ -133,13 +133,17 @@ def handle_unregister(admin, name: str, body: bytes) -> bytes:
     return json.dumps({"model": name, "unregistered": version}).encode()
 
 
-def handle_decode(session, name: str, body: bytes) -> bytes:
+def handle_decode(session, name: str, body: bytes,
+                  timing=None) -> bytes:
     """POST /serving/v1/models/<name>:decode — continuous-batching
     autoregressive decode:
 
         {"prompt": [1, 2, 3], "max_new_tokens": 16,
          "eos_id": 0, "priority": "high"}       # eos/priority optional
         -> {"model": ..., "tokens": [...]}
+
+    ``timing`` receives the request's ``ttft`` seconds for the
+    Server-Timing header (decode rollouts judge latency on TTFT).
     """
     if session is None:
         raise HttpError(404, "no serving session attached "
@@ -153,9 +157,9 @@ def handle_decode(session, name: str, body: bytes) -> bytes:
         raise HttpError(400, 'body must be {"prompt": [...], '
                              '"max_new_tokens": N}')
     priority = payload.get("priority", "normal")
-    if priority not in ("high", "normal", "batch"):
-        raise HttpError(400, f"priority must be high|normal|batch, "
-                             f"got {priority!r}")
+    if priority not in ("high", "normal", "batch", "train"):
+        raise HttpError(400, f"priority must be high|normal|batch|"
+                             f"train, got {priority!r}")
     timeout = payload.get("timeout_ms")
     try:
         timeout = float(timeout) / 1e3 if timeout is not None else None
@@ -167,7 +171,8 @@ def handle_decode(session, name: str, body: bytes) -> bytes:
         raise HttpError(400, f"bad decode parameters: {e}") from None
     try:
         tokens = session.decode(name, prompt, max_new, eos_id=eos_id,
-                                timeout=timeout, priority=priority)
+                                timeout=timeout, priority=priority,
+                                timing=timing)
     except ModelNotFound as e:
         raise HttpError(404, f"unknown decoder: {e}") from None
     except ShedError as e:
@@ -225,9 +230,9 @@ def handle_predict(session, name: str, body: bytes,
                              f"got {timeout!r}") from None
     version = payload.get("version")
     priority = payload.get("priority", "normal")
-    if priority not in ("high", "normal", "batch"):
-        raise HttpError(400, f"priority must be high|normal|batch, "
-                             f"got {priority!r}")
+    if priority not in ("high", "normal", "batch", "train"):
+        raise HttpError(400, f"priority must be high|normal|batch|"
+                             f"train, got {priority!r}")
     try:
         entry = session.registry.get(name, version)
         x = np.asarray(payload["instances"],

@@ -57,6 +57,16 @@ class ChunkedPrefill:
         self.model = model
         self.chunk = int(chunk)
         self._jit = jax.jit(self._fn)
+        # ISSUE 20: the prefill/verify executable rides the persistent
+        # store like the token step (unsharded lane only; identity
+        # when the store is off or the model has no program digest)
+        # (the block width rides the per-signature key, not the
+        # program: prefill and verify instances share store entries)
+        if getattr(model, "_store_program", None) is not None:
+            from deeplearning4j_tpu.serving.decode import _maybe_store
+
+            self._jit = _maybe_store(self._jit, "decode:prefill",
+                                     model, "prefill")
 
     def _fn(self, params, state, blocks, pos0, counts, table):
         import jax.numpy as jnp

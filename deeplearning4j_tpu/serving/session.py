@@ -28,7 +28,8 @@ from deeplearning4j_tpu.telemetry import tracing
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher, ServingTimeout, execute_plan)
 from deeplearning4j_tpu.serving.buckets import BucketLadder, unpad
-from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.registry import (ModelNotFound,
+                                                 ModelRegistry)
 
 
 class InferenceSession:
@@ -121,6 +122,20 @@ class InferenceSession:
                       slots=engine.model.max_slots)
         return engine
 
+    def unregister_decoder(self, name):
+        """Detach (and close) the decode engine under `name` — the
+        retract half of a decode-path rollout (ISSUE 20). Raises
+        ModelNotFound when no such decoder exists, matching the
+        versioned registry's unregister contract."""
+        with self._lock:
+            engine = self._decoders.pop(name, None)
+        if engine is None:
+            raise ModelNotFound(name)
+        engine.close()
+        from deeplearning4j_tpu.telemetry import flight
+
+        flight.record("decoder_unregistered", model=name)
+
     def decoder(self, name):
         engine = self._decoders.get(name)
         if engine is None:
@@ -128,9 +143,12 @@ class InferenceSession:
         return engine
 
     def decode(self, name, prompt, max_new_tokens, eos_id=None,
-               timeout=None, priority="normal"):
+               timeout=None, priority="normal", timing=None):
         """Generated token ids for one prompt through the continuous
-        batcher (admission-controlled like predict)."""
+        batcher (admission-controlled like predict). ``timing`` (a
+        dict) receives the request's ``ttft`` seconds so the transport
+        can answer with a Server-Timing header — decode-path rollouts
+        judge canaries on time-to-first-token (ISSUE 20)."""
         if self._closed:
             raise RuntimeError("session closed")
         engine = self.decoder(name)
@@ -146,7 +164,7 @@ class InferenceSession:
                     lambda f, t=ticket: t.release())
                 ticket = None
             try:
-                return req.result(timeout=timeout)
+                tokens = req.result(timeout=timeout)
             except _FutureTimeout:
                 # same normalization as predict(): pre-3.11 the futures
                 # TimeoutError is NOT the builtin, and the HTTP 504
@@ -154,6 +172,12 @@ class InferenceSession:
                 raise ServingTimeout(
                     f"decode on {name!r} timed out after {timeout}s"
                 ) from None
+            if timing is not None:
+                # read AFTER the result: t_first is written by the
+                # engine thread at first-token emission
+                timing["ttft"] = ((req.t_first or time.perf_counter())
+                                  - req.t_submit)
+            return tokens
         finally:
             if ticket is not None:
                 ticket.release()

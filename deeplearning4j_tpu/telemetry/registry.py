@@ -653,6 +653,12 @@ FLEET_MIRROR_HELP = ("Canary mirror comparisons by verdict "
                      "(agree|disagree|error)")
 FLEET_CAPTURED_HELP = ("Live requests head-sampled into the traffic-"
                        "capture ring (train-from-traffic)")
+FLEET_RESPAWNS_HELP = ("Autopilot respawn attempts of dead spawned "
+                       "workers, by worker and outcome "
+                       "(ok|failed|gave_up)")
+FLEET_TARGET_WORKERS_HELP = ("Autoscaler's current desired fleet size "
+                             "(spawn/retire decisions converge the "
+                             "actual size toward it)")
 
 
 class FleetInstruments:
@@ -661,7 +667,8 @@ class FleetInstruments:
     disabled router performs zero registry calls per request)."""
 
     __slots__ = ("_requests", "_worker_up", "retries", "rollout_state",
-                 "_hop", "_hop_phase", "_mirror", "captured")
+                 "_hop", "_hop_phase", "_mirror", "captured",
+                 "_respawns", "target_workers")
 
     def __init__(self, registry):
         self._requests = registry.counter(
@@ -681,6 +688,11 @@ class FleetInstruments:
             "dl4j_fleet_mirror_total", FLEET_MIRROR_HELP, ("verdict",))
         self.captured = registry.counter(
             "dl4j_fleet_captured_total", FLEET_CAPTURED_HELP)
+        self._respawns = registry.counter(
+            "dl4j_fleet_respawns_total", FLEET_RESPAWNS_HELP,
+            ("worker", "outcome"))
+        self.target_workers = registry.gauge(
+            "dl4j_fleet_target_workers", FLEET_TARGET_WORKERS_HELP)
 
     def request(self, worker, outcome):
         self._requests.labels(worker=worker, outcome=outcome).inc()
@@ -696,6 +708,9 @@ class FleetInstruments:
 
     def mirror(self, verdict):
         self._mirror.labels(verdict=verdict).inc()
+
+    def respawn(self, worker, outcome):
+        self._respawns.labels(worker=worker, outcome=outcome).inc()
 
 
 def fleet_instruments():

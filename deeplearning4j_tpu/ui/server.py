@@ -431,12 +431,8 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    if kind == "predict":
-                        out = handler(self.server.ui._serving, name,
-                                      body, timing=timing)
-                    else:
-                        out = handler(self.server.ui._serving, name,
-                                      body)
+                    out = handler(self.server.ui._serving, name,
+                                  body, timing=timing)
                 except shttp.HttpError as e:
                     # attribute BEFORE the span exits: finish() hands
                     # the attrs to the export ring

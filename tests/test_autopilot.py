@@ -1,0 +1,760 @@
+"""ISSUE 20 tests: the closed-loop fleet.
+
+Fast tier: train-class admission arbitration (train tickets shed
+first, the throttled iterator holds exactly one standing slot and
+releases on job end), a real capture → fine-tune → publish → promote
+run over in-process workers, respawner backoff/give-up semantics with
+injectable process/clock seams, autoscaler hysteresis (flapping load
+produces zero actions) + capacity-planner gating, capture
+append/rotation with bit-identical replay, and decode-path rollouts
+(token-stream agreement promotes, a diverging canary rolls back with
+the incumbent engine untouched).
+
+Slow tier (armed lock witness): the end-to-end closed-loop scenario —
+a spawned fleet serving while a fine-tune job trains at ``train``
+priority from its own captured traffic, publishes the checkpoint
+through a canary, survives a SIGKILLed worker via the respawner, and
+scales up under sustained overload / back down when idle, every
+transition a flight event visible at /debug/fleet.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.fleet import (
+    Autopilot, Autoscaler, CaptureReplayIterator, FleetFineTuner,
+    FleetRouter, Respawner, TrafficCapture, WorkerHandle)
+from deeplearning4j_tpu.fleet.autopilot import ThrottledIterator
+from deeplearning4j_tpu.fleet.capture import capture_files, load_capture
+from deeplearning4j_tpu.fleet.router import _http
+from deeplearning4j_tpu.serving import AdmissionController
+from deeplearning4j_tpu.serving.admission import ShedError
+from deeplearning4j_tpu.telemetry import flight
+from deeplearning4j_tpu.telemetry.memledger import CapacityError
+
+from tests.test_fleet import (
+    CPU_ENV, _drive_until, _Fleet, _InprocWorker, _spec)
+
+
+def _tiny_net(seed=3, n_in=3, n_out=2):
+    from deeplearning4j_tpu.nn import (
+        DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer.Builder(nOut=8, activation="tanh")
+                   .build())
+            .layer(OutputLayer.Builder().nOut(n_out)
+                   .activation("softmax").build())
+            .setInputType(InputType.feedForward(n_in))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _fill_capture(cap, n=8, n_in=3, n_out=2, model="m", seed=0):
+    """Synthesize n captured requests with distillation labels."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        x = rng.normal(size=(2, n_in)).astype(np.float32)
+        p = rng.dirichlet(np.ones(n_out), size=2).astype(np.float32)
+        cap.maybe_record(
+            model, json.dumps({"instances": x.tolist()}).encode(),
+            json.dumps({"predictions": p.tolist(),
+                        "version": 1}).encode())
+    return cap
+
+
+def _events(kind):
+    return flight.get_recorder().events(kind)
+
+
+def _batches(n=3, n_in=3, n_out=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(size=(2, n_in)).astype(np.float32),
+             np.eye(n_out, dtype=np.float32)[[0, 1]])
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# train-class admission arbitration
+# ---------------------------------------------------------------------------
+
+class TestTrainClassArbitration:
+    def test_train_tickets_shed_first(self):
+        adm = AdmissionController(default_budget=8)
+        # budget 8: train cap = 2, normal cap = 6, high cap = 8
+        t1 = adm.admit("m", "train")
+        t2 = adm.admit("m", "train")
+        with pytest.raises(ShedError) as ei:
+            adm.admit("m", "train")
+        assert ei.value.priority == "train"
+        assert ei.value.retry_after > 0
+        # the SAME standing load does not shed normal or high traffic:
+        # train is the first class over its share
+        n = adm.admit("m", "normal")
+        h = adm.admit("m", "high")
+        for t in (t1, t2, n, h):
+            t.release()
+        assert adm.describe()["m"]["standing"] == 0
+
+    def test_throttled_iterator_one_slot_released_at_end(self):
+        adm = AdmissionController(default_budget=8)
+        it = ThrottledIterator(ListDataSetIterator(_batches(3), 2),
+                               adm, "m")
+        seen = []
+        standing = []
+        it.reset()
+        while it.hasNext():
+            seen.append(it.next())
+            standing.append(adm.describe()["m"]["standing"])
+        # each handed-out batch held exactly ONE train slot
+        assert len(seen) == 3
+        assert standing == [1, 1, 1]
+        # epoch end released the last ticket
+        assert adm.describe()["m"]["standing"] == 0
+        # a second epoch works (__iter__ resets)
+        assert len(list(it)) == 3
+        it.close()
+        assert adm.describe()["m"]["standing"] == 0
+
+    def test_throttled_iterator_waits_out_shed(self):
+        adm = AdmissionController(default_budget=8)
+        blockers = [adm.admit("m", "train"), adm.admit("m", "train")]
+        slept = []
+
+        def sleep(dt):
+            # serving load drains while the trainer is parked
+            if blockers:
+                blockers.pop().release()
+            slept.append(dt)
+
+        it = ThrottledIterator(ListDataSetIterator(_batches(1), 2),
+                               adm, "m", sleep=sleep)
+        out = list(it)
+        assert len(out) == 1
+        assert it.sheds >= 1 and slept
+        it.close()
+        for b in blockers:
+            b.release()
+        # the iterator's own ticket is gone; only the un-drained
+        # blocker was left standing
+        assert adm.describe()["m"]["standing"] == 0
+
+    def test_throttled_iterator_gives_up_past_max_wait(self):
+        adm = AdmissionController(default_budget=8)
+        blockers = [adm.admit("m", "train"), adm.admit("m", "train")]
+        it = ThrottledIterator(ListDataSetIterator(_batches(1), 2),
+                               adm, "m", sleep=lambda dt: None,
+                               max_wait=0.0)
+        with pytest.raises(ShedError):
+            list(it)
+        for b in blockers:
+            b.release()
+
+
+# ---------------------------------------------------------------------------
+# fine-tune → publish → promote (in-process fleet)
+# ---------------------------------------------------------------------------
+
+class TestFineTuner:
+    def test_capture_to_promoted_version(self, tmp_path):
+        cap = _fill_capture(TrafficCapture(), n=8)
+        path = cap.save(str(tmp_path / "traffic.jsonl"))
+        adm = AdmissionController(default_budget=8)
+        with _Fleet(n=2) as f:
+            ft = FleetFineTuner(
+                f.router, "m", path, _tiny_net,
+                str(tmp_path / "ckpt"), admission=adm, epochs=2,
+                batch_size=4,
+                spec_extra={"example_shape": [3]},
+                rollout_kw={"fraction": 1.0, "min_samples": 4,
+                            "p99_ratio": 100.0},
+                everyNIterations=1)
+            ctl = ft.run()
+            assert ft.state == "complete"
+            assert ft.checkpoint and os.path.exists(ft.checkpoint)
+            assert ft.published_version == 2
+            # the canary judges the fine-tuned model; agreement is
+            # relaxed (min_agreement defaults to 0.0 on this path), so
+            # the verdict rides errors/latency and must promote
+            _drive_until(f, ctl, timeout=30.0)
+            assert ctl.state == "complete", ctl.describe()
+            # every worker now serves the checkpoint build as v2
+            status, _, body = f.predict([[1.0, 2.0, 3.0]])
+            assert status == 200
+            assert json.loads(body)["version"] == 2
+        # the job's train tickets are all released
+        assert adm.describe()["m"]["standing"] == 0
+        kinds = [e["kind"] for e in flight.get_recorder().events()]
+        for k in ("finetune_start", "finetune_publish",
+                  "finetune_complete"):
+            assert k in kinds
+        done = _events("finetune_complete")[-1]
+        assert done["outcome"] == "ok" and done["version"] == 2
+
+    def test_empty_capture_fails_cleanly(self, tmp_path):
+        cap = TrafficCapture()
+        path = cap.save(str(tmp_path / "empty.jsonl"))
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        ft = FleetFineTuner(router, "m", path, _tiny_net,
+                            str(tmp_path / "ckpt"))
+        with pytest.raises(ValueError):
+            ft.run()
+        assert ft.state == "failed" and "no examples" in ft.error
+        assert _events("finetune_complete")[-1]["outcome"] == "failed"
+
+
+# ---------------------------------------------------------------------------
+# respawner
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.returncode = rc
+        self.pid = 4242
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = self.returncode = -9
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _respawnable(tmp_path, name="w0"):
+    return WorkerHandle(
+        name, "http://127.0.0.1:1", proc=_FakeProc(rc=1),
+        spawn={"cmd": ["true"], "env": {},
+               "port_file": str(tmp_path / f"{name}.port")})
+
+
+class TestRespawner:
+    def test_respawn_success_updates_handle(self, tmp_path):
+        w = _respawnable(tmp_path)
+        router = FleetRouter([w])
+        port_file = w.spawn["port_file"]
+
+        def popen(cmd, env):
+            with open(port_file, "w") as f:
+                f.write("5123")
+            return _FakeProc(rc=None)   # alive
+
+        clock = _Clock()
+        rs = Respawner(router, max_respawns=3, spawn_timeout=2.0,
+                       clock=clock, popen=popen)
+        assert rs.tick() == [("w0", "ok")]
+        assert w.url == "http://127.0.0.1:5123"
+        assert w.proc.poll() is None
+        # an alive worker is not touched on subsequent ticks
+        clock.t += 100.0
+        assert rs.tick() == []
+        assert _events("worker_respawn")[-1]["outcome"] == "ok"
+
+    def test_gives_up_after_budget(self, tmp_path):
+        from deeplearning4j_tpu.resilience.supervisor import (
+            SupervisorConfig)
+
+        w = _respawnable(tmp_path)
+        router = FleetRouter([w])
+        clock = _Clock()
+        rs = Respawner(
+            router, max_respawns=2, spawn_timeout=0.2, clock=clock,
+            popen=lambda cmd, env: _FakeProc(rc=7),   # dies instantly
+            config=SupervisorConfig(backoff_base=1.0,
+                                    backoff_factor=2.0))
+        outcomes = []
+        for _ in range(10):
+            outcomes += rs.tick()
+            clock.t += 0.4
+        # backoff gates the attempts: after attempt 1 the next try
+        # waits backoff(1)=1.0s of injected clock, then backoff(2)=2.0
+        assert outcomes == [("w0", "failed"), ("w0", "failed"),
+                            ("w0", "gave_up")]
+        st = rs.describe()["workers"]["w0"]
+        assert st["gave_up"] and st["attempts"] == 2
+        # terminal: no further attempts however long we wait
+        clock.t += 1000.0
+        assert rs.tick() == []
+        evs = _events("worker_respawn")
+        assert [e["outcome"] for e in evs[-3:]] == \
+            ["failed", "failed", "gave_up"]
+
+    def test_adopted_workers_skipped(self):
+        # no proc / no spawn record -> nothing to respawn
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        assert Respawner(router).tick() == []
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+def _scaler(router, load, **kw):
+    state = {"v": load}
+    clock = _Clock()
+    kw.setdefault("sustain_ticks", 2)
+    kw.setdefault("cooldown", 5.0)
+    sc = Autoscaler(
+        router, _spec(), "k", worker_rps=4.0, min_workers=1,
+        max_workers=3, load_fn=lambda: state["v"],
+        spawn_fn=lambda spec, name: WorkerHandle(
+            name, "http://127.0.0.1:1"), clock=clock, **kw)
+    return sc, clock, state
+
+
+class TestAutoscaler:
+    def test_flapping_load_no_flapping_workers(self):
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        sc, clock, state = _scaler(router, 12.0)
+        # alternates 12 rps (wants 3 workers) and 0 (wants 1) — the
+        # sustain requirement is never met, so nothing ever happens
+        for i in range(20):
+            state["v"] = 12.0 if i % 2 == 0 else 0.0
+            assert sc.tick() is None
+            clock.t += 1.0
+        assert len(router.workers) == 1
+
+    def test_sustained_load_scales_up_then_idle_scales_down(self):
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        sc, clock, state = _scaler(router, 9.0)   # wants ceil(9/4) = 3
+        assert sc.tick() is None             # sustain 1/2
+        assert sc.tick() == "scale_up"       # acts, one per action
+        assert [w.name for w in router.workers] == ["w0", "auto0"]
+        # cooldown: no second action until the clock passes it
+        assert sc.tick() is None and sc.tick() is None
+        clock.t += 6.0
+        assert sc.tick() is None             # re-sustain after cooldown
+        assert sc.tick() == "scale_up"
+        assert len(router.workers) == 3
+        assert sc.last_desired == 3
+        # idle: back down, retiring the autoscaler's own workers first,
+        # never below min_workers
+        state["v"] = 0.0
+        clock.t += 6.0
+        decisions = []
+        for _ in range(12):
+            d = sc.tick()
+            if d:
+                decisions.append(d)
+                clock.t += 6.0
+        assert decisions == ["scale_down", "scale_down"]
+        assert [w.name for w in router.workers] == ["w0"]
+        for _ in range(4):
+            assert sc.tick() is None         # floor holds
+        evs = _events("autoscale")
+        assert [e["decision"] for e in evs[-4:]] == \
+            ["scale_up", "scale_up", "scale_down", "scale_down"]
+        kinds = [e["kind"] for e in flight.get_recorder().events()]
+        assert "worker_added" in kinds and "worker_retired" in kinds
+
+    def test_capacity_planner_blocks_spawn(self, monkeypatch):
+        from deeplearning4j_tpu.telemetry import memledger
+
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        sc, clock, state = _scaler(router, 9.0, need_bytes=1 << 40)
+
+        def deny(site, need_bytes, detail=None, **kw):
+            raise CapacityError(f"{site}: no headroom for {need_bytes}")
+
+        monkeypatch.setattr(memledger, "plan_capacity", deny)
+        assert sc.tick() is None
+        assert sc.tick() == "blocked"
+        assert len(router.workers) == 1      # never spawned
+        assert _events("autoscale")[-1]["decision"] == "blocked"
+        # the demand is still pending: once capacity appears the next
+        # tick acts without re-sustaining from zero
+        monkeypatch.setattr(memledger, "plan_capacity",
+                            lambda *a, **kw: None)
+        assert sc.tick() == "scale_up"
+        assert len(router.workers) == 2
+
+    def test_desired_clamps_to_bounds(self):
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        sc, _, _ = _scaler(router, 0.0)
+        assert sc.desired(1e9) == 3 and sc.desired(0.0) == 1
+
+
+# ---------------------------------------------------------------------------
+# capture append + rotation
+# ---------------------------------------------------------------------------
+
+class TestCaptureAppendRotation:
+    def test_append_commits_only_new_records(self, tmp_path):
+        cap = TrafficCapture()
+        _fill_capture(cap, n=3, seed=1)
+        path = str(tmp_path / "c.jsonl")
+        cap.save(path, append=True)
+        assert len(load_capture(path)) == 3
+        _fill_capture(cap, n=2, seed=2)
+        cap.save(path, append=True)
+        recs = load_capture(path)
+        assert [r["seq"] for r in recs] == [1, 2, 3, 4, 5]
+        # idempotent: appending with nothing new changes no bytes
+        with open(path, "rb") as f:
+            before = f.read()
+        cap.save(path, append=True)
+        with open(path, "rb") as f:
+            assert f.read() == before
+
+    def test_rotation_and_bit_identical_replay(self, tmp_path):
+        cap = TrafficCapture()
+        path = str(tmp_path / "c.jsonl")
+        # force rotations: max_bytes smaller than two appends' records
+        for seed in range(4):
+            _fill_capture(cap, n=2, seed=seed)
+            cap.save(path, append=True, max_bytes=400)
+        files = capture_files(path)
+        assert len(files) > 1
+        assert files[-1] == path           # base file is newest
+        # the rotated set reads oldest-first: seq strictly increasing
+        seqs = [r["seq"] for r in load_capture(path)]
+        assert seqs == sorted(seqs) and len(seqs) == 8
+        # replay of the rotated set is bit-identical to an unrotated
+        # save of the same ring
+        flat = str(tmp_path / "flat.jsonl")
+        cap.save(flat)                     # full ring, one file
+        a = [ds.features for ds in CaptureReplayIterator(path)]
+        b = [ds.features for ds in CaptureReplayIterator(flat)]
+        assert len(a) == len(b) > 0
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_rotated_files_survive_multiple_sweeps(self, tmp_path):
+        cap = TrafficCapture()
+        path = str(tmp_path / "c.jsonl")
+        for seed in range(6):
+            _fill_capture(cap, n=2, seed=10 + seed)
+            cap.save(path, append=True, max_bytes=200)
+        # every record is still present exactly once across the set
+        assert [r["seq"] for r in load_capture(path)] == \
+            list(range(1, 13))
+
+
+# ---------------------------------------------------------------------------
+# decode-path rollouts
+# ---------------------------------------------------------------------------
+
+def _dspec(seed=0, name="d", version=1):
+    return {"name": name, "version": version, "kind": "decoder",
+            "seed": seed, "vocab": 16, "hidden": 8, "n_layers": 1,
+            "n_heads": 2, "max_len": 32, "max_slots": 2, "page": 4,
+            "max_pages_per_slot": 8}
+
+
+def _decode(fleet, prompt=(1, 2, 3), n=4, model="d"):
+    body = json.dumps({"prompt": list(prompt),
+                       "max_new_tokens": n}).encode()
+    return _http(f"{fleet.url}/serving/v1/models/{model}:decode",
+                 body=body, timeout=30.0)
+
+
+def _drive_decode_until(fleet, ctl, timeout=60.0):
+    # like _drive_until: no per-request status assert — a router poll
+    # can transiently mark a worker not-ready under suite load (503),
+    # and the rollout verdict below is the oracle
+    deadline = time.monotonic() + timeout
+    while not ctl.terminal() and time.monotonic() < deadline:
+        _decode(fleet)
+        time.sleep(0.005)
+    assert ctl.terminal(), \
+        f"decode rollout stuck in {ctl.state}: {ctl.describe()}"
+
+
+class TestDecodeRollout:
+    def test_agreeing_decode_canary_promotes(self):
+        with _Fleet(n=2, specs=[_spec(), _dspec()]) as f:
+            status, rh, body = _decode(f)
+            assert status == 200
+            baseline = json.loads(body)["tokens"]
+            # the worker reports TTFT; the router passes the header on
+            st = {k.lower(): v for k, v in rh.items()}
+            assert "ttft" in st.get("server-timing", "")
+            ctl = f.router.start_rollout(
+                "d", _dspec(seed=0), version=2, fraction=1.0,
+                min_samples=3, p99_ratio=100.0, push_timeout=120.0)
+            assert ctl.kind == "decode"
+            assert ctl.mirror_name == "d@v2"
+            # while canarying, the alias engine exists on the canary
+            canary = next(w for w in f.workers
+                          if w.handle.name == ctl.canary.name)
+            assert "d@v2" in canary.session._decoders
+            _drive_decode_until(f, ctl)
+            assert ctl.state == "complete", ctl.describe()
+            s = ctl.describe()
+            assert s["agreement"] == 1.0 and s["errors"] == 0
+            # promotion replaced the bare name everywhere and dropped
+            # the judging alias
+            for w in f.workers:
+                assert "d" in w.session._decoders
+                assert "d@v2" not in w.session._decoders
+            status, _, body = _decode(f)
+            assert status == 200
+            assert json.loads(body)["tokens"] == baseline
+
+    def test_diverging_decode_canary_rolls_back(self):
+        with _Fleet(n=2, specs=[_spec(), _dspec()]) as f:
+            engines = [w.session._decoders["d"] for w in f.workers]
+            ctl = f.router.start_rollout(
+                "d", _dspec(seed=99), version=2, fraction=1.0,
+                min_samples=3, p99_ratio=100.0, push_timeout=120.0)
+            _drive_decode_until(f, ctl)
+            assert ctl.state == "rolled_back", ctl.describe()
+            assert "agreement" in ctl.decision["reason"]
+            # rollback retracted ONLY the alias: the incumbent engines
+            # were never touched
+            for w, engine in zip(f.workers, engines):
+                assert w.session._decoders["d"] is engine
+                assert "d@v2" not in w.session._decoders
+
+    def test_decode_rollout_does_not_pin_predict(self):
+        with _Fleet(n=2, specs=[_spec(), _dspec()]) as f:
+            ctl = f.router.start_rollout(
+                "d", _dspec(seed=0), version=2, fraction=1.0,
+                min_samples=10_000, p99_ratio=100.0,
+                push_timeout=120.0)
+            try:
+                assert not ctl.pins("d") and not ctl.pins("m")
+                # predict traffic flows un-pinned during a decode canary
+                status, _, body = f.predict([[1.0, 2.0, 3.0]])
+                assert status == 200
+                assert json.loads(body)["version"] == 1
+            finally:
+                ctl._rollback("test over", ctl._stats())
+
+
+# ---------------------------------------------------------------------------
+# autopilot control loop
+# ---------------------------------------------------------------------------
+
+class TestAutopilot:
+    def test_tick_survives_controller_errors_and_describe(self):
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+
+        class Boom:
+            def tick(self):
+                raise RuntimeError("boom")
+
+            def describe(self):
+                return {"boom": True}
+
+        ap = Autopilot(router, respawner=Boom(), interval=0.01)
+        ap.tick()   # must not raise
+        assert ap.ticks == 1
+        assert ap.describe()["respawner"] == {"boom": True}
+
+    def test_thread_attaches_to_router_and_stops(self):
+        router = FleetRouter([WorkerHandle("w0", "http://127.0.0.1:1")])
+        rs = Respawner(router)
+        with Autopilot(router, respawner=rs, interval=0.01) as ap:
+            ap.start()
+            assert router.autopilot is ap
+            deadline = time.monotonic() + 5.0
+            while ap.ticks == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ap.ticks > 0
+            assert "respawner" in router.describe()["autopilot"]
+        assert not ap._thread.is_alive()
+        assert _events("autopilot_start")
+
+
+# ---------------------------------------------------------------------------
+# the closed loop, end to end (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestClosedLoop:
+    def test_capture_finetune_publish_respawn_autoscale(self, tmp_path):
+        import signal as _signal
+
+        from deeplearning4j_tpu.fleet.router import spawn_local_workers
+
+        mlp = {"name": "m", "version": 1, "kind": "mlp", "n_in": 3,
+               "n_out": 2, "width": 8, "seed": 7,
+               "example_shape": [3], "ladder": [1, 4]}
+        spec = {"models": [mlp]}
+        handles = spawn_local_workers(
+            2, spec, base_dir=str(tmp_path / "fleet"), timeout=120.0,
+            extra_env=CPU_ENV)
+        cap = TrafficCapture(sample_interval=1, max_records=256)
+        router = FleetRouter(handles, poll_interval=0.1, capture=cap,
+                             owns_workers=True,
+                             retry_budget=4).start(port=0)
+        url = f"http://127.0.0.1:{router.port}"
+        rng = np.random.default_rng(5)
+        stats = {"sent": 0, "ok": 0, "lat": []}
+
+        def predict_once():
+            x = rng.normal(size=(2, 3)).astype(np.float32)
+            t0 = time.perf_counter()
+            status, _, rb = _http(
+                f"{url}/serving/v1/models/m:predict",
+                body=json.dumps({"instances": x.tolist()}).encode(),
+                timeout=30.0)
+            stats["sent"] += 1
+            stats["ok"] += status == 200
+            if status != 200:
+                stats.setdefault("bad", []).append((status, rb[:300]))
+            stats["lat"].append(time.perf_counter() - t0)
+            return status
+
+        try:
+            # ---- phase 1: serve + capture --------------------------
+            for _ in range(30):
+                assert predict_once() == 200
+            path = cap.save(str(tmp_path / "traffic.jsonl"),
+                            append=True)
+            assert len(load_capture(path)) >= 30
+
+            # ---- phase 2: fine-tune at train priority while serving
+            # continues; serving p99 stays bounded -------------------
+            adm = AdmissionController(default_budget=8)
+            ft = FleetFineTuner(
+                router, "m", path, lambda: _tiny_net(seed=7),
+                str(tmp_path / "ckpt"), admission=adm, epochs=2,
+                batch_size=8, spec_extra={"example_shape": [3]},
+                rollout_kw={"fraction": 1.0, "min_samples": 5,
+                            "p99_ratio": 100.0, "push_timeout": 120.0},
+                everyNIterations=1).start()
+            base_lat = list(stats["lat"])
+            while ft._thread.is_alive():
+                predict_once()
+                time.sleep(0.005)
+            ft.join(30.0)
+            during = stats["lat"][len(base_lat):]
+            assert ft.state == "complete", ft.describe()
+            # serving kept answering during the concurrent fit, and
+            # its p99 stayed within a generous bound of the unloaded
+            # baseline (CPU box; this catches seconds-long stalls, not
+            # microseconds of jitter)
+            assert during, "no serving traffic during fine-tune"
+            p99 = float(np.quantile(during, 0.99))
+            base = max(float(np.quantile(base_lat, 0.99)), 0.005)
+            assert p99 < 50 * base, (p99, base)
+            assert adm.describe()["m"]["standing"] == 0
+
+            # ---- phase 3: the published canary promotes ------------
+            ctl = router.rollout
+            assert ctl is not None and ctl.version == 2
+            deadline = time.monotonic() + 120.0
+            while not ctl.terminal() and time.monotonic() < deadline:
+                predict_once()
+                time.sleep(0.005)
+            assert ctl.state == "complete", ctl.describe()
+            status, _, body = _http(
+                f"{url}/serving/v1/models/m:predict",
+                body=json.dumps(
+                    {"instances": [[0.1, 0.2, 0.3]]}).encode(),
+                timeout=30.0)
+            assert status == 200 and json.loads(body)["version"] == 2
+
+            # ---- phase 4: SIGKILL a worker; the autopilot respawns
+            # it with zero client-visible errors ---------------------
+            rs = Respawner(router, max_respawns=3, spawn_timeout=120.0)
+            ap = Autopilot(router, respawner=rs, interval=0.1).start()
+            # steady state first: the promote just pushed v2 to the
+            # non-canary worker, which reports warming until its ladder
+            # compiles — kill only once BOTH workers are routable, or
+            # the fleet legitimately has zero capacity for a moment
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                _, _, hb = _http(url + "/healthz", timeout=10.0)
+                if json.loads(hb)["fleet"]["routable"] == 2:
+                    break
+                time.sleep(0.05)
+            victim = router.workers[0]
+            # the flight ring is process-global: earlier tests in this
+            # process (TestRespawner's fakes, also named w0) may have
+            # left worker_respawn events — count only events after the
+            # kill
+            seen = len(_events("worker_respawn"))
+
+            def _respawned():
+                return any(e["outcome"] == "ok"
+                           for e in _events("worker_respawn")[seen:])
+
+            os.kill(victim.proc.pid, _signal.SIGKILL)
+            ok_before, sent_before = stats["ok"], stats["sent"]
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                predict_once()
+                if _respawned() and victim.up:
+                    break
+                time.sleep(0.01)
+            assert _respawned()
+            # retries absorbed the death: zero failed requests
+            assert stats["ok"] - ok_before == \
+                stats["sent"] - sent_before, stats.get("bad")
+            # the respawned worker rejoined routing
+            deadline = time.monotonic() + 60.0
+            while not victim.up and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert victim.up and victim.proc.poll() is None
+
+            # ---- phase 5: sustained overload scales up; idle scales
+            # down — driven deterministically via tick() -------------
+            clock = _Clock()
+            load = {"v": 9.0}   # 2x what two workers handle at 4 rps
+            sc = Autoscaler(
+                router, spec, "k", worker_rps=4.0, min_workers=2,
+                max_workers=3, sustain_ticks=2, cooldown=1.0,
+                load_fn=lambda: load["v"],
+                spawn_fn=lambda s, name: spawn_local_workers(
+                    1, s, base_dir=str(tmp_path / "auto"),
+                    timeout=120.0, extra_env=CPU_ENV,
+                    name_prefix="auto",
+                    start_index=int(name[4:]))[0],
+                clock=clock)
+            ap.autoscaler = sc
+            decisions = []
+            for _ in range(6):
+                d = sc.tick()
+                if d:
+                    decisions.append(d)
+                    clock.t += 2.0
+            assert decisions == ["scale_up"]
+            assert len(router.workers) == 3
+            # the new worker serves traffic too
+            for _ in range(10):
+                assert predict_once() == 200
+            load["v"] = 0.0
+            clock.t += 2.0
+            for _ in range(8):
+                d = sc.tick()
+                if d:
+                    decisions.append(d)
+                    clock.t += 2.0
+            assert decisions == ["scale_up", "scale_down"]
+            assert len(router.workers) == 2
+
+            # ---- every transition observable -----------------------
+            events = {e["kind"]
+                      for e in flight.get_recorder().events()}
+            for k in ("finetune_start", "finetune_publish",
+                      "finetune_complete", "rollout_start",
+                      "rollout_complete", "worker_respawn",
+                      "worker_added", "worker_retired", "autoscale",
+                      "autopilot_start"):
+                assert k in events, f"missing flight event {k}"
+            desc = router.describe()
+            assert "autopilot" in desc
+            assert desc["autopilot"]["respawner"]["workers"]
+            ap.close()
+        finally:
+            router.close()

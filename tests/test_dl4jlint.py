@@ -857,6 +857,139 @@ class TestFleetRouterFixtures:
         assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
 
 
+class TestAutopilotFixtures:
+    """ISSUE 20 satellites: TP/near-miss pairs for the autopilot
+    control thread (thread-hygiene, ``dl4j:fleet:*`` naming), the
+    respawn/target-workers telemetry emitters (telemetry-gate), and
+    the fine-tune worker thread (collective-thread: training on a
+    thread is fine — reaching a collective from one is the defect)."""
+
+    def test_flags_unhygienic_autopilot_thread(self, tmp_path):
+        # the incident shape: a control-loop thread without daemon=
+        # outlives a crashed test, unjoined it races close(), and
+        # unnamed it shows up in flamegraphs as Thread-N
+        src = """
+            import threading
+
+            class Autopilot:
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def _loop(self):
+                    pass
+        """
+        hits = rules_of(lint(tmp_path, src), "thread-hygiene")
+        msgs = "\n".join(h.message for h in hits)
+        assert "daemon" in msgs and "never .join()ed" in msgs
+        assert "unnamed package thread" in msgs
+        assert len(hits) == 3
+
+    def test_near_miss_autopilot_idiom_clean(self, tmp_path):
+        # the shape fleet/autopilot.py actually uses: explicit
+        # daemon=, a dl4j:fleet:* name, a stop event, join in close()
+        clean = """
+            import threading
+
+            class Autopilot:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._thread = threading.Thread(
+                        target=self._loop, daemon=True,
+                        name="dl4j:fleet:autopilot")
+                    self._thread.start()
+
+                def _loop(self):
+                    while not self._stop.wait(0.5):
+                        pass
+
+                def close(self):
+                    self._stop.set()
+                    self._thread.join(timeout=5.0)
+        """
+        assert rules_of(lint(tmp_path, clean), "thread-hygiene") == []
+
+    def test_flags_ungated_respawn_emission(self, tmp_path):
+        # a raw counter bump on the respawn path with no gate breaks
+        # zero-calls-when-disabled (PR 1, extended to the autopilot
+        # emitters in ISSUE 20)
+        src = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_respawn(worker, outcome):
+                telemetry.get_registry().counter(
+                    "dl4j_fleet_respawns_total", "h",
+                    ("worker", "outcome")).labels(
+                    worker=worker, outcome=outcome).inc()
+        """
+        assert len(rules_of(lint(tmp_path, src), "telemetry-gate")) == 1
+
+    def test_near_miss_bundle_gated_respawn_emission(self, tmp_path):
+        # the idiom autopilot.py uses: fleet_instruments() returns
+        # None while telemetry is disabled, so the bundle IS the gate
+        # for both the respawn counter and the target-workers gauge
+        clean = """
+            from deeplearning4j_tpu import telemetry
+
+            def note_respawn(worker, outcome, target):
+                inst = telemetry.fleet_instruments()
+                if inst is None:
+                    return
+                inst.respawn(worker, outcome)
+                telemetry.get_registry().gauge(
+                    "dl4j_fleet_target_workers", "h",
+                    ()).labels().set(float(target))
+        """
+        assert rules_of(lint(tmp_path, clean), "telemetry-gate") == []
+
+    def test_flags_finetune_thread_reaching_collective(self, tmp_path):
+        # a fine-tune thread whose train step reaches a collective
+        # deadlocks against the main thread's own psum partners — the
+        # exact hazard the rule exists for, one call deep
+        src = """
+            import threading
+            import jax
+
+            def train_step(grads):
+                return jax.lax.pmean(grads, "data")
+
+            def fine_tune():
+                return train_step(1.0)
+
+            def start():
+                t = threading.Thread(target=fine_tune, daemon=True,
+                                     name="dl4j:fleet:finetune-m")
+                t.start()
+                t.join()
+        """
+        hits = rules_of(lint(tmp_path, src), "collective-thread")
+        assert len(hits) == 1
+        assert "fine_tune" in hits[0].message
+
+    def test_near_miss_finetune_plain_fit_clean(self, tmp_path):
+        # FleetFineTuner's actual shape: the worker thread drives a
+        # single-replica fit (plain jit, no collectives) — training
+        # off-thread is not the defect
+        clean = """
+            import threading
+            import jax
+
+            def train_step(x):
+                return jax.jit(lambda v: v * 2.0)(x)
+
+            def fine_tune():
+                return train_step(1.0)
+
+            def start():
+                t = threading.Thread(target=fine_tune, daemon=True,
+                                     name="dl4j:fleet:finetune-m")
+                t.start()
+                t.join()
+        """
+        assert rules_of(lint(tmp_path, clean),
+                        "collective-thread") == []
+
+
 class TestProfilerFixtures:
     """ISSUE 18 satellites: TP/near-miss pairs for the unnamed-thread
     half of thread-hygiene, the ``get_profiler`` telemetry-gate
